@@ -1,0 +1,37 @@
+"""gofr_tpu — a TPU-native microservice framework.
+
+Built from scratch with the capabilities of GoFr (reference surveyed in
+SURVEY.md): one ``App`` object yields an HTTP server, a gRPC server, or a
+CLI app sharing a single transport-agnostic handler signature
+``handler(ctx) -> result``; an injected container provides env-file config,
+leveled structured logging, tracing, Redis and SQL datasources, inter-service
+HTTP clients, and health checks.
+
+On top of that GoFr-equivalent core, TPU is a first-class inference
+datasource: ``gofr_tpu.tpu`` compiles JAX/pjit models (Pallas kernels for the
+hot ops), handlers enqueue dynamically batched forward passes via
+``ctx.tpu``, metrics export device utilization, and the health probe checks
+device liveness.
+
+Parity map: /root/reference/pkg/gofr (see SURVEY.md §2 for the full
+component inventory this package mirrors).
+"""
+
+from gofr_tpu.version import __version__
+
+__all__ = ["App", "Context", "new", "new_cmd", "__version__"]
+
+
+def __getattr__(name):  # PEP 562: lazy so leaf modules import without transports
+    try:
+        if name in ("App", "new", "new_cmd"):
+            from gofr_tpu import app
+
+            return getattr(app, name)
+        if name == "Context":
+            from gofr_tpu.context import Context
+
+            return Context
+    except ImportError as exc:
+        raise AttributeError(f"gofr_tpu.{name} unavailable: {exc}") from exc
+    raise AttributeError(f"module 'gofr_tpu' has no attribute {name!r}")

@@ -1,0 +1,92 @@
+"""12-factor configuration: ``./configs/.env`` file loaded into the process
+environment, reads always backed by live env vars.
+
+Parity: /root/reference/pkg/gofr/config/config.go:3-6 (the two-method Config
+interface) and config/godotenv.go:9-33 (.env load then ``os.Getenv``).
+Semantics preserved: the .env file never overrides variables already present
+in the environment, and lookups hit the live environment so tests can inject
+values with ``monkeypatch.setenv``.
+
+TPU-native keys added on top of the reference set (SURVEY.md §2 #22):
+``TPU_ENABLED``, ``TPU_TOPOLOGY``, ``MODEL_NAME``, ``MODEL_PATH``,
+``BATCH_MAX_SIZE``, ``BATCH_TIMEOUT_MS``, ``METRICS_ENABLED``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Protocol
+
+
+class Config(Protocol):
+    """Two-method config surface every component depends on."""
+
+    def get(self, key: str) -> Optional[str]: ...
+
+    def get_or_default(self, key: str, default: str) -> str: ...
+
+
+def parse_env_file(path: str) -> dict[str, str]:
+    """Parse a dotenv file: KEY=VALUE lines, ``#`` comments, optional
+    single/double quotes, ``export`` prefix tolerated."""
+    out: dict[str, str] = {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return out
+    for raw in lines:
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("export "):
+            line = line[len("export "):].lstrip()
+        if "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        key = key.strip()
+        value = value.strip()
+        if not key:
+            continue
+        if value[:1] in ("'", '"'):
+            quote = value[0]
+            closing = value.find(quote, 1)
+            if closing != -1:
+                value = value[1:closing]  # anything after the close quote is comment/junk
+            else:
+                value = value[1:]
+        elif " #" in value:
+            # strip trailing inline comment on unquoted values
+            value = value.split(" #", 1)[0].rstrip()
+        out[key] = value
+    return out
+
+
+class EnvConfig:
+    """Config backed directly by the process environment."""
+
+    def get(self, key: str) -> Optional[str]:
+        return os.environ.get(key)
+
+    def get_or_default(self, key: str, default: str) -> str:
+        value = os.environ.get(key)
+        return value if value not in (None, "") else default
+
+
+class EnvFileConfig(EnvConfig):
+    """Loads ``<configs_dir>/.env`` into the environment (non-overriding),
+    then behaves like :class:`EnvConfig`.
+
+    Parity: config/godotenv.go:18-33 — missing file is not an error; the app
+    simply runs on ambient environment variables.
+    """
+
+    def __init__(self, configs_dir: str = "./configs") -> None:
+        self.configs_dir = configs_dir
+        env_path = os.path.join(configs_dir, ".env")
+        for key, value in parse_env_file(env_path).items():
+            os.environ.setdefault(key, value)
+
+
+def new_env_file(configs_dir: str = "./configs") -> EnvFileConfig:
+    return EnvFileConfig(configs_dir)

@@ -1,0 +1,12 @@
+"""Datasource layer: health model, decoupled logger protocol, and the
+concrete datasources (SQL, Redis, TPU).
+
+Parity: /root/reference/pkg/gofr/datasource/ — notably the layering rule that
+datasources define their own minimal logger protocol instead of importing the
+logging package (datasource/logger.go:9-16).
+"""
+
+from gofr_tpu.datasource.health import DOWN, UP, Health
+from gofr_tpu.datasource.logger import DatasourceLogger
+
+__all__ = ["Health", "UP", "DOWN", "DatasourceLogger"]

@@ -1,0 +1,27 @@
+"""Minimal logger protocol consumed by datasources.
+
+Parity: /root/reference/pkg/gofr/datasource/logger.go:9-16 — datasources
+depend on this tiny protocol, not on ``gofr_tpu.logging``, so the logging
+package stays free to pretty-print datasource log types without an import
+cycle (the consumer-defined-interface rule called out in SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+
+class DatasourceLogger(Protocol):
+    def debug(self, *args: Any) -> None: ...
+
+    def debugf(self, fmt: str, *args: Any) -> None: ...
+
+    def info(self, *args: Any) -> None: ...
+
+    def infof(self, fmt: str, *args: Any) -> None: ...
+
+    def warn(self, *args: Any) -> None: ...
+
+    def error(self, *args: Any) -> None: ...
+
+    def errorf(self, fmt: str, *args: Any) -> None: ...

@@ -1,0 +1,109 @@
+"""Framework error types with HTTP status mapping.
+
+Parity: /root/reference/pkg/gofr/http/responder.go:43-57 — the responder
+derives the HTTP status from the error value a handler returns. In Python the
+handler *raises*; any exception carrying ``status_code`` maps to that status,
+everything else is a 500 (matching the reference default).
+"""
+
+from __future__ import annotations
+
+
+class GofrError(Exception):
+    """Base error; subclasses set ``status_code``."""
+
+    status_code: int = 500
+
+    def __init__(self, message: str = ""):
+        super().__init__(message)
+        self.message = message or self.__class__.__name__
+
+    def __str__(self) -> str:  # envelope message text
+        return self.message
+
+
+class InvalidParamError(GofrError):
+    """Bad/missing request parameter -> 400."""
+
+    status_code = 400
+
+    def __init__(self, *params: str):
+        self.params = list(params)
+        n = len(self.params)
+        noun = "parameter" if n == 1 else "parameters"
+        super().__init__(f"'{n}' invalid {noun} {', '.join(self.params)}")
+
+
+class MissingParamError(GofrError):
+    status_code = 400
+
+    def __init__(self, *params: str):
+        self.params = list(params)
+        n = len(self.params)
+        noun = "parameter" if n == 1 else "parameters"
+        super().__init__(f"'{n}' missing {noun} {', '.join(self.params)}")
+
+
+class EntityNotFoundError(GofrError):
+    """Row/key not found -> 404."""
+
+    status_code = 404
+
+    def __init__(self, name: str = "entity", value: str = ""):
+        super().__init__(f"No '{name}' found for value '{value}'")
+
+
+class RouteNotFoundError(GofrError):
+    status_code = 404
+
+    def __init__(self) -> None:
+        super().__init__("route not registered")
+
+
+class UnauthenticatedError(GofrError):
+    status_code = 401
+
+    def __init__(self, message: str = "authentication required"):
+        super().__init__(message)
+
+
+class ForbiddenError(GofrError):
+    status_code = 403
+
+    def __init__(self, message: str = "forbidden"):
+        super().__init__(message)
+
+
+class RequestTimeoutError(GofrError):
+    status_code = 408
+
+    def __init__(self, message: str = "request timed out"):
+        super().__init__(message)
+
+
+class TooManyRequestsError(GofrError):
+    """Batch queue overflow / admission control -> 429 (TPU-native addition:
+    the batching layer sheds load instead of growing the queue unboundedly)."""
+
+    status_code = 429
+
+    def __init__(self, message: str = "server overloaded"):
+        super().__init__(message)
+
+
+class HTTPError(GofrError):
+    """Arbitrary status escape hatch."""
+
+    def __init__(self, status_code: int, message: str):
+        self.status_code = status_code
+        super().__init__(message)
+
+
+def status_from_error(err: BaseException | None) -> int:
+    """Parity: http/responder.go:43-57 — unknown errors are 500."""
+    if err is None:
+        return 200
+    code = getattr(err, "status_code", None)
+    if isinstance(code, int) and 100 <= code <= 599:
+        return code
+    return 500

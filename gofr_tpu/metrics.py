@@ -1,0 +1,215 @@
+"""Metrics: counters, gauges, histograms with Prometheus text exposition.
+
+The reference has **no metrics subsystem** (SURVEY.md §5 — a redisotel
+metrics call is commented out at datasource/redis/redis.go:52-55). Metrics
+are a required TPU-native addition (BASELINE.json north star: export request
+rates, TTFT histograms, device utilization). Implemented from scratch —
+thread-safe registry, labeled series, and the Prometheus text format served
+at ``/metrics`` by the HTTP server.
+
+Default framework metrics (registered by the container):
+- ``gofr_http_requests_total{method,path,status}``
+- ``gofr_http_request_duration_seconds`` (histogram)
+- ``gofr_tpu_requests_total{model,status}`` / ``gofr_tpu_ttft_seconds``
+- ``gofr_tpu_batch_size`` / ``gofr_tpu_queue_depth`` (gauges)
+- ``gofr_tpu_device_memory_bytes{kind}``
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Iterable, Optional, Sequence
+
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{_escape_label(str(v))}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: dict[str, str]) -> tuple[str, ...]:
+        return tuple(str(labels.get(n, "")) for n in self.label_names)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        super().__init__(name, help_, label_names)
+        self._values: dict[tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        with self._lock:
+            items = list(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, val in items:
+            yield f"{self.name}{_fmt_labels(self.label_names, key)} {_fmt_value(val)}"
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+        self._totals: dict[tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def percentile(self, q: float, **labels: str) -> float:
+        """Approximate percentile from bucket counts (upper bound of the
+        bucket containing the q-quantile)."""
+        key = self._key(labels)
+        with self._lock:
+            counts = list(self._counts.get(key, []))
+            total = self._totals.get(key, 0)
+        if not total:
+            return 0.0
+        rank = q * total
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= rank:
+                return self.buckets[i]
+        return self.buckets[-1]
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        with self._lock:
+            keys = list(self._totals)
+            snap = {k: (list(self._counts[k]), self._sums[k], self._totals[k]) for k in keys}
+        for key, (counts, sum_, total) in snap.items():
+            acc = 0
+            for i, b in enumerate(self.buckets):
+                acc += counts[i]
+                lab = _fmt_labels(self.label_names + ("le",), key + (_fmt_value(b),))
+                yield f"{self.name}_bucket{lab} {acc}"
+            lab = _fmt_labels(self.label_names + ("le",), key + ("+Inf",))
+            yield f"{self.name}_bucket{lab} {total}"
+            yield f"{self.name}_sum{_fmt_labels(self.label_names, key)} {repr(sum_)}"
+            yield f"{self.name}_count{_fmt_labels(self.label_names, key)} {total}"
+
+
+class Registry:
+    """Thread-safe metric registry with text exposition."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "", labels: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(name, Counter, lambda: Counter(name, help_, labels))
+
+    def gauge(self, name: str, help_: str = "", labels: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(name, Gauge, lambda: Gauge(name, help_, labels))
+
+    def histogram(
+        self,
+        name: str,
+        help_: str = "",
+        labels: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_create(
+            name, Histogram, lambda: Histogram(name, help_, labels, buckets)
+        )
+
+    def _get_or_create(self, name: str, cls: type, factory: Any) -> Any:
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = factory()
+                self._metrics[name] = metric
+            if type(metric) is not cls:
+                raise TypeError(f"metric {name} already registered as {type(metric).__name__}")
+            return metric
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+class Timer:
+    """Context manager observing elapsed seconds into a histogram."""
+
+    def __init__(self, hist: Histogram, **labels: str):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.hist.observe(time.perf_counter() - self._start, **self.labels)

@@ -1,0 +1,60 @@
+"""Test utilities: capture stdout/stderr of a function, mock logger.
+
+Parity: /root/reference/pkg/gofr/testutil/os.go:8-36 (pipe-swap capture) and
+testutil/mock_logger.go:15-75 (leveled mock logger recording output). The
+Python logger resolves ``sys.stdout``/``sys.stderr`` at call time, so a
+simple swap captures everything the real logger writes.
+"""
+
+from __future__ import annotations
+
+import io
+import sys
+import time
+from typing import Any, Callable
+
+from gofr_tpu.logging import Level, Logger
+
+
+def stdout_output_for(func: Callable[[], Any]) -> str:
+    """Run ``func`` and return everything written to stdout.
+
+    Parity: testutil/os.go:8-21.
+    """
+    old = sys.stdout
+    sys.stdout = buf = io.StringIO()
+    try:
+        func()
+    finally:
+        sys.stdout = old
+    return buf.getvalue()
+
+
+def stderr_output_for(func: Callable[[], Any]) -> str:
+    """Parity: testutil/os.go:23-36."""
+    old = sys.stderr
+    sys.stderr = buf = io.StringIO()
+    try:
+        func()
+    finally:
+        sys.stderr = old
+    return buf.getvalue()
+
+
+class MockLogger(Logger):
+    """Logger that records rendered lines in ``.lines`` (JSON mode) while
+    still honoring level filtering. Parity: testutil/mock_logger.go:15-75."""
+
+    def __init__(self, level: Level = Level.DEBUG):
+        super().__init__(level, terminal=False)
+        self.lines: list[str] = []
+
+    def _write(self, level: Level, message: Any) -> None:  # type: ignore[override]
+        self.lines.append(self._render_json(level, message, time.time()))
+
+    @property
+    def output(self) -> str:
+        return "".join(self.lines)
+
+    def contains(self, text: str) -> bool:
+        return text in self.output

@@ -1,0 +1,25 @@
+"""Test environment: force JAX onto a virtual 8-device CPU mesh BEFORE jax
+is imported anywhere, mirroring the reference CI's strategy of running
+against local fakes (SURVEY.md §4: sqlmock/miniredis ↔ CPU PJRT here).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import socket
+
+import pytest
+
+
+@pytest.fixture
+def free_port():
+    def _get():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    return _get

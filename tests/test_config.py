@@ -1,0 +1,58 @@
+"""Config tests. Parity model: reference config behavior (godotenv load,
+env-var backing, defaults)."""
+
+import os
+
+from gofr_tpu.config import EnvConfig, EnvFileConfig, parse_env_file
+
+
+def test_parse_env_file(tmp_path):
+    p = tmp_path / ".env"
+    p.write_text(
+        """
+# comment
+APP_NAME=test-app
+export HTTP_PORT=8001
+QUOTED="hello world"
+SINGLE='single'
+INLINE=value # trailing comment
+EMPTY=
+NOEQ
+""".strip()
+    )
+    env = parse_env_file(str(p))
+    assert env["APP_NAME"] == "test-app"
+    assert env["HTTP_PORT"] == "8001"
+    assert env["QUOTED"] == "hello world"
+    assert env["SINGLE"] == "single"
+    assert env["INLINE"] == "value"
+    assert env["EMPTY"] == ""
+    assert "NOEQ" not in env
+
+
+def test_env_file_does_not_override_existing(tmp_path, monkeypatch):
+    configs = tmp_path / "configs"
+    configs.mkdir()
+    (configs / ".env").write_text("KEEP_ME=from_file\nNEW_KEY=fresh\n")
+    monkeypatch.setenv("KEEP_ME", "from_env")
+    monkeypatch.delenv("NEW_KEY", raising=False)
+    cfg = EnvFileConfig(str(configs))
+    assert cfg.get("KEEP_ME") == "from_env"
+    assert cfg.get("NEW_KEY") == "fresh"
+    os.environ.pop("NEW_KEY", None)
+
+
+def test_get_or_default(monkeypatch):
+    cfg = EnvConfig()
+    monkeypatch.delenv("DOES_NOT_EXIST", raising=False)
+    assert cfg.get("DOES_NOT_EXIST") is None
+    assert cfg.get_or_default("DOES_NOT_EXIST", "8000") == "8000"
+    monkeypatch.setenv("EXISTS", "42")
+    assert cfg.get_or_default("EXISTS", "8000") == "42"
+    monkeypatch.setenv("EMPTYVAL", "")
+    assert cfg.get_or_default("EMPTYVAL", "dflt") == "dflt"
+
+
+def test_missing_env_file_is_fine(tmp_path):
+    cfg = EnvFileConfig(str(tmp_path / "nope"))
+    assert cfg.get_or_default("ANYTHING_AT_ALL", "x") == "x"

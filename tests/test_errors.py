@@ -1,0 +1,25 @@
+from gofr_tpu.errors import (
+    EntityNotFoundError,
+    GofrError,
+    HTTPError,
+    InvalidParamError,
+    MissingParamError,
+    TooManyRequestsError,
+    status_from_error,
+)
+
+
+def test_status_mapping():
+    assert status_from_error(None) == 200
+    assert status_from_error(InvalidParamError("id")) == 400
+    assert status_from_error(MissingParamError("name")) == 400
+    assert status_from_error(EntityNotFoundError("user", "7")) == 404
+    assert status_from_error(TooManyRequestsError()) == 429
+    assert status_from_error(HTTPError(418, "teapot")) == 418
+    assert status_from_error(ValueError("boom")) == 500
+    assert status_from_error(GofrError("x")) == 500
+
+
+def test_messages():
+    assert "user" in str(EntityNotFoundError("user", "7"))
+    assert "id" in str(InvalidParamError("id"))

@@ -1,0 +1,78 @@
+"""Logging tests. Parity model: reference logger tests asserting leveled
+output, sink split, and JSON structure via stdout/stderr capture."""
+
+import json
+
+from gofr_tpu.logging import Level, Logger, level_from_string, new_logger, new_silent_logger
+from gofr_tpu.testutil import MockLogger, stderr_output_for, stdout_output_for
+
+
+def test_level_from_string():
+    assert level_from_string("DEBUG") == Level.DEBUG
+    assert level_from_string("warn") == Level.WARN
+    assert level_from_string("bogus") == Level.INFO
+    assert level_from_string("") == Level.INFO
+
+
+def test_level_filtering():
+    logger = MockLogger(Level.WARN)
+    logger.debug("nope")
+    logger.info("nope")
+    logger.warn("yes-warn")
+    logger.error("yes-error")
+    assert "nope" not in logger.output
+    assert "yes-warn" in logger.output
+    assert "yes-error" in logger.output
+
+
+def test_stdout_stderr_split():
+    logger = Logger(Level.DEBUG, terminal=False)
+    out = stdout_output_for(lambda: (logger.info("to-stdout"), logger.error("to-stderr")))
+    assert "to-stdout" in out
+    assert "to-stderr" not in out
+    err = stderr_output_for(lambda: (logger.info("to-stdout"), logger.error("to-stderr")))
+    assert "to-stderr" in err
+    assert "to-stdout" not in err
+
+
+def test_json_entry_shape():
+    logger = Logger(Level.DEBUG, terminal=False)
+    out = stdout_output_for(lambda: logger.infof("hello %s", "world"))
+    entry = json.loads(out)
+    assert entry["level"] == "INFO"
+    assert entry["message"] == "hello world"
+    assert "time" in entry
+
+
+def test_typed_log_entry():
+    class FakeLog:
+        def pretty_terminal(self):
+            return "PRETTY"
+
+        def log_fields(self):
+            return {"method": "GET", "duration_us": 12}
+
+    logger = Logger(Level.DEBUG, terminal=False)
+    out = stdout_output_for(lambda: logger.info(FakeLog()))
+    entry = json.loads(out)
+    assert entry["message"] == {"method": "GET", "duration_us": 12}
+    pretty = Logger(Level.DEBUG, terminal=True)
+    out2 = stdout_output_for(lambda: pretty.info(FakeLog()))
+    assert "PRETTY" in out2
+
+
+def test_silent_logger():
+    logger = new_silent_logger()
+    out = stdout_output_for(lambda: logger.info("x"))
+    err = stderr_output_for(lambda: logger.fatal("y"))
+    assert out == "" and err == ""
+
+
+def test_variadic_join():
+    logger = MockLogger()
+    logger.info("a", 1, True)
+    assert "a 1 True" in logger.output
+
+
+def test_new_logger_from_string():
+    assert new_logger("ERROR").level == Level.ERROR

@@ -1,0 +1,58 @@
+from gofr_tpu.metrics import Counter, Gauge, Histogram, Registry, Timer
+
+
+def test_counter_and_labels():
+    reg = Registry()
+    c = reg.counter("gofr_http_requests_total", "reqs", labels=("method", "status"))
+    c.inc(method="GET", status="200")
+    c.inc(2, method="GET", status="200")
+    c.inc(method="POST", status="500")
+    assert c.value(method="GET", status="200") == 3
+    text = reg.expose()
+    assert 'gofr_http_requests_total{method="GET",status="200"} 3' in text
+    assert "# TYPE gofr_http_requests_total counter" in text
+
+
+def test_gauge():
+    g = Gauge("queue_depth", "")
+    g.set(5)
+    g.dec()
+    assert g.value() == 4
+
+
+def test_histogram_exposition_and_percentile():
+    h = Histogram("lat", "latency", buckets=(0.1, 0.5, 1.0))
+    for v in (0.05, 0.06, 0.2, 0.7, 2.0):
+        h.observe(v)
+    text = "\n".join(h.expose())
+    assert 'lat_bucket{le="0.1"} 2' in text
+    assert 'lat_bucket{le="0.5"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 5' in text
+    assert "lat_count 5" in text
+    assert h.percentile(0.5) == 0.5
+    assert h.percentile(0.99) == 1.0
+
+
+def test_registry_reuse_and_type_conflict():
+    reg = Registry()
+    a = reg.counter("x", "")
+    b = reg.counter("x", "")
+    assert a is b
+    try:
+        reg.gauge("x", "")
+        raise AssertionError("expected TypeError")
+    except TypeError:
+        pass
+
+
+def test_unlabeled_counter_exposes_zero():
+    reg = Registry()
+    reg.counter("never_incremented", "")
+    assert "never_incremented 0" in reg.expose()
+
+
+def test_timer():
+    h = Histogram("t", "", buckets=(10.0,))
+    with Timer(h):
+        pass
+    assert h.percentile(0.5) == 10.0  # bucketed upper bound

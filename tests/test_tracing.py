@@ -1,0 +1,114 @@
+import json
+import threading
+
+from gofr_tpu.tracing import (
+    Span,
+    Tracer,
+    ZipkinExporter,
+    current_span,
+    current_trace_id,
+    init_tracer,
+    parse_traceparent,
+)
+
+
+class _ListExporter:
+    def __init__(self):
+        self.spans = []
+
+    def export(self, span):
+        self.spans.append(span)
+
+    def shutdown(self):
+        pass
+
+
+def test_span_nesting_and_ids():
+    exp = _ListExporter()
+    tracer = Tracer(exp)
+    with tracer.start_span("parent", kind="SERVER") as parent:
+        assert current_span() is parent
+        with tracer.start_span("child") as child:
+            assert child.trace_id == parent.trace_id
+            assert child.parent_id == parent.span_id
+        assert current_span() is parent
+    assert current_span() is None
+    assert [s.name for s in exp.spans] == ["child", "parent"]
+    assert exp.spans[0].end_us >= exp.spans[0].start_us
+
+
+def test_traceparent_roundtrip():
+    tracer = Tracer(_ListExporter())
+    with tracer.start_span("root") as root:
+        header = root.traceparent()
+    parsed = parse_traceparent(header)
+    assert parsed == (root.trace_id, root.span_id)
+    span = tracer.start_span("continuation", traceparent=header, activate=False)
+    assert span.trace_id == root.trace_id
+    assert span.parent_id == root.span_id
+    span.end()
+
+
+def test_parse_traceparent_invalid():
+    assert parse_traceparent("") is None
+    assert parse_traceparent("00-bad") is None
+    assert parse_traceparent("00-zz-yy-01") is None
+
+
+def test_trace_id_as_correlation_id():
+    tracer = Tracer(_ListExporter())
+    with tracer.start_span("req"):
+        assert current_trace_id() is not None
+        assert len(current_trace_id()) == 32
+
+
+def test_zipkin_payload_shape():
+    exp = _ListExporter()
+    tracer = Tracer(exp)
+    with tracer.start_span("GET /hello", kind="SERVER") as s:
+        s.set_tag("http.status", 200)
+    z = exp.spans[0].to_zipkin("svc")
+    assert z["name"] == "GET /hello"
+    assert z["kind"] == "SERVER"
+    assert z["localEndpoint"] == {"serviceName": "svc"}
+    assert z["tags"]["http.status"] == "200"
+    json.dumps(z)  # serializable
+
+
+def test_zipkin_exporter_posts_batch(free_port):
+    import http.server
+
+    port = free_port()
+    received = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append(json.loads(body))
+            self.send_response(202)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        exp = ZipkinExporter(f"http://127.0.0.1:{port}/api/v2/spans", flush_interval=0.05)
+        tracer = Tracer(exp)
+        with tracer.start_span("exported"):
+            pass
+        exp.shutdown()
+        assert received and received[0][0]["name"] == "exported"
+    finally:
+        srv.shutdown()
+
+
+def test_init_tracer_without_host(monkeypatch):
+    from gofr_tpu.config import EnvConfig
+
+    monkeypatch.delenv("TRACER_HOST", raising=False)
+    tracer = init_tracer(EnvConfig())
+    with tracer.start_span("noop"):
+        pass  # must not raise
