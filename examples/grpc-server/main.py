@@ -1,0 +1,29 @@
+"""Reference gRPC serving binary.
+
+Parity: /root/reference/examples/grpc-server/main.go:8-14 + grpc/server.go —
+a Hello service behind the framework's gRPC server. Uses the JSON service
+mode (no protoc codegen needed); generated-stub services register the same
+way via ``app.register_service``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+
+
+def say_hello(ctx):
+    name = ctx.param("name") or "World"
+    return f"Hello {name}!"
+
+
+def main():
+    app = gofr_tpu.new(configs_dir=os.path.join(os.path.dirname(__file__), "configs"))
+    app.register_json_service("HelloService", {"SayHello": say_hello})
+    app.run()
+
+
+if __name__ == "__main__":
+    main()

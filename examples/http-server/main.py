@@ -1,0 +1,60 @@
+"""Reference HTTP serving binary.
+
+Parity: /root/reference/examples/http-server/main.go:14-88 — hello/error/
+redis/mysql/trace routes plus a registered downstream service. TPU-native
+additions arrive via configs: when MODEL_NAME is set the /infer and /generate
+routes serve the compiled model through the dynamic batcher.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+from gofr_tpu.errors import HTTPError
+
+
+def hello(ctx):
+    name = ctx.param("name")
+    return f"Hello {name}!" if name else "Hello World!"
+
+
+def error_route(ctx):
+    raise HTTPError(500, "some error occurred")
+
+
+def redis_handler(ctx):
+    if ctx.redis is None:
+        raise HTTPError(503, "redis not configured")
+    return ctx.redis.get("test")
+
+
+def mysql_handler(ctx):
+    if ctx.db is None:
+        raise HTTPError(503, "sql not configured")
+    return ctx.db.select_value("SELECT 2+2")
+
+
+def trace_handler(ctx):
+    with ctx.trace("some-sample-work"):
+        pass
+    svc = ctx.get_http_service("anotherService")
+    if svc is not None:
+        svc.get("redis")
+    return "ok"
+
+
+def main():
+    app = gofr_tpu.new(configs_dir=os.path.join(os.path.dirname(__file__), "configs"))
+    app.add_http_service("anotherService", f"http://localhost:{app.http_port}")
+    app.get("/hello", hello)
+    app.get("/error", error_route)
+    app.get("/redis", redis_handler)
+    app.get("/mysql", mysql_handler)
+    app.get("/trace", trace_handler)
+    app.run()
+
+
+if __name__ == "__main__":
+    main()

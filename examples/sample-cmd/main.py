@@ -1,0 +1,32 @@
+"""Reference CLI binary.
+
+Parity: /root/reference/examples/sample-cmd/main.go:9-22 — sub-commands
+sharing the transport-agnostic handler signature.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import gofr_tpu
+
+
+def hello(ctx):
+    name = ctx.param("name")
+    return f"Hello {name}!" if name else "Hello!"
+
+
+def params(ctx):
+    return f"Hello {ctx.param('name')}!"
+
+
+def main():
+    app = gofr_tpu.new_cmd()
+    app.sub_command("hello", hello)
+    app.sub_command("params", params)
+    app.run()
+
+
+if __name__ == "__main__":
+    main()

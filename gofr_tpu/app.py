@@ -1,0 +1,173 @@
+"""App core: construction, config load, tracer init, route registration,
+concurrent server startup.
+
+Parity: /root/reference/pkg/gofr/gofr.go —
+- ``new()`` (:49): read config, build container, init tracer, prepare HTTP
+  (port from HTTP_PORT|8000, :57-62) and gRPC (GRPC_PORT|9000, :65-70);
+- ``new_cmd()`` (:76): config + container + tracer, no servers;
+- ``run()`` (:90-126): default routes (health/favicon/catch-all, :102-107),
+  servers started concurrently, blocks until shutdown;
+- route helpers GET/PUT/POST/DELETE (:152-169), ``add_http_service``
+  (:139-149), ``sub_command`` (:181), ``register_service`` for gRPC (:42).
+
+Improvement over the reference (SURVEY.md §5 notes it lacks graceful
+shutdown): SIGINT/SIGTERM drain servers and close the container.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+from gofr_tpu.config import Config, EnvFileConfig
+from gofr_tpu.container import Container
+from gofr_tpu.context import Context
+from gofr_tpu.handler import (
+    Handler,
+    catch_all_handler,
+    favicon_handler,
+    health_handler,
+    make_endpoint,
+    metrics_handler,
+)
+from gofr_tpu.http.middleware import (
+    cors_middleware,
+    logging_middleware,
+    metrics_middleware,
+    tracer_middleware,
+)
+from gofr_tpu.http.router import Router
+from gofr_tpu.http.server import HTTPServer
+from gofr_tpu.tracing import init_tracer
+
+DEFAULT_HTTP_PORT = 8000  # parity: pkg/gofr/default.go:3-6
+DEFAULT_GRPC_PORT = 9000
+
+
+class App:
+    def __init__(self, configs_dir: Optional[str] = None, cmd_app: bool = False):
+        self.config: Config = EnvFileConfig(configs_dir or "./configs")
+        self.container = Container(self.config)
+        self.logger = self.container.logger
+        self.tracer = init_tracer(self.config, self.logger)
+        self._cmd_app = cmd_app
+        self._cmd_routes: list[tuple[str, Handler]] = []
+        self._grpc_registrations: list[tuple[Any, Any]] = []
+        self._grpc_json_services: dict[str, dict[str, Handler]] = {}
+        self._grpc_server: Optional[Any] = None
+        self.http_server: Optional[HTTPServer] = None
+
+        self.router = Router()
+        if not cmd_app:
+            self.http_port = int(self.config.get_or_default("HTTP_PORT", str(DEFAULT_HTTP_PORT)))
+            self.grpc_port = int(self.config.get_or_default("GRPC_PORT", str(DEFAULT_GRPC_PORT)))
+            # middleware chain, outermost first (parity: http/router.go:19-23)
+            self.router.use(
+                tracer_middleware,
+                logging_middleware(self.logger),
+                metrics_middleware(self.container.metrics),
+                cors_middleware,
+            )
+
+    # -- route registration (parity: gofr.go:152-169) ------------------------
+    def get(self, pattern: str, handler: Handler) -> None:
+        self.add_route("GET", pattern, handler)
+
+    def post(self, pattern: str, handler: Handler) -> None:
+        self.add_route("POST", pattern, handler)
+
+    def put(self, pattern: str, handler: Handler) -> None:
+        self.add_route("PUT", pattern, handler)
+
+    def patch(self, pattern: str, handler: Handler) -> None:
+        self.add_route("PATCH", pattern, handler)
+
+    def delete(self, pattern: str, handler: Handler) -> None:
+        self.add_route("DELETE", pattern, handler)
+
+    def add_route(self, method: str, pattern: str, handler: Handler) -> None:
+        self.router.add(method, pattern, make_endpoint(handler, self.container))
+
+    # -- inter-service clients (parity: gofr.go:139-149) ---------------------
+    def add_http_service(self, name: str, address: str) -> None:
+        from gofr_tpu.service import new_http_service
+
+        self.container.services[name] = new_http_service(address, self.logger, name=name)
+
+    # -- gRPC (parity: gofr.go:42-46) ----------------------------------------
+    def register_service(self, add_to_server: Callable, servicer: Any) -> None:
+        """Register a generated-stub gRPC service: ``add_to_server`` is the
+        protoc-generated ``add_XServicer_to_server`` callable."""
+        self._grpc_registrations.append((add_to_server, servicer))
+
+    def register_json_service(self, service_name: str, methods: dict[str, Handler]) -> None:
+        """Register a reflection-free JSON-over-gRPC service: each method is
+        a transport-agnostic ``handler(ctx)`` (TPU-native addition for
+        serving without protoc codegen)."""
+        self._grpc_json_services[service_name] = methods
+
+    # -- CLI (parity: gofr.go:181, cmd.go:54-63) -----------------------------
+    def sub_command(self, pattern: str, handler: Handler) -> None:
+        self._cmd_routes.append((pattern, handler))
+
+    # -- run ------------------------------------------------------------------
+    def _install_default_routes(self) -> None:
+        # parity: gofr.go:102-107
+        self.router.add("GET", "/.well-known/health", make_endpoint(health_handler, self.container))
+        self.router.add("GET", "/favicon.ico", make_endpoint(favicon_handler, self.container))
+        self.router.add("GET", "/metrics", make_endpoint(metrics_handler, self.container))
+        self.router.set_not_found(make_endpoint(catch_all_handler, self.container))
+
+    def run(self) -> None:
+        """Blocking run (parity: gofr.go:90-126)."""
+        if self._cmd_app:
+            from gofr_tpu.cmd import run_cmd
+
+            code = run_cmd(self)
+            if code != 0:
+                raise SystemExit(code)
+            return
+        self.start()
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            self.logger.info("shutting down")
+        finally:
+            self.shutdown()
+
+    def start(self) -> "App":
+        """Start servers in background threads and return (test/bench shape;
+        the reference achieves the same with goroutines + WaitGroup,
+        gofr.go:109-125)."""
+        self._install_default_routes()
+        self.http_server = HTTPServer(self.router, self.http_port, self.logger)
+        self.http_server.run_in_thread()
+        if self._grpc_registrations or self._grpc_json_services:
+            from gofr_tpu.grpcx import GRPCServer
+
+            self._grpc_server = GRPCServer(
+                self.grpc_port,
+                self.container,
+                registrations=self._grpc_registrations,
+                json_services=self._grpc_json_services,
+            )
+            self._grpc_server.start()
+        return self
+
+    def shutdown(self) -> None:
+        if self.http_server:
+            self.http_server.shutdown()
+        if self._grpc_server:
+            self._grpc_server.stop()
+        self.container.close()
+        self.tracer.shutdown()
+
+
+def new(configs_dir: Optional[str] = None) -> App:
+    """Parity: gofr.go:49."""
+    return App(configs_dir=configs_dir)
+
+
+def new_cmd(configs_dir: Optional[str] = None) -> App:
+    """Parity: gofr.go:76."""
+    return App(configs_dir=configs_dir, cmd_app=True)

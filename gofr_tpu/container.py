@@ -1,0 +1,115 @@
+"""Dependency-injection container: logger, config, datasources, service
+clients, metrics, and the TPU device.
+
+Parity: /root/reference/pkg/gofr/container/container.go:19-95 — config-driven
+conditional wiring (Redis when REDIS_HOST, SQL when DB host/name configured,
+:48-86), connect errors logged but NEVER fatal (the app runs degraded,
+:60-64, :80-85), health aggregation (:26-38), ``GetHTTPService`` (:93).
+TPU-native additions: a ``tpu`` member wired from TPU_*/MODEL_* config keys
+and a metrics registry (the reference has none, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from gofr_tpu.config import Config
+from gofr_tpu.datasource.health import DOWN, UP, Health
+from gofr_tpu.logging import new_logger
+from gofr_tpu.metrics import Registry
+
+
+class Container:
+    def __init__(self, config: Config, wire: bool = True):
+        self.config = config
+        self.logger = new_logger(config.get_or_default("LOG_LEVEL", "INFO"))
+        self.metrics = Registry()
+        self.services: dict[str, Any] = {}
+        self.redis: Optional[Any] = None
+        self.db: Optional[Any] = None
+        self.tpu: Optional[Any] = None
+        if wire:
+            self._wire_redis()
+            self._wire_sql()
+            self._wire_tpu()
+
+    # -- conditional wiring (parity: container.go:48-86) ---------------------
+    def _wire_redis(self) -> None:
+        host = self.config.get("REDIS_HOST")
+        if not host:
+            return
+        port = int(self.config.get_or_default("REDIS_PORT", "6379"))
+        try:
+            from gofr_tpu.datasource.redis import new_client
+
+            self.redis = new_client(host, port, self.logger)
+            self.logger.infof("connected to redis at %s:%s", host, port)
+        except Exception as exc:  # non-fatal degraded startup
+            self.logger.errorf("could not connect to redis at %s:%s, error: %s", host, port, exc)
+            self.redis = None
+
+    def _wire_sql(self) -> None:
+        name = self.config.get("DB_NAME")
+        host = self.config.get("DB_HOST")
+        if not name and not host:
+            return
+        try:
+            from gofr_tpu.datasource.sql import new_sql
+
+            self.db = new_sql(self.config, self.logger)
+            self.logger.infof("connected to database '%s'", name or host)
+        except Exception as exc:
+            self.logger.errorf("could not connect to database, error: %s", exc)
+            self.db = None
+
+    def _wire_tpu(self) -> None:
+        enabled = (self.config.get_or_default("TPU_ENABLED", "") or "").lower()
+        model = self.config.get("MODEL_NAME")
+        if enabled not in ("true", "1", "yes") and not model:
+            return
+        try:
+            from gofr_tpu.tpu import new_device
+
+            self.tpu = new_device(self.config, self.logger, self.metrics)
+            self.logger.infof("TPU datasource ready: %s", self.tpu.describe())
+        except Exception as exc:
+            self.logger.errorf("could not initialize TPU datasource, error: %s", exc)
+            self.tpu = None
+
+    # -- health (parity: container.go:26-38) ---------------------------------
+    def health(self) -> dict[str, Any]:
+        details: dict[str, Any] = {}
+        overall = UP
+        for name, source in (("redis", self.redis), ("sql", self.db), ("tpu", self.tpu)):
+            if source is None:
+                continue
+            try:
+                h: Health = source.health_check()
+            except Exception as exc:
+                h = Health(DOWN, {"error": str(exc)})
+            details[name] = h.to_dict()
+            if h.status != UP:
+                overall = DOWN
+        # NOTE: registered service clients are NOT probed here (parity:
+        # container.go:26-38 checks only datasources). Probing downstreams
+        # from the health endpoint recurses when a service points at this
+        # same app (the reference example does exactly that).
+        return {"status": overall, "details": details}
+
+    def get_http_service(self, name: str) -> Any:
+        """Parity: container.go:93 — nil-safe lookup."""
+        return self.services.get(name)
+
+    def close(self) -> None:
+        for source in (self.redis, self.db, self.tpu):
+            closer = getattr(source, "close", None)
+            if closer:
+                try:
+                    closer()
+                except Exception:
+                    pass
+
+
+def new_container(config: Config) -> Container:
+    """Parity: container/container.go:40."""
+    return Container(config)

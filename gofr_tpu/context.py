@@ -1,0 +1,82 @@
+"""Per-request Context handed to every handler — the DI access point.
+
+Parity: /root/reference/pkg/gofr/context.go:12-70 — embeds the request and
+the container (:13-26), ``Trace()`` span helper (:45-50), ``Bind`` (:52).
+TPU-native addition: ``ctx.tpu`` exposes the TPU datasource for enqueueing
+batched forward passes (SURVEY.md §2 #14).
+
+The same Context type serves HTTP, gRPC, and CMD transports — the keystone
+transport-agnostic design (request.go:10-16, responder.go:5-7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from gofr_tpu.tracing import Span, current_trace_id, get_tracer
+
+
+class Context:
+    def __init__(self, request: Any, container: Any):
+        self.request = request
+        self.container = container
+
+    # -- request passthrough (parity: context.go embedding) -----------------
+    def param(self, key: str) -> str:
+        return self.request.param(key)
+
+    def params(self, key: str) -> list[str]:
+        return self.request.params(key)
+
+    def path_param(self, key: str) -> str:
+        return self.request.path_param(key)
+
+    def bind(self, into: Any = None) -> Any:
+        return self.request.bind(into)
+
+    def header(self, name: str) -> str:
+        header = getattr(self.request, "header", None)
+        return header(name) if header else ""
+
+    def host_name(self) -> str:
+        return self.request.host_name()
+
+    # -- container accessors -------------------------------------------------
+    @property
+    def logger(self) -> Any:
+        return self.container.logger
+
+    @property
+    def config(self) -> Any:
+        return self.container.config
+
+    @property
+    def redis(self) -> Any:
+        return self.container.redis
+
+    @property
+    def db(self) -> Any:
+        return self.container.db
+
+    @property
+    def tpu(self) -> Any:
+        """The TPU inference datasource (TPU-native addition)."""
+        return self.container.tpu
+
+    @property
+    def metrics(self) -> Any:
+        return self.container.metrics
+
+    def get_http_service(self, name: str) -> Any:
+        """Parity: container/container.go:93."""
+        return self.container.get_http_service(name)
+
+    # -- tracing -------------------------------------------------------------
+    def trace(self, name: str) -> Span:
+        """User span helper (parity: context.go:45-50); use as a context
+        manager: ``with ctx.trace("work"): ...``"""
+        return get_tracer().start_span(name)
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return current_trace_id()

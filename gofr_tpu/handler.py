@@ -1,0 +1,81 @@
+"""Bridges the transport-agnostic handler signature onto the HTTP server,
+plus the built-in routes.
+
+Parity: /root/reference/pkg/gofr/handler.go:12-53 — the handler adapter
+builds a per-request Context (:33), opens a "gofr-handler" span (:34), calls
+user code (:35), and hands (result, error) to the responder; built-ins:
+healthHandler (:38), faviconHandler (:42), catchAllHandler -> 404 (:51).
+TPU-native addition: a /metrics endpoint (Prometheus text exposition).
+
+Handlers may be sync (run on a worker thread so the event loop never blocks)
+or ``async def`` (awaited on the loop — preferred for TPU batch enqueue).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+from typing import Any, Callable
+
+from gofr_tpu import static
+from gofr_tpu.context import Context
+from gofr_tpu.errors import RouteNotFoundError
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.responder import respond
+from gofr_tpu.http.response import File, Raw, Response
+from gofr_tpu.tracing import get_tracer
+
+Handler = Callable[[Context], Any]
+
+
+def make_endpoint(func: Handler, container: Any) -> Callable:
+    """Adapt ``handler(ctx) -> result`` into an async router endpoint."""
+
+    is_async = inspect.iscoroutinefunction(func)
+
+    async def endpoint(request: Request) -> Response:
+        ctx = Context(request, container)
+        with get_tracer().start_span("gofr-handler"):
+            try:
+                if is_async:
+                    result = await func(ctx)
+                else:
+                    loop = asyncio.get_running_loop()
+                    # propagate the active span (contextvars) into the worker
+                    # thread so ctx.trace_id / child spans nest correctly
+                    call = contextvars.copy_context().run
+                    result = await loop.run_in_executor(None, call, func, ctx)
+                error = None
+            except Exception as exc:  # handler errors -> enveloped response
+                result, error = None, exc
+        if error is not None and not hasattr(error, "status_code"):
+            # unknown errors are 500s; log them (parity with the reference's
+            # responder hiding internals behind a generic message)
+            container.logger.errorf("handler error on %s %s: %r", request.method, request.path, error)
+        return respond(result, error)
+
+    return endpoint
+
+
+# -- built-in handlers (parity: handler.go:38-53) ---------------------------
+
+def health_handler(ctx: Context) -> Any:
+    """Aggregated datasource health (handler.go:38, container.go:26-38)."""
+    return ctx.container.health()
+
+
+def favicon_handler(_: Context) -> File:
+    return File(content=static.favicon(), content_type="image/x-icon")
+
+
+def catch_all_handler(_: Context) -> None:
+    raise RouteNotFoundError()
+
+
+def metrics_handler(ctx: Context) -> Response:
+    return Response(
+        status=200,
+        headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+        body=ctx.container.metrics.expose().encode("utf-8"),
+    )

@@ -1,0 +1,17 @@
+"""HTTP transport: asyncio HTTP/1.1 server, router, middleware,
+request/responder, and response types.
+
+Parity: /root/reference/pkg/gofr/http/ (router.go, request.go, responder.go,
+middleware/, response/). The server itself is built from scratch on asyncio
+instead of wrapping a third-party stack — the TPU-native hot path (dynamic
+batching in front of device execution) wants the event loop in-framework so
+request futures and batch flush deadlines share one scheduler.
+"""
+
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.response import File, Raw, Response, Stream
+from gofr_tpu.http.responder import respond
+from gofr_tpu.http.router import Router
+from gofr_tpu.http.server import HTTPServer
+
+__all__ = ["Request", "Response", "Raw", "File", "Stream", "respond", "Router", "HTTPServer"]

@@ -1,0 +1,170 @@
+"""HTTP middleware: tracing, access-logging with panic recovery, CORS.
+
+Parity: /root/reference/pkg/gofr/http/middleware/ —
+- tracer.go:11-23: root SERVER span named "METHOD /path";
+- logger.go:24-114: timed RequestLog (trace id, method, uri, ip, status,
+  response time µs), X-Correlation-ID response header from the trace id
+  (:46-47), client IP from X-Forwarded-For (:72-84), and panic recovery
+  returning a JSON 500 with a logged stack trace (:91-114);
+- cors.go:5-19: permissive wildcard CORS with OPTIONS short-circuit.
+
+Middleware compose as ``mw(next_endpoint) -> endpoint`` over async endpoints
+(installed by the router, router.go:19-23 parity).
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+from dataclasses import dataclass
+from typing import Any
+
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.response import Response
+from gofr_tpu.http.router import Endpoint
+from gofr_tpu.tracing import SERVER, get_tracer
+
+
+@dataclass
+class RequestLog:
+    """Typed access-log entry (parity: middleware/logger.go:24-33)."""
+
+    trace_id: str
+    method: str
+    uri: str
+    ip: str
+    status: int
+    response_time_us: int
+    user_agent: str = ""
+
+    def pretty_terminal(self) -> str:
+        color = 32 if self.status < 400 else (33 if self.status < 500 else 31)
+        return (
+            f"\x1b[{color}m{self.status}\x1b[0m "
+            f"{self.method:<7s} {self.uri} {self.response_time_us}µs {self.ip}"
+        )
+
+    def log_fields(self) -> dict[str, Any]:
+        return {
+            "trace_id": self.trace_id,
+            "method": self.method,
+            "uri": self.uri,
+            "ip": self.ip,
+            "status": self.status,
+            "response_time_us": self.response_time_us,
+            "user_agent": self.user_agent,
+        }
+
+
+def client_ip(request: Request) -> str:
+    """Parity: middleware/logger.go:72-84 — first X-Forwarded-For hop."""
+    fwd = request.header("x-forwarded-for")
+    if fwd:
+        return fwd.split(",")[0].strip()
+    return request.remote_addr
+
+
+def tracer_middleware(next_ep: Endpoint) -> Endpoint:
+    """Root server span per request (parity: middleware/tracer.go:11-23)."""
+
+    async def endpoint(request: Request) -> Response:
+        tracer = get_tracer()
+        span = tracer.start_span(
+            f"{request.method} {request.path}",
+            kind=SERVER,
+            traceparent=request.header("traceparent"),
+        )
+        try:
+            response = await next_ep(request)
+            span.set_tag("http.status_code", response.status)
+            return response
+        finally:
+            span.__exit__(None, None, None)
+
+    return endpoint
+
+
+def logging_middleware(logger: Any) -> Any:
+    """Access log + recovery (parity: middleware/logger.go:41-114)."""
+
+    def middleware(next_ep: Endpoint) -> Endpoint:
+        async def endpoint(request: Request) -> Response:
+            from gofr_tpu.tracing import current_trace_id
+
+            start = time.perf_counter()
+            trace_id = current_trace_id() or ""
+            try:
+                response = await next_ep(request)
+            except Exception:
+                # Panic recovery: JSON 500 + stack trace log (logger.go:91-114).
+                logger.error(
+                    {"error": "panic recovered", "stack": traceback.format_exc(), "trace_id": trace_id}
+                )
+                response = Response(
+                    status=500,
+                    headers={"Content-Type": "application/json"},
+                    body=b'{"error":{"message":"some unexpected error has occurred"}}',
+                )
+            elapsed_us = int((time.perf_counter() - start) * 1e6)
+            if trace_id:
+                response.headers.setdefault("X-Correlation-ID", trace_id)
+            logger.info(
+                RequestLog(
+                    trace_id=trace_id,
+                    method=request.method,
+                    uri=request.target,
+                    ip=client_ip(request),
+                    status=response.status,
+                    response_time_us=elapsed_us,
+                    user_agent=request.header("user-agent"),
+                )
+            )
+            return response
+
+        return endpoint
+
+    return middleware
+
+
+def cors_middleware(next_ep: Endpoint) -> Endpoint:
+    """Permissive CORS (parity: middleware/cors.go:5-19)."""
+
+    async def endpoint(request: Request) -> Response:
+        if request.method == "OPTIONS":
+            return Response(status=200, headers=dict(_CORS_HEADERS))
+        response = await next_ep(request)
+        response.headers.setdefault("Access-Control-Allow-Origin", "*")
+        return response
+
+    return endpoint
+
+
+_CORS_HEADERS = {
+    "Access-Control-Allow-Origin": "*",
+    "Access-Control-Allow-Methods": "GET, POST, PUT, PATCH, DELETE, OPTIONS",
+    "Access-Control-Allow-Headers": "Content-Type, Authorization, Traceparent",
+}
+
+
+def metrics_middleware(registry: Any) -> Any:
+    """TPU-native addition: request counters + latency histogram for every
+    route (the reference has no metrics subsystem, SURVEY.md §5)."""
+
+    requests_total = registry.counter(
+        "gofr_http_requests_total", "HTTP requests", labels=("method", "status")
+    )
+    duration = registry.histogram(
+        "gofr_http_request_duration_seconds", "HTTP request latency"
+    )
+
+    def middleware(next_ep: Endpoint) -> Endpoint:
+        async def endpoint(request: Request) -> Response:
+            start = time.perf_counter()
+            response = await next_ep(request)
+            duration.observe(time.perf_counter() - start)
+            requests_total.inc(method=request.method, status=str(response.status))
+            return response
+
+        return endpoint
+
+    return middleware

@@ -1,0 +1,104 @@
+"""Builds the wire response from a handler's (result, error) pair.
+
+Parity: /root/reference/pkg/gofr/http/responder.go:11-62 — the
+``{"data": ...}`` / ``{"error": {"message": ...}}`` JSON envelope (:59-62),
+``Raw``/``File`` special-casing (:24-37), and status derived from the error
+(:43-57 via gofr_tpu.errors.status_from_error). TPU-native addition:
+``Stream`` results become chunked SSE responses for token decode endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, AsyncIterator, Optional
+
+from gofr_tpu.errors import status_from_error
+from gofr_tpu.http.response import File, Raw, Response, Stream
+
+_JSON = "application/json"
+
+
+def _json_bytes(payload: Any) -> bytes:
+    return json.dumps(payload, default=_jsonable, separators=(",", ":")).encode("utf-8")
+
+
+def _jsonable(obj: Any) -> Any:
+    # numpy / jax arrays and scalars serialize as lists / python scalars
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    if hasattr(obj, "item") and getattr(obj, "shape", None) == ():
+        return obj.item()
+    if hasattr(obj, "__dict__"):
+        return obj.__dict__
+    return str(obj)
+
+
+def _frame_sse(item: Any) -> bytes:
+    if isinstance(item, bytes):
+        data = item.decode("utf-8", "replace")
+    elif isinstance(item, str):
+        data = item
+    else:
+        data = json.dumps(item, default=_jsonable)
+    return ("data: " + data + "\n\n").encode("utf-8")
+
+
+async def _sse_iter(stream: Stream) -> AsyncIterator[bytes]:
+    events = stream.events
+    if hasattr(events, "__aiter__"):
+        async for item in events:  # type: ignore[union-attr]
+            yield _frame_sse(item) if stream.sse else _to_bytes(item)
+    else:
+        # Sync generators (e.g. blocking token decode) must not stall the
+        # event loop between yields; pull each item on a worker thread.
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        iterator = iter(events)  # type: ignore[arg-type]
+        sentinel = object()
+        while True:
+            item = await loop.run_in_executor(None, next, iterator, sentinel)
+            if item is sentinel:
+                break
+            yield _frame_sse(item) if stream.sse else _to_bytes(item)
+
+
+def _to_bytes(item: Any) -> bytes:
+    if isinstance(item, bytes):
+        return item
+    if isinstance(item, str):
+        return item.encode("utf-8")
+    return _json_bytes(item)
+
+
+def respond(result: Any, error: Optional[BaseException]) -> Response:
+    """Parity: http/responder.go:19-41 (Respond's type switch)."""
+    if error is not None:
+        status = status_from_error(error)
+        if status == 500 and not hasattr(error, "status_code"):
+            # Hide internals for unexpected errors (parity: the reference's
+            # recovery path returns a generic message, middleware/logger.go:104).
+            message = "some unexpected error has occurred"
+        else:
+            message = str(error) or error.__class__.__name__
+        body = _json_bytes({"error": {"message": message}})
+        return Response(status=status, headers={"Content-Type": _JSON}, body=body)
+
+    if isinstance(result, Response):
+        return result
+    if isinstance(result, Raw):
+        return Response(status=200, headers={"Content-Type": _JSON}, body=_json_bytes(result.data))
+    if isinstance(result, File):
+        return Response(
+            status=200, headers={"Content-Type": result.content_type}, body=result.content
+        )
+    if isinstance(result, Stream):
+        headers = {
+            "Content-Type": result.content_type,
+            "Cache-Control": "no-cache",
+            "X-Accel-Buffering": "no",
+        }
+        return Response(status=200, headers=headers, stream=_sse_iter(result))
+
+    body = _json_bytes({"data": result})
+    return Response(status=200, headers={"Content-Type": _JSON}, body=body)

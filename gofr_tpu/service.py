@@ -1,0 +1,200 @@
+"""Inter-service HTTP client: named downstream services with uniform
+logging, tracing, and correlation-ID propagation.
+
+Parity: /root/reference/pkg/gofr/service/new.go:18-176 — the ten-method
+surface (Get/Post/Put/Patch/Delete × plain / WithHeaders, :25-54),
+per-request CLIENT span (:116-119), correlation ID from the caller's trace
+(:126), timed ServiceLog / ErrorLog (:134-156), and query encoding
+(:161-176). Over DCN between pod hosts this same client is the host-to-host
+coordination path (SURVEY.md §2 #20).
+"""
+
+from __future__ import annotations
+
+import json as _json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from gofr_tpu.datasource.health import DOWN, UP, Health
+from gofr_tpu.tracing import CLIENT, current_span, get_tracer
+
+
+@dataclass
+class ServiceLog:
+    """Typed outbound-call log entry (parity: service/logger.go:5-21)."""
+
+    correlation_id: str
+    service: str
+    method: str
+    uri: str
+    status: int
+    response_time_us: int
+
+    def pretty_terminal(self) -> str:
+        color = 32 if 0 < self.status < 400 else 31
+        return (
+            f"\x1b[{color}m{self.status}\x1b[0m "
+            f"{self.method:<7s} {self.uri} {self.response_time_us}µs [svc {self.service}]"
+        )
+
+    def log_fields(self) -> dict[str, Any]:
+        return {
+            "correlation_id": self.correlation_id,
+            "service": self.service,
+            "method": self.method,
+            "uri": self.uri,
+            "status": self.status,
+            "response_time_us": self.response_time_us,
+        }
+
+
+class ServiceResponse:
+    """Parity: service/response.go:5-17."""
+
+    def __init__(self, status_code: int, body: bytes, headers: dict[str, str]):
+        self.status_code = status_code
+        self.body = body
+        self.headers = headers
+
+    def json(self) -> Any:
+        return _json.loads(self.body.decode("utf-8") or "null")
+
+
+class HTTPService:
+    """A named downstream-service client (parity: service/new.go:18-23)."""
+
+    def __init__(self, address: str, logger: Any, name: str = "", timeout: float = 30.0):
+        self.address = address.rstrip("/")
+        self.logger = logger
+        self.name = name or self.address
+        self.timeout = timeout
+
+    # -- the 10-method HTTP interface (parity: new.go:25-54) -----------------
+    def get(self, path: str, params: Optional[dict] = None) -> ServiceResponse:
+        return self._send("GET", path, params, None, None)
+
+    def get_with_headers(self, path: str, params: Optional[dict], headers: dict) -> ServiceResponse:
+        return self._send("GET", path, params, None, headers)
+
+    def post(self, path: str, params: Optional[dict] = None, body: Any = None) -> ServiceResponse:
+        return self._send("POST", path, params, body, None)
+
+    def post_with_headers(self, path, params, body, headers) -> ServiceResponse:
+        return self._send("POST", path, params, body, headers)
+
+    def put(self, path: str, params: Optional[dict] = None, body: Any = None) -> ServiceResponse:
+        return self._send("PUT", path, params, body, None)
+
+    def put_with_headers(self, path, params, body, headers) -> ServiceResponse:
+        return self._send("PUT", path, params, body, headers)
+
+    def patch(self, path: str, params: Optional[dict] = None, body: Any = None) -> ServiceResponse:
+        return self._send("PATCH", path, params, body, None)
+
+    def patch_with_headers(self, path, params, body, headers) -> ServiceResponse:
+        return self._send("PATCH", path, params, body, headers)
+
+    def delete(self, path: str, body: Any = None) -> ServiceResponse:
+        return self._send("DELETE", path, None, body, None)
+
+    def delete_with_headers(self, path, body, headers) -> ServiceResponse:
+        return self._send("DELETE", path, None, body, headers)
+
+    # -- internals (parity: createAndSendRequest, new.go:111-159) ------------
+    def _send(
+        self,
+        method: str,
+        path: str,
+        params: Optional[dict],
+        body: Any,
+        headers: Optional[dict],
+    ) -> ServiceResponse:
+        uri = self.address + "/" + path.lstrip("/")
+        if params:
+            uri += "?" + _encode_query(params)
+
+        data: Optional[bytes] = None
+        send_headers = dict(headers or {})
+        if body is not None:
+            if isinstance(body, bytes):
+                data = body
+            else:
+                data = _json.dumps(body).encode("utf-8")
+                send_headers.setdefault("Content-Type", "application/json")
+
+        tracer = get_tracer()
+        span = tracer.start_span(f"{method} {uri}", kind=CLIENT, activate=False)
+        correlation_id = span.trace_id
+        # downstream SERVER span must parent onto this CLIENT span
+        send_headers.setdefault("traceparent", span.traceparent())
+        send_headers.setdefault("X-Correlation-ID", correlation_id)
+
+        start = time.perf_counter()
+        status = 0
+        try:
+            req = urllib.request.Request(uri, data=data, headers=send_headers, method=method)
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                status = resp.status
+                payload = resp.read()
+                resp_headers = dict(resp.headers.items())
+        except urllib.error.HTTPError as exc:
+            status = exc.code
+            payload = exc.read()
+            resp_headers = dict(exc.headers.items()) if exc.headers else {}
+        except Exception as exc:
+            elapsed_us = int((time.perf_counter() - start) * 1e6)
+            span.set_tag("error", exc)
+            span.end()
+            self.logger.error(
+                ServiceLog(correlation_id, self.name, method, uri, 0, elapsed_us)
+            )
+            raise ServiceCallError(self.name, uri, exc) from exc
+
+        elapsed_us = int((time.perf_counter() - start) * 1e6)
+        span.set_tag("http.status_code", status)
+        span.end()
+        log_entry = ServiceLog(correlation_id, self.name, method, uri, status, elapsed_us)
+        if status >= 500:
+            self.logger.error(log_entry)
+        else:
+            self.logger.info(log_entry)
+        return ServiceResponse(status, payload, resp_headers)
+
+    def health_check(self) -> Health:
+        """GET /.well-known/health on the downstream (TPU-native addition:
+        the container aggregates registered services into its own health)."""
+        try:
+            resp = self.get("/.well-known/health")
+            return Health(UP if resp.status_code == 200 else DOWN, {"host": self.address})
+        except Exception as exc:
+            return Health(DOWN, {"host": self.address, "error": str(exc)})
+
+
+class ServiceCallError(Exception):
+    status_code = 502
+
+    def __init__(self, service: str, uri: str, cause: Exception):
+        super().__init__(f"call to service '{service}' failed: {cause}")
+        self.service = service
+        self.uri = uri
+        self.cause = cause
+
+
+def _encode_query(params: dict) -> str:
+    """Parity: service/new.go:161-176 — list values repeat the key."""
+    pairs: list[tuple[str, str]] = []
+    for key, value in params.items():
+        if isinstance(value, (list, tuple)):
+            pairs.extend((key, str(v)) for v in value)
+        else:
+            pairs.append((key, str(value)))
+    return urllib.parse.urlencode(pairs)
+
+
+def new_http_service(address: str, logger: Any, name: str = "") -> HTTPService:
+    """Parity: service/new.go:56."""
+    return HTTPService(address, logger, name=name)

@@ -1,0 +1,12 @@
+"""Embedded static assets.
+
+Parity: /root/reference/pkg/gofr/static/files.go:5-7 — a go:embed'd favicon
+served at /favicon.ico. Here the icon ships inside the package and loads via
+importlib.resources.
+"""
+
+from importlib import resources
+
+
+def favicon() -> bytes:
+    return resources.files(__package__).joinpath("favicon.ico").read_bytes()

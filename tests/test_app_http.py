@@ -1,0 +1,177 @@
+"""End-to-end App tests over real sockets.
+
+Parity model: reference gofr_test.go:109-132 (boot the app, hit real
+routes), handler_test.go, middleware tests (SURVEY.md §4)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import gofr_tpu
+from gofr_tpu.errors import InvalidParamError
+from gofr_tpu.http.response import Raw, Stream
+
+
+@pytest.fixture
+def app(free_port, monkeypatch, tmp_path):
+    monkeypatch.setenv("HTTP_PORT", str(free_port()))
+    monkeypatch.setenv("LOG_LEVEL", "FATAL")
+    monkeypatch.delenv("REDIS_HOST", raising=False)
+    monkeypatch.delenv("DB_NAME", raising=False)
+    monkeypatch.delenv("DB_HOST", raising=False)
+    monkeypatch.delenv("TPU_ENABLED", raising=False)
+    monkeypatch.delenv("MODEL_NAME", raising=False)
+    monkeypatch.chdir(tmp_path)
+    application = gofr_tpu.new()
+    yield application
+    application.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read(), dict(r.headers.items())
+
+
+def test_hello_route_envelope(app):
+    app.get("/hello", lambda ctx: "Hello World!")
+    app.start()
+    base = f"http://127.0.0.1:{app.http_port}"
+    status, body, headers = _get(base + "/hello")
+    assert status == 200
+    assert json.loads(body) == {"data": "Hello World!"}
+    assert headers["Content-Type"] == "application/json"
+    assert "X-Correlation-ID" in headers
+
+
+def test_path_and_query_params(app):
+    app.get("/greet/{name}", lambda ctx: f"hi {ctx.path_param('name')} x{ctx.param('times')}")
+    app.start()
+    status, body, _ = _get(f"http://127.0.0.1:{app.http_port}/greet/ada?times=3")
+    assert json.loads(body) == {"data": "hi ada x3"}
+
+
+def test_error_handler_status(app):
+    def boom(ctx):
+        raise InvalidParamError("id")
+
+    app.get("/err", boom)
+    app.start()
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{app.http_port}/err", timeout=5)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "invalid" in json.loads(e.read())["error"]["message"]
+
+
+def test_panic_recovery_returns_500(app):
+    def panics(ctx):
+        raise RuntimeError("kaboom")
+
+    app.get("/panic", panics)
+    app.start()
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{app.http_port}/panic", timeout=5)
+        raise AssertionError("expected 500")
+    except urllib.error.HTTPError as e:
+        assert e.code == 500
+        assert e.read() == b'{"error":{"message":"some unexpected error has occurred"}}'
+
+
+def test_default_routes(app):
+    app.start()
+    base = f"http://127.0.0.1:{app.http_port}"
+    status, body, _ = _get(base + "/.well-known/health")
+    assert status == 200
+    assert json.loads(body)["data"]["status"] == "UP"
+
+    status, body, headers = _get(base + "/favicon.ico")
+    assert status == 200
+    assert headers["Content-Type"] == "image/x-icon"
+    assert body[:4] == b"\x00\x00\x01\x00"  # ICO magic
+
+    status, body, headers = _get(base + "/metrics")
+    assert status == 200
+    assert b"gofr_http_requests_total" in body
+
+    try:
+        urllib.request.urlopen(base + "/nope", timeout=5)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_post_bind_and_raw(app):
+    def create(ctx):
+        data = ctx.bind()
+        return Raw({"echo": data["v"] * 2})
+
+    app.post("/double", create)
+    app.start()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{app.http_port}/double",
+        data=b'{"v": 21}',
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert json.loads(r.read()) == {"echo": 42}
+
+
+def test_async_handler_and_sse_stream(app):
+    async def stream(ctx):
+        async def gen():
+            for i in range(3):
+                yield f"tok{i}"
+
+        return Stream(gen())
+
+    app.get("/stream", stream)
+    app.start()
+    with urllib.request.urlopen(f"http://127.0.0.1:{app.http_port}/stream", timeout=5) as r:
+        assert r.headers["Content-Type"] == "text/event-stream"
+        payload = r.read()
+    assert payload == b"data: tok0\n\ndata: tok1\n\ndata: tok2\n\n"
+
+
+def test_cors_preflight(app):
+    app.start()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{app.http_port}/anything", method="OPTIONS"
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert r.status == 200
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+
+
+def test_keep_alive_multiple_requests(app):
+    app.get("/ping", lambda ctx: "pong")
+    app.start()
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", app.http_port, timeout=5)
+    for _ in range(3):
+        conn.request("GET", "/ping")
+        resp = conn.getresponse()
+        assert json.loads(resp.read()) == {"data": "pong"}
+    conn.close()
+
+
+def test_trace_context_propagation(app):
+    seen = {}
+
+    def echo_trace(ctx):
+        seen["trace_id"] = ctx.trace_id
+        return "ok"
+
+    app.get("/t", echo_trace)
+    app.start()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{app.http_port}/t",
+        headers={"traceparent": "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert r.headers["X-Correlation-ID"] == "ab" * 16
+    assert seen["trace_id"] == "ab" * 16

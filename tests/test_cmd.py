@@ -1,0 +1,74 @@
+"""CMD transport tests.
+
+Parity model: cmd_test.go:15-29 and examples/sample-cmd/main_test.go:21-45
+(os.Args injection + stdout/stderr capture)."""
+
+import pytest
+
+import gofr_tpu
+from gofr_tpu.cmd import CMDRequest, command_string, run_cmd
+from gofr_tpu.testutil import stderr_output_for, stdout_output_for
+
+
+@pytest.fixture
+def cmd_app(monkeypatch, tmp_path):
+    monkeypatch.setenv("LOG_LEVEL", "FATAL")
+    monkeypatch.chdir(tmp_path)
+    return gofr_tpu.new_cmd()
+
+
+def test_flag_parsing():
+    req = CMDRequest(["hello", "-verbose", "--name=ada", "-n=3"])
+    assert req.param("verbose") == "true"
+    assert req.param("name") == "ada"
+    assert req.param("n") == "3"
+    assert req.param("missing") == ""
+
+
+def test_command_string_skips_flags():
+    assert command_string(["hello", "-a", "--b=c", "world"]) == "hello world"
+
+
+def test_bind_types():
+    class Opts:
+        name: str = ""
+        count: int = 0
+        fast: bool = False
+
+    req = CMDRequest(["run", "--name=x", "--count=5", "-fast"])
+    opts = req.bind(Opts)
+    assert opts.name == "x" and opts.count == 5 and opts.fast is True
+
+
+def test_route_match_and_output(cmd_app):
+    cmd_app.sub_command("hello", lambda ctx: f"Hello {ctx.param('name')}!")
+    out = stdout_output_for(lambda: run_cmd(cmd_app, ["hello", "--name=ada"]))
+    assert out == "Hello ada!\n"
+
+
+def test_regex_route(cmd_app):
+    cmd_app.sub_command(r"greet \w+", lambda ctx: "matched")
+    out = stdout_output_for(lambda: run_cmd(cmd_app, ["greet", "bob"]))
+    assert "matched" in out
+
+
+def test_no_command_found(cmd_app):
+    cmd_app.sub_command("hello", lambda ctx: "hi")
+    err = stderr_output_for(lambda: run_cmd(cmd_app, ["bogus"]))
+    assert "No Command Found!" in err
+
+
+def test_handler_error_to_stderr(cmd_app):
+    def fails(ctx):
+        raise ValueError("broken pipe dream")
+
+    cmd_app.sub_command("fail", fails)
+    err = stderr_output_for(lambda: run_cmd(cmd_app, ["fail"]))
+    assert "broken pipe dream" in err
+    assert run_cmd(cmd_app, ["fail"]) == 1
+
+
+def test_dict_result_prints_json(cmd_app):
+    cmd_app.sub_command("info", lambda ctx: {"version": 1})
+    out = stdout_output_for(lambda: run_cmd(cmd_app, ["info"]))
+    assert '"version": 1' in out

@@ -1,0 +1,51 @@
+"""Container wiring tests.
+
+Parity model: container_test.go:18-48 — config-driven wiring; invalid hosts
+leave members None and the app degrades instead of dying (SURVEY.md §3.1)."""
+
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.container import Container, new_container
+
+
+def test_no_datasources_by_default(monkeypatch):
+    for key in ("REDIS_HOST", "DB_NAME", "DB_HOST", "TPU_ENABLED", "MODEL_NAME"):
+        monkeypatch.delenv(key, raising=False)
+    c = new_container(EnvConfig())
+    assert c.redis is None and c.db is None and c.tpu is None
+    health = c.health()
+    assert health["status"] == "UP"
+    assert health["details"] == {}
+
+
+def test_invalid_redis_host_degrades(monkeypatch):
+    monkeypatch.setenv("REDIS_HOST", "256.0.0.1")
+    monkeypatch.setenv("REDIS_PORT", "1")
+    monkeypatch.delenv("DB_NAME", raising=False)
+    monkeypatch.delenv("DB_HOST", raising=False)
+    monkeypatch.delenv("TPU_ENABLED", raising=False)
+    monkeypatch.delenv("MODEL_NAME", raising=False)
+    c = Container(EnvConfig())  # must not raise
+    assert c.redis is None
+
+
+def test_get_http_service_nil_safe():
+    c = Container(EnvConfig(), wire=False)
+    assert c.get_http_service("missing") is None
+    sentinel = object()
+    c.services["x"] = sentinel
+    assert c.get_http_service("x") is sentinel
+
+
+def test_health_aggregates_down(monkeypatch):
+    c = Container(EnvConfig(), wire=False)
+
+    class FakeSource:
+        def health_check(self):
+            from gofr_tpu.datasource.health import DOWN, Health
+
+            return Health(DOWN, {"err": "x"})
+
+    c.redis = FakeSource()
+    health = c.health()
+    assert health["status"] == "DOWN"
+    assert health["details"]["redis"]["status"] == "DOWN"

@@ -1,0 +1,98 @@
+"""gRPC transport tests over a real in-process server + channel.
+
+Parity model: grpc_test.go:24-52 (server lifecycle incl. error paths) and
+examples/grpc-server tests (SURVEY.md §4)."""
+
+import json
+
+import grpc
+import pytest
+
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.container import Container
+from gofr_tpu.errors import EntityNotFoundError
+from gofr_tpu.grpcx import GRPCServer
+from gofr_tpu.testutil import MockLogger
+
+
+@pytest.fixture
+def server(free_port):
+    port = free_port()
+    container = Container(EnvConfig(), wire=False)
+    container.logger = MockLogger()
+
+    def say_hello(ctx):
+        name = ctx.param("name") or "World"
+        return f"Hello {name}!"
+
+    def not_found(ctx):
+        raise EntityNotFoundError("user", ctx.param("id"))
+
+    def panics(ctx):
+        raise RuntimeError("secret internals")
+
+    srv = GRPCServer(
+        port,
+        container,
+        json_services={
+            "HelloService": {"SayHello": say_hello, "Lookup": not_found, "Panic": panics}
+        },
+    )
+    srv.start()
+    yield port, container
+    srv.stop()
+
+
+def _call(port, method, payload, metadata=None):
+    with grpc.insecure_channel(f"localhost:{port}") as channel:
+        stub = channel.unary_unary(
+            f"/HelloService/{method}",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        return stub(json.dumps(payload).encode(), metadata=metadata, timeout=5)
+
+
+def test_json_unary_call(server):
+    port, _ = server
+    resp = json.loads(_call(port, "SayHello", {"name": "ada"}))
+    assert resp == {"data": "Hello ada!"}
+
+
+def test_typed_error_maps_to_grpc_status(server):
+    port, _ = server
+    with pytest.raises(grpc.RpcError) as exc:
+        _call(port, "Lookup", {"id": "9"})
+    assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+    assert "No 'user' found" in exc.value.details()
+
+
+def test_unknown_error_hides_internals(server):
+    port, container = server
+    with pytest.raises(grpc.RpcError) as exc:
+        _call(port, "Panic", {})
+    assert exc.value.code() == grpc.StatusCode.INTERNAL
+    assert "secret internals" not in exc.value.details()
+    assert "secret internals" in container.logger.output  # logged server-side
+
+
+def test_unknown_method_unimplemented(server):
+    port, _ = server
+    with pytest.raises(grpc.RpcError) as exc:
+        _call(port, "Nope", {})
+    assert exc.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+
+def test_rpc_log_emitted(server):
+    port, container = server
+    _call(port, "SayHello", {"name": "x"})
+    assert "/HelloService/SayHello" in container.logger.output
+
+
+def test_invalid_json_payload(server):
+    port, _ = server
+    with grpc.insecure_channel(f"localhost:{port}") as channel:
+        stub = channel.unary_unary("/HelloService/SayHello")
+        with pytest.raises(grpc.RpcError) as exc:
+            stub(b"\xff\xfe not json", timeout=5)
+    assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT

@@ -1,0 +1,165 @@
+"""Router + request + responder unit tests (no sockets).
+
+Parity model: reference router/request/responder tests using httptest
+recorders (SURVEY.md §4)."""
+
+import asyncio
+import json
+
+import pytest
+
+from gofr_tpu.errors import EntityNotFoundError
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.responder import respond
+from gofr_tpu.http.response import File, Raw, Response, Stream
+from gofr_tpu.http.router import Router
+
+
+def _req(method="GET", target="/", headers=None, body=b""):
+    return Request(method, target, headers or {}, body)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_path_params_and_methods():
+    router = Router()
+
+    async def user(req):
+        return Response(body=req.path_params["id"].encode())
+
+    router.add("GET", "/user/{id}", user)
+    resp = run(router.dispatcher()(_req(target="/user/42")))
+    assert resp.body == b"42"
+
+
+def test_405_with_allow_header():
+    router = Router()
+
+    async def ep(req):
+        return Response()
+
+    router.add("GET", "/thing", ep)
+    resp = run(router.dispatcher()(_req(method="POST", target="/thing")))
+    assert resp.status == 405
+    assert resp.headers["Allow"] == "GET"
+
+
+def test_catch_all_404():
+    router = Router()
+
+    async def nf(req):
+        return Response(status=404, body=b"nope")
+
+    router.set_not_found(nf)
+    resp = run(router.dispatcher()(_req(target="/missing")))
+    assert resp.status == 404 and resp.body == b"nope"
+
+
+def test_strict_slash_off():
+    router = Router()
+
+    async def ep(req):
+        return Response(body=b"hit")
+
+    router.add("GET", "/abc", ep)
+    assert run(router.dispatcher()(_req(target="/abc/"))).body == b"hit"
+
+
+def test_head_matches_get_route():
+    router = Router()
+
+    async def ep(req):
+        return Response(body=b"payload")
+
+    router.add("GET", "/x", ep)
+    assert run(router.dispatcher()(_req(method="HEAD", target="/x"))).body == b"payload"
+
+
+def test_middleware_order():
+    router = Router()
+    calls = []
+
+    def mw(tag):
+        def middleware(next_ep):
+            async def endpoint(req):
+                calls.append(tag)
+                return await next_ep(req)
+
+            return endpoint
+
+        return middleware
+
+    async def ep(req):
+        calls.append("handler")
+        return Response()
+
+    router.add("GET", "/", ep)
+    router.use(mw("outer"), mw("inner"))
+    run(router.dispatcher()(_req(target="/")))
+    assert calls == ["outer", "inner", "handler"]
+
+
+def test_request_facade():
+    req = Request(
+        "POST",
+        "/users/7/posts?limit=10&tag=a&tag=b",
+        {"Host": "svc.local", "X-Forwarded-Proto": "https", "Content-Type": "application/json"},
+        b'{"title": "hi", "views": 3}',
+        remote_addr="1.2.3.4",
+        path_params={"uid": "7"},
+    )
+    assert req.param("limit") == "10"
+    assert req.params("tag") == ["a", "b"]
+    assert req.param("missing") == ""
+    assert req.path_param("uid") == "7"
+    assert req.host_name() == "https://svc.local"
+    assert req.header("content-TYPE") == "application/json"
+    data = req.bind()
+    assert data == {"title": "hi", "views": 3}
+
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Post:
+        title: str = ""
+        views: int = 0
+
+    post = req.bind(Post)
+    assert post.title == "hi" and post.views == 3
+
+
+def test_envelope_success_and_error():
+    ok = respond({"name": "x"}, None)
+    assert ok.status == 200
+    assert json.loads(ok.body) == {"data": {"name": "x"}}
+
+    err = respond(None, EntityNotFoundError("user", "9"))
+    assert err.status == 404
+    assert json.loads(err.body)["error"]["message"] == "No 'user' found for value '9'"
+
+    unknown = respond(None, RuntimeError("boom"))
+    assert unknown.status == 500
+
+
+def test_raw_and_file_responses():
+    raw = respond(Raw([1, 2, 3]), None)
+    assert json.loads(raw.body) == [1, 2, 3]
+
+    f = respond(File(b"\x00\x01", content_type="image/x-icon"), None)
+    assert f.body == b"\x00\x01"
+    assert f.headers["Content-Type"] == "image/x-icon"
+
+
+def test_stream_response_sse_framing():
+    async def collect():
+        resp = respond(Stream(iter(["tok1", {"t": 2}])), None)
+        chunks = []
+        async for c in resp.stream:
+            chunks.append(c)
+        return chunks
+
+    chunks = run(collect())
+    assert chunks[0] == b"data: tok1\n\n"
+    assert chunks[1] == b'data: {"t": 2}\n\n'

@@ -1,0 +1,94 @@
+"""Inter-service HTTP client tests against a live in-process server.
+
+Parity model: service/new_test.go:35-90 — a test server asserts
+method/path/query/headers server-side (SURVEY.md §4)."""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from gofr_tpu.service import ServiceCallError, new_http_service
+from gofr_tpu.testutil import MockLogger
+
+
+@pytest.fixture
+def echo_server(free_port):
+    port = free_port()
+    seen = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _handle(self):
+            seen["method"] = self.command
+            seen["path"] = self.path
+            seen["headers"] = dict(self.headers.items())
+            length = int(self.headers.get("Content-Length", 0))
+            seen["body"] = self.rfile.read(length) if length else b""
+            status = 500 if self.path.startswith("/fail") else 200
+            payload = json.dumps({"ok": True}).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _handle
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}", seen
+    srv.shutdown()
+
+
+def test_get_with_params_and_correlation(echo_server):
+    base, seen = echo_server
+    logger = MockLogger()
+    svc = new_http_service(base, logger, name="downstream")
+    resp = svc.get("items", params={"limit": 5, "tag": ["a", "b"]})
+    assert resp.status_code == 200
+    assert resp.json() == {"ok": True}
+    assert seen["method"] == "GET"
+    assert seen["path"] == "/items?limit=5&tag=a&tag=b"
+    lower_headers = {k.lower(): v for k, v in seen["headers"].items()}
+    assert "x-correlation-id" in lower_headers
+    assert lower_headers["traceparent"].startswith("00-")
+    assert "downstream" in logger.output
+
+
+def test_post_json_body_and_headers(echo_server):
+    base, seen = echo_server
+    svc = new_http_service(base, MockLogger())
+    svc.post_with_headers("create", None, {"a": 1}, {"X-Api-Key": "k"})
+    assert seen["method"] == "POST"
+    assert json.loads(seen["body"]) == {"a": 1}
+    assert seen["headers"]["Content-Type"] == "application/json"
+    assert seen["headers"]["X-Api-Key"] == "k"
+
+
+def test_5xx_logged_as_error(echo_server):
+    base, _ = echo_server
+    logger = MockLogger()
+    svc = new_http_service(base, logger)
+    resp = svc.get("fail")
+    assert resp.status_code == 500
+    assert '"level": "ERROR"' in logger.output
+
+
+def test_unreachable_service_raises_502():
+    svc = new_http_service("http://127.0.0.1:1", MockLogger(), name="ghost")
+    with pytest.raises(ServiceCallError) as exc:
+        svc.get("x")
+    assert exc.value.status_code == 502
+    assert "ghost" in str(exc.value)
+
+
+def test_health_check(echo_server):
+    base, _ = echo_server
+    svc = new_http_service(base, MockLogger())
+    assert svc.health_check().status == "UP"
+    ghost = new_http_service("http://127.0.0.1:1", MockLogger())
+    assert ghost.health_check().status == "DOWN"
