@@ -128,8 +128,16 @@ class App:
                 raise SystemExit(code)
             return
         self.start()
+        stop = threading.Event()
         try:
-            threading.Event().wait()
+            import signal
+
+            signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        except (ValueError, OSError):
+            pass  # not the main thread; SIGTERM keeps default handling
+        try:
+            stop.wait()
+            self.logger.info("SIGTERM received, shutting down")
         except KeyboardInterrupt:
             self.logger.info("shutting down")
         finally:

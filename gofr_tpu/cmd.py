@@ -116,7 +116,14 @@ def run_cmd(app: Any, args: Optional[list[str]] = None) -> int:
     command = command_string(argv)
     responder = CMDResponder()
     for pattern, handler in app._cmd_routes:
-        if re.fullmatch(pattern, command) or pattern == command:
+        if pattern == command:
+            matched = True
+        else:
+            try:
+                matched = re.fullmatch(pattern, command) is not None
+            except re.error:  # pattern is a plain literal, not a regex
+                matched = False
+        if matched:
             request = CMDRequest(argv)
             ctx = Context(request, app.container)
             with get_tracer().start_span(f"cmd {command or pattern}"):

@@ -253,7 +253,9 @@ class GRPCServer:
                 else:
                     context.abort(code, str(exc))
                 return b""
-            return json.dumps({"data": result}, default=str).encode("utf-8")
+            from gofr_tpu.http.responder import _jsonable
+
+            return json.dumps({"data": result}, default=_jsonable).encode("utf-8")
 
         return unary
 

@@ -68,7 +68,12 @@ class Request:
             raise HTTPError(400, "invalid request body: expected a JSON object")
         if dataclasses.is_dataclass(into) and isinstance(into, type):
             names = {f.name for f in dataclasses.fields(into)}
-            return into(**{k: v for k, v in data.items() if k in names})
+            try:
+                return into(**{k: v for k, v in data.items() if k in names})
+            except TypeError as exc:  # missing required fields is a client error
+                from gofr_tpu.errors import HTTPError
+
+                raise HTTPError(400, f"invalid request body: {exc}") from exc
         if isinstance(into, type):
             obj = into()
             for k, v in data.items():

@@ -119,8 +119,8 @@ class HTTPServer:
                 keep_alive = await self._handle_one(reader, writer, remote)
                 if not keep_alive:
                     break
-        except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.TimeoutError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.TimeoutError):
+            pass  # routine client disconnects (reset, broken pipe, abort)
         except asyncio.LimitOverrunError:
             await self._write_simple(writer, 431, b'{"error":{"message":"headers too large"}}')
         finally:
@@ -233,9 +233,17 @@ class HTTPServer:
                         continue
                     writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
                     await writer.drain()
-            finally:
-                writer.write(b"0\r\n\r\n")
-                await writer.drain()
+            except Exception as exc:
+                # Abort WITHOUT the terminal chunk so the client sees a
+                # truncated chunked body (distinguishable from completion).
+                if self.logger:
+                    self.logger.errorf("response stream aborted: %r", exc)
+                transport = writer.transport
+                if transport is not None:
+                    transport.abort()
+                return
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
         else:
             writer.write(response.body)
             await writer.drain()

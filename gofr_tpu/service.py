@@ -104,6 +104,33 @@ class HTTPService:
     def delete_with_headers(self, path, body, headers) -> ServiceResponse:
         return self._send("DELETE", path, None, body, headers)
 
+    # -- async variants -------------------------------------------------------
+    # The sync methods block; calling them from an ``async def`` handler
+    # would stall the server's event loop. Async handlers must use these.
+    async def async_get(self, path: str, params: Optional[dict] = None) -> ServiceResponse:
+        return await self._offload(self.get, path, params)
+
+    async def async_post(self, path: str, params: Optional[dict] = None, body: Any = None) -> ServiceResponse:
+        return await self._offload(self.post, path, params, body)
+
+    async def async_put(self, path: str, params: Optional[dict] = None, body: Any = None) -> ServiceResponse:
+        return await self._offload(self.put, path, params, body)
+
+    async def async_patch(self, path: str, params: Optional[dict] = None, body: Any = None) -> ServiceResponse:
+        return await self._offload(self.patch, path, params, body)
+
+    async def async_delete(self, path: str, body: Any = None) -> ServiceResponse:
+        return await self._offload(self.delete, path, body)
+
+    @staticmethod
+    async def _offload(fn: Any, *args: Any) -> ServiceResponse:
+        import asyncio
+        import contextvars
+
+        loop = asyncio.get_running_loop()
+        call = contextvars.copy_context().run
+        return await loop.run_in_executor(None, call, fn, *args)
+
     # -- internals (parity: createAndSendRequest, new.go:111-159) ------------
     def _send(
         self,

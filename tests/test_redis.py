@@ -1,0 +1,112 @@
+"""Redis datasource tests against in-process miniredis.
+
+Parity model: redis_test.go:23-51 — miniredis.Run(), command round-trips,
+logged command assertions (SURVEY.md §4)."""
+
+import threading
+import time
+
+import pytest
+
+from gofr_tpu.datasource.miniredis import MiniRedis
+from gofr_tpu.datasource.redis import RedisClient, RedisError, RedisServerError, new_client
+from gofr_tpu.logging import Level
+from gofr_tpu.testutil import MockLogger
+
+
+@pytest.fixture(scope="module")
+def mini():
+    server = MiniRedis().run()
+    yield server
+    server.close()
+
+
+@pytest.fixture
+def client(mini):
+    logger = MockLogger(Level.DEBUG)
+    c = new_client("127.0.0.1", mini.port, logger)
+    c.flushdb()
+    yield c, logger
+    c.close()
+
+
+def test_set_get_roundtrip(client):
+    c, logger = client
+    assert c.set("greeting", "hello") == "OK"
+    assert c.get("greeting") == "hello"
+    assert c.get("missing") is None
+    # logged command with duration (parity: redis_test.go:49-51)
+    assert "SET greeting hello" in logger.output
+    assert "duration_us" in logger.output
+
+
+def test_set_with_expiry(client):
+    c, _ = client
+    c.set("temp", "x", ex=100)
+    assert 0 < c.ttl("temp") <= 100
+    assert c.ttl("no-such-key") == -2
+
+
+def test_incr_del_exists(client):
+    c, _ = client
+    assert c.incr("counter") == 1
+    assert c.incr("counter") == 2
+    assert c.exists("counter") == 1
+    assert c.delete("counter") == 1
+    assert c.exists("counter") == 0
+
+
+def test_hash_and_list_ops(client):
+    c, _ = client
+    assert c.hset("h", "field", "v") == 1
+    assert c.hget("h", "field") == "v"
+    c.lpush("l", "a", "b")
+    assert c.rpop("l") == "a"
+
+
+def test_keys_pattern(client):
+    c, _ = client
+    c.set("user:1", "a")
+    c.set("user:2", "b")
+    c.set("other", "c")
+    assert sorted(c.keys("user:*")) == ["user:1", "user:2"]
+
+
+def test_server_error_keeps_connection(client):
+    c, _ = client
+    c.lpush("alist", "x")
+    with pytest.raises(RedisServerError):
+        c.get("alist")  # WRONGTYPE
+    assert c.ping()  # connection still usable
+
+
+def test_health_check(client, mini):
+    c, _ = client
+    h = c.health_check()
+    assert h.status == "UP"
+    assert h.details["redis_version"] == "7.0.0-mini"
+    assert "latency_us" in h.details
+
+
+def test_connect_failure_raises():
+    with pytest.raises(OSError):
+        RedisClient("127.0.0.1", 1, timeout=0.2)
+
+
+def test_concurrent_clients(client):
+    c, _ = client
+    errors = []
+
+    def work(i):
+        try:
+            c.set(f"k{i}", str(i))
+            assert c.get(f"k{i}") == str(i)
+        except Exception as exc:
+            errors.append(exc)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors

@@ -1,0 +1,122 @@
+"""SQL datasource tests.
+
+Parity model: db_test.go:19-271 — Select scenarios (tags, snake_case,
+unmatched columns), logged queries, tx commit/rollback (SURVEY.md §4)."""
+
+import dataclasses
+import threading
+
+import pytest
+
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.datasource.sql import DB, new_sql, to_snake_case
+from gofr_tpu.logging import Level
+from gofr_tpu.testutil import MockLogger
+
+
+@dataclasses.dataclass
+class User:
+    id: int = 0
+    full_name: str = ""
+    email: str = dataclasses.field(default="", metadata={"db": "mail"})
+
+
+@pytest.fixture
+def db():
+    logger = MockLogger(Level.DEBUG)
+    database = DB(":memory:", logger)
+    database.execute("CREATE TABLE users (id INTEGER PRIMARY KEY, full_name TEXT, mail TEXT, junk TEXT)")
+    database.execute_many(
+        "INSERT INTO users (id, full_name, mail, junk) VALUES (?, ?, ?, ?)",
+        [(1, "Ada Lovelace", "ada@x.io", "z"), (2, "Alan Turing", "alan@x.io", "z")],
+    )
+    yield database, logger
+    database.close()
+
+
+def test_to_snake_case():
+    assert to_snake_case("FullName") == "full_name"
+    assert to_snake_case("ID") == "id"
+    assert to_snake_case("HTTPPort") == "http_port"
+    assert to_snake_case("simple") == "simple"
+
+
+def test_select_into_dataclass(db):
+    database, _ = db
+    users = database.select(User, "SELECT * FROM users ORDER BY id")
+    assert len(users) == 2
+    assert users[0] == User(1, "Ada Lovelace", "ada@x.io")  # db tag mapped mail->email
+    assert users[1].full_name == "Alan Turing"  # snake_case mapping
+
+
+def test_select_one_and_value(db):
+    database, _ = db
+    user = database.select_one(User, "SELECT * FROM users WHERE id = ?", 2)
+    assert user.email == "alan@x.io"
+    assert database.select_one(User, "SELECT * FROM users WHERE id = ?", 99) is None
+    assert database.select_value("SELECT COUNT(*) FROM users") == 2
+    assert database.select_value("SELECT 2+2") == 4
+
+
+def test_exec_returns_rowcount_and_logs(db):
+    database, logger = db
+    n = database.execute("UPDATE users SET junk = ? WHERE id > ?", "y", 0)
+    assert n == 2
+    assert "UPDATE users SET junk" in logger.output
+    assert "duration_us" in logger.output
+
+
+def test_transaction_commit_and_rollback(db):
+    database, logger = db
+    with database.begin() as tx:
+        tx.execute("INSERT INTO users (id, full_name) VALUES (3, 'Grace')")
+    assert database.select_value("SELECT COUNT(*) FROM users") == 3
+
+    with pytest.raises(RuntimeError):
+        with database.begin() as tx:
+            tx.execute("INSERT INTO users (id, full_name) VALUES (4, 'Nope')")
+            raise RuntimeError("abort")
+    assert database.select_value("SELECT COUNT(*) FROM users") == 3  # rolled back
+    assert "ROLLBACK" in logger.output
+
+
+def test_memory_db_shared_across_threads(db):
+    database, _ = db
+    results = []
+
+    def read():
+        results.append(database.select_value("SELECT COUNT(*) FROM users"))
+
+    t = threading.Thread(target=read)
+    t.start()
+    t.join()
+    assert results == [2]
+
+
+def test_select_requires_dataclass(db):
+    database, _ = db
+    with pytest.raises(TypeError):
+        database.select(dict, "SELECT * FROM users")
+
+
+def test_health_check(db):
+    database, _ = db
+    h = database.health_check()
+    assert h.status == "UP"
+    assert "latency_us" in h.details
+
+
+def test_new_sql_dialect_gating(monkeypatch, tmp_path):
+    monkeypatch.setenv("DB_DIALECT", "sqlite")
+    monkeypatch.setenv("DB_NAME", str(tmp_path / "t.db"))
+    database = new_sql(EnvConfig(), MockLogger())
+    database.execute("CREATE TABLE t (x INTEGER)")
+    database.close()
+
+    monkeypatch.setenv("DB_DIALECT", "mysql")
+    with pytest.raises(RuntimeError, match="MySQL driver"):
+        new_sql(EnvConfig(), MockLogger())
+
+    monkeypatch.setenv("DB_DIALECT", "cockroach")
+    with pytest.raises(RuntimeError, match="unsupported"):
+        new_sql(EnvConfig(), MockLogger())
