@@ -1,0 +1,129 @@
+"""End-to-end serving benchmark (driver-run, real TPU).
+
+Boots the framework's HTTP server with the flagship transformer behind the
+dynamic batcher (the BASELINE.md config-3 shape: batched prefill endpoint),
+fires concurrent requests, and prints ONE JSON line:
+
+    {"metric": "p50_ttft_ms", "value": N, "unit": "ms", "vs_baseline": R}
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline is measured
+against the north-star target: p50 TTFT < 200 ms => vs_baseline = 200/p50
+(>1.0 beats the target).
+
+Env overrides: BENCH_MODEL (default "small"), BENCH_CLIENTS, BENCH_REQUESTS,
+BENCH_PROMPT_LEN.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gofr_jax_cache")
+    model = os.environ.get("BENCH_MODEL", "small")
+    clients = int(os.environ.get("BENCH_CLIENTS", "8"))
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "64"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "48"))
+
+    os.environ.update(
+        MODEL_NAME=model,
+        HTTP_PORT=os.environ.get("BENCH_PORT", "18811"),
+        LOG_LEVEL="FATAL",
+        BATCH_MAX_SIZE="8",
+        BATCH_TIMEOUT_MS="3",
+    )
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/gofr_jax_cache")
+    except Exception:
+        pass
+
+    import gofr_tpu
+
+    app = gofr_tpu.new()
+
+    async def infer(ctx):
+        payload = ctx.bind()
+        state = await ctx.tpu.infer_async(payload["tokens"])
+        import numpy as np
+
+        return {"next_token": int(np.argmax(state["logits"]))}
+
+    app.post("/infer", infer)
+    app.start()
+    base = f"http://127.0.0.1:{app.http_port}"
+
+    vocab = 200
+    body = json.dumps(
+        {"tokens": [(7 * i) % vocab + 1 for i in range(prompt_len)]}
+    ).encode()
+
+    def fire() -> float:
+        req = urllib.request.Request(
+            base + "/infer", data=body, headers={"Content-Type": "application/json"}
+        )
+        start = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            resp.read()
+        return time.perf_counter() - start
+
+    # warmup: compile prefill bucket + fill caches
+    for _ in range(3):
+        fire()
+
+    clients = max(1, min(clients, n_requests))
+    latencies: list[float] = []
+    lock = threading.Lock()
+    per_client = max(1, n_requests // clients)
+    wall_start = time.perf_counter()
+
+    def worker() -> None:
+        local = []
+        for _ in range(per_client):
+            local.append(fire())
+        with lock:
+            latencies.extend(local)
+
+    threads = [threading.Thread(target=worker) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - wall_start
+
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2] * 1000
+    p99 = latencies[min(len(latencies) - 1, int(len(latencies) * 0.99))] * 1000
+    rps = len(latencies) / wall
+
+    app.shutdown()
+    target_ms = 200.0  # north-star p50 TTFT target (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": "p50_ttft_ms",
+                "value": round(p50, 2),
+                "unit": "ms",
+                "vs_baseline": round(target_ms / max(p50, 1e-6), 3),
+                "p99_ttft_ms": round(p99, 2),
+                "req_per_sec": round(rps, 2),
+                "model": model,
+                "prompt_len": prompt_len,
+                "clients": clients,
+                "requests": len(latencies),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

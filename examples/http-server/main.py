@@ -45,6 +45,66 @@ def trace_handler(ctx):
     return "ok"
 
 
+async def infer_handler(ctx):
+    """Dynamic-batched forward pass (north star: GET/POST /infer)."""
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    payload = ctx.bind() if ctx.request.body else {"x": [0.0] * 64}
+    if not isinstance(payload, dict):
+        raise HTTPError(400, 'request body must be a JSON object like {"tokens": [...]}')
+    data = payload.get("x") or payload.get("tokens")
+    if not data:
+        raise HTTPError(400, 'missing "x" (features) or "tokens" (ids) in body')
+    result = await ctx.tpu.infer_async(data)
+    import numpy as np
+
+    if isinstance(result, dict):  # transformer prefill state -> next token
+        return {"next_token": int(np.argmax(result["logits"]))}
+    return {"y": np.asarray(result).tolist()}
+
+
+def generate_handler(ctx):
+    """Greedy generation; ?stream=true streams tokens over SSE."""
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    body = ctx.bind() if ctx.request.body else {}
+    if not isinstance(body, dict):
+        raise HTTPError(400, 'request body must be a JSON object like {"tokens": [...]}')
+    tokens = body.get("tokens") or [1, 2, 3]
+    max_new = int(body.get("max_new_tokens") or 16)
+    if ctx.param("stream") == "true":
+        from gofr_tpu.http.response import Stream
+
+        def events():
+            import queue as q
+
+            out: "q.Queue" = q.Queue()
+            done = object()
+            failure: list[BaseException] = []
+
+            def run():
+                try:
+                    ctx.tpu.generate(tokens, max_new, on_token=out.put)
+                except BaseException as exc:  # surfaced as an SSE error event
+                    failure.append(exc)
+                finally:
+                    out.put(done)
+
+            import threading
+
+            threading.Thread(target=run, daemon=True).start()
+            while True:
+                item = out.get()
+                if item is done:
+                    break
+                yield {"token": item}
+            if failure:
+                yield {"error": str(failure[0])}
+
+        return Stream(events())
+    return {"tokens": ctx.tpu.generate(tokens, max_new)}
+
+
 def main():
     app = gofr_tpu.new(configs_dir=os.path.join(os.path.dirname(__file__), "configs"))
     app.add_http_service("anotherService", f"http://localhost:{app.http_port}")
@@ -53,6 +113,8 @@ def main():
     app.get("/redis", redis_handler)
     app.get("/mysql", mysql_handler)
     app.get("/trace", trace_handler)
+    app.post("/infer", infer_handler)
+    app.post("/generate", generate_handler)
     app.run()
 
 

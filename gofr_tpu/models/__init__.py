@@ -1,0 +1,29 @@
+"""Model family served by the TPU datasource.
+
+Pure-JAX functional models: each module is an ``init(key, cfg) -> params``
+pytree builder plus jit-compatible apply functions. No framework-level
+Module classes — parameters are plain nested dicts, which shard cleanly
+under pjit (gofr_tpu.parallel builds PartitionSpec trees matching these
+dicts by name).
+
+Families: MLP (BASELINE config 1), BERT-style encoder for embeddings
+(config 2), Llama-family decoder for generation (configs 3-4).
+"""
+
+from gofr_tpu.models.bert import BertConfig, bert_embed, init_bert
+from gofr_tpu.models.mlp import MLPConfig, init_mlp, mlp_forward
+from gofr_tpu.models.transformer import (
+    TransformerConfig,
+    decode_step,
+    init_cache,
+    init_transformer,
+    prefill,
+    transformer_forward,
+)
+
+__all__ = [
+    "MLPConfig", "init_mlp", "mlp_forward",
+    "BertConfig", "init_bert", "bert_embed",
+    "TransformerConfig", "init_transformer", "transformer_forward",
+    "prefill", "decode_step", "init_cache",
+]

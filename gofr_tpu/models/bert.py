@@ -1,0 +1,95 @@
+"""BERT-style bidirectional encoder for embedding serving (BASELINE.json
+configs[2]: unary RPC serving BERT-base embeddings).
+
+Pre-LN encoder blocks with learned position embeddings, GELU FFN, mean-pool
+over valid tokens -> L2-normalized sentence embedding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.models.quant import mm as _mm
+from gofr_tpu.ops.attention import attention
+from gofr_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    max_seq: int = 512
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def init_bert(key: jax.Array, cfg: BertConfig) -> dict:
+    keys = iter(jax.random.split(key, cfg.n_layers * 6 + 3))
+
+    def dense(k: jax.Array, shape: tuple[int, ...], fan_in: int) -> jnp.ndarray:
+        return (jax.random.truncated_normal(k, -3, 3, shape) * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    params: dict[str, Any] = {
+        "tok_embed": dense(next(keys), (cfg.vocab_size, cfg.dim), cfg.dim),
+        "pos_embed": dense(next(keys), (cfg.max_seq, cfg.dim), cfg.dim),
+        "norm_f_w": jnp.ones((cfg.dim,), cfg.dtype),
+        "norm_f_b": jnp.zeros((cfg.dim,), cfg.dtype),
+    }
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append(
+            {
+                "attn_norm_w": jnp.ones((cfg.dim,), cfg.dtype),
+                "attn_norm_b": jnp.zeros((cfg.dim,), cfg.dtype),
+                "wqkv": dense(next(keys), (cfg.dim, 3 * cfg.dim), cfg.dim),
+                "wo": dense(next(keys), (cfg.dim, cfg.dim), cfg.dim),
+                "mlp_norm_w": jnp.ones((cfg.dim,), cfg.dtype),
+                "mlp_norm_b": jnp.zeros((cfg.dim,), cfg.dtype),
+                "w_in": dense(next(keys), (cfg.dim, cfg.hidden_dim), cfg.dim),
+                "b_in": jnp.zeros((cfg.hidden_dim,), cfg.dtype),
+                "w_out": dense(next(keys), (cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
+                "b_out": jnp.zeros((cfg.dim,), cfg.dtype),
+            }
+        )
+    params["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return params
+
+
+def bert_embed(
+    params: dict, tokens: jnp.ndarray, attn_mask: jnp.ndarray, cfg: BertConfig
+) -> jnp.ndarray:
+    """``tokens`` [B, S] ids, ``attn_mask`` [B, S] 1=valid. Returns
+    L2-normalized [B, dim] float32 embeddings."""
+    b, s = tokens.shape
+    x = params["tok_embed"][tokens] + params["pos_embed"][:s][None]
+    key_mask = attn_mask.astype(bool)
+
+    def body(carry, p):
+        h = layer_norm(carry, p["attn_norm_w"], p["attn_norm_b"], cfg.norm_eps)
+        qkv = _mm(h, p["wqkv"]).reshape(b, s, 3, cfg.n_heads, cfg.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = attention(q, k, v, causal=False, mask=key_mask, impl=cfg.attn_impl)
+        carry = carry + _mm(attn.reshape(b, s, cfg.dim), p["wo"])
+        h = layer_norm(carry, p["mlp_norm_w"], p["mlp_norm_b"], cfg.norm_eps)
+        h = _mm(jax.nn.gelu(_mm(h, p["w_in"]) + p["b_in"]), p["w_out"]) + p["b_out"]
+        return carry + h, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(x, params["norm_f_w"], params["norm_f_b"], cfg.norm_eps)
+    # masked mean pool in f32
+    xf = x.astype(jnp.float32)
+    weights = attn_mask.astype(jnp.float32)[..., None]
+    pooled = (xf * weights).sum(axis=1) / jnp.maximum(weights.sum(axis=1), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)

@@ -1,0 +1,35 @@
+"""Tiny MLP — the CPU-PJRT smoke model (BASELINE.json configs[1]: 2-layer
+MLP behind GET /infer)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 64
+    hidden_dim: int = 256
+    out_dim: int = 16
+    dtype: Any = jnp.float32
+
+
+def init_mlp(key: jax.Array, cfg: MLPConfig) -> dict:
+    k1, k2 = jax.random.split(key)
+    scale1 = (2.0 / cfg.in_dim) ** 0.5
+    scale2 = (2.0 / cfg.hidden_dim) ** 0.5
+    return {
+        "w1": (jax.random.normal(k1, (cfg.in_dim, cfg.hidden_dim)) * scale1).astype(cfg.dtype),
+        "b1": jnp.zeros((cfg.hidden_dim,), cfg.dtype),
+        "w2": (jax.random.normal(k2, (cfg.hidden_dim, cfg.out_dim)) * scale2).astype(cfg.dtype),
+        "b2": jnp.zeros((cfg.out_dim,), cfg.dtype),
+    }
+
+
+def mlp_forward(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]

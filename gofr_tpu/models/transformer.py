@@ -1,0 +1,256 @@
+"""Llama-family decoder-only transformer: RMSNorm, RoPE, GQA attention,
+SwiGLU — pure JAX, static shapes, KV-cached ragged-batch decode.
+
+TPU-first design notes:
+- all shapes static under jit: prefill is bucketed by the serving layer
+  (per-request true lengths passed separately), decode is a fixed [B, 1]
+  step over a preallocated cache;
+- attention runs through gofr_tpu.ops.attention (Pallas flash on TPU);
+- weights default to bfloat16 with f32 norm/softmax accumulation; int8
+  weight-only checkpoints route through gofr_tpu.models.quant.mm;
+- params are plain nested dicts so pjit PartitionSpec trees mirror them
+  (gofr_tpu.parallel.sharding names the same keys);
+- the cache is ragged-batch: per-request lengths [B], per-batch
+  dynamic_update_slice via vmap, so one compiled step serves requests at
+  different positions (continuous-batching-ready);
+- RoPE tables are built once per config (lru_cache) and embedded as jit
+  constants — no trig on the decode hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.models.quant import mm as _mm
+from gofr_tpu.ops.attention import attention
+from gofr_tpu.ops.norms import rms_norm
+from gofr_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_freqs(head_dim: int, max_seq: int, theta: float):
+    """Concrete per-config RoPE table, embedded as a constant in each jitted
+    forward — no trig on the decode hot path.
+
+    Computed AND cached as numpy: any jax array (even jnp.asarray of a
+    constant) created during a jit trace is a tracer, and caching a tracer
+    leaks it into later traces. A numpy array is concrete everywhere; the
+    use sites convert with jnp.asarray inside their own trace."""
+    import numpy as np
+
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    freqs = np.outer(np.arange(max_seq, dtype=np.float32), inv_freq)
+    return np.stack([np.cos(freqs), np.sin(freqs)], axis=-1).astype(np.float32)
+
+
+def init_transformer(key: jax.Array, cfg: TransformerConfig) -> dict:
+    """Weight layout mirrors Llama-3 shapes; initialization is scaled
+    truncated-normal (serving weights come from checkpoints; init exists for
+    tests and training-from-scratch)."""
+    n_keys = cfg.n_layers * 7 + 3
+    keys = iter(jax.random.split(key, n_keys))
+
+    def dense(k: jax.Array, shape: tuple[int, ...], fan_in: int) -> jnp.ndarray:
+        return (jax.random.truncated_normal(k, -3, 3, shape) * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    params: dict[str, Any] = {
+        "embed": dense(next(keys), (cfg.vocab_size, cfg.dim), cfg.dim),
+        "norm_f": jnp.ones((cfg.dim,), cfg.dtype),
+        "lm_head": dense(next(keys), (cfg.dim, cfg.vocab_size), cfg.dim),
+    }
+    layers = []
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    for _ in range(cfg.n_layers):
+        layers.append(
+            {
+                "attn_norm": jnp.ones((cfg.dim,), cfg.dtype),
+                "wq": dense(next(keys), (cfg.dim, cfg.dim), cfg.dim),
+                "wk": dense(next(keys), (cfg.dim, kv_dim), cfg.dim),
+                "wv": dense(next(keys), (cfg.dim, kv_dim), cfg.dim),
+                "wo": dense(next(keys), (cfg.dim, cfg.dim), cfg.dim),
+                "mlp_norm": jnp.ones((cfg.dim,), cfg.dtype),
+                "w_gate": dense(next(keys), (cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_up": dense(next(keys), (cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_down": dense(next(keys), (cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
+            }
+        )
+    # stack layers into one pytree level: [n_layers, ...] arrays, scanned in
+    # the forward — one compiled layer body instead of n_layers copies
+    params["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return params
+
+
+def _block(
+    cfg: TransformerConfig,
+    p: dict,
+    x: jnp.ndarray,
+    freqs: jnp.ndarray,
+    positions: jnp.ndarray,
+    kv_cache: Optional[tuple[jnp.ndarray, jnp.ndarray]] = None,
+    starts: Optional[jnp.ndarray] = None,
+    key_mask: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray]]:
+    """One decoder block — the single implementation shared by the
+    no-cache forward and the cached prefill/decode path.
+
+    Without cache: attention over this call's keys, returns (out, (k, v)).
+    With cache: merges k/v into the per-batch cache at ``starts`` [B] and
+    attends the full cache window; returns (out, (k_cache, v_cache)).
+    """
+    b, s, _ = x.shape
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    q = _mm(h, p["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = _mm(h, p["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = _mm(h, p["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, freqs, positions)
+    k = apply_rope(k, freqs, positions)
+
+    if kv_cache is None:
+        attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        merged = (k, v)
+    else:
+        k_cache, v_cache = kv_cache
+
+        def merge(cache_b, new_b, start_b):
+            return jax.lax.dynamic_update_slice(cache_b, new_b, (start_b, 0, 0))
+
+        k_cache = jax.vmap(merge)(k_cache, k.astype(k_cache.dtype), starts)
+        v_cache = jax.vmap(merge)(v_cache, v.astype(v_cache.dtype), starts)
+        attn = attention(
+            q, k_cache, v_cache, causal=True, q_offset=starts,
+            mask=key_mask, impl=cfg.attn_impl,
+        )
+        merged = (k_cache, v_cache)
+
+    x = x + _mm(attn.reshape(b, s, cfg.dim), p["wo"])
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(_mm(h, p["w_gate"])) * _mm(h, p["w_up"])
+    x = x + _mm(gated, p["w_down"])
+    return x, merged
+
+
+def transformer_forward(
+    params: dict, tokens: jnp.ndarray, cfg: TransformerConfig
+) -> jnp.ndarray:
+    """Full-sequence forward -> logits [B, S, V] (training / no-cache
+    scoring). Layers run under lax.scan over stacked weights."""
+    b, s = tokens.shape
+    freqs = jnp.asarray(_cached_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta))
+    positions = jnp.arange(s)
+    x = params["embed"][tokens]
+
+    def body(carry, layer_params):
+        y, _ = _block(cfg, layer_params, carry, freqs, positions)
+        return y, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["norm_f"], cfg.norm_eps)
+    return _mm(x, params["lm_head"]).astype(jnp.float32)
+
+
+# -- KV-cached ragged-batch serving path -------------------------------------
+
+def init_cache(cfg: TransformerConfig, batch: int, max_seq: int | None = None) -> dict:
+    """Cache layout [n_layers, B, max_seq, n_kv_heads, head_dim] with
+    per-request ``lengths`` [B]. ``max_seq`` must not exceed cfg.max_seq
+    (the RoPE table bounds valid positions)."""
+    max_seq = max_seq or cfg.max_seq
+    if max_seq > cfg.max_seq:
+        raise ValueError(
+            f"cache max_seq {max_seq} exceeds config max_seq {cfg.max_seq} "
+            "(RoPE table bound)"
+        )
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "lengths": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def _forward_with_cache(
+    params: dict,
+    tokens: jnp.ndarray,
+    cache: dict,
+    cfg: TransformerConfig,
+    lengths: Optional[jnp.ndarray],
+) -> tuple[jnp.ndarray, dict]:
+    """Run ``tokens`` [B, S] starting at per-request ``cache['lengths']``.
+    ``lengths`` [B] gives the true (un-padded) token count of this call per
+    request (defaults to S). Returns logits at each request's final real
+    position and the updated cache."""
+    b, s = tokens.shape
+    max_seq = cache["k"].shape[2]
+    starts = cache["lengths"]  # [B]
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
+    freqs = jnp.asarray(_cached_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta))
+    positions = starts[:, None] + jnp.arange(s)[None, :]  # [B, S]
+    x = params["embed"][tokens]
+
+    # keys valid for query j of request b: cache positions <= starts_b + j
+    # (causal handles the per-query bound; this mask bounds the written
+    # region so never-written cache slots are excluded)
+    valid = jnp.arange(max_seq)[None, :] < (starts + s)[:, None]  # [B, max_seq]
+
+    def body(carry, inputs):
+        layer_params, k_cache, v_cache = inputs
+        y, (k_cache, v_cache) = _block(
+            cfg, layer_params, carry, freqs, positions,
+            kv_cache=(k_cache, v_cache), starts=starts, key_mask=valid,
+        )
+        return y, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["norm_f"], cfg.norm_eps)
+    # gather each request's last REAL position (pad-aware bucketed prefill)
+    last_idx = jnp.clip(lengths - 1, 0, s - 1)  # [B]
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = _mm(x_last, params["lm_head"]).astype(jnp.float32)
+    new_cache = {"k": k_new, "v": v_new, "lengths": starts + lengths}
+    return logits, new_cache
+
+
+def prefill(
+    params: dict,
+    tokens: jnp.ndarray,
+    cache: dict,
+    cfg: TransformerConfig,
+    lengths: Optional[jnp.ndarray] = None,
+) -> tuple[jnp.ndarray, dict]:
+    """Process a (possibly padded) prompt bucket [B, S]; ``lengths`` [B] are
+    true prompt lengths. Returns next-token logits [B, V] + cache."""
+    return _forward_with_cache(params, tokens, cache, cfg, lengths)
+
+
+def decode_step(
+    params: dict, token: jnp.ndarray, cache: dict, cfg: TransformerConfig
+) -> tuple[jnp.ndarray, dict]:
+    """One autoregressive step: ``token`` [B, 1] -> logits [B, V] + cache."""
+    return _forward_with_cache(params, token, cache, cfg, None)

@@ -1,0 +1,14 @@
+"""TPU-first compute ops: norms, rotary embeddings, attention.
+
+The reference framework has no compute layer (SURVEY.md §0: zero ML
+components); these ops exist for the TPU-native capability — models compiled
+with jit/pjit and served through the TPU datasource. Each op has a pure-XLA
+reference implementation; the attention hot op additionally has a Pallas
+flash kernel used automatically on TPU (``gofr_tpu.ops.flash_attention``).
+"""
+
+from gofr_tpu.ops.attention import attention
+from gofr_tpu.ops.norms import layer_norm, rms_norm
+from gofr_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = ["attention", "rms_norm", "layer_norm", "apply_rope", "rope_frequencies"]

@@ -1,0 +1,96 @@
+"""Multi-head / grouped-query attention with selectable implementation.
+
+- ``impl="xla"``: pure-jnp reference (softmax in f32, grouped einsum so GQA
+  never materializes repeated KV heads).
+- ``impl="pallas"``: Pallas TPU flash-attention kernel (gofr_tpu.ops.flash).
+- ``impl="auto"``: pallas on TPU when shapes are tile-friendly, else XLA.
+
+Layouts: q [B, Sq, Hq, D]; k, v [B, Skv, Hkv, D]; Hq % Hkv == 0.
+``q_offset`` positions the query block absolutely (decode: cache length).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float(-1e30)
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    q_offset: int | jnp.ndarray = 0,
+    mask: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    if impl == "auto":
+        # the flash kernel has no padding-mask support yet; masked calls
+        # must stay on the XLA path rather than silently dropping the mask
+        impl = "pallas" if (mask is None and _pallas_ok(q, k)) else "xla"
+    if impl == "pallas":
+        if mask is not None:
+            raise NotImplementedError("pallas flash attention does not support mask=")
+        from gofr_tpu.ops.flash import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, q_offset=q_offset, scale=scale)
+    return _xla_attention(q, k, v, causal, q_offset, mask, scale)
+
+
+def _pallas_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
+    if jax.default_backend() not in ("tpu",):
+        return False
+    b, sq, hq, d = q.shape
+    skv = k.shape[1]
+    # flash kernel wants lane-aligned head_dim and enough rows to tile
+    return d % 128 == 0 and sq >= 8 and skv % 128 == 0
+
+
+def _xla_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool,
+    q_offset: int | jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    scale: Optional[float],
+) -> jnp.ndarray:
+    b, sq, hq, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    groups = hq // hkv
+    if scale is None:
+        scale = d ** -0.5
+
+    qg = q.reshape(b, sq, hkv, groups, d)
+    # [b, hkv, groups, sq, skv]; accumulate in f32 for softmax stability
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+
+    if causal:
+        k_pos = jnp.arange(skv)
+        offset = jnp.asarray(q_offset)
+        if offset.ndim == 0:
+            q_pos = offset + jnp.arange(sq)  # [sq]
+            causal_mask = (k_pos[None, :] <= q_pos[:, None])[None, None, None]
+        else:
+            # per-batch offsets [b]: ragged decode positions
+            q_pos = offset.reshape(-1, 1) + jnp.arange(sq)[None, :]  # [b, sq]
+            causal_mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None, None]
+        logits = jnp.where(causal_mask, logits, _NEG_INF)
+    if mask is not None:
+        # mask: [b, skv] key-validity (padding) or [b, sq, skv]
+        if mask.ndim == 2:
+            m = mask[:, None, None, None, :]
+        else:
+            m = mask[:, None, None, :, :]
+        logits = jnp.where(m, logits, _NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(b, sq, hq, d)

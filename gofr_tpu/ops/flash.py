@@ -1,0 +1,22 @@
+"""Pallas TPU flash attention. Placeholder dispatching to the XLA reference
+until the kernel lands (task: pallas flash kernel); the public signature is
+stable so callers never change."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    q_offset: int | jnp.ndarray = 0,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    from gofr_tpu.ops.attention import _xla_attention
+
+    return _xla_attention(q, k, v, causal, q_offset, None, scale)

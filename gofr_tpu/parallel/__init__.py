@@ -1,0 +1,23 @@
+"""Parallelism: device meshes, parameter sharding rules, and collectives.
+
+The reference has zero distributed components (SURVEY.md §2 "parallelism
+strategies: absent") — scale is Kubernetes replicas. The TPU build makes
+parallelism first-class the XLA way: a named Mesh (dp/fsdp/sp/tp axes),
+NamedSharding PartitionSpec trees over the model's param dicts, and jit —
+GSPMD inserts the collectives (psum/all-gather/reduce-scatter) over ICI.
+Host-to-host coordination rides the framework's own gRPC/HTTP service layer
+over DCN (SURVEY.md §2 "distributed communication backend").
+"""
+
+from gofr_tpu.parallel.mesh import axis_size, make_mesh, mesh_shape_for
+from gofr_tpu.parallel.sharding import (
+    batch_spec,
+    cache_specs,
+    param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "make_mesh", "mesh_shape_for", "axis_size",
+    "param_specs", "batch_spec", "cache_specs", "shard_params",
+]

@@ -1,0 +1,105 @@
+"""PartitionSpec rules for the model families.
+
+Name-based rules over the plain-dict param trees (the reason models keep
+params as dicts, models/__init__.py): given a param tree, produce a
+matching tree of jax.sharding.PartitionSpec.
+
+Transformer layout (stacked layer weights have a leading n_layers axis that
+is never sharded):
+
+| weight              | shape              | spec                      |
+|---------------------|--------------------|---------------------------|
+| embed               | [V, D]             | P('tp', 'fsdp')           |
+| lm_head             | [D, V]             | P('fsdp', 'tp')           |
+| wq / wk / wv        | [L, D, H*hd]       | P(None, 'fsdp', 'tp')     |
+| wo                  | [L, D, D]          | P(None, 'tp', 'fsdp')     |
+| w_gate / w_up       | [L, D, F]          | P(None, 'fsdp', 'tp')     |
+| w_down              | [L, F, D]          | P(None, 'tp', 'fsdp')     |
+| norms / biases      | [...]              | replicated                |
+
+This is the Megatron pattern: column-parallel in-projections, row-parallel
+out-projections — XLA inserts the psum on the row-parallel output. ``fsdp``
+shards the other matmul dimension (ZeRO-3); gradients reduce-scatter over
+``fsdp`` and all-reduce over ``dp`` automatically under jit.
+
+Int8-packed weights ({"q", "scale"}) shard like the underlying weight
+(scale rows are tiny and follow the output axis).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# name -> (spec for plain 2-D [in, out], stacked 3-D gets None prepended)
+_COL_PARALLEL = {"wq", "wk", "wv", "w_gate", "w_up", "w_in", "wqkv"}  # out dim -> tp
+_ROW_PARALLEL = {"wo", "w_down", "w_out"}  # in dim -> tp
+
+
+def _spec_for(name: str, ndim: int) -> P:
+    if name == "embed" or name == "tok_embed":
+        # vocab axis replicated: token gather over a vocab-sharded table
+        # is ambiguous for GSPMD (would need collective gather); hidden
+        # axis over tp keeps activations sharded from the start
+        return P(None, "tp")
+    if name == "lm_head":
+        return P("fsdp", "tp")
+    if name == "pos_embed":
+        return P(None, "fsdp")
+    if name in _COL_PARALLEL:
+        base = ("fsdp", "tp")
+    elif name in _ROW_PARALLEL:
+        base = ("tp", "fsdp")
+    else:  # norms, biases, scalars: replicate
+        return P()
+    pad = (None,) * (ndim - 2)
+    return P(*pad, *base)
+
+
+def param_specs(params: Any, _name: str = "") -> Any:
+    """Mirror a param tree with PartitionSpecs (name-based rules)."""
+
+    def walk(tree: Any, name: str) -> Any:
+        if isinstance(tree, dict):
+            if set(tree) == {"q", "scale"}:  # int8-packed leaf pair
+                q_spec = _spec_for(name, tree["q"].ndim)
+                # scale is [..., 1, out]; shard only the out axis like q
+                tail = q_spec[-1] if len(q_spec) > 0 else None
+                scale_pad = (None,) * (tree["scale"].ndim - 1)
+                return {"q": q_spec, "scale": P(*scale_pad, tail)}
+            return {k: walk(v, k) for k, v in tree.items()}
+        return _spec_for(name, getattr(tree, "ndim", 0))
+
+    return walk(params, _name)
+
+
+def batch_spec(sp: bool = False) -> P:
+    """Token batches [B, S]: batch over dp(+fsdp), optionally sequence over
+    sp (ring attention path)."""
+    return P(("dp", "fsdp"), "sp" if sp else None)
+
+
+def cache_specs(cache: Any) -> Any:
+    """KV cache [L, B, S, Hkv, hd]: batch over dp(+fsdp), kv heads over tp."""
+    return {
+        "k": P(None, ("dp", "fsdp"), None, "tp", None),
+        "v": P(None, ("dp", "fsdp"), None, "tp", None),
+        "lengths": P(("dp", "fsdp")),
+    }
+
+
+def shard_params(params: Any, mesh: Mesh, specs: Optional[Any] = None) -> Any:
+    """Place a param tree onto the mesh with NamedShardings."""
+    if specs is None:
+        specs = param_specs(params)
+
+    def walk(p: Any, s: Any) -> Any:
+        # explicit recursion: PartitionSpec is itself a tuple, so a generic
+        # tree_map over the spec tree would descend INTO the specs
+        if isinstance(p, dict):
+            return {k: walk(p[k], s[k]) for k in p}
+        return jax.device_put(p, NamedSharding(mesh, s))
+
+    return walk(params, specs)

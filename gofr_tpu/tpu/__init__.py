@@ -1,0 +1,17 @@
+"""TPU inference datasource — the framework's flagship addition.
+
+Parity role: this is the component BASELINE.json's north star adds to the
+GoFr capability set — TPU as a first-class datasource wired by the container
+from TPU_*/MODEL_* config keys, reached from handlers via ``ctx.tpu``, with
+the same degraded-startup, health-check, query-logging, and metrics
+treatment the reference gives Redis and SQL (SURVEY.md §2 #16-18).
+
+Where the reference's north star wraps the PJRT C API, this build sits
+directly on JAX's runtime (jaxlib IS the PJRT client): models are jitted
+(AOT-compiled) JAX functions, device buffers are jax.Arrays, and execution
+flows through a deadline-based dynamic batcher.
+"""
+
+from gofr_tpu.tpu.device import TPUDevice, TPULog, new_device
+
+__all__ = ["TPUDevice", "TPULog", "new_device"]

@@ -1,0 +1,189 @@
+"""Deadline-based dynamic batcher in front of device execution.
+
+SURVEY.md §7 hard part (b): dynamic batching without destroying p50 TTFT.
+Design:
+
+- requests enqueue (payload, Future) on a bounded queue; overflow sheds load
+  with 429 instead of growing latency unboundedly;
+- a dedicated worker thread takes the first request, then drains more until
+  ``max_batch`` or ``timeout_ms`` past the FIRST request's arrival —
+  the first request never waits longer than the deadline;
+- batches pad the batch dimension to the next power of two (bounded set of
+  compiled shapes), excess rows are masked out on split;
+- works from sync handlers (Future.result) and async handlers
+  (asyncio.wrap_future) alike — no event-loop coupling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from gofr_tpu.errors import TooManyRequestsError
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class _Item:
+    __slots__ = ("payload", "future", "arrival")
+
+    def __init__(self, payload: Any):
+        self.payload = payload
+        self.future: Future = Future()
+        self.arrival = time.perf_counter()
+
+
+class DynamicBatcher:
+    """Batches ``run_batch(list_of_payloads) -> list_of_results`` calls.
+
+    ``run_batch`` receives between 1 and ``max_batch`` payloads and must
+    return one result per payload (it handles padding internally so it can
+    exploit pow2 bucketing).
+    """
+
+    def __init__(
+        self,
+        run_batch: Callable[[list[Any]], Sequence[Any]],
+        max_batch: int = 8,
+        timeout_ms: float = 5.0,
+        max_queue: int = 256,
+        metrics: Any = None,
+        name: str = "default",
+        pipeline_depth: int = 2,
+    ):
+        self.run_batch = run_batch
+        self.max_batch = max_batch
+        self.timeout_s = timeout_ms / 1000.0
+        # pipeline_depth > 1 overlaps device execute of batch N+1 with the
+        # host-transfer/completion of batch N — essential when the device
+        # link has high round-trip latency (tunneled PJRT: ~65ms/sync)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._dispatch_pool = ThreadPoolExecutor(
+            max_workers=max(1, pipeline_depth), thread_name_prefix=f"gofr-dispatch-{name}"
+        )
+        self._queue: "queue.Queue[Optional[_Item]]" = queue.Queue(maxsize=max_queue)
+        self._closed = False
+        if metrics is not None:
+            self._batch_hist = metrics.histogram(
+                "gofr_tpu_batch_size", "dispatched batch sizes",
+                labels=("model",), buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+            )
+            self._queue_gauge = metrics.gauge(
+                "gofr_tpu_queue_depth", "requests waiting for a batch", labels=("model",)
+            )
+            self._wait_hist = metrics.histogram(
+                "gofr_tpu_queue_wait_seconds", "time from enqueue to dispatch",
+                labels=("model",),
+            )
+        else:
+            self._batch_hist = self._queue_gauge = self._wait_hist = None
+        self.name = name
+        self._thread = threading.Thread(target=self._run, daemon=True, name=f"gofr-batcher-{name}")
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, payload: Any) -> Future:
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        item = _Item(payload)
+        try:
+            self._queue.put_nowait(item)
+        except queue.Full:
+            raise TooManyRequestsError("inference queue is full") from None
+        if self._queue_gauge:
+            self._queue_gauge.set(self._queue.qsize(), model=self.name)
+        return item.future
+
+    def infer(self, payload: Any, timeout: float = 60.0) -> Any:
+        """Blocking call for sync handlers."""
+        return self.submit(payload).result(timeout=timeout)
+
+    async def infer_async(self, payload: Any) -> Any:
+        """Awaitable call for async handlers."""
+        return await asyncio.wrap_future(self.submit(payload))
+
+    # -- worker --------------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            try:
+                first = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                if self._closed:
+                    return
+                continue
+            if first is None:
+                return
+            batch = [first]
+            deadline = first.arrival + self.timeout_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    item = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if item is None:
+                    self._dispatch_pool.submit(self._dispatch, batch)
+                    return
+                batch.append(item)
+            self._dispatch_pool.submit(self._dispatch, batch)
+
+    def _dispatch(self, batch: list[_Item]) -> None:
+        now = time.perf_counter()
+        if self._batch_hist:
+            self._batch_hist.observe(len(batch), model=self.name)
+            self._queue_gauge.set(self._queue.qsize(), model=self.name)
+            for item in batch:
+                self._wait_hist.observe(now - item.arrival, model=self.name)
+        try:
+            results = self.run_batch([item.payload for item in batch])
+        except Exception as exc:
+            for item in batch:
+                if not item.future.cancelled():
+                    item.future.set_exception(exc)
+            return
+        for item, result in zip(batch, results):
+            if not item.future.cancelled():
+                item.future.set_result(result)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass
+        self._thread.join(timeout=2.0)
+        # fail anything still queued fast instead of letting blocking
+        # callers sleep out their full timeout
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and not item.future.done():
+                item.future.set_exception(RuntimeError("batcher closed"))
+        self._dispatch_pool.shutdown(wait=False)
+
+
+def pad_rows(rows: list[np.ndarray], target: int) -> np.ndarray:
+    """Stack [n, ...] rows and pad the batch dim to ``target`` by repeating
+    the last row (repeats keep shapes identical to real work, so padded and
+    unpadded batches hit the same compiled executable)."""
+    stacked = np.stack(rows)
+    if len(rows) < target:
+        pad = np.repeat(stacked[-1:], target - len(rows), axis=0)
+        stacked = np.concatenate([stacked, pad], axis=0)
+    return stacked

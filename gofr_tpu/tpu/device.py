@@ -1,0 +1,467 @@
+"""TPU device datasource: model loading, compiled entry points, dynamic
+batching, health, query logging, metrics.
+
+Config keys (SURVEY.md §2 #22 TPU-native additions):
+- ``MODEL_NAME``: mlp | bert-tiny | bert-base | tiny | small | llama3-8b |
+  llama3-70b (transformer names from gofr_tpu.models.llama.CONFIGS)
+- ``MODEL_PATH``: optional orbax checkpoint dir (absent -> seeded init)
+- ``MODEL_QUANT``: "int8" for weight-only quantized serving
+- ``BATCH_MAX_SIZE`` / ``BATCH_TIMEOUT_MS``: batcher shape
+- ``TPU_ENABLED``: force the datasource on without MODEL_NAME
+
+The datasource receives the container treatment the reference gives Redis
+and SQL: non-fatal degraded startup (container.py), ``health_check`` with
+device liveness + memory stats, typed TPULog entries, Prometheus metrics
+(requests, TTFT, batch sizes, queue depth, device memory).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.datasource.health import DOWN, UP, Health
+from gofr_tpu.tpu.batcher import DynamicBatcher, next_pow2, pad_rows
+from gofr_tpu.tracing import get_tracer
+
+
+@dataclass
+class TPULog:
+    """Typed device-query log entry (gofr style, SURVEY.md §2 #21)."""
+
+    model: str
+    op: str
+    batch_size: int
+    duration_us: int
+
+    def pretty_terminal(self) -> str:
+        return (
+            f"\x1b[33mTPU\x1b[0m [{self.model}.{self.op} b={self.batch_size}] "
+            f"{self.duration_us}µs"
+        )
+
+    def log_fields(self) -> dict[str, Any]:
+        return {
+            "datasource": "tpu",
+            "model": self.model,
+            "op": self.op,
+            "batch_size": self.batch_size,
+            "duration_us": self.duration_us,
+        }
+
+
+class TPUDevice:
+    def __init__(self, config: Any, logger: Any, metrics: Any):
+        self.logger = logger
+        self.metrics = metrics
+        self.model_name = config.get_or_default("MODEL_NAME", "mlp")
+        self.max_batch = int(config.get_or_default("BATCH_MAX_SIZE", "8"))
+        self.timeout_ms = float(config.get_or_default("BATCH_TIMEOUT_MS", "5"))
+        self.quant = config.get_or_default("MODEL_QUANT", "") == "int8"
+        self.model_path = config.get("MODEL_PATH")
+
+        self.devices = jax.devices()
+        self.platform = self.devices[0].platform
+        self.device_kind = getattr(self.devices[0], "device_kind", self.platform)
+
+        self._requests = metrics.counter(
+            "gofr_tpu_requests_total", "TPU inference requests", labels=("model", "op", "status")
+        )
+        self._ttft = metrics.histogram(
+            "gofr_tpu_ttft_seconds", "time to first token / result", labels=("model", "op")
+        )
+        self._mem_gauge = metrics.gauge(
+            "gofr_tpu_device_memory_bytes", "device memory", labels=("kind",)
+        )
+
+        self.runner = _build_runner(self.model_name, self.quant, self.model_path, self.max_batch)
+        self.runner.warmup()
+        self.batcher = DynamicBatcher(
+            self._run_batch,
+            max_batch=self.max_batch,
+            timeout_ms=self.timeout_ms,
+            metrics=metrics,
+            name=self.model_name,
+        )
+        self._healthy = True
+
+    # -- handler-facing API --------------------------------------------------
+    def infer(self, payload: Any, timeout: float = 60.0) -> Any:
+        """Blocking single inference (sync handlers). Payload shape depends
+        on the model: MLP -> feature vector; bert -> {"tokens": [...]};
+        transformer -> {"tokens": [...]} returning next-token logits argmax."""
+        start = time.perf_counter()
+        span = get_tracer().start_span(f"tpu-{self.model_name}", activate=False)
+        try:
+            result = self.batcher.infer(self._prepare(payload), timeout=timeout)
+            self._observe("infer", "ok", start)
+            return result
+        except Exception:
+            self._observe("infer", "error", start)
+            raise
+        finally:
+            span.end()
+
+    async def infer_async(self, payload: Any) -> Any:
+        start = time.perf_counter()
+        try:
+            result = await self.batcher.infer_async(self._prepare(payload))
+            self._observe("infer", "ok", start)
+            return result
+        except Exception:
+            self._observe("infer", "error", start)
+            raise
+
+    def generate(
+        self,
+        tokens: list[int],
+        max_new_tokens: int = 32,
+        on_token: Optional[Any] = None,
+    ) -> list[int]:
+        """Autoregressive generation (transformer models): prefill goes
+        through the dynamic batcher (TTFT path); decode steps run per
+        request. ``on_token`` streams each new token id (SSE endpoints)."""
+        start = time.perf_counter()
+        try:
+            out = self.runner.generate(
+                tokens, max_new_tokens, on_token=on_token,
+                prefill_batcher=self.batcher, ttft_cb=lambda: self._ttft.observe(
+                    time.perf_counter() - start, model=self.model_name, op="generate"
+                ),
+            )
+            self._requests.inc(model=self.model_name, op="generate", status="ok")
+            return out
+        except Exception:
+            self._requests.inc(model=self.model_name, op="generate", status="error")
+            raise
+
+    # -- internals -----------------------------------------------------------
+    def _prepare(self, payload: Any) -> Any:
+        return self.runner.prepare(payload)
+
+    def _run_batch(self, payloads: list[Any]) -> list[Any]:
+        start = time.perf_counter()
+        results = self.runner.run_batch(payloads)
+        elapsed_us = int((time.perf_counter() - start) * 1e6)
+        self.logger.debug(
+            TPULog(self.model_name, "batch", len(payloads), elapsed_us)
+        )
+        return results
+
+    def _observe(self, op: str, status: str, start: float) -> None:
+        self._requests.inc(model=self.model_name, op=op, status=status)
+        if status == "ok":
+            self._ttft.observe(time.perf_counter() - start, model=self.model_name, op=op)
+
+    def describe(self) -> str:
+        return (
+            f"model={self.model_name} platform={self.platform} "
+            f"devices={len(self.devices)} kind={self.device_kind}"
+            + (" quant=int8" if self.quant else "")
+        )
+
+    # -- health (north star: device liveness on /.well-known/health) ---------
+    def health_check(self) -> Health:
+        details: dict[str, Any] = {
+            "platform": self.platform,
+            "device_kind": str(self.device_kind),
+            "device_count": len(self.devices),
+            "model": self.model_name,
+        }
+        try:
+            stats = self.devices[0].memory_stats() or {}
+            used = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit")
+            if used is not None:
+                details["memory_bytes_in_use"] = used
+                self._mem_gauge.set(used, kind="in_use")
+            if limit is not None:
+                details["memory_bytes_limit"] = limit
+                self._mem_gauge.set(limit, kind="limit")
+        except Exception:
+            pass  # memory_stats unsupported on some backends
+        try:
+            # tiny device round-trip proves the runtime is alive
+            probe = jnp.zeros((8,), jnp.float32) + 1.0
+            ok = bool(np.asarray(probe).sum() == 8.0)
+        except Exception as exc:
+            return Health(DOWN, {**details, "error": str(exc)})
+        return Health(UP if ok else DOWN, details)
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+def new_device(config: Any, logger: Any, metrics: Any) -> TPUDevice:
+    """Container wiring entry (parity with redis.new_client / sql.new_sql)."""
+    return TPUDevice(config, logger, metrics)
+
+
+# -- model runners ------------------------------------------------------------
+
+class _MLPRunner:
+    name = "mlp"
+
+    def __init__(self, quant: bool, model_path: Optional[str], max_batch: int = 8):
+        self.max_batch = max_batch
+        from gofr_tpu.models.mlp import MLPConfig, init_mlp, mlp_forward
+
+        self.cfg = MLPConfig()
+        self.params = _load_or_init(
+            model_path, lambda: init_mlp(jax.random.key(0), self.cfg)
+        )
+        self._fwd = jax.jit(mlp_forward)
+
+    def prepare(self, payload: Any) -> np.ndarray:
+        x = np.asarray(payload, dtype=np.float32).reshape(-1)
+        if x.shape[0] != self.cfg.in_dim:
+            from gofr_tpu.errors import InvalidParamError
+
+            raise InvalidParamError(f"input must have {self.cfg.in_dim} features")
+        return x
+
+    def run_batch(self, payloads: list[np.ndarray]) -> list[np.ndarray]:
+        n = len(payloads)
+        batch = pad_rows(payloads, next_pow2(n))
+        out = np.asarray(self._fwd(self.params, jnp.asarray(batch)))
+        return [out[i] for i in range(n)]
+
+    def warmup(self) -> None:
+        b = 1
+        while b <= next_pow2(self.max_batch):
+            self._fwd(self.params, jnp.zeros((b, self.cfg.in_dim))).block_until_ready()
+            b *= 2
+
+    def generate(self, *a: Any, **k: Any) -> list[int]:
+        raise NotImplementedError("generate() requires a transformer model")
+
+
+class _BertRunner:
+    def __init__(self, name: str, quant: bool, model_path: Optional[str], max_batch: int = 8):
+        self.max_batch = max_batch
+        from gofr_tpu.models.bert import BertConfig, bert_embed, init_bert
+        from gofr_tpu.models.quant import quantize_params
+
+        self.name = name
+        if name == "bert-tiny":
+            self.cfg = BertConfig(vocab_size=30522, dim=128, n_layers=2, n_heads=2,
+                                  hidden_dim=512, max_seq=128)
+        else:
+            self.cfg = BertConfig()
+        self.bucket = 128 if self.cfg.max_seq >= 128 else self.cfg.max_seq
+        params = _load_or_init(model_path, lambda: init_bert(jax.random.key(0), self.cfg))
+        self.params = quantize_params(params) if quant else params
+        cfg = self.cfg
+        self._embed = jax.jit(lambda p, t, m: bert_embed(p, t, m, cfg))
+
+    def prepare(self, payload: Any) -> np.ndarray:
+        if isinstance(payload, dict):
+            tokens = payload.get("tokens", [])
+        else:
+            tokens = payload
+        ids = np.asarray(tokens, dtype=np.int32).reshape(-1)[: self.bucket]
+        if ids.size == 0:
+            from gofr_tpu.errors import InvalidParamError
+
+            raise InvalidParamError("tokens must be a non-empty list of ids")
+        return ids
+
+    def run_batch(self, payloads: list[np.ndarray]) -> list[np.ndarray]:
+        n = len(payloads)
+        width = self.bucket
+        batch = np.zeros((next_pow2(n), width), np.int32)
+        mask = np.zeros((next_pow2(n), width), np.int32)
+        for i, ids in enumerate(payloads):
+            batch[i, : ids.size] = ids
+            mask[i, : ids.size] = 1
+        mask[n:, 0] = 1  # padded rows need >=1 valid token for the pooler
+        out = np.asarray(self._embed(self.params, jnp.asarray(batch), jnp.asarray(mask)))
+        return [out[i] for i in range(n)]
+
+    def warmup(self) -> None:
+        b = 1
+        while b <= next_pow2(self.max_batch):
+            t = jnp.zeros((b, self.bucket), jnp.int32)
+            m = jnp.ones((b, self.bucket), jnp.int32)
+            self._embed(self.params, t, m).block_until_ready()
+            b *= 2
+
+    def generate(self, *a: Any, **k: Any) -> list[int]:
+        raise NotImplementedError("generate() requires a transformer model")
+
+
+class _TransformerRunner:
+    """Decoder serving: batched bucketed prefill + per-request decode."""
+
+    SEQ_BUCKETS = (64, 128, 256, 512, 1024, 2048)
+
+    def __init__(self, name: str, quant: bool, model_path: Optional[str], max_batch: int = 8):
+        self.max_batch = max_batch
+        from gofr_tpu.models.llama import CONFIGS
+        from gofr_tpu.models.quant import quantize_params
+        from gofr_tpu.models.transformer import (
+            decode_step,
+            init_cache,
+            init_transformer,
+            prefill,
+        )
+
+        self.name = name
+        self.cfg = CONFIGS[name]
+        params = _load_or_init(
+            model_path, lambda: init_transformer(jax.random.key(0), self.cfg)
+        )
+        self.params = quantize_params(params) if quant else params
+        cfg = self.cfg
+        self._init_cache = init_cache
+        self._prefill = jax.jit(lambda p, t, c, l: prefill(p, t, c, cfg, l))
+        self._decode = jax.jit(lambda p, t, c: decode_step(p, t, c, cfg))
+        self.buckets = [b for b in self.SEQ_BUCKETS if b <= cfg.max_seq] or [cfg.max_seq]
+        # preallocated zero caches per batch size: prefill never mutates its
+        # input cache, so one shared zero cache per bsz removes per-batch
+        # allocation dispatches (the tunneled device link makes every
+        # dispatch expensive)
+        self._zero_caches: dict[int, Any] = {}
+
+    def _bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        return self.buckets[-1]
+
+    def prepare(self, payload: Any) -> np.ndarray:
+        if isinstance(payload, dict):
+            tokens = payload.get("tokens", [])
+        else:
+            tokens = payload
+        ids = np.asarray(tokens, dtype=np.int32).reshape(-1)
+        if ids.size == 0:
+            from gofr_tpu.errors import InvalidParamError
+
+            raise InvalidParamError("tokens must be a non-empty list of ids")
+        return ids[-self.cfg.max_seq :]
+
+    def _zero_cache(self, bsz: int) -> Any:
+        cache = self._zero_caches.get(bsz)
+        if cache is None:
+            cache = self._init_cache(self.cfg, bsz, max_seq=self.cfg.max_seq)
+            self._zero_caches[bsz] = cache
+        return cache
+
+    def run_batch(self, payloads: list[np.ndarray]) -> list[Any]:
+        """Batched prefill over a shared sequence bucket -> per-request
+        (next_token_logits, cache_row) results.
+
+        The batch dim is always padded to max_batch: ONE compiled shape per
+        sequence bucket, all warmed at startup — no compile on the serving
+        path (north star: p50 TTFT < 200ms)."""
+        n = len(payloads)
+        # prompts longer than the largest bucket keep their LAST tokens
+        # (consistent with prepare(): recency wins for next-token prediction)
+        biggest = self.buckets[-1]
+        payloads = [p[-biggest:] for p in payloads]
+        lengths = np.array([p.size for p in payloads], np.int32)
+        bucket = self._bucket_for(int(lengths.max()))
+        bsz = next_pow2(max(len(payloads), self.max_batch))
+        tokens = np.zeros((bsz, bucket), np.int32)
+        for i, ids in enumerate(payloads):
+            tokens[i, : ids.size] = ids
+        full_lengths = np.ones((bsz,), np.int32)
+        full_lengths[:n] = lengths
+        cache = self._zero_cache(bsz)
+        logits, cache = self._prefill(
+            self.params, jnp.asarray(tokens), cache, jnp.asarray(full_lengths)
+        )
+        logits = np.asarray(logits)
+        return [
+            {"logits": logits[i], "cache": _slice_cache(cache, i), "length": int(full_lengths[i])}
+            for i in range(n)
+        ]
+
+    def generate(
+        self,
+        tokens: list[int],
+        max_new_tokens: int,
+        on_token: Any = None,
+        prefill_batcher: Any = None,
+        ttft_cb: Any = None,
+    ) -> list[int]:
+        ids = self.prepare(tokens)
+        if prefill_batcher is not None:
+            state = prefill_batcher.infer(ids)
+        else:
+            state = self.run_batch([ids])[0]
+        logits, cache = state["logits"], state["cache"]
+        out: list[int] = []
+        token = int(np.argmax(logits[-1] if logits.ndim > 1 else logits))
+        if ttft_cb:
+            ttft_cb()
+        out.append(token)
+        if on_token:
+            on_token(token)
+        max_len = int(cache["k"].shape[2])
+        for _ in range(max_new_tokens - 1):
+            if int(cache["lengths"][0]) >= max_len:
+                break
+            step_logits, cache = self._decode(
+                self.params, jnp.asarray([[token]], jnp.int32), cache
+            )
+            token = int(np.argmax(np.asarray(step_logits)[0]))
+            out.append(token)
+            if on_token:
+                on_token(token)
+        return out
+
+    def warmup(self) -> None:
+        # one compiled prefill per sequence bucket (batch fixed at
+        # max_batch), plus the b=1 decode step — nothing compiles on the
+        # serving path afterwards
+        b = next_pow2(self.max_batch)
+        for bucket in self.buckets:
+            cache = self._zero_cache(b)
+            logits, cache = self._prefill(
+                self.params,
+                jnp.zeros((b, bucket), jnp.int32),
+                cache,
+                jnp.ones((b,), jnp.int32),
+            )
+            logits.block_until_ready()
+        one = _slice_cache(cache, 0)
+        step, _ = self._decode(self.params, jnp.zeros((1, 1), jnp.int32), one)
+        step.block_until_ready()
+
+
+def _slice_cache(cache: dict, i: int) -> dict:
+    return {
+        "k": cache["k"][:, i : i + 1],
+        "v": cache["v"][:, i : i + 1],
+        "lengths": cache["lengths"][i : i + 1],
+    }
+
+
+def _load_or_init(model_path: Optional[str], init_fn: Any) -> Any:
+    if model_path:
+        from gofr_tpu.training.checkpoint import restore_params
+
+        return restore_params(model_path)
+    return init_fn()
+
+
+def _build_runner(name: str, quant: bool, model_path: Optional[str], max_batch: int = 8) -> Any:
+    from gofr_tpu.models.llama import CONFIGS
+
+    if name in ("mlp", "tiny-mlp"):
+        return _MLPRunner(quant, model_path, max_batch)
+    if name.startswith("bert"):
+        return _BertRunner(name, quant, model_path, max_batch)
+    if name in CONFIGS:
+        return _TransformerRunner(name, quant, model_path, max_batch)
+    raise ValueError(
+        f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "
+        f"or one of {sorted(CONFIGS)}"
+    )

@@ -1,0 +1,7 @@
+"""Training and checkpointing.
+
+The reference has no training (stateless microservices, SURVEY.md §5
+"checkpoint/resume: absent"); the TPU build adds a sharded train step
+(gofr_tpu.training.trainer) so served models can be fine-tuned in place, and
+orbax-backed checkpoints as the MODEL_PATH contract the serving layer loads.
+"""

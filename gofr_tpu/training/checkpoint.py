@@ -1,0 +1,76 @@
+"""Checkpoint save/restore — the MODEL_PATH contract.
+
+Orbax is the primary format (async-capable, sharding-aware: restore can
+place shards directly on a jax.sharding.Mesh). The serving layer
+(gofr_tpu.tpu.device._load_or_init) restores from MODEL_PATH at startup;
+there is no resume-during-serving state (parity: the reference loads config
+at startup and stays stateless, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def save_params(path: str, params: Any) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(os.path.join(path, "params"), params, force=True)
+    checkpointer.wait_until_finished()
+
+
+def restore_params(path: str, like: Optional[Any] = None) -> Any:
+    """Restore a param pytree. ``like`` (abstract shapes/shardings, e.g.
+    jax.eval_shape of the init fn, optionally with shardings attached)
+    enables direct sharded placement on restore."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    checkpointer = ocp.StandardCheckpointer()
+    target = os.path.join(path, "params")
+    if like is not None:
+        return checkpointer.restore(target, target=like)
+    return checkpointer.restore(target)
+
+
+def save_train_state(path: str, params: Any, opt_state: Any, step: int) -> None:
+    """Full training state for resume (params + optimizer + step)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(
+        os.path.join(path, f"state_{step}"),
+        {"params": params, "opt_state": opt_state, "step": step},
+        force=True,
+    )
+    checkpointer.wait_until_finished()
+
+
+def latest_step(path: str) -> Optional[int]:
+    try:
+        steps = [
+            int(name.split("_", 1)[1])
+            for name in os.listdir(os.path.abspath(path))
+            if name.startswith("state_")
+        ]
+        return max(steps) if steps else None
+    except OSError:
+        return None
+
+
+def restore_train_state(path: str, step: Optional[int] = None) -> Any:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if step is None:
+        step = latest_step(path)
+        if step is None:
+            raise FileNotFoundError(f"no training state under {path}")
+    checkpointer = ocp.StandardCheckpointer()
+    return checkpointer.restore(os.path.join(path, f"state_{step}"))

@@ -1,0 +1,109 @@
+"""Sharded training: next-token loss, optax update, pjit over a mesh.
+
+TPU-first: ONE jitted train step with in/out shardings — GSPMD emits the
+collectives (grad all-reduce over dp, reduce-scatter/all-gather over fsdp,
+activation collectives over tp). Params and optimizer state are donated so
+the update is in-place in HBM. ``jax.checkpoint`` (remat) wraps the scanned
+layer body to trade FLOPs for memory on long sequences.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gofr_tpu.models.transformer import TransformerConfig, transformer_forward
+from gofr_tpu.parallel.sharding import batch_spec, param_specs, shard_params
+
+
+def cross_entropy_loss(
+    params: Any,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    loss_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Next-token prediction loss over ``tokens`` [B, S]; mask [B, S-1]
+    optionally excludes positions (padding) from the mean."""
+    logits = transformer_forward(params, tokens[:, :-1], cfg)  # [B, S-1, V]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if loss_mask is not None:
+        weights = loss_mask.astype(jnp.float32)
+        return (nll * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+    return nll.mean()
+
+
+def init_train_state(key: jax.Array, cfg: TransformerConfig, optimizer: Any) -> dict:
+    from gofr_tpu.models.transformer import init_transformer
+
+    params = init_transformer(key, cfg)
+    return {"params": params, "opt_state": optimizer.init(params), "step": jnp.zeros((), jnp.int32)}
+
+
+def make_train_step(
+    cfg: TransformerConfig,
+    optimizer: Any,
+    mesh: Optional[Mesh] = None,
+    remat: bool = True,
+) -> Callable:
+    """Build the jitted train step. With a mesh, in/out shardings pin params
+    to their tp/fsdp layout and the batch to dp; without, plain jit."""
+
+    loss_fn = cross_entropy_loss
+    if remat:
+        loss_fn = jax.checkpoint(cross_entropy_loss, static_argnums=(2,))
+
+    def train_step(state: dict, tokens: jnp.ndarray) -> tuple[dict, dict]:
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens, cfg)
+        updates, opt_state = optimizer.update(grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {"params": params, "opt_state": opt_state, "step": state["step"] + 1}
+        grad_norm = optax.global_norm(grads)
+        return new_state, {"loss": loss, "grad_norm": grad_norm, "step": new_state["step"]}
+
+    if mesh is None:
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    # The state arrives already placed (place_train_state): params in their
+    # tp/fsdp layout, moments mirroring them — GSPMD propagates from there.
+    # Only the batch needs pinning to dp.
+    batch_sharding = NamedSharding(mesh, batch_spec())
+    return jax.jit(train_step, donate_argnums=(0,), in_shardings=(None, batch_sharding))
+
+
+def place_train_state(state: dict, mesh: Mesh) -> dict:
+    """Shard params (tp/fsdp rules) and matching optimizer moments onto the
+    mesh; scalars replicate."""
+    p_specs = param_specs(state["params"])
+    params = shard_params(state["params"], mesh, p_specs)
+
+    # optax states are namedtuples/pytrees whose leaves either mirror the
+    # param tree (moments -> shard like params) or are scalars (replicate)
+    def place(tree: Any) -> Any:
+        if isinstance(tree, dict) and set(tree) == set(state["params"]):
+            return shard_params(tree, mesh, p_specs)
+        if isinstance(tree, (list, tuple)):
+            placed = [place(t) for t in tree]
+            return type(tree)(*placed) if hasattr(tree, "_fields") else type(tree)(placed)
+        if isinstance(tree, dict):
+            return {k: place(v) for k, v in tree.items()}
+        if hasattr(tree, "ndim"):
+            return jax.device_put(tree, NamedSharding(mesh, P()))
+        return tree
+
+    opt_state = place(state["opt_state"])
+    step = jax.device_put(state["step"], NamedSharding(mesh, P()))
+    return {"params": params, "opt_state": opt_state, "step": step}
+
+
+def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1) -> Any:
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )

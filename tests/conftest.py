@@ -5,10 +5,23 @@ against local fakes (SURVEY.md §4: sqlmock/miniredis ↔ CPU PJRT here).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# HARD override: the ambient environment pins JAX_PLATFORMS to the TPU
+# plugin; tests must run on the virtual 8-device CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# The ambient sitecustomize force-registers the TPU plugin even when
+# JAX_PLATFORMS=cpu is in the env; the config update below is the override
+# that actually sticks (must run before any backend initialization).
+jax.config.update("jax_platforms", "cpu")
+
+# this jax build computes f32 matmuls at reduced precision by default (TPU
+# convention); numeric tests need exact f32 accumulation
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import socket
 

@@ -1,0 +1,199 @@
+"""Model family tests (CPU, tiny configs)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models import (
+    BertConfig,
+    MLPConfig,
+    bert_embed,
+    decode_step,
+    init_bert,
+    init_cache,
+    init_mlp,
+    init_transformer,
+    mlp_forward,
+    prefill,
+    transformer_forward,
+)
+from gofr_tpu.models.llama import CONFIGS, TINY
+from gofr_tpu.models.quant import (
+    dequantize_params,
+    quantization_error,
+    quantize_params,
+)
+
+CFG = TINY
+
+# jitted entry points (compiled once per shape; eager JAX on this CPU build
+# is far too slow for per-op dispatch in tests)
+_fwd = jax.jit(lambda p, t: transformer_forward(p, t, CFG))
+_prefill = jax.jit(lambda p, t, c: prefill(p, t, c, CFG))
+_decode = jax.jit(lambda p, t, c: decode_step(p, t, c, CFG))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer(jax.random.key(0), CFG)
+
+
+def test_mlp_forward_shape_and_jit():
+    cfg = MLPConfig(in_dim=8, hidden_dim=16, out_dim=4)
+    p = init_mlp(jax.random.key(0), cfg)
+    x = jnp.ones((3, 8))
+    y = jax.jit(mlp_forward)(p, x)
+    assert y.shape == (3, 4)
+    assert bool(jnp.isfinite(y).all())
+
+
+def test_transformer_forward_shape(params):
+    tokens = jnp.ones((2, 10), jnp.int32)
+    logits = _fwd(params, tokens)
+    assert logits.shape == (2, 10, CFG.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_transformer_causality(params):
+    t1 = jax.random.randint(jax.random.key(1), (1, 8), 0, CFG.vocab_size)
+    t2 = t1.at[0, -1].set((t1[0, -1] + 1) % CFG.vocab_size)
+    l1 = _fwd(params, t1)
+    l2 = _fwd(params, t2)
+    # logits strictly before the changed position are identical
+    np.testing.assert_allclose(np.asarray(l1[:, :-1]), np.asarray(l2[:, :-1]), atol=1e-5)
+
+
+def test_prefill_matches_full_forward(params):
+    tokens = jax.random.randint(jax.random.key(2), (2, 12), 0, CFG.vocab_size)
+    full = _fwd(params, tokens)[:, -1]
+    cache = init_cache(CFG, batch=2, max_seq=32)
+    logits, cache = _prefill(params, tokens, cache)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full), rtol=1e-4, atol=1e-4)
+    assert cache["lengths"].tolist() == [12, 12]
+
+
+def test_decode_matches_prefill(params):
+    """Greedy decode step-by-step must reproduce full-sequence logits."""
+    tokens = jax.random.randint(jax.random.key(3), (1, 9), 0, CFG.vocab_size)
+    prompt, tail = tokens[:, :5], tokens[:, 5:]
+
+    cache = init_cache(CFG, batch=1, max_seq=32)
+    logits, cache = _prefill(params, prompt, cache)
+    stepwise = [logits]
+    for i in range(tail.shape[1]):
+        logits, cache = _decode(params, tail[:, i : i + 1], cache)
+        stepwise.append(logits)
+
+    for i in range(len(stepwise)):
+        full = _fwd(params, tokens[:, : 5 + i])[:, -1]
+        np.testing.assert_allclose(
+            np.asarray(stepwise[i]), np.asarray(full), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_bert_embedding_shape_and_mask():
+    cfg = BertConfig(
+        vocab_size=128, dim=32, n_layers=2, n_heads=4, hidden_dim=64, max_seq=16,
+        dtype=jnp.float32, attn_impl="xla",
+    )
+    p = init_bert(jax.random.key(4), cfg)
+    tokens = jax.random.randint(jax.random.key(5), (2, 10), 0, 128)
+    mask = jnp.ones((2, 10), jnp.int32).at[:, 7:].set(0)
+    embed_fn = jax.jit(lambda p, t, m: bert_embed(p, t, m, cfg))
+    emb = embed_fn(p, tokens, mask)
+    assert emb.shape == (2, 32)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(emb), axis=-1), 1.0, rtol=1e-5)
+    # padding content must not affect the embedding
+    tokens2 = tokens.at[:, 7:].set(0)
+    emb2 = embed_fn(p, tokens2, mask)
+    np.testing.assert_allclose(np.asarray(emb), np.asarray(emb2), atol=1e-5)
+
+
+def test_quantization_roundtrip_error_small():
+    w = jax.random.normal(jax.random.key(6), (64, 32))
+    assert quantization_error(w) < 0.02
+
+
+def test_quantized_forward_close(params):
+    tokens = jax.random.randint(jax.random.key(7), (1, 6), 0, CFG.vocab_size)
+    base = _fwd(params, tokens)
+    qparams = quantize_params(params)
+    # int8 leaves present
+    assert qparams["layers"]["wq"]["q"].dtype == jnp.int8
+    quant = jax.jit(lambda p, t: transformer_forward(p, t, CFG))(qparams, tokens)
+    base_probs = jax.nn.softmax(base[:, -1])
+    quant_probs = jax.nn.softmax(quant[:, -1])
+    # distributions stay close under weight-only int8
+    assert float(jnp.abs(base_probs - quant_probs).sum()) < 0.15
+
+    # dequantize restores plain arrays usable by the same forward
+    deq = dequantize_params(qparams, jnp.float32)
+    deq_logits = jax.jit(lambda p, t: transformer_forward(p, t, CFG))(deq, tokens)
+    np.testing.assert_allclose(np.asarray(quant), np.asarray(deq_logits), rtol=1e-3, atol=1e-3)
+
+
+def test_ragged_prefill_ignores_padding(params):
+    """A prompt padded to a bucket must yield the same logits and decode
+    behavior as the unpadded prompt (per-request lengths)."""
+    tokens = jax.random.randint(jax.random.key(8), (1, 5), 0, CFG.vocab_size)
+    # unpadded reference
+    cache_a = init_cache(CFG, batch=1, max_seq=32)
+    ref, cache_a = _prefill(params, tokens, cache_a)
+    # padded to bucket 8 with garbage padding
+    padded = jnp.concatenate([tokens, jnp.full((1, 3), 7, jnp.int32)], axis=1)
+    cache_b = init_cache(CFG, batch=1, max_seq=32)
+    got, cache_b = prefill(params, padded, cache_b, CFG, lengths=jnp.array([5], jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+    assert int(cache_b["lengths"][0]) == 5
+    # decode after padded prefill matches decode after exact prefill
+    nxt = jnp.argmax(got, axis=-1)[:, None].astype(jnp.int32)
+    la, _ = _decode(params, nxt, cache_a)
+    lb, _ = _decode(params, nxt, cache_b)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=2e-4, atol=2e-4)
+
+
+def test_ragged_batch_mixed_lengths(params):
+    """Two requests with different prompt lengths in one bucket."""
+    t_a = jax.random.randint(jax.random.key(9), (1, 7), 0, CFG.vocab_size)
+    t_b = jax.random.randint(jax.random.key(10), (1, 4), 0, CFG.vocab_size)
+    # individual references
+    ra, _ = prefill(params, t_a, init_cache(CFG, 1, 32), CFG)
+    rb, _ = prefill(params, t_b, init_cache(CFG, 1, 32), CFG)
+    # batched: pad b to 7
+    batch_tokens = jnp.concatenate(
+        [t_a, jnp.concatenate([t_b, jnp.zeros((1, 3), jnp.int32)], axis=1)]
+    )
+    lengths = jnp.array([7, 4], jnp.int32)
+    logits, cache = prefill(params, batch_tokens, init_cache(CFG, 2, 32), CFG, lengths=lengths)
+    np.testing.assert_allclose(np.asarray(logits[0]), np.asarray(ra[0]), rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(np.asarray(logits[1]), np.asarray(rb[0]), rtol=3e-4, atol=3e-4)
+    assert cache["lengths"].tolist() == [7, 4]
+
+
+def test_cache_max_seq_bound():
+    with pytest.raises(ValueError, match="RoPE"):
+        init_cache(CFG, 1, CFG.max_seq + 1)
+
+
+def test_quantized_bert_forward():
+    cfg = BertConfig(
+        vocab_size=64, dim=16, n_layers=1, n_heads=2, hidden_dim=32, max_seq=8,
+        dtype=jnp.float32, attn_impl="xla",
+    )
+    p = init_bert(jax.random.key(11), cfg)
+    qp = quantize_params(p)
+    tokens = jnp.ones((1, 4), jnp.int32)
+    mask = jnp.ones((1, 4), jnp.int32)
+    base = bert_embed(p, tokens, mask, cfg)
+    quant = bert_embed(qp, tokens, mask, cfg)
+    assert float(jnp.abs(base - quant).max()) < 0.05
+
+
+def test_named_configs_have_llama_shapes():
+    cfg = CONFIGS["llama3-8b"]
+    assert cfg.dim == 4096 and cfg.n_layers == 32 and cfg.n_kv_heads == 8
+    assert CONFIGS["llama3-70b"].hidden_dim == 28672

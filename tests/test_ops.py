@@ -1,0 +1,105 @@
+"""Numeric tests for compute ops (CPU, f32)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ops.attention import attention
+from gofr_tpu.ops.norms import layer_norm, rms_norm
+from gofr_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+def test_rms_norm_matches_manual():
+    x = jax.random.normal(jax.random.key(0), (2, 5, 8))
+    w = jnp.linspace(0.5, 1.5, 8)
+    got = rms_norm(x, w)
+    want = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-5) * np.asarray(w)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_layer_norm_zero_mean_unit_var():
+    x = jax.random.normal(jax.random.key(1), (3, 16)) * 5 + 2
+    y = layer_norm(x, jnp.ones(16), jnp.zeros(16))
+    np.testing.assert_allclose(np.asarray(y).mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y).std(-1), 1.0, atol=1e-3)
+
+
+def test_rope_preserves_norm_and_identity_at_zero():
+    q = jax.random.normal(jax.random.key(2), (1, 4, 2, 8))
+    freqs = rope_frequencies(8, 32)
+    positions = jnp.arange(4)
+    rotated = apply_rope(q, freqs, positions)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(rotated), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 is the identity rotation
+    np.testing.assert_allclose(np.asarray(rotated[:, 0]), np.asarray(q[:, 0]), rtol=1e-6)
+
+
+def test_rope_relative_property():
+    # dot(q_m, k_n) depends only on m-n: shift both positions, dots unchanged
+    q = jax.random.normal(jax.random.key(3), (1, 1, 1, 16))
+    k = jax.random.normal(jax.random.key(4), (1, 1, 1, 16))
+    freqs = rope_frequencies(16, 64)
+
+    def dot_at(m, n):
+        qm = apply_rope(q, freqs, jnp.array([m]))
+        kn = apply_rope(k, freqs, jnp.array([n]))
+        return float(jnp.sum(qm * kn))
+
+    assert abs(dot_at(5, 3) - dot_at(25, 23)) < 1e-4
+
+
+def test_attention_causality():
+    key = jax.random.key(5)
+    q = jax.random.normal(key, (1, 6, 2, 4))
+    k = jax.random.normal(jax.random.key(6), (1, 6, 2, 4))
+    v = jax.random.normal(jax.random.key(7), (1, 6, 2, 4))
+    out1 = attention(q, k, v, causal=True, impl="xla")
+    # perturb the LAST key/value; outputs at earlier positions must not move
+    k2 = k.at[:, -1].set(99.0)
+    v2 = v.at[:, -1].set(-99.0)
+    out2 = attention(q, k2, v2, causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-6)
+    assert not np.allclose(np.asarray(out1[:, -1]), np.asarray(out2[:, -1]))
+
+
+def test_attention_gqa_matches_repeated_mha():
+    b, s, hq, hkv, d = 2, 5, 4, 2, 8
+    q = jax.random.normal(jax.random.key(8), (b, s, hq, d))
+    k = jax.random.normal(jax.random.key(9), (b, s, hkv, d))
+    v = jax.random.normal(jax.random.key(10), (b, s, hkv, d))
+    gqa = attention(q, k, v, causal=True, impl="xla")
+    k_rep = jnp.repeat(k, hq // hkv, axis=2)
+    v_rep = jnp.repeat(v, hq // hkv, axis=2)
+    mha = attention(q, k_rep, v_rep, causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(gqa), np.asarray(mha), rtol=2e-5, atol=2e-5)
+
+
+def test_attention_padding_mask():
+    b, s, h, d = 1, 4, 1, 4
+    q = jax.random.normal(jax.random.key(11), (b, s, h, d))
+    k = jax.random.normal(jax.random.key(12), (b, s, h, d))
+    v = jax.random.normal(jax.random.key(13), (b, s, h, d))
+    mask = jnp.array([[True, True, False, False]])
+    out = attention(q, k, v, causal=False, mask=mask, impl="xla")
+    # masked keys changed -> output unchanged
+    k2 = k.at[:, 2:].set(7.0)
+    v2 = v.at[:, 2:].set(-7.0)
+    out2 = attention(q, k2, v2, causal=False, mask=mask, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-6)
+
+
+def test_attention_decode_offset():
+    # decode: 1 query at absolute position 3 sees keys 0..3 only
+    q = jax.random.normal(jax.random.key(14), (1, 1, 1, 4))
+    k = jax.random.normal(jax.random.key(15), (1, 8, 1, 4))
+    v = jax.random.normal(jax.random.key(16), (1, 8, 1, 4))
+    out = attention(q, k, v, causal=True, q_offset=3, impl="xla")
+    k2 = k.at[:, 4:].set(55.0)
+    v2 = v.at[:, 4:].set(55.0)
+    out2 = attention(q, k2, v2, causal=True, q_offset=3, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-6)
