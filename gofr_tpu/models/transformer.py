@@ -112,7 +112,7 @@ def _block(
     positions: jnp.ndarray,
     kv_cache: Optional[tuple[jnp.ndarray, jnp.ndarray]] = None,
     starts: Optional[jnp.ndarray] = None,
-    key_mask: Optional[jnp.ndarray] = None,
+    kv_lens: Optional[jnp.ndarray] = None,
 ) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray]]:
     """One decoder block — the single implementation shared by the
     no-cache forward and the cached prefill/decode path.
@@ -142,7 +142,7 @@ def _block(
         v_cache = jax.vmap(merge)(v_cache, v.astype(v_cache.dtype), starts)
         attn = attention(
             q, k_cache, v_cache, causal=True, q_offset=starts,
-            mask=key_mask, impl=cfg.attn_impl,
+            kv_lens=kv_lens, impl=cfg.attn_impl,
         )
         merged = (k_cache, v_cache)
 
@@ -204,7 +204,6 @@ def _forward_with_cache(
     request (defaults to S). Returns logits at each request's final real
     position and the updated cache."""
     b, s = tokens.shape
-    max_seq = cache["k"].shape[2]
     starts = cache["lengths"]  # [B]
     if lengths is None:
         lengths = jnp.full((b,), s, jnp.int32)
@@ -213,15 +212,15 @@ def _forward_with_cache(
     x = params["embed"][tokens]
 
     # keys valid for query j of request b: cache positions <= starts_b + j
-    # (causal handles the per-query bound; this mask bounds the written
+    # (causal handles the per-query bound; kv_lens bounds the written
     # region so never-written cache slots are excluded)
-    valid = jnp.arange(max_seq)[None, :] < (starts + s)[:, None]  # [B, max_seq]
+    written = starts + s  # [B]
 
     def body(carry, inputs):
         layer_params, k_cache, v_cache = inputs
         y, (k_cache, v_cache) = _block(
             cfg, layer_params, carry, freqs, positions,
-            kv_cache=(k_cache, v_cache), starts=starts, key_mask=valid,
+            kv_cache=(k_cache, v_cache), starts=starts, kv_lens=written,
         )
         return y, (k_cache, v_cache)
 

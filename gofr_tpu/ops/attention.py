@@ -2,11 +2,16 @@
 
 - ``impl="xla"``: pure-jnp reference (softmax in f32, grouped einsum so GQA
   never materializes repeated KV heads).
-- ``impl="pallas"``: Pallas TPU flash-attention kernel (gofr_tpu.ops.flash).
+- ``impl="pallas"``: Pallas TPU flash-attention kernel (gofr_tpu.ops.flash);
+  runs in interpret mode on non-TPU backends so tests cover the kernel.
 - ``impl="auto"``: pallas on TPU when shapes are tile-friendly, else XLA.
 
 Layouts: q [B, Sq, Hq, D]; k, v [B, Skv, Hkv, D]; Hq % Hkv == 0.
 ``q_offset`` positions the query block absolutely (decode: cache length).
+``kv_lens`` [B] bounds the valid key prefix (padded/unwritten cache tail)
+— the structured form of a padding mask, supported by both paths.
+``mask`` is an arbitrary boolean mask ([B, Skv] or [B, Sq, Skv]); only the
+XLA path supports it.
 """
 
 from __future__ import annotations
@@ -26,19 +31,32 @@ def attention(
     causal: bool = True,
     q_offset: int | jnp.ndarray = 0,
     mask: Optional[jnp.ndarray] = None,
+    kv_lens: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
     impl: str = "auto",
 ) -> jnp.ndarray:
     if impl == "auto":
-        # the flash kernel has no padding-mask support yet; masked calls
-        # must stay on the XLA path rather than silently dropping the mask
+        # arbitrary masks stay on the XLA path (kv_lens is fine: the flash
+        # kernel bounds its KV loop with it)
         impl = "pallas" if (mask is None and _pallas_ok(q, k)) else "xla"
     if impl == "pallas":
         if mask is not None:
-            raise NotImplementedError("pallas flash attention does not support mask=")
+            raise NotImplementedError(
+                "pallas flash attention supports kv_lens=, not arbitrary mask="
+            )
         from gofr_tpu.ops.flash import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, q_offset=q_offset, scale=scale)
+        return flash_attention(
+            q, k, v, causal=causal, q_offset=q_offset, kv_lens=kv_lens, scale=scale
+        )
+    if kv_lens is not None:
+        len_mask = jnp.arange(k.shape[1])[None, :] < kv_lens[:, None]  # [B, Skv]
+        if mask is None:
+            mask = len_mask
+        elif mask.ndim == 2:
+            mask = jnp.logical_and(mask, len_mask)
+        else:
+            mask = jnp.logical_and(mask, len_mask[:, None, :])
     return _xla_attention(q, k, v, causal, q_offset, mask, scale)
 
 
@@ -47,8 +65,10 @@ def _pallas_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
         return False
     b, sq, hq, d = q.shape
     skv = k.shape[1]
-    # flash kernel wants lane-aligned head_dim and enough rows to tile
-    return d % 128 == 0 and sq >= 8 and skv % 128 == 0
+    # lane-aligned head_dim and a tileable KV axis; sq=1 decode is included
+    # deliberately — the kernel pads the q block but bounds its KV loop to
+    # the live cache prefix, beating XLA's O(max_seq) scan over the cache
+    return d % 128 == 0 and skv % 128 == 0
 
 
 def _xla_attention(
@@ -92,5 +112,11 @@ def _xla_attention(
         logits = jnp.where(m, logits, _NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if causal or mask is not None:
+        # fully-masked rows (e.g. kv_lens == 0 padding slots) emit zeros —
+        # same convention as the flash kernel's l == 0 guard — instead of
+        # the uniform-softmax mean(v) that finite -inf masking would give
+        all_masked = jnp.all(logits <= _NEG_INF / 2, axis=-1, keepdims=True)
+        probs = jnp.where(all_masked, 0.0, probs.astype(jnp.float32)).astype(q.dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
     return out.reshape(b, sq, hq, d)

@@ -1,12 +1,280 @@
-"""Pallas TPU flash attention. Placeholder dispatching to the XLA reference
-until the kernel lands (task: pallas flash kernel); the public signature is
-stable so callers never change."""
+"""Pallas TPU flash attention (forward kernel + recompute backward).
+
+TPU-first design (pallas_guide: grid/block specs, scalar prefetch, online
+softmax in VMEM):
+
+- grid = (batch, q_heads, q_blocks); the KV loop runs *inside* the kernel
+  as a ``lax.fori_loop`` with a **dynamic trip count** — causal blocks past
+  the diagonal and blocks past the written KV length are never visited, so
+  prefill does half the work and ragged decode touches only the live cache
+  prefix.
+- K/V for one (batch, kv-head) live whole in VMEM (max_seq 8192 × 128 in
+  bf16 = 2 MiB each, well under the ~16 MiB budget); Q is tiled ``block_q``
+  rows at a time. GQA maps query head → kv head in the BlockSpec index map,
+  so repeated KV heads are never materialized.
+- per-batch scalars (``q_offset`` for ragged decode positions, ``kv_lens``
+  bounding the valid cache prefix) ride scalar prefetch
+  (``PrefetchScalarGridSpec``) — available before the body for the
+  dynamic loop bound.
+- online softmax: running (m, l, acc) in f32; probabilities cast back to
+  the value dtype so the p·V matmul hits the MXU in bf16 with f32
+  accumulation.
+- backward: ``jax.custom_vjp`` that **recomputes** attention with the XLA
+  reference and differentiates that — flash speed forward, correct
+  gradients under ``jax.grad`` (training default is the XLA/ring path;
+  a fused backward kernel can replace this without an API change).
+
+Layouts match gofr_tpu.ops.attention: q [B, Sq, Hq, D]; k, v [B, Skv,
+Hkv, D]; Hq % Hkv == 0. On non-TPU backends the kernel runs in pallas
+interpret mode (tests exercise the real kernel logic on the CPU mesh, the
+way the reference tests run against in-process fakes, SURVEY.md §4).
+"""
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float(-1e30)
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_KV = 128
+
+
+def _kernel(
+    offs_ref,  # [B] int32 scalar-prefetch: absolute position of q row 0
+    lens_ref,  # [B] int32 scalar-prefetch: valid KV prefix length
+    q_ref,  # [1, 1, block_q, D]
+    k_ref,  # [1, 1, Skv_pad, D]
+    v_ref,  # [1, 1, Skv_pad, D]
+    out_ref,  # [1, 1, block_q, D]
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_kv: int,
+    num_kv_blocks: int,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+
+    offset = offs_ref[b]
+    kv_len = lens_ref[b]
+
+    qb = q_ref[0, 0, :, :]  # [block_q, D]
+    d = qb.shape[-1]
+
+    # absolute positions of this query block's rows (2D iota: TPU rule)
+    q_pos = (
+        offset
+        + qi * block_q
+        + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    )  # [block_q, 1]
+    k_ids = jax.lax.broadcasted_iota(jnp.int32, (1, block_kv), 1)  # [1, block_kv]
+
+    # dynamic trip count: stop at the KV length bound, and (causal) at the
+    # block containing this q block's last row
+    hi = pl.cdiv(kv_len, block_kv)
+    if causal:
+        last_q = offset + (qi + 1) * block_q  # exclusive
+        hi = jnp.minimum(hi, pl.cdiv(last_q, block_kv))
+    hi = jnp.minimum(hi, num_kv_blocks)
+
+    def body(j, carry):
+        m_prev, l_prev, acc_prev = carry
+        kb = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :]  # [block_kv, D]
+        vb = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
+
+        s = jax.lax.dot_general(
+            qb,
+            kb,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_kv]
+
+        k_pos = j * block_kv + k_ids  # [1, block_kv]
+        mask = k_pos < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        p = jnp.exp(s - m_new)  # [block_q, block_kv] f32
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(vb.dtype),
+            vb,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc_prev * alpha + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+
+    # fully-masked rows (padding) have l == 0 → emit zeros, not NaN
+    out = acc / jnp.where(l == 0.0, 1.0, l)
+    out_ref[0, 0, :, :] = out.astype(out_ref.dtype)
+
+
+def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
+    pad = to - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_q", "block_kv", "interpret")
+)
+def _flash_fwd_impl(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    offsets: jnp.ndarray,
+    kv_lens: jnp.ndarray,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_kv: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    b, sq, hq, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    groups = hq // hkv
+
+    # [B, H, S, D] layout: the kernel tiles (sublane=seq, lane=head_dim)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    # sublane floor 16 covers the bf16 min tile (f32 needs only 8); sq=1
+    # decode pads its q block rather than falling back to XLA
+    block_q = min(block_q, max(sq, 16))
+    block_kv = min(block_kv, skv)
+    sq_pad = pl.cdiv(sq, block_q) * block_q
+    skv_pad = pl.cdiv(skv, block_kv) * block_kv
+    qt = _pad_axis(qt, 2, sq_pad)
+    kt = _pad_axis(kt, 2, skv_pad)
+    vt = _pad_axis(vt, 2, skv_pad)
+    num_q_blocks = sq_pad // block_q
+    num_kv_blocks = skv_pad // block_kv
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hq, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bi, h, qi, *_: (bi, h, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, skv_pad, d),
+                lambda bi, h, qi, *_, g=groups: (bi, h // g, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, skv_pad, d),
+                lambda bi, h, qi, *_, g=groups: (bi, h // g, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, h, qi, *_: (bi, h, qi, 0)
+        ),
+    )
+
+    kernel = functools.partial(
+        _kernel,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_kv=block_kv,
+        num_kv_blocks=num_kv_blocks,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq_pad, d), q.dtype),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * hq * sq * skv * d,
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=b * hq * sq * skv,
+        ),
+    )(offsets, kv_lens, qt, kt, vt)
+    return jnp.swapaxes(out[:, :, :sq, :], 1, 2)
+
+
+def _normalize_scalars(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    q_offset: int | jnp.ndarray,
+    kv_lens: Optional[jnp.ndarray],
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    b, skv = q.shape[0], k.shape[1]
+    offsets = jnp.asarray(q_offset, jnp.int32)
+    if offsets.ndim == 0:
+        offsets = jnp.full((b,), offsets, jnp.int32)
+    if kv_lens is None:
+        lens = jnp.full((b,), skv, jnp.int32)
+    else:
+        lens = jnp.minimum(jnp.asarray(kv_lens, jnp.int32), skv)
+    return offsets, lens
+
+
+def _reference(q, k, v, offsets, kv_lens, causal, scale):
+    """XLA reference with identical semantics (backward recompute path)."""
+    from gofr_tpu.ops.attention import attention
+
+    return attention(
+        q, k, v, causal=causal, q_offset=offsets, kv_lens=kv_lens, scale=scale,
+        impl="xla",
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, offsets, kv_lens, causal, scale, block_q, block_kv, interpret):
+    return _flash_fwd_impl(
+        q, k, v, offsets, kv_lens, causal, scale, block_q, block_kv, interpret
+    )
+
+
+def _flash_fwd(q, k, v, offsets, kv_lens, causal, scale, block_q, block_kv, interpret):
+    out = _flash_fwd_impl(
+        q, k, v, offsets, kv_lens, causal, scale, block_q, block_kv, interpret
+    )
+    return out, (q, k, v, offsets, kv_lens)
+
+
+def _flash_bwd(causal, scale, block_q, block_kv, interpret, residuals, g):
+    q, k, v, offsets, kv_lens = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference(q_, k_, v_, offsets, kv_lens, causal, scale),
+        q,
+        k,
+        v,
+    )
+    dq, dk, dv = vjp(g)
+    return (
+        dq,
+        dk,
+        dv,
+        np.zeros(offsets.shape, jax.dtypes.float0),
+        np.zeros(kv_lens.shape, jax.dtypes.float0),
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(
@@ -15,8 +283,23 @@ def flash_attention(
     v: jnp.ndarray,
     causal: bool = True,
     q_offset: int | jnp.ndarray = 0,
+    kv_lens: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_kv: int = DEFAULT_BLOCK_KV,
+    interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
-    from gofr_tpu.ops.attention import _xla_attention
+    """Flash attention. q [B, Sq, Hq, D]; k, v [B, Skv, Hkv, D].
 
-    return _xla_attention(q, k, v, causal, q_offset, None, scale)
+    ``q_offset``: scalar or [B] absolute position of q row 0 (ragged
+    decode). ``kv_lens``: optional [B] count of valid KV positions
+    (padded/unwritten cache tail is masked). Differentiable via recompute.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    offsets, lens = _normalize_scalars(q, k, q_offset, kv_lens)
+    return _flash(
+        q, k, v, offsets, lens, causal, float(scale), block_q, block_kv, interpret
+    )

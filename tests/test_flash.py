@@ -1,0 +1,170 @@
+"""Pallas flash-attention kernel vs the XLA reference (interpret mode on
+the CPU mesh — same kernel logic that compiles on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ops.attention import _xla_attention, attention
+from gofr_tpu.ops.flash import flash_attention
+
+
+def _rand(key, shape):
+    return jax.random.normal(jax.random.key(key), shape, jnp.float32)
+
+
+def _assert_close(got, want, atol=2e-5):
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=atol)
+
+
+def test_flash_matches_xla_causal():
+    b, s, h, d = 2, 64, 2, 32
+    q, k, v = _rand(0, (b, s, h, d)), _rand(1, (b, s, h, d)), _rand(2, (b, s, h, d))
+    got = flash_attention(q, k, v, causal=True, block_q=16, block_kv=16)
+    want = _xla_attention(q, k, v, True, 0, None, None)
+    _assert_close(got, want)
+
+
+def test_flash_non_causal():
+    b, s, h, d = 1, 32, 2, 16
+    q, k, v = _rand(3, (b, s, h, d)), _rand(4, (b, s, h, d)), _rand(5, (b, s, h, d))
+    got = flash_attention(q, k, v, causal=False, block_q=8, block_kv=8)
+    want = _xla_attention(q, k, v, False, 0, None, None)
+    _assert_close(got, want)
+
+
+def test_flash_gqa():
+    b, s, hq, hkv, d = 2, 32, 4, 2, 16
+    q = _rand(6, (b, s, hq, d))
+    k, v = _rand(7, (b, s, hkv, d)), _rand(8, (b, s, hkv, d))
+    got = flash_attention(q, k, v, causal=True, block_q=8, block_kv=8)
+    want = _xla_attention(q, k, v, True, 0, None, None)
+    _assert_close(got, want)
+
+
+def test_flash_unaligned_seq_pads():
+    # seq not a multiple of the block: wrapper pads, output sliced back
+    b, s, h, d = 1, 23, 1, 8
+    q, k, v = _rand(9, (b, s, h, d)), _rand(10, (b, s, h, d)), _rand(11, (b, s, h, d))
+    got = flash_attention(q, k, v, causal=True, block_q=8, block_kv=8)
+    want = _xla_attention(q, k, v, True, 0, None, None)
+    _assert_close(got, want)
+
+
+def test_flash_ragged_offsets_and_kv_lens():
+    # decode-shaped: queries at different absolute positions per batch row,
+    # cache valid only up to kv_lens
+    b, sq, skv, h, d = 2, 8, 64, 2, 16
+    q = _rand(12, (b, sq, h, d))
+    k, v = _rand(13, (b, skv, h, d)), _rand(14, (b, skv, h, d))
+    offsets = jnp.array([5, 17], jnp.int32)
+    kv_lens = offsets + sq
+    got = flash_attention(
+        q, k, v, causal=True, q_offset=offsets, kv_lens=kv_lens, block_q=8, block_kv=8
+    )
+    mask = jnp.arange(skv)[None, :] < kv_lens[:, None]
+    want = _xla_attention(q, k, v, True, offsets, mask, None)
+    _assert_close(got, want)
+    # keys beyond kv_lens must be invisible
+    k2 = k.at[:, 40:].set(99.0)
+    v2 = v.at[:, 40:].set(-99.0)
+    got2 = flash_attention(
+        q, k2, v2, causal=True, q_offset=offsets, kv_lens=kv_lens, block_q=8, block_kv=8
+    )
+    row0 = np.asarray(got)[0]
+    np.testing.assert_allclose(np.asarray(got2)[0], row0, atol=1e-6)
+
+
+def test_flash_scale_override():
+    b, s, h, d = 1, 16, 1, 8
+    q, k, v = _rand(15, (b, s, h, d)), _rand(16, (b, s, h, d)), _rand(17, (b, s, h, d))
+    got = flash_attention(q, k, v, causal=True, scale=0.1, block_q=8, block_kv=8)
+    want = _xla_attention(q, k, v, True, 0, None, 0.1)
+    _assert_close(got, want)
+
+
+def test_flash_bf16_close_to_f32_reference():
+    b, s, h, d = 1, 32, 2, 16
+    q, k, v = _rand(18, (b, s, h, d)), _rand(19, (b, s, h, d)), _rand(20, (b, s, h, d))
+    got = flash_attention(
+        q.astype(jnp.bfloat16),
+        k.astype(jnp.bfloat16),
+        v.astype(jnp.bfloat16),
+        causal=True,
+        block_q=8,
+        block_kv=8,
+    )
+    want = _xla_attention(q, k, v, True, 0, None, None)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_flash_gradients_match_xla():
+    b, s, h, d = 1, 16, 2, 8
+    q, k, v = _rand(21, (b, s, h, d)), _rand(22, (b, s, h, d)), _rand(23, (b, s, h, d))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=8, block_kv=8) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, True, 0, None, None) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gx):
+        _assert_close(a, b_, atol=1e-4)
+
+
+def test_attention_auto_rejects_mask_on_pallas():
+    b, s, h, d = 1, 16, 1, 8
+    q, k, v = _rand(24, (b, s, h, d)), _rand(25, (b, s, h, d)), _rand(26, (b, s, h, d))
+    with pytest.raises(NotImplementedError):
+        attention(q, k, v, mask=jnp.ones((b, s), bool), impl="pallas")
+
+
+def test_attention_kv_lens_xla_path_equals_mask():
+    b, s, h, d = 2, 12, 1, 8
+    q, k, v = _rand(27, (b, s, h, d)), _rand(28, (b, s, h, d)), _rand(29, (b, s, h, d))
+    kv_lens = jnp.array([5, 9], jnp.int32)
+    got = attention(q, k, v, causal=False, kv_lens=kv_lens, impl="xla")
+    mask = jnp.arange(s)[None, :] < kv_lens[:, None]
+    want = attention(q, k, v, causal=False, mask=mask, impl="xla")
+    _assert_close(got, want)
+
+
+def test_flash_pallas_impl_via_attention():
+    b, s, h, d = 1, 32, 2, 16
+    q, k, v = _rand(30, (b, s, h, d)), _rand(31, (b, s, h, d)), _rand(32, (b, s, h, d))
+    got = attention(q, k, v, causal=True, impl="pallas")
+    want = attention(q, k, v, causal=True, impl="xla")
+    _assert_close(got, want)
+
+
+def test_flash_decode_sq1():
+    # sq=1 decode shape: padded q block, KV loop bounded by kv_lens
+    b, skv, h, d = 2, 64, 2, 16
+    q = _rand(33, (b, 1, h, d))
+    k, v = _rand(34, (b, skv, h, d)), _rand(35, (b, skv, h, d))
+    offsets = jnp.array([10, 30], jnp.int32)
+    got = flash_attention(
+        q, k, v, causal=True, q_offset=offsets, kv_lens=offsets + 1,
+        block_q=16, block_kv=16,
+    )
+    want = attention(
+        q, k, v, causal=True, q_offset=offsets, kv_lens=offsets + 1, impl="xla"
+    )
+    _assert_close(got, want)
+
+
+def test_fully_masked_rows_zero_on_both_paths():
+    # kv_lens == 0 slot: both impls emit zeros (not uniform mean(v))
+    b, s, h, d = 2, 8, 1, 8
+    q, k, v = _rand(36, (b, s, h, d)), _rand(37, (b, s, h, d)), _rand(38, (b, s, h, d))
+    kv_lens = jnp.array([0, s], jnp.int32)
+    xla = attention(q, k, v, causal=False, kv_lens=kv_lens, impl="xla")
+    fl = flash_attention(q, k, v, causal=False, kv_lens=kv_lens, block_q=8, block_kv=8)
+    np.testing.assert_allclose(np.asarray(xla)[0], 0.0, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(fl)[0], 0.0, atol=1e-7)
+    _assert_close(fl[1], xla[1])
