@@ -113,13 +113,16 @@ def _block(
     kv_cache: Optional[tuple[jnp.ndarray, jnp.ndarray]] = None,
     starts: Optional[jnp.ndarray] = None,
     kv_lens: Optional[jnp.ndarray] = None,
+    attn_fn: Optional[Any] = None,
 ) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray]]:
     """One decoder block — the single implementation shared by the
-    no-cache forward and the cached prefill/decode path.
+    no-cache forward, the cached prefill/decode path, and the
+    sequence-parallel ring path (which passes ``attn_fn``).
 
-    Without cache: attention over this call's keys, returns (out, (k, v)).
-    With cache: merges k/v into the per-batch cache at ``starts`` [B] and
-    attends the full cache window; returns (out, (k_cache, v_cache)).
+    Without cache: attention over this call's keys (via ``attn_fn`` when
+    given), returns (out, (k, v)). With cache: merges k/v into the
+    per-batch cache at ``starts`` [B] and attends the full cache window;
+    returns (out, (k_cache, v_cache)).
     """
     b, s, _ = x.shape
     h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
@@ -130,7 +133,10 @@ def _block(
     k = apply_rope(k, freqs, positions)
 
     if kv_cache is None:
-        attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        if attn_fn is not None:
+            attn = attn_fn(q, k, v)
+        else:
+            attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
         merged = (k, v)
     else:
         k_cache, v_cache = kv_cache

@@ -1,0 +1,210 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Long-context is first-class (SURVEY.md §5 "long-context/sequence
+parallelism" — absent in the reference, required of the TPU build): a
+sequence too long for one chip's HBM is sharded across the ``sp`` axis,
+each device holding a [B, S/sp] slice of tokens, activations, K and V.
+
+Design (Liu et al. blockwise ring attention, the scaling-book recipe):
+- run the WHOLE transformer under ``shard_map`` with the sequence axis
+  sharded over ``sp``: embedding gather, norms, and MLP are pointwise over
+  sequence so they need no communication; RoPE uses absolute positions
+  computed from the shard index;
+- attention rotates K/V shards around the ring with ``jax.lax.ppermute``
+  (XLA lowers to ICI neighbor exchange, overlapping the transfer with the
+  current chunk's compute), combining chunks with the same online-softmax
+  update the flash kernel uses — max/sum-exp accumulators, one pass, no
+  [S, S] materialization;
+- the causal mask between chunk pairs is applied elementwise; fully-masked
+  pairs (source chunk strictly after the query chunk) burn one masked
+  matmul rather than branching — SPMD keeps all devices in lockstep
+  through the ring anyway;
+- next-token loss under sequence sharding shifts targets across shard
+  boundaries with one more ppermute and a validity mask for the global
+  last position; means reduce with psum over (sp, dp-like) axes.
+
+All public entry points take the mesh and build the shard_map; the inner
+functions are plain per-shard JAX, jit-compiled once.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gofr_tpu.models.quant import mm as _mm
+from gofr_tpu.models.transformer import TransformerConfig, _block, _cached_freqs
+from gofr_tpu.ops.norms import rms_norm
+
+_NEG_INF = float(-1e30)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Blockwise ring attention over sequence shards.
+
+    Must run inside ``shard_map`` with the sequence axis sharded over
+    ``axis_name``. q, k, v: per-device shards [B, S_local, H(q|kv), D] at
+    shard index ``axis_index(axis_name)``; position of local row j is
+    ``idx * S_local + j``. Returns the attention output shard.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, hq, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    groups = hq // hkv
+    if scale is None:
+        scale = d ** -0.5
+
+    qg = q.reshape(b, sq, hkv, groups, d)
+    q_pos = idx * sq + jnp.arange(sq)  # [sq] absolute
+
+    # online-softmax accumulators in the grouped layout [b, hkv, g, sq, ·]
+    m = jnp.full((b, hkv, groups, sq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, hkv, groups, sq, 1), jnp.float32)
+    acc = jnp.zeros((b, hkv, groups, sq, d), jnp.float32)
+
+    # send to the right neighbor; after t steps we hold chunk (idx - t) % n
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    k_cur, v_cur = k, v
+    for step in range(n):
+        src = (idx - step) % n
+        kv_pos = src * skv + jnp.arange(skv)  # [skv] absolute
+
+        s = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, k_cur, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            mask = (kv_pos[None, :] <= q_pos[:, None])[None, None, None]
+            s = jnp.where(mask, s, _NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bhgqk,bkhd->bhgqd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32,
+        )
+        m = m_new
+
+        if step < n - 1:
+            # one combined neighbor exchange over ICI; XLA overlaps it
+            # with the next chunk's matmuls
+            k_cur, v_cur = jax.lax.ppermute((k_cur, v_cur), axis_name, perm)
+
+    out = acc / jnp.where(l == 0.0, 1.0, l)  # masked rows (none when causal) → 0
+    # [b, hkv, g, sq, d] -> [b, sq, hq, d]
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, sq, hq, d)
+    return out.astype(q.dtype)
+
+
+def _shard_forward(
+    params: dict, tokens: jnp.ndarray, cfg: TransformerConfig, axis_name: str
+) -> jnp.ndarray:
+    """Per-shard transformer forward: tokens [B, S_local] at shard
+    ``axis_index``; everything except attention is sequence-pointwise, so
+    the canonical decoder block (models.transformer._block) is reused with
+    ring attention injected via ``attn_fn``."""
+    b, s = tokens.shape
+    n = jax.lax.axis_size(axis_name)
+    if s * n > cfg.max_seq:
+        raise ValueError(
+            f"global sequence {s * n} exceeds cfg.max_seq {cfg.max_seq} "
+            "(RoPE table bound) — raise max_seq for long-context configs"
+        )
+    idx = jax.lax.axis_index(axis_name)
+    freqs = jnp.asarray(_cached_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta))
+    positions = idx * s + jnp.arange(s)  # absolute positions of this shard
+    x = params["embed"][tokens]
+
+    def attn_fn(q, k, v):
+        return ring_attention(q, k, v, axis_name=axis_name, causal=True)
+
+    def body(carry, p):
+        y, _ = _block(cfg, p, carry, freqs, positions, attn_fn=attn_fn)
+        return y, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["norm_f"], cfg.norm_eps)
+    return _mm(x, params["lm_head"]).astype(jnp.float32)
+
+
+def make_ring_forward(cfg: TransformerConfig, mesh: Mesh, batch_axes=("dp", "fsdp")):
+    """Jitted full-sequence forward with the sequence axis sharded over
+    ``sp``: tokens [B, S] -> logits [B, S, V], S split across the ring.
+    Params replicate over sp (combine with fsdp/tp via the outer sharding
+    as usual — GSPMD handles the interplay outside the shard_map)."""
+    fwd = jax.shard_map(
+        functools.partial(_shard_forward, cfg=cfg, axis_name="sp"),
+        mesh=mesh,
+        in_specs=(P(), P(batch_axes, "sp")),
+        out_specs=P(batch_axes, "sp", None),
+        check_vma=False,
+    )
+    return jax.jit(fwd)
+
+
+def _shard_loss(
+    params: dict, tokens: jnp.ndarray, cfg: TransformerConfig, axis_name: str
+) -> jnp.ndarray:
+    """Per-shard next-token loss. The target for the shard's last position
+    is the FIRST token of the right neighbor's shard (ppermute); the global
+    last position is masked out."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s = tokens.shape
+    logits = _shard_forward(params, tokens, cfg, axis_name)  # [B, S_local, V]
+
+    # left-rotate first tokens: shard i receives shard (i+1)'s tokens[:, 0]
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    next_first = jax.lax.ppermute(tokens[:, :1], axis_name, perm)  # [B, 1]
+    targets = jnp.concatenate([tokens[:, 1:], next_first], axis=1)  # [B, S_local]
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # mask the global final position (no next token exists)
+    is_last_shard = idx == (n - 1)
+    pos_weight = jnp.where(
+        jnp.logical_and(is_last_shard, jnp.arange(s) == s - 1), 0.0, 1.0
+    )[None, :]
+    local_sum = jnp.sum(nll * pos_weight)
+    local_cnt = jnp.sum(jnp.broadcast_to(pos_weight, nll.shape))
+    total = jax.lax.psum(jnp.stack([local_sum, local_cnt]), axis_name)
+    return total[0] / total[1]
+
+
+def make_ring_loss(cfg: TransformerConfig, mesh: Mesh, batch_axes=("dp", "fsdp")):
+    """Jitted sequence-parallel next-token loss: tokens [B, S] -> scalar.
+    Batch-axis averaging happens implicitly: each dp shard computes its own
+    mean and the jit-level output spec replicates (psum over sp happens
+    inside; outer mean over batch shards via jnp.mean of per-shard means
+    is exact because all shards see the same position count)."""
+
+    def per_shard(params, tokens):
+        loss = _shard_loss(params, tokens, cfg, axis_name="sp")
+        # average over batch-sharding axes too so the replicated output is
+        # the global mean
+        for ax in batch_axes:
+            loss = jax.lax.pmean(loss, ax)
+        return loss
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), P(batch_axes, "sp")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
