@@ -1,0 +1,18 @@
+"""Loss primitives shared by the plain, ring (sp), and pipeline (pp)
+training paths — one definition so the parallel losses can never silently
+diverge from the baseline the tests compare against."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def next_token_nll(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Per-position negative log-likelihood.
+
+    logits [..., S, V] (any float dtype; softmax accumulates in f32),
+    targets [..., S] int -> nll [..., S] float32.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -10,6 +10,11 @@ over DCN (SURVEY.md §2 "distributed communication backend").
 """
 
 from gofr_tpu.parallel.mesh import axis_size, make_mesh, mesh_shape_for
+from gofr_tpu.parallel.pipeline import (
+    make_pipeline_forward,
+    make_pipeline_loss,
+    place_pipeline_params,
+)
 from gofr_tpu.parallel.ring import make_ring_forward, make_ring_loss, ring_attention
 from gofr_tpu.parallel.sharding import (
     batch_spec,
@@ -22,4 +27,5 @@ __all__ = [
     "make_mesh", "mesh_shape_for", "axis_size",
     "param_specs", "batch_spec", "cache_specs", "shard_params",
     "ring_attention", "make_ring_forward", "make_ring_loss",
+    "make_pipeline_forward", "make_pipeline_loss", "place_pipeline_params",
 ]

@@ -5,6 +5,8 @@ Axis convention (jax-ml scaling-book style):
 - ``fsdp`` — data parallelism with parameter sharding (ZeRO-3 style;
              params/optimizer sharded, all-gathered per layer)
 - ``sp``   — sequence/context parallelism (ring attention over ICI)
+- ``pp``   — pipeline parallelism (layer stages, GPipe microbatches)
+- ``ep``   — expert parallelism (MoE experts, all_to_all token dispatch)
 - ``tp``   — tensor parallelism (heads / hidden dim split)
 
 On a physical slice the trailing axes should map to the fastest ICI links;
@@ -20,7 +22,7 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh
 
-AXES = ("dp", "fsdp", "sp", "tp")
+AXES = ("dp", "fsdp", "sp", "pp", "ep", "tp")
 
 
 def mesh_shape_for(
@@ -28,12 +30,19 @@ def mesh_shape_for(
     tp: int = 1,
     sp: int = 1,
     fsdp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
 ) -> dict[str, int]:
     """Fill ``dp`` with whatever remains after the explicit axes."""
-    denom = tp * sp * fsdp
+    denom = tp * sp * fsdp * pp * ep
     if n_devices % denom != 0:
-        raise ValueError(f"{n_devices} devices not divisible by tp*sp*fsdp={denom}")
-    return {"dp": n_devices // denom, "fsdp": fsdp, "sp": sp, "tp": tp}
+        raise ValueError(
+            f"{n_devices} devices not divisible by tp*sp*fsdp*pp*ep={denom}"
+        )
+    return {
+        "dp": n_devices // denom, "fsdp": fsdp, "sp": sp,
+        "pp": pp, "ep": ep, "tp": tp,
+    }
 
 
 def make_mesh(

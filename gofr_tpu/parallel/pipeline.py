@@ -1,0 +1,194 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2 "parallelism
+strategies: PP: absent"); the TPU build makes it first-class the XLA way:
+
+- the transformer's stacked layer weights ``[L, ...]`` are sharded over
+  ``pp`` on the leading axis — device i holds the contiguous layer block
+  ``[i·L/pp, (i+1)·L/pp)``, i.e. stage i. No reshape, no per-stage param
+  trees: the sharding IS the stage assignment;
+- inside ``shard_map`` every device runs the same program (SPMD lockstep):
+  a ``lax.scan`` over the classic GPipe schedule of ``M + pp - 1`` ticks.
+  Stage 0 injects microbatch t at tick t; every stage applies its layer
+  block; activations rotate to the next stage with ``jax.lax.ppermute``
+  (ICI neighbor exchange, overlapped with the next tick's matmuls by XLA);
+  the last stage records each exiting microbatch into an output buffer;
+- bubbles are the standard GPipe ``(pp-1)/(M+pp-1)`` fraction — raise
+  ``n_micro`` to amortize;
+- backward needs no hand-written schedule: ``jax.grad`` differentiates
+  through the scan + ppermute (transpose of ppermute is the reversed
+  permutation), yielding the reverse pipeline automatically, and the
+  transpose of replicated in_specs psums grads for the shared embed /
+  lm_head / norm weights across stages;
+- combines with data parallelism by sharding the batch over ``dp``/``fsdp``
+  in the same shard_map (each pp ring serves one dp shard) and with tensor
+  parallelism by leaving ``tp`` to GSPMD outside the shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gofr_tpu.models.quant import mm as _mm
+from gofr_tpu.models.transformer import TransformerConfig, _block, _cached_freqs
+from gofr_tpu.ops.loss import next_token_nll
+from gofr_tpu.ops.norms import rms_norm
+
+
+def pipeline_param_specs(params: Optional[dict] = None) -> Any:
+    """shard_map in_specs prefix tree: stacked ``layers`` sharded over pp on
+    the leading (layer) axis, everything else replicated. Derived from the
+    actual param tree when given so placement and in_specs cannot drift."""
+    keys = tuple(params) if params is not None else ("embed", "norm_f", "lm_head", "layers")
+    return {k: (P("pp") if k == "layers" else P()) for k in keys}
+
+
+def _check_stages(cfg: TransformerConfig, mesh: Mesh) -> None:
+    pp = mesh.shape.get("pp", 1)
+    if cfg.n_layers % pp:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={pp} — each pipeline "
+            "stage needs an equal contiguous layer block"
+        )
+
+
+def _stage_forward(
+    cfg: TransformerConfig, stage_layers: Any, x: jnp.ndarray, freqs: jnp.ndarray
+) -> jnp.ndarray:
+    """Apply this device's contiguous layer block to one microbatch."""
+    positions = jnp.arange(x.shape[1])
+
+    def body(carry, p):
+        y, _ = _block(cfg, p, carry, freqs, positions)
+        return y, None
+
+    y, _ = lax.scan(body, x, stage_layers)
+    return y
+
+
+def _pipe_hidden(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    n_micro: int,
+    axis_name: str,
+) -> tuple[jnp.ndarray, jnp.ndarray, int]:
+    """Run the GPipe schedule. Returns (hidden [B, S, D] — real data only on
+    the LAST stage, zeros elsewhere —, stage index, n_stages)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s = tokens.shape
+    if b % n_micro:
+        raise ValueError(f"local batch {b} not divisible by n_micro={n_micro}")
+    mb = b // n_micro
+    freqs = jnp.asarray(_cached_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta))
+    emb = params["embed"][tokens].reshape(n_micro, mb, s, cfg.dim)
+
+    state0 = jnp.zeros((mb, s, cfg.dim), emb.dtype)
+    outs0 = jnp.zeros((n_micro, mb, s, cfg.dim), emb.dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        state, outs = carry
+        # stage 0 injects microbatch t (replays the last one during drain
+        # ticks; that output exits after the loop ends and is never read)
+        inject = emb[jnp.minimum(t, n_micro - 1)]
+        x_in = jnp.where(idx == 0, inject, state)
+        y = _stage_forward(cfg, params["layers"], x_in, freqs)
+        # microbatch injected at tick t exits the last stage at tick t+n-1,
+        # so at tick t the exiting microbatch is o = t-(n-1)
+        o = t - (n - 1)
+        write = jnp.logical_and(idx == n - 1, o >= 0)
+        upd = lax.dynamic_update_slice_in_dim(
+            outs, y[None].astype(outs.dtype), jnp.clip(o, 0, n_micro - 1), axis=0
+        )
+        outs = jnp.where(write, upd, outs)
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, outs), None
+
+    (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(n_micro + n - 1))
+    return outs.reshape(b, s, cfg.dim), idx, n
+
+
+def make_pipeline_forward(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    n_micro: Optional[int] = None,
+    batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+):
+    """Jitted pipeline-parallel forward: tokens [B, S] -> logits [B, S, V]
+    (replicated across pp via a final psum). Batch is sharded over
+    ``batch_axes``; ``n_micro`` defaults to 2·pp (halves the bubble)."""
+    _check_stages(cfg, mesh)
+    n_micro = n_micro or 2 * mesh.shape.get("pp", 1)
+
+    def per_shard(params, tokens):
+        hidden, idx, n = _pipe_hidden(params, tokens, cfg, n_micro, "pp")
+        h = rms_norm(hidden, params["norm_f"], cfg.norm_eps)
+        logits = _mm(h, params["lm_head"]).astype(jnp.float32)
+        # only the last stage holds real activations; psum replicates its
+        # logits to the whole pp ring
+        logits = jnp.where(idx == n - 1, logits, 0.0)
+        return lax.psum(logits, "pp")
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(pipeline_param_specs(), P(batch_axes)),
+        out_specs=P(batch_axes),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def make_pipeline_loss(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    n_micro: Optional[int] = None,
+    batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+):
+    """Jitted pipeline-parallel next-token loss: tokens [B, S] -> scalar.
+    The loss (not the [B, S, V] logits) crosses the pp ring — one scalar
+    psum instead of an all-reduce of logits."""
+    _check_stages(cfg, mesh)
+    n_micro = n_micro or 2 * mesh.shape.get("pp", 1)
+
+    def per_shard(params, tokens):
+        hidden, idx, n = _pipe_hidden(params, tokens[:, :-1], cfg, n_micro, "pp")
+        h = rms_norm(hidden, params["norm_f"], cfg.norm_eps)
+        logits = _mm(h, params["lm_head"]).astype(jnp.float32)
+        nll = next_token_nll(logits, tokens[:, 1:])
+        loss = lax.psum(jnp.where(idx == n - 1, nll.mean(), 0.0), "pp")
+        for ax in batch_axes:
+            loss = lax.pmean(loss, ax)
+        return loss
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(pipeline_param_specs(), P(batch_axes)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def place_pipeline_params(params: dict, mesh: Mesh) -> dict:
+    """Shard the param tree for the pipeline (same spec rule shard_map's
+    in_specs use, via ``pipeline_param_specs``) — device_put with
+    NamedShardings so the jitted step never reshuffles."""
+    from jax.sharding import NamedSharding
+
+    specs = pipeline_param_specs(params)
+
+    def put(tree: Any, spec: P) -> Any:
+        if isinstance(tree, dict):
+            return {k: put(v, spec) for k, v in tree.items()}
+        return jax.device_put(tree, NamedSharding(mesh, spec))
+
+    return {k: put(v, specs[k]) for k, v in params.items()}

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from gofr_tpu.models.quant import mm as _mm
 from gofr_tpu.models.transformer import TransformerConfig, _block, _cached_freqs
+from gofr_tpu.ops.loss import next_token_nll
 from gofr_tpu.ops.norms import rms_norm
 
 _NEG_INF = float(-1e30)
@@ -171,9 +172,7 @@ def _shard_loss(
     perm = [(i, (i - 1) % n) for i in range(n)]
     next_first = jax.lax.ppermute(tokens[:, :1], axis_name, perm)  # [B, 1]
     targets = jnp.concatenate([tokens[:, 1:], next_first], axis=1)  # [B, S_local]
-
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    nll = next_token_nll(logits, targets)
     # mask the global final position (no next token exists)
     is_last_shard = idx == (n - 1)
     pos_weight = jnp.where(

@@ -18,6 +18,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gofr_tpu.models.transformer import TransformerConfig, transformer_forward
+from gofr_tpu.ops.loss import next_token_nll
 from gofr_tpu.parallel.sharding import batch_spec, param_specs, shard_params
 
 
@@ -30,9 +31,7 @@ def cross_entropy_loss(
     """Next-token prediction loss over ``tokens`` [B, S]; mask [B, S-1]
     optionally excludes positions (padding) from the mean."""
     logits = transformer_forward(params, tokens[:, :-1], cfg)  # [B, S-1, V]
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    nll = next_token_nll(logits, tokens[:, 1:])
     if loss_mask is not None:
         weights = loss_mask.astype(jnp.float32)
         return (nll * weights).sum() / jnp.maximum(weights.sum(), 1.0)

@@ -1,0 +1,84 @@
+"""Pipeline parallelism (pp axis): GPipe schedule must be numerically
+identical to the plain single-device forward — same params, same tokens,
+stages are just a partition of the layer stack."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+    transformer_forward,
+)
+from gofr_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from gofr_tpu.parallel.pipeline import (
+    make_pipeline_forward,
+    make_pipeline_loss,
+    place_pipeline_params,
+)
+from gofr_tpu.training.trainer import cross_entropy_loss
+
+CFG = TransformerConfig(
+    vocab_size=97, dim=16, n_layers=4, n_heads=4, n_kv_heads=2,
+    hidden_dim=32, max_seq=64, dtype=jnp.float32, attn_impl="xla",
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    return jax.random.randint(jax.random.key(1), (8, 12), 0, CFG.vocab_size)
+
+
+def test_pipeline_forward_matches_plain(params, tokens):
+    mesh = make_mesh(mesh_shape_for(8, pp=4), devices=jax.devices()[:8])
+    fwd = make_pipeline_forward(CFG, mesh, n_micro=2)
+    got = np.asarray(fwd(place_pipeline_params(params, mesh), tokens))
+    want = np.asarray(transformer_forward(params, tokens, CFG))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_forward_pp2_with_dp(params, tokens):
+    mesh = make_mesh(mesh_shape_for(8, pp=2, fsdp=2), devices=jax.devices()[:8])
+    fwd = make_pipeline_forward(CFG, mesh, n_micro=2)
+    got = np.asarray(fwd(place_pipeline_params(params, mesh), tokens))
+    want = np.asarray(transformer_forward(params, tokens, CFG))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_loss_and_grads_match_plain(params, tokens):
+    mesh = make_mesh(mesh_shape_for(8, pp=4), devices=jax.devices()[:8])
+    loss_fn = make_pipeline_loss(CFG, mesh, n_micro=2)
+    placed = place_pipeline_params(params, mesh)
+
+    got_loss, got_grads = jax.value_and_grad(loss_fn)(placed, tokens)
+    want_loss, want_grads = jax.value_and_grad(
+        lambda p, t: cross_entropy_loss(p, t, CFG)
+    )(params, tokens)
+
+    np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-4)
+    for key in ("embed", "lm_head", "norm_f"):
+        np.testing.assert_allclose(
+            np.asarray(got_grads[key]), np.asarray(want_grads[key]),
+            rtol=5e-3, atol=1e-5, err_msg=key,
+        )
+    for key in ("wq", "w_down", "attn_norm"):
+        np.testing.assert_allclose(
+            np.asarray(got_grads["layers"][key]),
+            np.asarray(want_grads["layers"][key]),
+            rtol=5e-3, atol=1e-5, err_msg=f"layers.{key}",
+        )
+
+
+def test_pipeline_rejects_indivisible_microbatch(params):
+    mesh = make_mesh(mesh_shape_for(8, pp=4), devices=jax.devices()[:8])
+    fwd = make_pipeline_forward(CFG, mesh, n_micro=3)
+    bad = jnp.ones((8, 12), jnp.int32)  # 8 % 3 != 0
+    with pytest.raises(ValueError, match="n_micro"):
+        fwd(place_pipeline_params(params, mesh), bad)
