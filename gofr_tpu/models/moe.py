@@ -1,0 +1,192 @@
+"""Mixture-of-Experts decoder: top-k routed SwiGLU experts per layer.
+
+The reference has no ML components at all (SURVEY.md §2 "EP: absent");
+expert parallelism is a first-class requirement of the TPU build. This
+module holds the model definition and the exact (dense) compute path:
+
+- ``MoEConfig`` extends the dense transformer config with expert counts
+  and routing hyperparameters (Mixtral-style: every layer's MLP is a
+  top-k mixture of SwiGLU experts; attention is unchanged GQA);
+- the router is a linear gate over the hidden state; top-k softmax
+  weights are renormalized over the chosen experts;
+- ``moe_forward`` computes every expert for every token and mixes by the
+  routing weights — exact, no capacity drops, O(E·T·D·F) compute. It is
+  the single-device serving path for small models and the numerical
+  reference the expert-parallel path (gofr_tpu.parallel.expert, which
+  dispatches tokens over the ``ep`` mesh axis with all_to_all) is tested
+  against;
+- auxiliary losses: Switch-style load-balance loss and router z-loss,
+  accumulated across layers and returned beside the logits.
+
+Capacity-based dispatch (static shapes for XLA) lives in ``_routing`` and
+is shared by the expert-parallel path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gofr_tpu.models.quant import mm as _mm
+from gofr_tpu.models.transformer import TransformerConfig, _block, _cached_freqs
+from gofr_tpu.ops.norms import rms_norm
+
+
+@dataclass(frozen=True)
+class MoEConfig(TransformerConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0  # expert slots = T·k·factor/E (EP path)
+    aux_weight: float = 0.01  # load-balance loss weight
+    z_weight: float = 1e-3  # router z-loss weight
+
+
+def init_moe(key: jax.Array, cfg: MoEConfig) -> dict:
+    """Param tree: attention weights match the dense transformer; the MLP
+    is replaced by a router [D, E] and stacked expert weights [E, D, F]."""
+    n_keys = cfg.n_layers * 9 + 3
+    keys = iter(jax.random.split(key, n_keys))
+
+    def dense(k: jax.Array, shape: tuple[int, ...], fan_in: int) -> jnp.ndarray:
+        return (jax.random.truncated_normal(k, -3, 3, shape) * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    params: dict[str, Any] = {
+        "embed": dense(next(keys), (cfg.vocab_size, cfg.dim), cfg.dim),
+        "norm_f": jnp.ones((cfg.dim,), cfg.dtype),
+        "lm_head": dense(next(keys), (cfg.dim, cfg.vocab_size), cfg.dim),
+    }
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append(
+            {
+                "attn_norm": jnp.ones((cfg.dim,), cfg.dtype),
+                "wq": dense(next(keys), (cfg.dim, cfg.dim), cfg.dim),
+                "wk": dense(next(keys), (cfg.dim, kv_dim), cfg.dim),
+                "wv": dense(next(keys), (cfg.dim, kv_dim), cfg.dim),
+                "wo": dense(next(keys), (cfg.dim, cfg.dim), cfg.dim),
+                "mlp_norm": jnp.ones((cfg.dim,), cfg.dtype),
+                # router in f32: routing decisions are precision-sensitive
+                "router": dense(next(keys), (cfg.dim, cfg.n_experts), cfg.dim).astype(jnp.float32),
+                "w_gate": dense(next(keys), (cfg.n_experts, cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_up": dense(next(keys), (cfg.n_experts, cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_down": dense(next(keys), (cfg.n_experts, cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
+            }
+        )
+    params["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return params
+
+
+def _route_top_k(
+    logits: jnp.ndarray, top_k: int
+) -> tuple[jnp.ndarray, jnp.ndarray, dict]:
+    """Top-k expert choice from router logits [T, E]: returns renormalized
+    weights [T, k], indices [T, k], and the aux-loss dict."""
+    n_experts = logits.shape[-1]
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+    gate_vals, expert_idx = lax.top_k(gates, top_k)
+    gate_vals = gate_vals / gate_vals.sum(-1, keepdims=True)
+    # Switch load-balance: E · Σ_e (token fraction to e) · (mean router prob e)
+    me = gates.mean(axis=0)
+    f = jax.nn.one_hot(expert_idx[:, 0], n_experts).mean(axis=0)
+    load_balance = n_experts * jnp.sum(f * me)
+    z = jnp.mean(jax.scipy.special.logsumexp(logits.astype(jnp.float32), -1) ** 2)
+    return gate_vals, expert_idx, {"load_balance": load_balance, "router_z": z}
+
+
+def _routing(
+    logits: jnp.ndarray, top_k: int, capacity: int
+) -> tuple[jnp.ndarray, jnp.ndarray, dict]:
+    """Capacity-bounded dispatch/combine tensors (GShard style) — static
+    shapes for XLA. dispatch/combine: [T, E, C]; tokens overflowing an
+    expert's C slots are dropped (their residual stream passes through)."""
+    t, n_experts = logits.shape
+    gate_vals, expert_idx, aux = _route_top_k(logits, top_k)
+    dispatch = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    counts = jnp.zeros((n_experts,), jnp.float32)
+    for j in range(top_k):
+        oh = jax.nn.one_hot(expert_idx[:, j], n_experts)  # [T, E]
+        pos = counts[None, :] + jnp.cumsum(oh, axis=0) - oh  # slot before me
+        pos_t = jnp.sum(pos * oh, axis=-1).astype(jnp.int32)  # [T]
+        slot = jax.nn.one_hot(pos_t, capacity) * (pos_t < capacity)[:, None]
+        d_j = oh[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d_j
+        combine = combine + gate_vals[:, j, None, None] * d_j
+        counts = counts + oh.sum(axis=0)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(
+    w_gate: jnp.ndarray, w_up: jnp.ndarray, w_down: jnp.ndarray, xs: jnp.ndarray
+) -> jnp.ndarray:
+    """SwiGLU over per-expert token blocks: xs [E, C, D] -> [E, C, D]."""
+    g = jnp.einsum("ecd,edf->ecf", xs, w_gate)
+    u = jnp.einsum("ecd,edf->ecf", xs, w_up)
+    return jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, w_down)
+
+
+def _moe_mlp_dense(p: dict, x: jnp.ndarray, cfg: MoEConfig) -> tuple[jnp.ndarray, dict]:
+    """Exact mixture: every expert computes every token, outputs mixed by
+    the renormalized top-k weights. x [B, S, D]."""
+    b, s, d = x.shape
+    xt = x.reshape(b * s, d)
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32), p["router"])
+    gate_vals, expert_idx, aux = _route_top_k(logits, cfg.top_k)
+    g = jnp.einsum("td,edf->tef", xt, p["w_gate"])
+    u = jnp.einsum("td,edf->tef", xt, p["w_up"])
+    y_all = jnp.einsum("tef,efd->ted", jax.nn.silu(g) * u, p["w_down"])  # [T, E, D]
+    oh = jax.nn.one_hot(expert_idx, cfg.n_experts)  # [T, k, E]
+    w = jnp.sum(gate_vals[:, :, None] * oh, axis=1)  # [T, E]
+    out = jnp.einsum("te,ted->td", w.astype(y_all.dtype), y_all)
+    return out.reshape(b, s, d).astype(x.dtype), aux
+
+
+def moe_block(
+    cfg: MoEConfig,
+    p: dict,
+    x: jnp.ndarray,
+    freqs: jnp.ndarray,
+    positions: jnp.ndarray,
+    moe_mlp: Any = _moe_mlp_dense,
+) -> tuple[jnp.ndarray, dict]:
+    """The canonical decoder block (models/transformer.py ``_block``: GQA
+    attention + residual) with the MLP swapped for routed experts."""
+    y, _, aux = _block(
+        cfg, p, x, freqs, positions, mlp_fn=lambda pp, h: moe_mlp(pp, h, cfg)
+    )
+    return y, aux
+
+
+def moe_forward(
+    params: dict, tokens: jnp.ndarray, cfg: MoEConfig, moe_mlp: Any = _moe_mlp_dense
+) -> tuple[jnp.ndarray, dict]:
+    """Full forward -> (logits [B, S, V] f32, aux losses averaged over
+    layers)."""
+    b, s = tokens.shape
+    freqs = jnp.asarray(_cached_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta))
+    positions = jnp.arange(s)
+    x = params["embed"][tokens]
+
+    def body(carry, layer_params):
+        y, aux = moe_block(cfg, layer_params, carry, freqs, positions, moe_mlp)
+        return y, aux
+
+    x, aux_stack = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["norm_f"], cfg.norm_eps)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
+    aux = {k: v.mean() for k, v in aux_stack.items()}
+    return logits, aux
+
+
+def moe_loss(params: dict, tokens: jnp.ndarray, cfg: MoEConfig) -> jnp.ndarray:
+    """Next-token loss + weighted aux losses (dense/exact path)."""
+    from gofr_tpu.ops.loss import next_token_nll
+
+    logits, aux = moe_forward(params, tokens[:, :-1], cfg)
+    nll = next_token_nll(logits, tokens[:, 1:]).mean()
+    return nll + cfg.aux_weight * aux["load_balance"] + cfg.z_weight * aux["router_z"]

@@ -104,6 +104,13 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig) -> dict:
     return params
 
 
+def _default_mlp(p: dict, h: jnp.ndarray) -> tuple[jnp.ndarray, dict]:
+    """Dense SwiGLU MLP (the ``mlp_fn`` default); MoE swaps in routed
+    experts here (models/moe.py)."""
+    gated = jax.nn.silu(_mm(h, p["w_gate"])) * _mm(h, p["w_up"])
+    return _mm(gated, p["w_down"]), {}
+
+
 def _block(
     cfg: TransformerConfig,
     p: dict,
@@ -114,15 +121,17 @@ def _block(
     starts: Optional[jnp.ndarray] = None,
     kv_lens: Optional[jnp.ndarray] = None,
     attn_fn: Optional[Any] = None,
-) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray]]:
+    mlp_fn: Optional[Any] = None,
+) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray], dict]:
     """One decoder block — the single implementation shared by the
-    no-cache forward, the cached prefill/decode path, and the
-    sequence-parallel ring path (which passes ``attn_fn``).
+    no-cache forward, the cached prefill/decode path, the sequence-parallel
+    ring path (which passes ``attn_fn``), and the MoE model (which passes
+    ``mlp_fn`` returning (out, aux_losses)).
 
     Without cache: attention over this call's keys (via ``attn_fn`` when
-    given), returns (out, (k, v)). With cache: merges k/v into the
+    given), returns (out, (k, v), aux). With cache: merges k/v into the
     per-batch cache at ``starts`` [B] and attends the full cache window;
-    returns (out, (k_cache, v_cache)).
+    returns (out, (k_cache, v_cache), aux).
     """
     b, s, _ = x.shape
     h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
@@ -154,9 +163,9 @@ def _block(
 
     x = x + _mm(attn.reshape(b, s, cfg.dim), p["wo"])
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
-    gated = jax.nn.silu(_mm(h, p["w_gate"])) * _mm(h, p["w_up"])
-    x = x + _mm(gated, p["w_down"])
-    return x, merged
+    y, aux = (mlp_fn or _default_mlp)(p, h)
+    x = x + y
+    return x, merged, aux
 
 
 def transformer_forward(
@@ -170,7 +179,7 @@ def transformer_forward(
     x = params["embed"][tokens]
 
     def body(carry, layer_params):
-        y, _ = _block(cfg, layer_params, carry, freqs, positions)
+        y, _, _ = _block(cfg, layer_params, carry, freqs, positions)
         return y, None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
@@ -224,7 +233,7 @@ def _forward_with_cache(
 
     def body(carry, inputs):
         layer_params, k_cache, v_cache = inputs
-        y, (k_cache, v_cache) = _block(
+        y, (k_cache, v_cache), _ = _block(
             cfg, layer_params, carry, freqs, positions,
             kv_cache=(k_cache, v_cache), starts=starts, kv_lens=written,
         )

@@ -9,6 +9,12 @@ Host-to-host coordination rides the framework's own gRPC/HTTP service layer
 over DCN (SURVEY.md §2 "distributed communication backend").
 """
 
+from gofr_tpu.parallel.expert import (
+    make_moe_forward,
+    make_moe_loss,
+    moe_param_specs,
+    place_moe_params,
+)
 from gofr_tpu.parallel.mesh import axis_size, make_mesh, mesh_shape_for
 from gofr_tpu.parallel.pipeline import (
     make_pipeline_forward,
@@ -28,4 +34,5 @@ __all__ = [
     "param_specs", "batch_spec", "cache_specs", "shard_params",
     "ring_attention", "make_ring_forward", "make_ring_loss",
     "make_pipeline_forward", "make_pipeline_loss", "place_pipeline_params",
+    "make_moe_forward", "make_moe_loss", "moe_param_specs", "place_moe_params",
 ]

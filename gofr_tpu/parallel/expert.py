@@ -1,0 +1,169 @@
+"""Expert parallelism: MoE token dispatch over the ``ep`` mesh axis.
+
+The reference has no distributed components (SURVEY.md §2 "EP: absent");
+this is the TPU-native design:
+
+- expert weights ``[L, E, D, F]`` shard over ``ep`` on the expert axis —
+  each device owns ``E/ep`` experts (attention/router/embed replicate
+  over ep, batch shards over ALL of dp·fsdp·ep so attention stays pure
+  data-parallel);
+- inside ``shard_map`` each device routes its local tokens (GShard
+  capacity-bounded dispatch, static shapes), then ``jax.lax.all_to_all``
+  over ``ep`` exchanges token blocks so every device receives exactly the
+  tokens routed to ITS experts, computes its experts' SwiGLU, and a second
+  all_to_all returns outputs to the tokens' home devices — two ICI
+  all-to-alls per MoE layer, the canonical TPU MoE pattern;
+- gradients flow through both all_to_alls (transpose of all_to_all is the
+  reverse all_to_all); aux losses psum/pmean across the mesh.
+
+Numerical contract: with ample capacity this path equals the exact dense
+mixture (gofr_tpu.models.moe.moe_forward) — tested against it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gofr_tpu.models.moe import (
+    MoEConfig,
+    _expert_ffn,
+    _routing,
+    moe_forward,
+)
+from gofr_tpu.ops.loss import next_token_nll
+
+_EXPERT_KEYS = ("w_gate", "w_up", "w_down")
+
+
+_LAYER_KEYS = (
+    "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+    "router", "w_gate", "w_up", "w_down",
+)
+
+
+def moe_param_specs(params: Optional[dict] = None) -> Any:
+    """Spec tree: stacked expert weights [L, E, D, F] shard E over ep;
+    everything else replicates (tp/fsdp composition happens outside the
+    shard_map via GSPMD as usual). Derived from the actual param tree when
+    given so placement and shard_map in_specs cannot drift."""
+    top = tuple(params) if params is not None else ("embed", "norm_f", "lm_head", "layers")
+    layer_keys = tuple(params["layers"]) if params is not None else _LAYER_KEYS
+
+    def layer_specs() -> dict:
+        return {
+            k: (P(None, "ep") if k in _EXPERT_KEYS else P()) for k in layer_keys
+        }
+
+    return {k: (layer_specs() if k == "layers" else P()) for k in top}
+
+
+def place_moe_params(params: dict, mesh: Mesh) -> dict:
+    """device_put the tree with the same spec rule the shard_map uses."""
+    specs = moe_param_specs(params)
+
+    def put(tree: Any, spec: Any) -> Any:
+        if isinstance(tree, dict):
+            return {k: put(tree[k], spec[k] if isinstance(spec, dict) else spec) for k in tree}
+        return jax.device_put(tree, NamedSharding(mesh, spec))
+
+    return put(params, specs)
+
+
+def _capacity(tokens_local: int, cfg: MoEConfig) -> int:
+    cap = int(tokens_local * cfg.top_k * cfg.capacity_factor / cfg.n_experts)
+    return max(cap, 1)
+
+
+def _moe_mlp_ep(
+    p: dict, x: jnp.ndarray, cfg: MoEConfig, axis_name: str = "ep"
+) -> tuple[jnp.ndarray, dict]:
+    """Expert-parallel MoE MLP: x [B_loc, S, D]; expert weights arrive
+    sharded [E/ep, D, F]. Two all_to_alls move tokens to their experts'
+    devices and back."""
+    b, s, d = x.shape
+    t = b * s
+    capacity = _capacity(t, cfg)
+    xt = x.reshape(t, d)
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32), p["router"])
+    dispatch, combine, aux = _routing(logits, cfg.top_k, capacity)
+
+    # gather each expert's token block: [E, C, D] (E = GLOBAL expert count)
+    xs = jnp.einsum("tec,td->ecd", dispatch.astype(xt.dtype), xt)
+    # scatter expert blocks to their owners; collect peers' tokens along C:
+    # [E, C, D] -> [E/ep, ep·C, D]
+    xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=1, tiled=True)
+    ys = _expert_ffn(p["w_gate"], p["w_up"], p["w_down"], xs)
+    # return outputs to the tokens' home devices: [E/ep, ep·C, D] -> [E, C, D]
+    ys = lax.all_to_all(ys, axis_name, split_axis=1, concat_axis=0, tiled=True)
+    out = jnp.einsum("ecd,tec->td", ys, combine.astype(ys.dtype))
+    return out.reshape(b, s, d).astype(x.dtype), aux
+
+
+def make_moe_forward(
+    cfg: MoEConfig,
+    mesh: Mesh,
+    batch_axes: tuple[str, ...] = ("dp", "fsdp", "ep"),
+):
+    """Jitted expert-parallel forward: tokens [B, S] -> (logits [B, S, V],
+    aux). Batch shards over dp·fsdp·ep; experts over ep."""
+    _check_experts(cfg, mesh)
+
+    def per_shard(params, tokens):
+        logits, aux = moe_forward(params, tokens, cfg, moe_mlp=_moe_mlp_ep)
+        # aux statistics are per-device (local batch); average them so the
+        # replicated output is the global value, not an arbitrary shard's
+        for ax in batch_axes:
+            aux = {k: lax.pmean(v, ax) for k, v in aux.items()}
+        return logits, aux
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(moe_param_specs(), P(batch_axes)),
+        out_specs=(P(batch_axes), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def make_moe_loss(
+    cfg: MoEConfig,
+    mesh: Mesh,
+    batch_axes: tuple[str, ...] = ("dp", "fsdp", "ep"),
+):
+    """Jitted expert-parallel loss: next-token NLL + weighted aux losses,
+    pmean'd over the whole mesh."""
+    _check_experts(cfg, mesh)
+
+    def per_shard(params, tokens):
+        logits, aux = moe_forward(
+            params, tokens[:, :-1], cfg, moe_mlp=_moe_mlp_ep
+        )
+        loss = next_token_nll(logits, tokens[:, 1:]).mean()
+        loss = loss + cfg.aux_weight * aux["load_balance"] + cfg.z_weight * aux["router_z"]
+        for ax in batch_axes:
+            loss = lax.pmean(loss, ax)
+        return loss
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(moe_param_specs(), P(batch_axes)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _check_experts(cfg: MoEConfig, mesh: Mesh) -> None:
+    ep = mesh.shape.get("ep", 1)
+    if cfg.n_experts % ep:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by ep={ep} — each device "
+            "needs an equal expert block"
+        )

@@ -64,7 +64,7 @@ def _stage_forward(
     positions = jnp.arange(x.shape[1])
 
     def body(carry, p):
-        y, _ = _block(cfg, p, carry, freqs, positions)
+        y, _, _ = _block(cfg, p, carry, freqs, positions)
         return y, None
 
     y, _ = lax.scan(body, x, stage_layers)

@@ -134,7 +134,7 @@ def _shard_forward(
         return ring_attention(q, k, v, axis_name=axis_name, causal=True)
 
     def body(carry, p):
-        y, _ = _block(cfg, p, carry, freqs, positions, attn_fn=attn_fn)
+        y, _, _ = _block(cfg, p, carry, freqs, positions, attn_fn=attn_fn)
         return y, None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
