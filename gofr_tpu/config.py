@@ -8,8 +8,9 @@ in the environment, and lookups hit the live environment so tests can inject
 values with ``monkeypatch.setenv``.
 
 TPU-native keys added on top of the reference set (SURVEY.md §2 #22):
-``TPU_ENABLED``, ``TPU_TOPOLOGY``, ``MODEL_NAME``, ``MODEL_PATH``,
-``BATCH_MAX_SIZE``, ``BATCH_TIMEOUT_MS``, ``METRICS_ENABLED``.
+``TPU_ENABLED``, ``TPU_MESH`` (serving mesh, e.g. "tp=4,dp=4"),
+``MODEL_NAME``, ``MODEL_PATH``, ``MODEL_QUANT``, ``BATCH_MAX_SIZE``,
+``BATCH_TIMEOUT_MS``, ``METRICS_ENABLED``.
 """
 
 from __future__ import annotations

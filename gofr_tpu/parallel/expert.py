@@ -27,7 +27,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from gofr_tpu.models.moe import (
     MoEConfig,
@@ -64,14 +64,9 @@ def moe_param_specs(params: Optional[dict] = None) -> Any:
 
 def place_moe_params(params: dict, mesh: Mesh) -> dict:
     """device_put the tree with the same spec rule the shard_map uses."""
-    specs = moe_param_specs(params)
+    from gofr_tpu.parallel.sharding import shard_params
 
-    def put(tree: Any, spec: Any) -> Any:
-        if isinstance(tree, dict):
-            return {k: put(tree[k], spec[k] if isinstance(spec, dict) else spec) for k in tree}
-        return jax.device_put(tree, NamedSharding(mesh, spec))
-
-    return put(params, specs)
+    return shard_params(params, mesh, moe_param_specs(params))
 
 
 def _capacity(tokens_local: int, cfg: MoEConfig) -> int:

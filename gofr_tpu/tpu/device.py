@@ -7,6 +7,12 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
 - ``MODEL_PATH``: optional orbax checkpoint dir (absent -> seeded init)
 - ``MODEL_QUANT``: "int8" for weight-only quantized serving
 - ``BATCH_MAX_SIZE`` / ``BATCH_TIMEOUT_MS``: batcher shape
+- ``TPU_MESH``: multi-chip serving mesh, e.g. "tp=4" (llama3-8b on
+  v5e-4: Megatron-sharded weights + tp-sharded KV heads) or "tp=4,dp=4"
+  (llama3-70b on v5e-16: tensor-parallel replicas, batch over dp).
+  Collectives are emitted by GSPMD over ICI; absent -> single chip.
+  (``TPU_TOPOLOGY`` in "axis=N" form is accepted as an alias, but the
+  "NxM" physical-grid values TPU VMs export under that name are ignored.)
 - ``TPU_ENABLED``: force the datasource on without MODEL_NAME
 
 The datasource receives the container treatment the reference gives Redis
@@ -68,6 +74,11 @@ class TPUDevice:
         self.devices = jax.devices()
         self.platform = self.devices[0].platform
         self.device_kind = getattr(self.devices[0], "device_kind", self.platform)
+        self.mesh = _mesh_from_topology(
+            config.get_or_default("TPU_MESH", "")
+            or config.get_or_default("TPU_TOPOLOGY", ""),
+            self.devices,
+        )
 
         self._requests = metrics.counter(
             "gofr_tpu_requests_total", "TPU inference requests", labels=("model", "op", "status")
@@ -79,7 +90,10 @@ class TPUDevice:
             "gofr_tpu_device_memory_bytes", "device memory", labels=("kind",)
         )
 
-        self.runner = _build_runner(self.model_name, self.quant, self.model_path, self.max_batch)
+        self.runner = _build_runner(
+            self.model_name, self.quant, self.model_path, self.max_batch,
+            mesh=self.mesh,
+        )
         self.runner.warmup()
         self.batcher = DynamicBatcher(
             self._run_batch,
@@ -163,6 +177,7 @@ class TPUDevice:
             f"model={self.model_name} platform={self.platform} "
             f"devices={len(self.devices)} kind={self.device_kind}"
             + (" quant=int8" if self.quant else "")
+            + (f" mesh={dict(self.mesh.shape)}" if self.mesh is not None else "")
         )
 
     # -- health (north star: device liveness on /.well-known/health) ---------
@@ -200,6 +215,40 @@ class TPUDevice:
 def new_device(config: Any, logger: Any, metrics: Any) -> TPUDevice:
     """Container wiring entry (parity with redis.new_client / sql.new_sql)."""
     return TPUDevice(config, logger, metrics)
+
+
+def _mesh_from_topology(topology: str, devices: list) -> Optional[Any]:
+    """Parse ``TPU_MESH`` ("tp=4", "tp=4,dp=4", "fsdp=2,tp=2") into a
+    serving mesh over the local devices; empty/unset -> None (single chip).
+    Values without "=" (e.g. the "1x1"/"2x4" physical-grid strings TPU VMs
+    export as TPU_TOPOLOGY) are not mesh requests -> None."""
+    topology = topology.strip()
+    if not topology or "=" not in topology:
+        return None
+    from gofr_tpu.parallel.mesh import make_mesh, mesh_shape_for
+
+    kwargs: dict[str, int] = {}
+    for part in topology.split(","):
+        key, _, val = part.strip().partition("=")
+        if key not in ("dp", "fsdp", "tp"):
+            raise ValueError(
+                f"TPU_MESH axis '{key}' not supported for serving — use "
+                "dp, fsdp, tp (sp/pp/ep are training-side axes)"
+            )
+        try:
+            kwargs[key] = int(val)
+        except ValueError:
+            raise ValueError(
+                f"TPU_MESH entry '{part.strip()}' is malformed — expected "
+                "axis=int, e.g. 'tp=4,dp=2'"
+            ) from None
+    dp = kwargs.pop("dp", 1)
+    n = dp * kwargs.get("fsdp", 1) * kwargs.get("tp", 1)
+    if n > len(devices):
+        raise ValueError(
+            f"TPU_MESH '{topology}' needs {n} devices, have {len(devices)}"
+        )
+    return make_mesh(mesh_shape_for(n, **kwargs), devices=devices[:n])
 
 
 # -- model runners ------------------------------------------------------------
@@ -296,11 +345,24 @@ class _BertRunner:
 
 
 class _TransformerRunner:
-    """Decoder serving: batched bucketed prefill + per-request decode."""
+    """Decoder serving: batched bucketed prefill + per-request decode.
+
+    With a serving ``mesh`` (TPU_TOPOLOGY): params are placed in their
+    Megatron tp/fsdp layout (parallel/sharding.py), the KV cache shards its
+    head axis over tp and its batch axis over dp, and token batches are
+    pinned to dp — the jitted prefill/decode then compile as SPMD programs
+    with GSPMD-inserted ICI collectives. Without a mesh: single chip."""
 
     SEQ_BUCKETS = (64, 128, 256, 512, 1024, 2048)
 
-    def __init__(self, name: str, quant: bool, model_path: Optional[str], max_batch: int = 8):
+    def __init__(
+        self,
+        name: str,
+        quant: bool,
+        model_path: Optional[str],
+        max_batch: int = 8,
+        mesh: Optional[Any] = None,
+    ):
         self.max_batch = max_batch
         from gofr_tpu.models.llama import CONFIGS
         from gofr_tpu.models.quant import quantize_params
@@ -317,6 +379,26 @@ class _TransformerRunner:
             model_path, lambda: init_transformer(jax.random.key(0), self.cfg)
         )
         self.params = quantize_params(params) if quant else params
+        self.mesh = mesh
+        self._token_sharding = None
+        self._cache_shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from gofr_tpu.parallel.sharding import cache_specs, shard_params
+
+            tp = mesh.shape.get("tp", 1)
+            if self.cfg.n_kv_heads % tp:
+                raise ValueError(
+                    f"n_kv_heads={self.cfg.n_kv_heads} not divisible by "
+                    f"tp={tp} — KV cache shards its head axis over tp"
+                )
+            self.params = shard_params(self.params, mesh)
+            self._token_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+            self._row_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+            self._cache_shardings = {
+                k: NamedSharding(mesh, s) for k, s in cache_specs(None).items()
+            }
         cfg = self.cfg
         self._init_cache = init_cache
         self._prefill = jax.jit(lambda p, t, c, l: prefill(p, t, c, cfg, l))
@@ -350,6 +432,11 @@ class _TransformerRunner:
         cache = self._zero_caches.get(bsz)
         if cache is None:
             cache = self._init_cache(self.cfg, bsz, max_seq=self.cfg.max_seq)
+            if self._cache_shardings is not None:
+                cache = {
+                    k: jax.device_put(v, self._cache_shardings[k])
+                    for k, v in cache.items()
+                }
             self._zero_caches[bsz] = cache
         return cache
 
@@ -374,9 +461,11 @@ class _TransformerRunner:
         full_lengths = np.ones((bsz,), np.int32)
         full_lengths[:n] = lengths
         cache = self._zero_cache(bsz)
-        logits, cache = self._prefill(
-            self.params, jnp.asarray(tokens), cache, jnp.asarray(full_lengths)
-        )
+        tokens_dev, lengths_dev = jnp.asarray(tokens), jnp.asarray(full_lengths)
+        if self._token_sharding is not None:
+            tokens_dev = jax.device_put(tokens_dev, self._token_sharding)
+            lengths_dev = jax.device_put(lengths_dev, self._row_sharding)
+        logits, cache = self._prefill(self.params, tokens_dev, cache, lengths_dev)
         logits = np.asarray(logits)
         return [
             {"logits": logits[i], "cache": _slice_cache(cache, i), "length": int(full_lengths[i])}
@@ -424,12 +513,15 @@ class _TransformerRunner:
         b = next_pow2(self.max_batch)
         for bucket in self.buckets:
             cache = self._zero_cache(b)
-            logits, cache = self._prefill(
-                self.params,
-                jnp.zeros((b, bucket), jnp.int32),
-                cache,
-                jnp.ones((b,), jnp.int32),
-            )
+            tokens = jnp.zeros((b, bucket), jnp.int32)
+            lengths = jnp.ones((b,), jnp.int32)
+            if self._token_sharding is not None:
+                # jit caches on input shardings: warm with the EXACT
+                # placement run_batch uses or every bucket recompiles on
+                # its first real request
+                tokens = jax.device_put(tokens, self._token_sharding)
+                lengths = jax.device_put(lengths, self._row_sharding)
+            logits, cache = self._prefill(self.params, tokens, cache, lengths)
             logits.block_until_ready()
         one = _slice_cache(cache, 0)
         step, _ = self._decode(self.params, jnp.zeros((1, 1), jnp.int32), one)
@@ -452,7 +544,13 @@ def _load_or_init(model_path: Optional[str], init_fn: Any) -> Any:
     return init_fn()
 
 
-def _build_runner(name: str, quant: bool, model_path: Optional[str], max_batch: int = 8) -> Any:
+def _build_runner(
+    name: str,
+    quant: bool,
+    model_path: Optional[str],
+    max_batch: int = 8,
+    mesh: Optional[Any] = None,
+) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
     if name in ("mlp", "tiny-mlp"):
@@ -460,7 +558,7 @@ def _build_runner(name: str, quant: bool, model_path: Optional[str], max_batch: 
     if name.startswith("bert"):
         return _BertRunner(name, quant, model_path, max_batch)
     if name in CONFIGS:
-        return _TransformerRunner(name, quant, model_path, max_batch)
+        return _TransformerRunner(name, quant, model_path, max_batch, mesh=mesh)
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "
         f"or one of {sorted(CONFIGS)}"
