@@ -1,0 +1,22 @@
+# Serving image for the http-server example (parity:
+# /root/reference/Dockerfile:1-13 — build stage + slim runtime, EXPOSE 8000).
+# TPU runtime: the libtpu wheel is installed in the TPU variant; the default
+# image serves on the CPU PJRT backend. Zero CUDA anywhere (north star).
+
+FROM python:3.11-slim AS base
+
+WORKDIR /srv/gofr_tpu
+COPY gofr_tpu/ gofr_tpu/
+COPY examples/ examples/
+
+# CPU serving by default; build with --build-arg JAX_EXTRA=tpu for a
+# libtpu-enabled image on a TPU VM host.
+ARG JAX_EXTRA=cpu
+RUN pip install --no-cache-dir "jax[${JAX_EXTRA}]" flax optax orbax-checkpoint \
+    chex einops numpy grpcio
+
+ENV PYTHONPATH=/srv/gofr_tpu
+ENV HTTP_PORT=8000 GRPC_PORT=9000
+EXPOSE 8000 9000
+
+CMD ["python", "examples/http-server/main.py"]

@@ -19,9 +19,52 @@ def say_hello(ctx):
     return f"Hello {name}!"
 
 
+def _model_body(ctx):
+    from gofr_tpu.errors import HTTPError
+
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    body = ctx.bind()
+    if body is not None and not isinstance(body, dict):
+        raise HTTPError(400, 'request body must be a JSON object like {"tokens": [...]}')
+    return body or {}
+
+
+def embed(ctx):
+    """Unary model RPC (BASELINE.md config 2: BERT embeddings)."""
+    body = _model_body(ctx)
+    if not body.get("tokens"):
+        from gofr_tpu.errors import HTTPError
+
+        raise HTTPError(400, 'missing "tokens" in body')
+    out = ctx.tpu.infer(body)
+    import numpy as np
+
+    if isinstance(out, dict):  # transformer prefill state
+        return {"next_token": int(np.argmax(out["logits"]))}
+    return {"embedding": np.asarray(out).tolist()}
+
+
+def generate_stream(ctx):
+    """Server-streaming token decode (BASELINE.md config 4 shape)."""
+    body = _model_body(ctx)
+    tokens = body.get("tokens") or [1, 2, 3]
+    max_new = int(body.get("max_new_tokens") or 16)
+    for token in ctx.tpu.generate_stream(tokens, max_new):
+        yield {"token": token}
+
+
 def main():
     app = gofr_tpu.new(configs_dir=os.path.join(os.path.dirname(__file__), "configs"))
-    app.register_json_service("HelloService", {"SayHello": say_hello})
+    app.register_json_service(
+        "HelloService",
+        {"SayHello": say_hello},
+    )
+    app.register_json_service(
+        "LLMService",
+        {"Embed": embed},
+        stream_methods={"Generate": generate_stream},
+    )
     app.run()
 
 

@@ -76,30 +76,11 @@ def generate_handler(ctx):
         from gofr_tpu.http.response import Stream
 
         def events():
-            import queue as q
-
-            out: "q.Queue" = q.Queue()
-            done = object()
-            failure: list[BaseException] = []
-
-            def run():
-                try:
-                    ctx.tpu.generate(tokens, max_new, on_token=out.put)
-                except BaseException as exc:  # surfaced as an SSE error event
-                    failure.append(exc)
-                finally:
-                    out.put(done)
-
-            import threading
-
-            threading.Thread(target=run, daemon=True).start()
-            while True:
-                item = out.get()
-                if item is done:
-                    break
-                yield {"token": item}
-            if failure:
-                yield {"error": str(failure[0])}
+            try:
+                for token in ctx.tpu.generate_stream(tokens, max_new):
+                    yield {"token": token}
+            except Exception as exc:  # surfaced as an SSE error event
+                yield {"error": str(exc)}
 
         return Stream(events())
     return {"tokens": ctx.tpu.generate(tokens, max_new)}

@@ -54,6 +54,7 @@ class App:
         self._cmd_routes: list[tuple[str, Handler]] = []
         self._grpc_registrations: list[tuple[Any, Any]] = []
         self._grpc_json_services: dict[str, dict[str, Handler]] = {}
+        self._grpc_json_stream_services: dict[str, dict[str, Handler]] = {}
         self._grpc_server: Optional[Any] = None
         self.http_server: Optional[HTTPServer] = None
 
@@ -100,11 +101,21 @@ class App:
         protoc-generated ``add_XServicer_to_server`` callable."""
         self._grpc_registrations.append((add_to_server, servicer))
 
-    def register_json_service(self, service_name: str, methods: dict[str, Handler]) -> None:
+    def register_json_service(
+        self,
+        service_name: str,
+        methods: dict[str, Handler],
+        stream_methods: Optional[dict[str, Handler]] = None,
+    ) -> None:
         """Register a reflection-free JSON-over-gRPC service: each method is
         a transport-agnostic ``handler(ctx)`` (TPU-native addition for
-        serving without protoc codegen)."""
-        self._grpc_json_services[service_name] = methods
+        serving without protoc codegen). ``stream_methods`` handlers return
+        an iterator; each item becomes one JSON message on a server stream
+        (token decode, BASELINE.md config 4)."""
+        if methods:
+            self._grpc_json_services[service_name] = methods
+        if stream_methods:
+            self._grpc_json_stream_services[service_name] = stream_methods
 
     # -- CLI (parity: gofr.go:181, cmd.go:54-63) -----------------------------
     def sub_command(self, pattern: str, handler: Handler) -> None:
@@ -150,7 +161,11 @@ class App:
         self._install_default_routes()
         self.http_server = HTTPServer(self.router, self.http_port, self.logger)
         self.http_server.run_in_thread()
-        if self._grpc_registrations or self._grpc_json_services:
+        if (
+            self._grpc_registrations
+            or self._grpc_json_services
+            or self._grpc_json_stream_services
+        ):
             from gofr_tpu.grpcx import GRPCServer
 
             self._grpc_server = GRPCServer(
@@ -158,6 +173,7 @@ class App:
                 self.container,
                 registrations=self._grpc_registrations,
                 json_services=self._grpc_json_services,
+                json_stream_services=self._grpc_json_stream_services,
             )
             self._grpc_server.start()
         return self

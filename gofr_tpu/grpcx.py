@@ -205,6 +205,7 @@ class GRPCServer:
         container: Any,
         registrations: Optional[list[tuple[Callable, Any]]] = None,
         json_services: Optional[dict[str, dict[str, Callable]]] = None,
+        json_stream_services: Optional[dict[str, dict[str, Callable]]] = None,
         max_workers: int = 32,
     ):
         self.port = port
@@ -216,15 +217,37 @@ class GRPCServer:
         )
         for add_to_server, servicer in registrations or []:
             add_to_server(servicer, self.server)
-        for service_name, methods in (json_services or {}).items():
-            self._register_json_service(service_name, methods)
+        stream_services = json_stream_services or {}
+        for service_name in set(json_services or {}) | set(stream_services):
+            self._register_json_service(
+                service_name,
+                (json_services or {}).get(service_name, {}),
+                stream_services.get(service_name, {}),
+            )
 
-    def _register_json_service(self, service_name: str, methods: dict[str, Callable]) -> None:
+    def _register_json_service(
+        self,
+        service_name: str,
+        methods: dict[str, Callable],
+        stream_methods: Optional[dict[str, Callable]] = None,
+    ) -> None:
+        overlap = set(methods) & set(stream_methods or {})
+        if overlap:
+            raise ValueError(
+                f"service '{service_name}' registers {sorted(overlap)} as both "
+                "unary and streaming — a method must be one or the other"
+            )
         handlers: dict[str, grpc.RpcMethodHandler] = {}
         for method_name, handler in methods.items():
             handlers[method_name] = grpc.unary_unary_rpc_method_handler(
                 self._wrap_json_handler(f"/{service_name}/{method_name}", handler),
                 request_deserializer=None,  # raw bytes
+                response_serializer=None,
+            )
+        for method_name, handler in (stream_methods or {}).items():
+            handlers[method_name] = grpc.unary_stream_rpc_method_handler(
+                self._wrap_json_stream_handler(f"/{service_name}/{method_name}", handler),
+                request_deserializer=None,
                 response_serializer=None,
             )
         generic = grpc.method_handlers_generic_handler(service_name, handlers)
@@ -245,19 +268,42 @@ class GRPCServer:
             try:
                 result = handler(ctx)
             except Exception as exc:
-                status = status_from_error(exc)
-                code = _status_to_grpc(status)
-                if status == 500 and not hasattr(exc, "status_code"):
-                    container.logger.errorf("grpc handler error on %s: %r", method, exc)
-                    context.abort(code, "some unexpected error has occurred")
-                else:
-                    context.abort(code, str(exc))
+                _abort_for_error(container, context, method, exc)
                 return b""
             from gofr_tpu.http.responder import _jsonable
 
             return json.dumps({"data": result}, default=_jsonable).encode("utf-8")
 
         return unary
+
+    def _wrap_json_stream_handler(self, method: str, handler: Callable) -> Callable:
+        """Server-streaming JSON RPC: the handler returns an iterator (or a
+        ``Stream``); each yielded item is one JSON message on the stream —
+        the token-decode transport for the bidi/streaming serving configs
+        (BASELINE.md config 4)."""
+        container = self.container
+
+        def unary_stream(request_bytes: bytes, context: grpc.ServicerContext):
+            metadata = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
+            try:
+                payload = json.loads(request_bytes.decode("utf-8")) if request_bytes else None
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, "invalid JSON payload")
+                return
+            request = GRPCRequest(method, payload, metadata)
+            ctx = Context(request, container)
+            from gofr_tpu.http.responder import _jsonable
+            from gofr_tpu.http.response import Stream
+
+            try:
+                result = handler(ctx)
+                events = result.events if isinstance(result, Stream) else result
+                for item in events:
+                    yield json.dumps(item, default=_jsonable).encode("utf-8")
+            except Exception as exc:
+                _abort_for_error(container, context, method, exc)
+
+        return unary_stream
 
     # -- lifecycle (parity: grpc.go:32-46) -----------------------------------
     def start(self) -> None:
@@ -271,6 +317,19 @@ class GRPCServer:
 
     def stop(self, grace: float = 2.0) -> None:
         self.server.stop(grace)
+
+
+def _abort_for_error(container: Any, context: grpc.ServicerContext, method: str, exc: Exception) -> None:
+    """Shared error→status policy for unary and streaming JSON handlers:
+    typed errors surface their message on the mapped status; unexpected
+    errors are logged server-side and masked as INTERNAL."""
+    status = status_from_error(exc)
+    code = _status_to_grpc(status)
+    if status == 500 and not hasattr(exc, "status_code"):
+        container.logger.errorf("grpc handler error on %s: %r", method, exc)
+        context.abort(code, "some unexpected error has occurred")
+    else:
+        context.abort(code, str(exc))
 
 
 def _status_to_grpc(status: int) -> grpc.StatusCode:

@@ -136,14 +136,17 @@ class TPUDevice:
         tokens: list[int],
         max_new_tokens: int = 32,
         on_token: Optional[Any] = None,
+        stop: Optional[Any] = None,
     ) -> list[int]:
         """Autoregressive generation (transformer models): prefill goes
         through the dynamic batcher (TTFT path); decode steps run per
-        request. ``on_token`` streams each new token id (SSE endpoints)."""
+        request. ``on_token`` streams each new token id (SSE endpoints);
+        ``stop`` (a threading.Event) aborts decode between steps — set it
+        when the client disconnects so the device stops doing unread work."""
         start = time.perf_counter()
         try:
             out = self.runner.generate(
-                tokens, max_new_tokens, on_token=on_token,
+                tokens, max_new_tokens, on_token=on_token, stop=stop,
                 prefill_batcher=self.batcher, ttft_cb=lambda: self._ttft.observe(
                     time.perf_counter() - start, model=self.model_name, op="generate"
                 ),
@@ -153,6 +156,41 @@ class TPUDevice:
         except Exception:
             self._requests.inc(model=self.model_name, op="generate", status="error")
             raise
+
+    def generate_stream(
+        self, tokens: list[int], max_new_tokens: int = 32
+    ) -> Any:
+        """Iterator of decoded token ids, yielded as they decode — the shared
+        bridge for SSE and gRPC streaming transports. Closing the iterator
+        (client disconnect) cancels the background decode instead of letting
+        it run to completion unread."""
+        import queue as queue_mod
+        import threading
+
+        out: "queue_mod.Queue" = queue_mod.Queue()
+        done = object()
+        failure: list[BaseException] = []
+        stop = threading.Event()
+
+        def run() -> None:
+            try:
+                self.generate(tokens, max_new_tokens, on_token=out.put, stop=stop)
+            except BaseException as exc:
+                failure.append(exc)
+            finally:
+                out.put(done)
+
+        threading.Thread(target=run, daemon=True).start()
+        try:
+            while True:
+                item = out.get()
+                if item is done:
+                    break
+                yield item
+            if failure:
+                raise failure[0]
+        finally:
+            stop.set()
 
     # -- internals -----------------------------------------------------------
     def _prepare(self, payload: Any) -> Any:
@@ -393,6 +431,15 @@ class _TransformerRunner:
                     f"n_kv_heads={self.cfg.n_kv_heads} not divisible by "
                     f"tp={tp} — KV cache shards its head axis over tp"
                 )
+            rows = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+            padded = next_pow2(max_batch)
+            if padded % rows:
+                raise ValueError(
+                    f"padded batch {padded} (next_pow2 of BATCH_MAX_SIZE="
+                    f"{max_batch}) not divisible by dp*fsdp={rows} — token "
+                    "batches shard their row axis over (dp, fsdp); raise "
+                    "BATCH_MAX_SIZE or shrink the dp/fsdp axes of TPU_MESH"
+                )
             self.params = shard_params(self.params, mesh)
             self._token_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
             self._row_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
@@ -477,6 +524,7 @@ class _TransformerRunner:
         tokens: list[int],
         max_new_tokens: int,
         on_token: Any = None,
+        stop: Any = None,
         prefill_batcher: Any = None,
         ttft_cb: Any = None,
     ) -> list[int]:
@@ -495,6 +543,8 @@ class _TransformerRunner:
             on_token(token)
         max_len = int(cache["k"].shape[2])
         for _ in range(max_new_tokens - 1):
+            if stop is not None and stop.is_set():
+                break
             if int(cache["lengths"][0]) >= max_len:
                 break
             step_logits, cache = self._decode(

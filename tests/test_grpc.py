@@ -96,3 +96,97 @@ def test_invalid_json_payload(server):
         with pytest.raises(grpc.RpcError) as exc:
             stub(b"\xff\xfe not json", timeout=5)
     assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+# -- server-streaming JSON services (token decode transport) ------------------
+
+@pytest.fixture
+def stream_server(free_port):
+    port = free_port()
+    container = Container(EnvConfig(), wire=False)
+    container.logger = MockLogger()
+
+    def countdown(ctx):
+        n = int(ctx.param("n") or 3)
+        for i in range(n, 0, -1):
+            yield {"tick": i}
+
+    def stream_fails(ctx):
+        yield {"tick": 1}
+        raise RuntimeError("decode blew up")
+
+    def bad_request(ctx):
+        from gofr_tpu.errors import InvalidParamError
+
+        raise InvalidParamError("n")
+        yield  # makes it a generator-shaped handler
+
+    srv = GRPCServer(
+        port,
+        container,
+        json_services={"Clock": {"Now": lambda ctx: "now"}},
+        json_stream_services={
+            "Clock": {"Countdown": countdown, "Broken": stream_fails, "Bad": bad_request}
+        },
+    )
+    srv.start()
+    yield port, container
+    srv.stop()
+
+
+def _stream(port, method, payload):
+    with grpc.insecure_channel(f"localhost:{port}") as channel:
+        stub = channel.unary_stream(f"/Clock/{method}")
+        return [json.loads(m) for m in stub(json.dumps(payload).encode(), timeout=10)]
+
+
+def test_json_stream_messages(stream_server):
+    port, _ = stream_server
+    assert _stream(port, "Countdown", {"n": 3}) == [
+        {"tick": 3}, {"tick": 2}, {"tick": 1},
+    ]
+
+
+def test_unary_and_stream_share_service_name(stream_server):
+    port, _ = stream_server
+    assert json.loads(_call_service(port, "Clock", "Now", {})) == {"data": "now"}
+
+
+def test_stream_midstream_error_aborts(stream_server):
+    port, container = stream_server
+    with grpc.insecure_channel(f"localhost:{port}") as channel:
+        stub = channel.unary_stream("/Clock/Broken")
+        it = stub(b"{}", timeout=10)
+        assert json.loads(next(it)) == {"tick": 1}
+        with pytest.raises(grpc.RpcError) as exc:
+            list(it)
+    assert exc.value.code() == grpc.StatusCode.INTERNAL
+    assert "decode blew up" not in exc.value.details()
+    assert "decode blew up" in container.logger.output
+
+
+def test_stream_typed_error_maps_status(stream_server):
+    port, _ = stream_server
+    with grpc.insecure_channel(f"localhost:{port}") as channel:
+        stub = channel.unary_stream("/Clock/Bad")
+        with pytest.raises(grpc.RpcError) as exc:
+            list(stub(b"{}", timeout=10))
+    assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def _call_service(port, service, method, payload):
+    with grpc.insecure_channel(f"localhost:{port}") as channel:
+        stub = channel.unary_unary(f"/{service}/{method}")
+        return stub(json.dumps(payload).encode(), timeout=5)
+
+
+def test_duplicate_unary_and_stream_method_rejected(free_port):
+    container = Container(EnvConfig(), wire=False)
+    container.logger = MockLogger()
+    with pytest.raises(ValueError, match="both"):
+        GRPCServer(
+            free_port(),
+            container,
+            json_services={"S": {"Gen": lambda ctx: 1}},
+            json_stream_services={"S": {"Gen": lambda ctx: iter(())}},
+        )

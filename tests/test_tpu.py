@@ -200,3 +200,58 @@ def test_infer_returns_prefill_state(tiny_device):
     state = tiny_device.infer({"tokens": [1, 2, 3, 4]})
     assert state["logits"].shape[-1] == 256
     assert state["length"] == 4
+
+
+def test_generate_stream_yields_and_completes(tiny_device):
+    toks = list(tiny_device.generate_stream([1, 2, 3], max_new_tokens=5))
+    assert toks == tiny_device.generate([1, 2, 3], max_new_tokens=5)
+
+
+def test_generate_stream_close_cancels_decode(tiny_device, monkeypatch):
+    # closing the iterator must halt the BACKGROUND decode, observed on the
+    # actual closed stream: slow each token down, close after two, then
+    # assert production stops (not just that a fresh pre-set event stops)
+    import time
+
+    produced = []
+    real_generate = tiny_device.generate
+
+    def spy(tokens, max_new_tokens=32, on_token=None, stop=None):
+        def slow_token(t):
+            produced.append(t)
+            on_token(t)
+            time.sleep(0.02)
+
+        return real_generate(tokens, max_new_tokens, on_token=slow_token, stop=stop)
+
+    monkeypatch.setattr(tiny_device, "generate", spy)
+    it = tiny_device.generate_stream([1, 2, 3], max_new_tokens=100)
+    next(it)
+    next(it)
+    it.close()
+    # decode halts at the next step boundary; allow a few in-flight steps
+    time.sleep(0.3)
+    n_after_close = len(produced)
+    assert n_after_close < 20, "decode kept running after the stream closed"
+    time.sleep(0.3)
+    assert len(produced) == n_after_close, "tokens still being produced after close"
+
+
+def test_generate_with_preset_stop_event(tiny_device):
+    ev = threading.Event()
+    ev.set()
+    out = tiny_device.generate([1, 2, 3], max_new_tokens=64, stop=ev)
+    assert len(out) == 1  # prefill token only; decode loop never entered
+
+
+def test_stop_event_mid_decode(tiny_device):
+    ev = threading.Event()
+    seen = []
+
+    def on_token(t):
+        seen.append(t)
+        if len(seen) == 3:
+            ev.set()
+
+    out = tiny_device.generate([1, 2, 3], max_new_tokens=64, on_token=on_token, stop=ev)
+    assert len(out) == 3  # stopped at the next step boundary

@@ -92,3 +92,10 @@ def test_dp_tp_mesh_infer():
 def test_kv_head_divisibility_enforced():
     with pytest.raises(ValueError, match="n_kv_heads"):
         _device(MODEL_NAME="tiny", TPU_MESH="tp=4")  # tiny has 2 kv heads
+
+
+def test_batch_divisibility_enforced():
+    # next_pow2(2)=2 rows can't shard over dp=4: clear config-time error,
+    # not an opaque device_put failure inside warmup
+    with pytest.raises(ValueError, match="BATCH_MAX_SIZE"):
+        _device(MODEL_NAME="tiny", BATCH_MAX_SIZE="2", TPU_MESH="dp=4")
