@@ -111,7 +111,14 @@ class App:
         a transport-agnostic ``handler(ctx)`` (TPU-native addition for
         serving without protoc codegen). ``stream_methods`` handlers return
         an iterator; each item becomes one JSON message on a server stream
-        (token decode, BASELINE.md config 4)."""
+        (token decode, BASELINE.md config 4). A name appearing in both maps
+        is rejected here, at registration time."""
+        overlap = set(methods) & set(stream_methods or {})
+        if overlap:
+            raise ValueError(
+                f"service '{service_name}' registers {sorted(overlap)} as both "
+                "unary and streaming — a method must be one or the other"
+            )
         if methods:
             self._grpc_json_services[service_name] = methods
         if stream_methods:

@@ -253,18 +253,24 @@ class GRPCServer:
         generic = grpc.method_handlers_generic_handler(service_name, handlers)
         self.server.add_generic_rpc_handlers((generic,))
 
+    def _build_json_context(
+        self, request_bytes: bytes, context: grpc.ServicerContext, method: str
+    ) -> Context:
+        """Shared request preamble for unary and streaming JSON RPCs:
+        metadata normalization, JSON decode (malformed → INVALID_ARGUMENT
+        abort), and handler Context construction."""
+        metadata = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
+        try:
+            payload = json.loads(request_bytes.decode("utf-8")) if request_bytes else None
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "invalid JSON payload")
+        return Context(GRPCRequest(method, payload, metadata), self.container)
+
     def _wrap_json_handler(self, method: str, handler: Callable) -> Callable:
         container = self.container
 
         def unary(request_bytes: bytes, context: grpc.ServicerContext) -> bytes:
-            metadata = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
-            try:
-                payload = json.loads(request_bytes.decode("utf-8")) if request_bytes else None
-            except (json.JSONDecodeError, UnicodeDecodeError):
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, "invalid JSON payload")
-                return b""
-            request = GRPCRequest(method, payload, metadata)
-            ctx = Context(request, container)
+            ctx = self._build_json_context(request_bytes, context, method)
             try:
                 result = handler(ctx)
             except Exception as exc:
@@ -284,14 +290,7 @@ class GRPCServer:
         container = self.container
 
         def unary_stream(request_bytes: bytes, context: grpc.ServicerContext):
-            metadata = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
-            try:
-                payload = json.loads(request_bytes.decode("utf-8")) if request_bytes else None
-            except (json.JSONDecodeError, UnicodeDecodeError):
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, "invalid JSON payload")
-                return
-            request = GRPCRequest(method, payload, metadata)
-            ctx = Context(request, container)
+            ctx = self._build_json_context(request_bytes, context, method)
             from gofr_tpu.http.responder import _jsonable
             from gofr_tpu.http.response import Stream
 

@@ -22,6 +22,11 @@ from gofr_tpu.parallel.pipeline import (
     place_pipeline_params,
 )
 from gofr_tpu.parallel.ring import make_ring_forward, make_ring_loss, ring_attention
+from gofr_tpu.parallel.ulysses import (
+    make_ulysses_forward,
+    make_ulysses_loss,
+    ulysses_attention,
+)
 from gofr_tpu.parallel.sharding import (
     batch_spec,
     cache_specs,
@@ -33,6 +38,7 @@ __all__ = [
     "make_mesh", "mesh_shape_for", "axis_size",
     "param_specs", "batch_spec", "cache_specs", "shard_params",
     "ring_attention", "make_ring_forward", "make_ring_loss",
+    "ulysses_attention", "make_ulysses_forward", "make_ulysses_loss",
     "make_pipeline_forward", "make_pipeline_loss", "place_pipeline_params",
     "make_moe_forward", "make_moe_loss", "moe_param_specs", "place_moe_params",
 ]

@@ -112,12 +112,17 @@ def ring_attention(
 
 
 def _shard_forward(
-    params: dict, tokens: jnp.ndarray, cfg: TransformerConfig, axis_name: str
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    axis_name: str,
+    attn_fn=None,
 ) -> jnp.ndarray:
     """Per-shard transformer forward: tokens [B, S_local] at shard
     ``axis_index``; everything except attention is sequence-pointwise, so
     the canonical decoder block (models.transformer._block) is reused with
-    ring attention injected via ``attn_fn``."""
+    the sequence-parallel attention injected via ``attn_fn`` (default: ring;
+    parallel.ulysses passes its all-to-all attention)."""
     b, s = tokens.shape
     n = jax.lax.axis_size(axis_name)
     if s * n > cfg.max_seq:
@@ -130,8 +135,9 @@ def _shard_forward(
     positions = idx * s + jnp.arange(s)  # absolute positions of this shard
     x = params["embed"][tokens]
 
-    def attn_fn(q, k, v):
-        return ring_attention(q, k, v, axis_name=axis_name, causal=True)
+    if attn_fn is None:
+        def attn_fn(q, k, v):
+            return ring_attention(q, k, v, axis_name=axis_name, causal=True)
 
     def body(carry, p):
         y, _, _ = _block(cfg, p, carry, freqs, positions, attn_fn=attn_fn)
@@ -158,7 +164,11 @@ def make_ring_forward(cfg: TransformerConfig, mesh: Mesh, batch_axes=("dp", "fsd
 
 
 def _shard_loss(
-    params: dict, tokens: jnp.ndarray, cfg: TransformerConfig, axis_name: str
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    axis_name: str,
+    attn_fn=None,
 ) -> jnp.ndarray:
     """Per-shard next-token loss. The target for the shard's last position
     is the FIRST token of the right neighbor's shard (ppermute); the global
@@ -166,7 +176,7 @@ def _shard_loss(
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s = tokens.shape
-    logits = _shard_forward(params, tokens, cfg, axis_name)  # [B, S_local, V]
+    logits = _shard_forward(params, tokens, cfg, axis_name, attn_fn)  # [B, S_local, V]
 
     # left-rotate first tokens: shard i receives shard (i+1)'s tokens[:, 0]
     perm = [(i, (i - 1) % n) for i in range(n)]

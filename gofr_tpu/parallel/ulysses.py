@@ -1,0 +1,120 @@
+"""Ulysses-style context parallelism: all-to-all sequence parallelism over
+the ``sp`` mesh axis.
+
+The second of the two first-class long-context strategies (SURVEY.md §5;
+ring attention is parallel/ring.py). Where ring attention keeps the
+sequence sharded and rotates K/V around the ring, Ulysses re-shards with
+two ``all_to_all`` collectives per attention: heads scatter, sequence
+gathers — each device then holds the FULL sequence for H/sp of the heads
+and runs an ordinary (flash) attention locally, after which a second
+all_to_all restores sequence sharding.
+
+Trade-offs vs ring (how they map to TPU):
+- Ulysses does 2 all-to-alls of activation size per attention call, ring
+  does n-1 neighbor exchanges of K/V size; on an ICI torus both ride
+  nearest-neighbor links, but Ulysses needs head-count divisibility
+  (n_heads % sp == 0) while ring scales to any shard count.
+- Ulysses attention itself is the unmodified single-device kernel (the
+  Pallas flash path applies as-is); ring re-implements the online softmax
+  around the permute loop.
+
+Everything except attention is sequence-pointwise, so the per-shard
+transformer body is shared with ring (ring._shard_forward, attn_fn
+injection).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gofr_tpu.models.transformer import TransformerConfig
+from gofr_tpu.ops.attention import attention
+from gofr_tpu.parallel.ring import _shard_forward, _shard_loss
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """All-to-all attention over sequence shards.
+
+    Must run inside ``shard_map`` with the sequence axis sharded over
+    ``axis_name``. q: [B, S_local, Hq, D], k/v: [B, S_local, Hkv, D] per
+    device. Requires Hq % sp == 0; Hkv that doesn't divide is repeated up
+    to Hq first (GQA degrades toward MHA under high sp — the KV all_to_all
+    then moves more bytes, the usual Ulysses+GQA trade)."""
+    n = jax.lax.axis_size(axis_name)
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % n:
+        raise ValueError(
+            f"n_heads={hq} not divisible by sp={n} — Ulysses shards the "
+            "head axis; use ring attention for this shard count"
+        )
+    if hkv % n:
+        reps = hq // hkv
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+
+    # heads scatter, sequence gathers: [B, S_loc, H, D] -> [B, S, H/n, D]
+    gather = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    out = attention(
+        gather(q), gather(k), gather(v), causal=causal, scale=scale, impl=impl
+    )
+    # restore sequence sharding: [B, S, Hq/n, D] -> [B, S_loc, Hq, D]
+    return jax.lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def _attn_fn(axis_name: str):
+    def fn(q, k, v):
+        return ulysses_attention(q, k, v, axis_name=axis_name, causal=True)
+
+    return fn
+
+
+def make_ulysses_forward(cfg: TransformerConfig, mesh: Mesh, batch_axes=("dp", "fsdp")):
+    """Jitted full-sequence forward with the sequence axis sharded over
+    ``sp``: tokens [B, S] -> logits [B, S, V] (mirror of
+    ring.make_ring_forward with all-to-all attention)."""
+    fwd = jax.shard_map(
+        functools.partial(
+            _shard_forward, cfg=cfg, axis_name="sp", attn_fn=_attn_fn("sp")
+        ),
+        mesh=mesh,
+        in_specs=(P(), P(batch_axes, "sp")),
+        out_specs=P(batch_axes, "sp", None),
+        check_vma=False,
+    )
+    return jax.jit(fwd)
+
+
+def make_ulysses_loss(cfg: TransformerConfig, mesh: Mesh, batch_axes=("dp", "fsdp")):
+    """Jitted sequence-parallel next-token loss: tokens [B, S] -> scalar."""
+
+    def per_shard(params, tokens):
+        loss = _shard_loss(params, tokens, cfg, axis_name="sp", attn_fn=_attn_fn("sp"))
+        for ax in batch_axes:
+            loss = jax.lax.pmean(loss, ax)
+        return loss
+
+    fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), P(batch_axes, "sp")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
