@@ -30,14 +30,32 @@ def _model_body(ctx):
     return body or {}
 
 
+def _prompt_from(body):
+    from gofr_tpu.errors import HTTPError
+
+    if "text" in body:
+        text = body["text"]
+        if not isinstance(text, str) or not text:
+            raise HTTPError(400, '"text" must be a non-empty string')
+        return text
+    if "tokens" in body:
+        tokens = body["tokens"]
+        if not isinstance(tokens, list) or not tokens:
+            raise HTTPError(400, '"tokens" must be a non-empty list of ids')
+        return tokens
+    return None
+
+
 def embed(ctx):
-    """Unary model RPC (BASELINE.md config 2: BERT embeddings)."""
+    """Unary model RPC (BASELINE.md config 2: BERT embeddings). Accepts
+    {"tokens": [...]} or, with a tokenizer configured, {"text": "..."}."""
     body = _model_body(ctx)
-    if not body.get("tokens"):
+    prompt = _prompt_from(body)
+    if prompt is None:
         from gofr_tpu.errors import HTTPError
 
-        raise HTTPError(400, 'missing "tokens" in body')
-    out = ctx.tpu.infer(body)
+        raise HTTPError(400, 'missing "tokens" or "text" in body')
+    out = ctx.tpu.infer(body if isinstance(prompt, list) else {"text": prompt})
     import numpy as np
 
     if isinstance(out, dict):  # transformer prefill state
@@ -48,10 +66,17 @@ def embed(ctx):
 def generate_stream(ctx):
     """Server-streaming token decode (BASELINE.md config 4 shape)."""
     body = _model_body(ctx)
-    tokens = body.get("tokens") or [1, 2, 3]
+    tokens = _prompt_from(body)
+    if tokens is None:
+        tokens = [1, 2, 3]  # demo prompt
     max_new = int(body.get("max_new_tokens") or 16)
+    tok = ctx.tpu.tokenizer
+    dec = tok.stream_decoder() if tok is not None else None
     for token in ctx.tpu.generate_stream(tokens, max_new):
-        yield {"token": token}
+        event = {"token": token}
+        if dec is not None:
+            event["text"] = dec.feed(token)
+        yield event
 
 
 def main():

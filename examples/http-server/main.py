@@ -64,26 +64,55 @@ async def infer_handler(ctx):
 
 
 def generate_handler(ctx):
-    """Greedy generation; ?stream=true streams tokens over SSE."""
+    """Greedy generation; ?stream=true streams tokens over SSE. Accepts
+    {"tokens": [...]} or, with a tokenizer configured, {"text": "..."}."""
     if ctx.tpu is None:
         raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
     body = ctx.bind() if ctx.request.body else {}
     if not isinstance(body, dict):
         raise HTTPError(400, 'request body must be a JSON object like {"tokens": [...]}')
-    tokens = body.get("tokens") or [1, 2, 3]
+    tokens = _prompt_from(body)
     max_new = int(body.get("max_new_tokens") or 16)
+    tok = ctx.tpu.tokenizer
     if ctx.param("stream") == "true":
         from gofr_tpu.http.response import Stream
 
         def events():
+            # incremental decode: multi-byte UTF-8 split across tokens
+            # buffers until the character completes
+            dec = tok.stream_decoder() if tok is not None else None
             try:
                 for token in ctx.tpu.generate_stream(tokens, max_new):
-                    yield {"token": token}
+                    event = {"token": token}
+                    if dec is not None:
+                        event["text"] = dec.feed(token)
+                    yield event
             except Exception as exc:  # surfaced as an SSE error event
                 yield {"error": str(exc)}
 
         return Stream(events())
-    return {"tokens": ctx.tpu.generate(tokens, max_new)}
+    out = ctx.tpu.generate(tokens, max_new)
+    result = {"tokens": out}
+    if tok is not None:
+        result["text"] = tok.decode(out)
+    return result
+
+
+def _prompt_from(body):
+    """Prompt from "text" (non-empty str) or "tokens" (non-empty list);
+    explicit-but-empty values are a 400, absent values fall back to the
+    demo prompt."""
+    if "text" in body:
+        text = body["text"]
+        if not isinstance(text, str) or not text:
+            raise HTTPError(400, '"text" must be a non-empty string')
+        return text
+    if "tokens" in body:
+        tokens = body["tokens"]
+        if not isinstance(tokens, list) or not tokens:
+            raise HTTPError(400, '"tokens" must be a non-empty list of ids')
+        return tokens
+    return [1, 2, 3]  # demo prompt
 
 
 def main():

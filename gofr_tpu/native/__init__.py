@@ -1,0 +1,116 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA/Pallas; the runtime around it gets native code
+where Python is the wrong tool (SURVEY.md §2 "Native components" — the
+reference is pure Go; this build's native boundary). Source lives in
+``native/`` at the repo root; this module compiles it on demand with g++
+into a per-user cache and exposes the raw ctypes handle. Consumers
+(gofr_tpu.tokenizer) fall back to pure-Python implementations when no
+toolchain is available, so the framework never hard-requires a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import pathlib
+import subprocess
+import tempfile
+from typing import Optional
+
+_SOURCES = ("tokenizer.cpp",)
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _source_dir() -> Optional[pathlib.Path]:
+    here = pathlib.Path(__file__).resolve()
+    for parent in here.parents:
+        cand = parent / "native"
+        if (cand / _SOURCES[0]).exists():
+            return cand
+    return None
+
+
+def _cache_dir() -> pathlib.Path:
+    base = os.environ.get("GOFR_NATIVE_CACHE") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "gofr_tpu"
+    )
+    path = pathlib.Path(base)
+    path.mkdir(parents=True, exist_ok=True)
+    return path
+
+
+def _build(src_dir: pathlib.Path) -> Optional[pathlib.Path]:
+    srcs = [src_dir / s for s in _SOURCES]
+    digest = hashlib.sha256(b"".join(p.read_bytes() for p in srcs)).hexdigest()[:16]
+    out = _cache_dir() / f"libgofr_native_{digest}.so"
+    if out.exists():
+        return out
+    # atomic build: compile to a temp name, rename into place
+    tmp = out.with_suffix(f".{os.getpid()}.tmp")
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", str(tmp)] + [
+        str(p) for p in srcs
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            tmp.unlink(missing_ok=True)
+        except OSError:
+            pass
+        return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, compiled and cached on first use; None when no
+    source tree or toolchain is available (callers use Python fallbacks)."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    explicit = os.environ.get("GOFR_NATIVE_LIB")
+    if explicit:
+        try:
+            _lib = _bind(ctypes.CDLL(explicit))
+        except OSError:
+            _lib = None
+        return _lib
+    if os.environ.get("GOFR_NATIVE_DISABLE"):
+        return None
+    src = _source_dir()
+    if src is None:
+        return None
+    built = _build(src)
+    if built is None:
+        return None
+    try:
+        _lib = _bind(ctypes.CDLL(str(built)))
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.gofr_tok_new.restype = c.c_void_p
+    lib.gofr_tok_new.argtypes = [c.c_char_p, c.c_int64, c.c_int32]
+    lib.gofr_tok_free.argtypes = [c.c_void_p]
+    lib.gofr_tok_vocab_size.restype = c.c_int32
+    lib.gofr_tok_vocab_size.argtypes = [c.c_void_p]
+    lib.gofr_tok_encode.restype = c.c_int64
+    lib.gofr_tok_encode.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int64, c.POINTER(c.c_int32), c.c_int64,
+    ]
+    lib.gofr_tok_decode.restype = c.c_int64
+    lib.gofr_tok_decode.argtypes = [
+        c.c_void_p, c.POINTER(c.c_int32), c.c_int64, c.POINTER(c.c_uint8), c.c_int64,
+    ]
+    lib.gofr_pack_rows.argtypes = [
+        c.POINTER(c.c_int32), c.POINTER(c.c_int64), c.c_int64, c.c_int64,
+        c.c_int32, c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+    ]
+    return lib

@@ -1,0 +1,248 @@
+"""Byte-level BPE tokenizer: native (C++) fast path, pure-Python fallback.
+
+Serving endpoints speak text; models speak ids. This module is the bridge:
+a greedy rank-based byte-level BPE (the GPT-2 family's merge loop,
+implemented from the published algorithm) with
+
+- a C++ implementation (native/tokenizer.cpp, loaded via gofr_tpu.native)
+  for the per-request hot path,
+- an identical pure-Python implementation used when no toolchain exists
+  (and as the equivalence oracle in tests),
+- a count-based trainer (``train_bpe``) so users can fit merges to their
+  corpus, and a one-line model file format: ``left right`` id pairs.
+
+Config wiring (container): ``TOKENIZER_PATH`` points at a merges file;
+``TOKENIZER=byte`` gives the mergeless 256-id byte tokenizer. Special ids
+(pad/bos/eos) occupy the TOP of the id space so raw byte ids stay stable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from collections import Counter
+from typing import Optional
+
+SPECIAL_TOKENS = ("<pad>", "<bos>", "<eos>")
+
+
+class Tokenizer:
+    def __init__(self, merges: list[tuple[int, int]], n_special: int = len(SPECIAL_TOKENS)):
+        # drop duplicates and pairs referencing not-yet-defined symbols —
+        # ranks and pieces must stay in lockstep (mirrors gofr_tok_new)
+        self.merges = []
+        self._ranks: dict[tuple[int, int], int] = {}
+        self._pieces = [bytes([i]) for i in range(256)]  # id -> byte string
+        for left, right in merges:
+            if (left, right) in self._ranks:
+                continue
+            if not (0 <= left < len(self._pieces) and 0 <= right < len(self._pieces)):
+                continue
+            self._ranks[(left, right)] = len(self.merges)
+            self.merges.append((left, right))
+            self._pieces.append(self._pieces[left] + self._pieces[right])
+        self.n_special = n_special
+        self._native = None
+        self._handle = None
+        from gofr_tpu import native
+
+        lib = native.load()
+        if lib is not None:
+            blob = "\n".join(f"{l} {r}" for l, r in self.merges).encode()
+            handle = lib.gofr_tok_new(blob, len(blob), n_special)
+            if handle:
+                self._native = lib
+                self._handle = handle
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def byte_level(cls, n_special: int = len(SPECIAL_TOKENS)) -> "Tokenizer":
+        """No merges: one id per byte (ids 0..255) + specials."""
+        return cls([], n_special)
+
+    @classmethod
+    def from_file(cls, path: str, n_special: int = len(SPECIAL_TOKENS)) -> "Tokenizer":
+        merges: list[tuple[int, int]] = []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) == 2:
+                    try:
+                        merges.append((int(parts[0]), int(parts[1])))
+                    except ValueError:
+                        continue  # header/comment lines are skipped
+        return cls(merges, n_special)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            for left, right in self.merges:
+                f.write(f"{left} {right}\n")
+
+    # -- properties ----------------------------------------------------------
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges) + self.n_special
+
+    @property
+    def backend(self) -> str:
+        return "native" if self._native is not None else "python"
+
+    def special_id(self, name: str) -> int:
+        """pad/bos/eos ids sit at the top of the id space."""
+        idx = SPECIAL_TOKENS.index(f"<{name}>")
+        if idx >= self.n_special:
+            raise ValueError(f"tokenizer has no <{name}> (n_special={self.n_special})")
+        return 256 + len(self.merges) + idx
+
+    # -- encode / decode -----------------------------------------------------
+    def encode(self, text: str | bytes) -> list[int]:
+        data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
+        if self._native is not None:
+            return self._encode_native(data)
+        return self._encode_python(data)
+
+    def decode(self, ids: list[int]) -> str:
+        if self._native is not None:
+            data = self._decode_native(ids)
+        else:
+            top = 256 + len(self.merges)
+            data = b"".join(self._pieces[i] for i in ids if 0 <= i < top)
+        return data.decode("utf-8", errors="replace")
+
+    def _encode_native(self, data: bytes) -> list[int]:
+        lib = self._native
+        cap = max(len(data), 1)
+        buf = (ctypes.c_int32 * cap)()
+        n = lib.gofr_tok_encode(self._handle, data, len(data), buf, cap)
+        return list(buf[: min(n, cap)])  # n <= len(data) always: merges only shrink
+
+    def _decode_native(self, ids: list[int]) -> bytes:
+        lib = self._native
+        arr = (ctypes.c_int32 * max(len(ids), 1))(*ids)
+        # every id decodes to >=1 byte; longest piece bounds the need
+        cap = max(1, sum(len(self._pieces[i]) if 0 <= i < len(self._pieces) else 0 for i in ids))
+        buf = (ctypes.c_uint8 * cap)()
+        n = lib.gofr_tok_decode(self._handle, arr, len(ids), buf, cap)
+        return bytes(buf[: min(n, cap)])
+
+    def _encode_python(self, data: bytes) -> list[int]:
+        """O(n log n) greedy merge: linked list + lazy min-heap, identical
+        candidate ordering (rank, then leftmost) to the native encode."""
+        import heapq
+
+        n = len(data)
+        if n == 0:
+            return []
+        ids = list(data)
+        nxt = list(range(1, n)) + [-1]
+        prv = [-1] + list(range(n - 1))
+        dead = [False] * n
+        ranks = self._ranks
+        heap: list[tuple[int, int, int, int]] = []
+        for i in range(n - 1):
+            rank = ranks.get((ids[i], ids[i + 1]))
+            if rank is not None:
+                heap.append((rank, i, ids[i], ids[i + 1]))
+        heapq.heapify(heap)
+        while heap:
+            rank, i, left, right = heapq.heappop(heap)
+            j = -1 if dead[i] else nxt[i]
+            if j < 0 or dead[i] or dead[j] or ids[i] != left or ids[j] != right:
+                continue  # stale candidate
+            ids[i] = 256 + rank
+            dead[j] = True
+            nxt[i] = nxt[j]
+            if nxt[j] >= 0:
+                prv[nxt[j]] = i
+            for a in (prv[i], i):
+                b = nxt[a] if a >= 0 else -1
+                if a >= 0 and b >= 0:
+                    r = ranks.get((ids[a], ids[b]))
+                    if r is not None:
+                        heapq.heappush(heap, (r, a, ids[a], ids[b]))
+        out = []
+        i = 0
+        while i >= 0:
+            out.append(ids[i])
+            i = nxt[i]
+        return out
+
+    def stream_decoder(self) -> "StreamDecoder":
+        """Incremental decoder for token streams: buffers partial UTF-8
+        sequences across token boundaries so multi-byte characters split
+        over tokens decode correctly (SSE/gRPC streaming)."""
+        return StreamDecoder(self)
+
+    def __del__(self):  # noqa: D105
+        lib, handle = getattr(self, "_native", None), getattr(self, "_handle", None)
+        if lib is not None and handle:
+            try:
+                lib.gofr_tok_free(handle)
+            except Exception:
+                pass
+
+
+class StreamDecoder:
+    """Feeds token ids one at a time, emitting text as soon as complete
+    UTF-8 sequences are available; trailing partial bytes stay buffered."""
+
+    def __init__(self, tokenizer: Tokenizer):
+        import codecs
+
+        self._tok = tokenizer
+        self._dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
+
+    def feed(self, token_id: int) -> str:
+        pieces = self._tok._pieces
+        if not 0 <= token_id < len(pieces):
+            return ""  # special/oob ids carry no bytes
+        return self._dec.decode(pieces[token_id])
+
+    def flush(self) -> str:
+        return self._dec.decode(b"", final=True)
+
+
+def train_bpe(
+    corpus: str | bytes,
+    vocab_size: int,
+    n_special: int = len(SPECIAL_TOKENS),
+) -> Tokenizer:
+    """Count-based BPE training: repeatedly merge the most frequent adjacent
+    pair until the vocabulary reaches ``vocab_size`` (or no pair repeats).
+    Simple full-recount per merge — training is offline, serving is not."""
+    data = corpus.encode("utf-8") if isinstance(corpus, str) else bytes(corpus)
+    n_merges = vocab_size - 256 - n_special
+    if n_merges < 0:
+        raise ValueError(f"vocab_size must be >= {256 + n_special}")
+    ids = list(data)
+    merges: list[tuple[int, int]] = []
+    for _ in range(n_merges):
+        counts = Counter(zip(ids, ids[1:]))
+        if not counts:
+            break
+        pair, freq = counts.most_common(1)[0]
+        if freq < 2:
+            break
+        new_id = 256 + len(merges)
+        merges.append(pair)
+        out = []
+        i = 0
+        while i < len(ids):
+            if i + 1 < len(ids) and (ids[i], ids[i + 1]) == pair:
+                out.append(new_id)
+                i += 2
+            else:
+                out.append(ids[i])
+                i += 1
+        ids = out
+    return Tokenizer(merges, n_special)
+
+
+def load_tokenizer(config) -> Optional[Tokenizer]:
+    """Container wiring: TOKENIZER_PATH (merges file) > TOKENIZER=byte >
+    None (id-only endpoints)."""
+    path = config.get("TOKENIZER_PATH")
+    if path:
+        return Tokenizer.from_file(path)
+    if config.get_or_default("TOKENIZER", "") == "byte":
+        return Tokenizer.byte_level()
+    return None

@@ -187,3 +187,39 @@ def pad_rows(rows: list[np.ndarray], target: int) -> np.ndarray:
         pad = np.repeat(stacked[-1:], target - len(rows), axis=0)
         stacked = np.concatenate([stacked, pad], axis=0)
     return stacked
+
+
+def pack_token_rows(
+    rows: Sequence[np.ndarray], n_rows: int, width: int, pad_id: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pack variable-length int32 id rows into a [n_rows, width] batch +
+    per-row kept lengths. Overlong rows keep their LAST tokens. Uses the
+    native gofr_pack_rows when the C++ library is available (the serving
+    hot path); Python loop otherwise."""
+    import ctypes
+
+    from gofr_tpu import native
+
+    out = np.full((n_rows, width), pad_id, np.int32)
+    out_lens = np.zeros(n_rows, np.int32)
+    if not rows:
+        return out, out_lens
+    lib = native.load()
+    if lib is not None:
+        flat = np.ascontiguousarray(
+            np.concatenate([np.asarray(r, np.int32).reshape(-1) for r in rows])
+        )
+        lens = np.asarray([np.asarray(r).size for r in rows], np.int64)
+        lib.gofr_pack_rows(
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(rows), width, pad_id,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out, out_lens
+    for i, row in enumerate(rows):
+        ids = np.asarray(row, np.int32).reshape(-1)[-width:]
+        out[i, : ids.size] = ids
+        out_lens[i] = ids.size
+    return out, out_lens

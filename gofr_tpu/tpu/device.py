@@ -32,7 +32,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from gofr_tpu.datasource.health import DOWN, UP, Health
-from gofr_tpu.tpu.batcher import DynamicBatcher, next_pow2, pad_rows
+from gofr_tpu.tpu.batcher import (
+    DynamicBatcher,
+    next_pow2,
+    pack_token_rows,
+    pad_rows,
+)
 from gofr_tpu.tracing import get_tracer
 
 
@@ -70,6 +75,9 @@ class TPUDevice:
         self.timeout_ms = float(config.get_or_default("BATCH_TIMEOUT_MS", "5"))
         self.quant = config.get_or_default("MODEL_QUANT", "") == "int8"
         self.model_path = config.get("MODEL_PATH")
+        from gofr_tpu.tokenizer import load_tokenizer
+
+        self.tokenizer = load_tokenizer(config)
 
         self.devices = jax.devices()
         self.platform = self.devices[0].platform
@@ -142,7 +150,10 @@ class TPUDevice:
         through the dynamic batcher (TTFT path); decode steps run per
         request. ``on_token`` streams each new token id (SSE endpoints);
         ``stop`` (a threading.Event) aborts decode between steps — set it
-        when the client disconnects so the device stops doing unread work."""
+        when the client disconnects so the device stops doing unread work.
+        ``tokens`` may be a str when a tokenizer is configured."""
+        if isinstance(tokens, str):
+            tokens = self._detokenize(tokens)["tokens"]
         start = time.perf_counter()
         try:
             out = self.runner.generate(
@@ -194,7 +205,26 @@ class TPUDevice:
 
     # -- internals -----------------------------------------------------------
     def _prepare(self, payload: Any) -> Any:
-        return self.runner.prepare(payload)
+        return self.runner.prepare(self._detokenize(payload))
+
+    def _detokenize(self, payload: Any) -> Any:
+        """Text payloads ({"text": ...} or a bare str) become token ids via
+        the configured tokenizer (TOKENIZER_PATH / TOKENIZER=byte)."""
+        text = None
+        if isinstance(payload, str):
+            text = payload
+        elif isinstance(payload, dict) and isinstance(payload.get("text"), str):
+            text = payload["text"]
+        if text is None:
+            return payload
+        if self.tokenizer is None:
+            from gofr_tpu.errors import InvalidParamError
+
+            raise InvalidParamError(
+                'text (no tokenizer configured — set TOKENIZER=byte or '
+                "TOKENIZER_PATH, or send token ids)"
+            )
+        return {"tokens": self.tokenizer.encode(text)}
 
     def _run_batch(self, payloads: list[Any]) -> list[Any]:
         start = time.perf_counter()
@@ -216,6 +246,11 @@ class TPUDevice:
             f"devices={len(self.devices)} kind={self.device_kind}"
             + (" quant=int8" if self.quant else "")
             + (f" mesh={dict(self.mesh.shape)}" if self.mesh is not None else "")
+            + (
+                f" tokenizer={self.tokenizer.backend}"
+                if self.tokenizer is not None
+                else ""
+            )
         )
 
     # -- health (north star: device liveness on /.well-known/health) ---------
@@ -473,6 +508,13 @@ class _TransformerRunner:
             from gofr_tpu.errors import InvalidParamError
 
             raise InvalidParamError("tokens must be a non-empty list of ids")
+        if ids.min() < 0 or ids.max() >= self.cfg.vocab_size:
+            from gofr_tpu.errors import InvalidParamError
+
+            raise InvalidParamError(
+                f"token ids must be in [0, {self.cfg.vocab_size}) for "
+                f"model '{self.name}' (tokenizer vocab larger than model?)"
+            )
         return ids[-self.cfg.max_seq :]
 
     def _zero_cache(self, bsz: int) -> Any:
@@ -497,16 +539,10 @@ class _TransformerRunner:
         n = len(payloads)
         # prompts longer than the largest bucket keep their LAST tokens
         # (consistent with prepare(): recency wins for next-token prediction)
-        biggest = self.buckets[-1]
-        payloads = [p[-biggest:] for p in payloads]
-        lengths = np.array([p.size for p in payloads], np.int32)
-        bucket = self._bucket_for(int(lengths.max()))
+        bucket = self._bucket_for(max(int(p.size) for p in payloads))
         bsz = next_pow2(max(len(payloads), self.max_batch))
-        tokens = np.zeros((bsz, bucket), np.int32)
-        for i, ids in enumerate(payloads):
-            tokens[i, : ids.size] = ids
-        full_lengths = np.ones((bsz,), np.int32)
-        full_lengths[:n] = lengths
+        tokens, lengths = pack_token_rows(payloads, bsz, bucket)
+        full_lengths = np.maximum(lengths, 1)  # padded rows need length>=1
         cache = self._zero_cache(bsz)
         tokens_dev, lengths_dev = jnp.asarray(tokens), jnp.asarray(full_lengths)
         if self._token_sharding is not None:

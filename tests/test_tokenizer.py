@@ -1,0 +1,196 @@
+"""Tokenizer: native C++ backend vs pure-Python oracle, trainer, packing.
+
+The native library is the framework's C++ boundary (native/tokenizer.cpp);
+every behavior is asserted equal between backends so the Python fallback
+doubles as the correctness oracle."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from gofr_tpu import native
+from gofr_tpu.tokenizer import SPECIAL_TOKENS, Tokenizer, train_bpe
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "the quicker the fox, the lazier the dog — überraschung! "
+) * 8
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return train_bpe(CORPUS, vocab_size=320)
+
+
+def test_native_library_builds():
+    lib = native.load()
+    assert lib is not None, "g++ toolchain present in this image; native must build"
+
+
+def test_byte_level_roundtrip():
+    tok = Tokenizer.byte_level()
+    text = "hello wörld ☃"
+    ids = tok.encode(text)
+    assert all(0 <= i < 256 for i in ids)
+    assert tok.decode(ids) == text
+
+
+def test_trained_roundtrip_and_compression(trained):
+    ids = trained.encode(CORPUS)
+    assert trained.decode(ids) == CORPUS
+    assert len(ids) < len(CORPUS.encode()) * 0.6, "BPE must compress its corpus"
+
+
+def test_native_matches_python_backend(trained):
+    if trained.backend != "native":
+        pytest.skip("no native toolchain")
+    py = Tokenizer(trained.merges)
+    py._native = None  # force the Python path
+    for text in ("", "a", CORPUS[:200], "emoji \U0001f680 mixed 123", "\x00\xff binary"):
+        assert trained.encode(text) == py._encode_python(
+            text.encode("utf-8")
+        ), f"backend mismatch on {text!r}"
+        ids = trained.encode(text)
+        assert trained.decode(ids) == py.decode(ids)
+
+
+def test_save_load_roundtrip(tmp_path, trained):
+    path = str(tmp_path / "merges.txt")
+    trained.save(path)
+    loaded = Tokenizer.from_file(path)
+    assert loaded.merges == trained.merges
+    sample = CORPUS[:100]
+    assert loaded.encode(sample) == trained.encode(sample)
+
+
+def test_special_ids_top_of_vocab(trained):
+    assert trained.special_id("pad") == 256 + len(trained.merges)
+    assert trained.special_id("eos") == trained.vocab_size - 1
+    assert trained.vocab_size == 256 + len(trained.merges) + len(SPECIAL_TOKENS)
+    # specials never appear in encoded output and decode to nothing
+    assert trained.decode([trained.special_id("pad")]) == ""
+
+
+def test_train_rejects_tiny_vocab():
+    with pytest.raises(ValueError, match="vocab_size"):
+        train_bpe("abc", vocab_size=10)
+
+
+def test_pack_rows_native():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("no native toolchain")
+    rows = [[1, 2, 3], [4], [5, 6, 7, 8, 9, 10]]
+    flat = np.asarray([x for r in rows for x in r], np.int32)
+    lens = np.asarray([len(r) for r in rows], np.int64)
+    width = 4
+    out = np.zeros((len(rows), width), np.int32)
+    out_lens = np.zeros(len(rows), np.int32)
+    lib.gofr_pack_rows(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(rows), width, 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    np.testing.assert_array_equal(out[0], [1, 2, 3, 0])
+    np.testing.assert_array_equal(out[1], [4, 0, 0, 0])
+    # overlong row keeps its LAST tokens (prepare() semantics)
+    np.testing.assert_array_equal(out[2], [7, 8, 9, 10])
+    np.testing.assert_array_equal(out_lens, [3, 1, 4])
+
+
+def test_backends_agree_on_overlapping_merges():
+    # "aa"+"a": greedy leftmost at equal rank; classic overlap pitfalls
+    a = ord("a")
+    tok = Tokenizer([(a, a), (256, a)])
+    py = Tokenizer(tok.merges)
+    py._native = None
+    for text in ("aaaa", "aaa", "aaaaa", "aabaa", "a" * 37):
+        got = tok.encode(text)
+        want = py._encode_python(text.encode())
+        assert got == want, (text, got, want)
+        assert tok.decode(got) == text
+
+
+def test_backends_agree_on_random_corpus(trained):
+    import random
+
+    rng = random.Random(7)
+    py = Tokenizer(trained.merges)
+    py._native = None
+    for _ in range(20):
+        n = rng.randrange(0, 200)
+        data = bytes(rng.randrange(256) for _ in range(n))
+        assert trained.encode(data) == py._encode_python(data), data
+
+
+def test_merges_file_headers_and_duplicates(tmp_path):
+    # header lines and duplicate pairs must not shift ids or desync decode
+    path = tmp_path / "merges.txt"
+    path.write_text("#version: 0.2\n104 105\n104 105\n99 100\n999999 3\n")
+    tok = Tokenizer.from_file(str(path))
+    assert tok.merges == [(104, 105), (99, 100)]
+    assert tok.encode("hi") == [256]
+    assert tok.encode("cd") == [257]
+    assert tok.decode([256, 257]) == "hicd"
+
+
+def test_stream_decoder_multibyte_split():
+    tok = Tokenizer.byte_level()
+    text = "héllo ☃ é"
+    ids = tok.encode(text)
+    dec = tok.stream_decoder()
+    pieces = [dec.feed(i) for i in ids]
+    assert "".join(pieces) + dec.flush() == text
+    # no replacement chars mid-stream for valid input
+    assert "�" not in "".join(pieces)
+    # truncated multi-byte at end of stream surfaces on flush as replacement
+    dec2 = tok.stream_decoder()
+    partial = "é".encode()[:1]
+    out = dec2.feed(partial[0])
+    assert out == ""  # buffered, not garbled
+    assert dec2.flush() == "�"
+
+
+def test_encode_large_input_is_fast(trained):
+    import time
+
+    big = (CORPUS * 300)[:200_000]
+    start = time.perf_counter()
+    ids = trained.encode(big)
+    elapsed = time.perf_counter() - start
+    assert trained.decode(ids) == big
+    assert elapsed < 3.0, f"encode of 200KB took {elapsed:.1f}s — not O(n log n)?"
+
+
+def test_pack_token_rows_matches_python_fallback(monkeypatch):
+    from gofr_tpu.tpu.batcher import pack_token_rows
+
+    rows = [np.asarray(r, np.int32) for r in ([1, 2, 3], [4], list(range(20)))]
+    got, got_lens = pack_token_rows(rows, 4, 8, pad_id=0)
+    import gofr_tpu.native as native_mod
+
+    monkeypatch.setattr(native_mod, "load", lambda: None)
+    want, want_lens = pack_token_rows(rows, 4, 8, pad_id=0)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(got_lens, want_lens)
+    np.testing.assert_array_equal(got[2], list(range(12, 20)))  # last tokens kept
+
+
+def test_native_parser_robustness_direct_abi():
+    # direct C-ABI consumers (GOFR_NATIVE_LIB users) may feed raw merges
+    # blobs: headers, duplicates, and special-range ids must all be skipped
+    lib = native.load()
+    if lib is None:
+        pytest.skip("no native toolchain")
+    blob = b"#version: 0.2\n104 105\n104 105\n300 3\n99 100\n"
+    h = lib.gofr_tok_new(blob, len(blob), 3)
+    try:
+        assert lib.gofr_tok_vocab_size(h) == 256 + 2 + 3  # hi, cd + specials
+        buf = (ctypes.c_int32 * 4)()
+        n = lib.gofr_tok_encode(h, b"hicd", 4, buf, 4)
+        assert list(buf[:n]) == [256, 257]
+    finally:
+        lib.gofr_tok_free(h)

@@ -255,3 +255,46 @@ def test_stop_event_mid_decode(tiny_device):
 
     out = tiny_device.generate([1, 2, 3], max_new_tokens=64, on_token=on_token, stop=ev)
     assert len(out) == 3  # stopped at the next step boundary
+
+
+# -- tokenizer wiring ---------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def text_device():
+    import os
+
+    env = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "4", "BATCH_TIMEOUT_MS": "2",
+           "TOKENIZER": "byte"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    device = new_device(EnvConfig(), MockLogger(Level.DEBUG), Registry())
+    yield device
+    device.close()
+    for k, v in old.items():
+        os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_text_payload_infer(text_device):
+    state = text_device.infer({"text": "hello"})
+    assert state["length"] == 5  # byte-level: one id per byte
+    assert "tokenizer=" in text_device.describe()
+
+
+def test_text_generate_matches_ids(text_device):
+    by_text = text_device.generate("hi", max_new_tokens=4)
+    by_ids = text_device.generate([ord("h"), ord("i")], max_new_tokens=4)
+    assert by_text == by_ids
+
+
+def test_text_without_tokenizer_rejected(tiny_device):
+    from gofr_tpu.errors import InvalidParamError
+
+    with pytest.raises(InvalidParamError, match="tokenizer"):
+        tiny_device.infer({"text": "hello"})
+
+
+def test_out_of_range_ids_rejected(tiny_device):
+    from gofr_tpu.errors import InvalidParamError
+
+    with pytest.raises(InvalidParamError, match="token ids"):
+        tiny_device.infer({"tokens": [1, 2, 999999]})
