@@ -70,13 +70,29 @@ def generate_stream(ctx):
     if tokens is None:
         tokens = [1, 2, 3]  # demo prompt
     max_new = int(body.get("max_new_tokens") or 16)
+    from gofr_tpu.errors import HTTPError
+    from gofr_tpu.ops.sampling import Sampler
+
+    try:
+        sampler = Sampler(
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            seed=body.get("seed"),
+        )
+    except (TypeError, ValueError) as exc:
+        raise HTTPError(400, f"invalid sampling params: {exc}")
     tok = ctx.tpu.tokenizer
     dec = tok.stream_decoder() if tok is not None else None
-    for token in ctx.tpu.generate_stream(tokens, max_new):
+    for token in ctx.tpu.generate_stream(tokens, max_new, sampler=sampler):
         event = {"token": token}
         if dec is not None:
             event["text"] = dec.feed(token)
         yield event
+    if dec is not None:
+        tail = dec.flush()  # bytes still buffered at stream end
+        if tail:
+            yield {"text": tail}
 
 
 def main():

@@ -73,6 +73,7 @@ def generate_handler(ctx):
         raise HTTPError(400, 'request body must be a JSON object like {"tokens": [...]}')
     tokens = _prompt_from(body)
     max_new = int(body.get("max_new_tokens") or 16)
+    sampler = _sampler_from(body)
     tok = ctx.tpu.tokenizer
     if ctx.param("stream") == "true":
         from gofr_tpu.http.response import Stream
@@ -82,20 +83,40 @@ def generate_handler(ctx):
             # buffers until the character completes
             dec = tok.stream_decoder() if tok is not None else None
             try:
-                for token in ctx.tpu.generate_stream(tokens, max_new):
+                for token in ctx.tpu.generate_stream(tokens, max_new, sampler=sampler):
                     event = {"token": token}
                     if dec is not None:
                         event["text"] = dec.feed(token)
                     yield event
+                if dec is not None:
+                    tail = dec.flush()  # bytes still buffered at stream end
+                    if tail:
+                        yield {"text": tail}
             except Exception as exc:  # surfaced as an SSE error event
                 yield {"error": str(exc)}
 
         return Stream(events())
-    out = ctx.tpu.generate(tokens, max_new)
+    out = ctx.tpu.generate(tokens, max_new, sampler=sampler)
     result = {"tokens": out}
     if tok is not None:
         result["text"] = tok.decode(out)
     return result
+
+
+def _sampler_from(body):
+    """Sampling params from the request body: temperature (default 0 =
+    greedy), top_k, top_p, seed."""
+    from gofr_tpu.ops.sampling import Sampler
+
+    try:
+        return Sampler(
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            seed=body.get("seed"),
+        )
+    except (TypeError, ValueError) as exc:
+        raise HTTPError(400, f"invalid sampling params: {exc}")
 
 
 def _prompt_from(body):

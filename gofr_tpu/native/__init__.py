@@ -43,9 +43,12 @@ def _cache_dir() -> pathlib.Path:
 
 
 def _build(src_dir: pathlib.Path) -> Optional[pathlib.Path]:
-    srcs = [src_dir / s for s in _SOURCES]
-    digest = hashlib.sha256(b"".join(p.read_bytes() for p in srcs)).hexdigest()[:16]
-    out = _cache_dir() / f"libgofr_native_{digest}.so"
+    try:
+        srcs = [src_dir / s for s in _SOURCES]
+        digest = hashlib.sha256(b"".join(p.read_bytes() for p in srcs)).hexdigest()[:16]
+        out = _cache_dir() / f"libgofr_native_{digest}.so"
+    except OSError:
+        return None  # unreadable sources / unwritable cache -> Python fallback
     if out.exists():
         return out
     # atomic build: compile to a temp name, rename into place

@@ -1,0 +1,112 @@
+"""On-device token sampling: temperature, top-k, nucleus (top-p).
+
+Decode-time sampling runs on the accelerator (one fused kernel over the
+[B, V] logits — no host round-trip of the full vocab distribution), keyed
+by ``jax.random`` so a request seed makes generation reproducible.
+
+Semantics (the standard composition): logits are temperature-scaled, then
+top-k filtered, then nucleus-filtered (smallest prefix of the sorted
+distribution whose mass reaches ``top_p``; always at least one token),
+then sampled categorically. ``temperature=0`` short-circuits to argmax.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float(-1e30)
+
+
+@jax.jit
+def sample_logits(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: float | jnp.ndarray = 1.0,
+    top_k: int | jnp.ndarray = 0,
+    top_p: float | jnp.ndarray = 1.0,
+) -> jnp.ndarray:
+    """[B, V] float logits -> [B] int32 sampled token ids.
+
+    temperature, top_k, and top_p are ALL dynamic operands: one compiled
+    sampler serves every request — request-supplied knobs must never
+    recompile on the serving path."""
+    logits = logits.astype(jnp.float32)
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+
+    # top-k (dynamic): threshold at the k-th largest value
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, jnp.full((b, 1), k_idx), axis=-1)
+    scaled = jnp.where(scaled < kth, _NEG_INF, scaled)
+
+    # nucleus over the top-k-filtered distribution (sequential warper
+    # semantics): drop tokens whose EXCLUSIVE cumulative probability (in
+    # descending order) has already reached top_p; the argmax token always
+    # survives (its exclusive cumsum is 0)
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+    cutoff_logit = jnp.min(
+        jnp.where(cum < top_p, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    scaled = jnp.where(scaled < cutoff_logit, _NEG_INF, scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+class Sampler:
+    """Per-request sampling state: seeded key split per step. A plain
+    Python object driven by the host decode loop (the [B, V] math above is
+    the on-device part)."""
+
+    def __init__(
+        self,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: Optional[int] = None,
+    ):
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if top_k < 0:
+            raise ValueError("top_k must be >= 0")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        if seed is None:
+            # unseeded requests must be genuinely random, not key(0)
+            import secrets
+
+            seed = secrets.randbits(63)
+        self._key = jax.random.key(int(seed))
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+    def pick(self, logits) -> int:
+        """[V] or [1, V] logits -> one token id."""
+        logits = jnp.asarray(logits)
+        if logits.ndim == 1:
+            logits = logits[None, :]
+        if self.greedy:
+            return int(jnp.argmax(logits[0]))
+        self._key, sub = jax.random.split(self._key)
+        return int(
+            sample_logits(
+                logits, sub, temperature=self.temperature,
+                top_k=self.top_k, top_p=self.top_p,
+            )[0]
+        )

@@ -145,19 +145,23 @@ class TPUDevice:
         max_new_tokens: int = 32,
         on_token: Optional[Any] = None,
         stop: Optional[Any] = None,
+        sampler: Optional[Any] = None,
     ) -> list[int]:
         """Autoregressive generation (transformer models): prefill goes
         through the dynamic batcher (TTFT path); decode steps run per
         request. ``on_token`` streams each new token id (SSE endpoints);
         ``stop`` (a threading.Event) aborts decode between steps — set it
         when the client disconnects so the device stops doing unread work.
-        ``tokens`` may be a str when a tokenizer is configured."""
+        ``tokens`` may be a str when a tokenizer is configured; ``sampler``
+        (ops.sampling.Sampler) sets temperature/top-k/top-p — default
+        greedy."""
         if isinstance(tokens, str):
             tokens = self._detokenize(tokens)["tokens"]
         start = time.perf_counter()
         try:
             out = self.runner.generate(
                 tokens, max_new_tokens, on_token=on_token, stop=stop,
+                sampler=sampler,
                 prefill_batcher=self.batcher, ttft_cb=lambda: self._ttft.observe(
                     time.perf_counter() - start, model=self.model_name, op="generate"
                 ),
@@ -169,7 +173,8 @@ class TPUDevice:
             raise
 
     def generate_stream(
-        self, tokens: list[int], max_new_tokens: int = 32
+        self, tokens: list[int], max_new_tokens: int = 32,
+        sampler: Optional[Any] = None,
     ) -> Any:
         """Iterator of decoded token ids, yielded as they decode — the shared
         bridge for SSE and gRPC streaming transports. Closing the iterator
@@ -185,7 +190,10 @@ class TPUDevice:
 
         def run() -> None:
             try:
-                self.generate(tokens, max_new_tokens, on_token=out.put, stop=stop)
+                self.generate(
+                    tokens, max_new_tokens, on_token=out.put, stop=stop,
+                    sampler=sampler,
+                )
             except BaseException as exc:
                 failure.append(exc)
             finally:
@@ -561,9 +569,14 @@ class _TransformerRunner:
         max_new_tokens: int,
         on_token: Any = None,
         stop: Any = None,
+        sampler: Any = None,
         prefill_batcher: Any = None,
         ttft_cb: Any = None,
     ) -> list[int]:
+        if sampler is None:
+            from gofr_tpu.ops.sampling import Sampler
+
+            sampler = Sampler()  # greedy
         ids = self.prepare(tokens)
         if prefill_batcher is not None:
             state = prefill_batcher.infer(ids)
@@ -571,7 +584,7 @@ class _TransformerRunner:
             state = self.run_batch([ids])[0]
         logits, cache = state["logits"], state["cache"]
         out: list[int] = []
-        token = int(np.argmax(logits[-1] if logits.ndim > 1 else logits))
+        token = sampler.pick(logits[-1] if logits.ndim > 1 else logits)
         if ttft_cb:
             ttft_cb()
         out.append(token)
@@ -586,7 +599,7 @@ class _TransformerRunner:
             step_logits, cache = self._decode(
                 self.params, jnp.asarray([[token]], jnp.int32), cache
             )
-            token = int(np.argmax(np.asarray(step_logits)[0]))
+            token = sampler.pick(np.asarray(step_logits)[0])
             out.append(token)
             if on_token:
                 on_token(token)

@@ -1,0 +1,145 @@
+"""Training data pipeline: token datasets + host→device prefetch.
+
+The loader side of the training stack (the reference has no data layer —
+SURVEY.md §2; this is TPU-native plumbing): tokens live in a flat binary
+file (np.memmap — no RAM limit), batches are random crops keyed by a seed
+(reproducible across restarts via the step counter), and a background
+prefetcher keeps the next batches already on device (with their training
+sharding applied) so the TPU never waits on the host.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, Optional
+
+import jax
+import numpy as np
+
+_DTYPE = np.uint16  # default: vocab <= 65536 (all shipped configs)
+_SENTINEL = object()
+
+
+def dtype_for_vocab(vocab_size: int) -> np.dtype:
+    return np.dtype(np.uint16 if vocab_size <= 65536 else np.uint32)
+
+
+def corpus_to_bin(text: str, tokenizer: Any, path: str, dtype: Any = None) -> int:
+    """Tokenize a corpus and write the flat token file ``TokenDataset``
+    reads. Returns the token count. dtype defaults to the smallest type
+    holding the tokenizer's vocab (uint16 / uint32); pass the SAME dtype to
+    ``TokenDataset`` when it isn't the uint16 default."""
+    if dtype is None:
+        dtype = dtype_for_vocab(getattr(tokenizer, "vocab_size", 1 << 16))
+    dtype = np.dtype(dtype)
+    vocab = getattr(tokenizer, "vocab_size", None)
+    if vocab is not None and vocab > np.iinfo(dtype).max + 1:
+        raise ValueError(
+            f"dtype {dtype} cannot hold tokenizer vocab {vocab} — use uint32"
+        )
+    ids = np.asarray(tokenizer.encode(text), dtype)
+    ids.tofile(path)
+    return int(ids.size)
+
+
+class TokenDataset:
+    """Fixed-length [batch, seq_len] crops over a flat token stream.
+
+    ``path_or_array``: a ``.bin`` file written by :func:`corpus_to_bin`
+    (memory-mapped, so datasets larger than RAM stream from disk) or any
+    1-D integer array. Batches are seeded random crops: ``batch(step)`` is
+    a pure function of (seed, step), which makes resume-from-checkpoint
+    reproduce the exact data order without loader state in the checkpoint.
+    """
+
+    def __init__(
+        self,
+        path_or_array: Any,
+        seq_len: int,
+        batch_size: int,
+        seed: int = 0,
+        dtype: Any = _DTYPE,
+    ):
+        if isinstance(path_or_array, str):
+            self.tokens = np.memmap(path_or_array, dtype=np.dtype(dtype), mode="r")
+        else:
+            self.tokens = np.asarray(path_or_array)
+        if self.tokens.ndim != 1:
+            raise ValueError("token stream must be 1-D")
+        if self.tokens.size < seq_len + 1:
+            raise ValueError(
+                f"dataset has {self.tokens.size} tokens; needs > seq_len={seq_len}"
+            )
+        self.seq_len = seq_len
+        self.batch_size = batch_size
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return int(self.tokens.size)
+
+    def batch(self, step: int) -> np.ndarray:
+        """[batch_size, seq_len] int32 crop for this step (deterministic)."""
+        rng = np.random.default_rng((self.seed << 32) | (step & 0xFFFFFFFF))
+        starts = rng.integers(0, self.tokens.size - self.seq_len, self.batch_size)
+        out = np.empty((self.batch_size, self.seq_len), np.int32)
+        for i, s in enumerate(starts):
+            out[i] = self.tokens[s : s + self.seq_len]
+        return out
+
+    def batches(self, start_step: int = 0) -> Iterator[np.ndarray]:
+        step = start_step
+        while True:
+            yield self.batch(step)
+            step += 1
+
+
+def prefetch_to_device(
+    iterator: Iterator[Any],
+    size: int = 2,
+    sharding: Optional[Any] = None,
+) -> Iterator[Any]:
+    """Wrap a host batch iterator so the next ``size`` batches are already
+    transferred to device (with ``sharding`` applied) while the current
+    step computes — the standard overlap that keeps HBM fed. The transfer
+    happens on a daemon thread; closing the generator stops it."""
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+    failure: list[BaseException] = []
+
+    def run() -> None:
+        try:
+            for batch in iterator:
+                if stop.is_set():
+                    return
+                arr = jax.device_put(batch, sharding) if sharding is not None else (
+                    jax.device_put(batch)
+                )
+                while not stop.is_set():
+                    try:
+                        q.put(arr, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as exc:
+            failure.append(exc)
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if failure:
+                    raise failure[0]
+                return
+            yield item
+    finally:
+        stop.set()

@@ -1,0 +1,140 @@
+"""Training data pipeline: dataset crops, determinism, device prefetch."""
+
+import numpy as np
+import pytest
+
+from gofr_tpu.tokenizer import Tokenizer
+from gofr_tpu.training.data import TokenDataset, corpus_to_bin, prefetch_to_device
+
+
+def test_corpus_to_bin_and_memmap(tmp_path):
+    path = str(tmp_path / "tokens.bin")
+    n = corpus_to_bin("hello world, " * 50, Tokenizer.byte_level(), path)
+    ds = TokenDataset(path, seq_len=16, batch_size=4)
+    assert len(ds) == n
+    b = ds.batch(0)
+    assert b.shape == (4, 16)
+    assert b.dtype == np.int32
+    assert (b >= 0).all() and (b < 256).all()
+
+
+def test_batches_deterministic_by_seed_and_step():
+    tokens = np.arange(1000) % 250
+    a = TokenDataset(tokens, seq_len=8, batch_size=2, seed=5)
+    b = TokenDataset(tokens, seq_len=8, batch_size=2, seed=5)
+    c = TokenDataset(tokens, seq_len=8, batch_size=2, seed=6)
+    np.testing.assert_array_equal(a.batch(3), b.batch(3))
+    assert not np.array_equal(a.batch(3), a.batch(4))
+    assert not np.array_equal(a.batch(3), c.batch(3))
+    # crops are contiguous windows of the stream
+    row = a.batch(0)[0]
+    np.testing.assert_array_equal(np.diff(row) % 250, np.ones(7))
+
+
+def test_dataset_validation():
+    with pytest.raises(ValueError, match="1-D"):
+        TokenDataset(np.zeros((3, 3), np.int32), seq_len=2, batch_size=1)
+    with pytest.raises(ValueError, match="seq_len"):
+        TokenDataset(np.zeros(4, np.int32), seq_len=8, batch_size=1)
+
+
+def test_prefetch_to_device_preserves_order_and_values():
+    ds = TokenDataset(np.arange(500) % 200, seq_len=4, batch_size=2, seed=1)
+    want = [ds.batch(i) for i in range(5)]
+    it = prefetch_to_device(iter(want), size=2)
+    got = [np.asarray(x) for x in it]
+    assert len(got) == 5
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+
+
+def test_prefetch_applies_sharding():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gofr_tpu.parallel.mesh import make_mesh, mesh_shape_for
+
+    mesh = make_mesh(mesh_shape_for(8, fsdp=4))  # dp=2 x fsdp=4
+    sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+    ds = TokenDataset(np.arange(500) % 200, seq_len=4, batch_size=8)
+    it = prefetch_to_device(ds.batches(0), size=1, sharding=sharding)
+    arr = next(it)
+    assert arr.sharding == sharding
+    it.close()
+
+
+def test_prefetch_propagates_errors():
+    def bad():
+        yield np.zeros((2, 2), np.int32)
+        raise RuntimeError("disk on fire")
+
+    it = prefetch_to_device(bad(), size=1)
+    next(it)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        list(it)
+
+
+def test_prefetch_close_stops_producer():
+    produced = []
+
+    def gen():
+        for i in range(10_000):
+            produced.append(i)
+            yield np.full((1, 1), i, np.int32)
+
+    it = prefetch_to_device(gen(), size=1)
+    next(it)
+    it.close()
+    import time
+
+    time.sleep(0.2)
+    n = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n, "producer kept running after close"
+    assert n < 100
+
+
+def test_end_to_end_train_step_with_loader():
+    import jax
+
+    from gofr_tpu.models.transformer import TransformerConfig
+    from gofr_tpu.training.trainer import (
+        default_optimizer,
+        init_train_state,
+        make_train_step,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=256, dim=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        hidden_dim=64, max_seq=32, dtype="float32", attn_impl="xla",
+    )
+    opt = default_optimizer(lr=1e-2)
+    state = init_train_state(jax.random.key(0), cfg, opt)
+    step_fn = make_train_step(cfg, opt)
+    ds = TokenDataset(np.arange(2000) % 256, seq_len=16, batch_size=4)
+    losses = []
+    for i, batch in zip(range(3), prefetch_to_device(ds.batches(0), size=2)):
+        state, metrics = step_fn(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(x) for x in losses)
+    assert int(state["step"]) == 3
+
+
+def test_corpus_to_bin_large_vocab_dtype(tmp_path):
+    from gofr_tpu.training.data import dtype_for_vocab
+
+    class BigVocabTok:
+        vocab_size = 100_000
+
+        def encode(self, text):
+            return [70_000, 99_999, 5]
+
+    path = str(tmp_path / "big.bin")
+    n = corpus_to_bin("x", BigVocabTok(), path)  # auto uint32
+    assert n == 3
+    ds = TokenDataset(path, seq_len=2, batch_size=1, dtype=np.uint32)
+    assert int(ds.tokens[1]) == 99_999
+    assert dtype_for_vocab(65536) == np.uint16
+    assert dtype_for_vocab(65537) == np.uint32
+    with pytest.raises(ValueError, match="uint32"):
+        corpus_to_bin("x", BigVocabTok(), path, dtype=np.uint16)

@@ -1,0 +1,138 @@
+"""On-device sampling: temperature / top-k / top-p semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ops.sampling import Sampler, sample_logits
+
+
+def _logits(vals):
+    return jnp.asarray([vals], jnp.float32)
+
+
+def test_temperature_zero_is_argmax():
+    logits = _logits([0.1, 3.0, 0.2, 1.0])
+    out = sample_logits(logits, jax.random.key(0), temperature=0.0)
+    assert int(out[0]) == 1
+
+
+def test_low_temperature_concentrates():
+    logits = _logits([0.0, 5.0, 0.0, 0.0])
+    keys = jax.random.split(jax.random.key(1), 64)
+    picks = [int(sample_logits(logits, k, temperature=0.1)[0]) for k in keys]
+    assert all(p == 1 for p in picks)
+
+
+def test_high_temperature_spreads():
+    logits = _logits([0.0, 2.0, 0.0, 0.0])
+    keys = jax.random.split(jax.random.key(2), 200)
+    picks = {int(sample_logits(logits, k, temperature=50.0)[0]) for k in keys}
+    assert len(picks) >= 3  # near-uniform across the vocab
+
+
+def test_top_k_masks_tail():
+    logits = _logits([5.0, 4.0, 3.0, 2.0, 1.0])
+    keys = jax.random.split(jax.random.key(3), 100)
+    picks = {int(sample_logits(logits, k, temperature=10.0, top_k=2)[0]) for k in keys}
+    assert picks <= {0, 1}
+    assert len(picks) == 2
+
+
+def test_top_p_nucleus_masks_tail():
+    # probs ~ [0.67, 0.24, 0.09/2, 0.09/2...]: top_p=0.7 keeps {0, 1}
+    logits = _logits([3.0, 2.0, 1.0, 1.0])
+    keys = jax.random.split(jax.random.key(4), 200)
+    picks = {int(sample_logits(logits, k, temperature=1.0, top_p=0.7)[0]) for k in keys}
+    assert picks <= {0, 1}, picks
+
+
+def test_top_p_always_keeps_argmax():
+    logits = _logits([1.0, 1.1, 1.0, 1.0])
+    out = sample_logits(logits, jax.random.key(5), temperature=1.0, top_p=1e-9)
+    assert int(out[0]) == 1
+
+
+def test_batched_sampling_shape():
+    logits = jnp.tile(_logits([1.0, 2.0, 3.0]), (5, 1))
+    out = sample_logits(logits, jax.random.key(6), temperature=1.0)
+    assert out.shape == (5,)
+    assert out.dtype == jnp.int32
+
+
+def test_sampler_seed_reproducible():
+    logits = np.asarray([0.0, 1.0, 2.0, 1.5], np.float32)
+    a = Sampler(temperature=1.0, seed=42)
+    b = Sampler(temperature=1.0, seed=42)
+    c = Sampler(temperature=1.0, seed=43)
+    seq_a = [a.pick(logits) for _ in range(8)]
+    seq_b = [b.pick(logits) for _ in range(8)]
+    seq_c = [c.pick(logits) for _ in range(8)]
+    assert seq_a == seq_b
+    assert seq_a != seq_c  # overwhelmingly likely
+
+
+def test_sampler_validation():
+    with pytest.raises(ValueError):
+        Sampler(temperature=-1)
+    with pytest.raises(ValueError):
+        Sampler(top_k=-1)
+    with pytest.raises(ValueError):
+        Sampler(top_p=0.0)
+    with pytest.raises(ValueError):
+        Sampler(top_p=1.5)
+
+
+def test_device_generate_with_sampler():
+    import os
+
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.logging import Level
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.testutil import MockLogger
+    from gofr_tpu.tpu.device import new_device
+
+    env = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2", "BATCH_TIMEOUT_MS": "1"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            greedy = device.generate([1, 2, 3], max_new_tokens=6)
+            seeded = device.generate(
+                [1, 2, 3], max_new_tokens=6,
+                sampler=Sampler(temperature=1.0, top_k=40, seed=7),
+            )
+            again = device.generate(
+                [1, 2, 3], max_new_tokens=6,
+                sampler=Sampler(temperature=1.0, top_k=40, seed=7),
+            )
+            assert seeded == again  # same seed, same tokens
+            assert len(seeded) == 6
+            assert greedy == device.generate([1, 2, 3], max_new_tokens=6)
+        finally:
+            device.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_unseeded_samplers_differ():
+    logits = np.asarray([1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0], np.float32)
+    seqs = {tuple(Sampler(temperature=5.0).pick(logits) for _ in range(12)) for _ in range(4)}
+    assert len(seqs) > 1, "unseeded sampling must not be deterministic across requests"
+
+
+def test_dynamic_top_k_no_recompile():
+    # varying request-supplied top_k must reuse ONE compiled executable
+    logits = jnp.asarray([[1.0, 2.0, 3.0, 4.0, 5.0]])
+    base = sample_logits._cache_size() if hasattr(sample_logits, "_cache_size") else None
+    for k in (1, 2, 3, 4, 0):
+        sample_logits(logits, jax.random.key(k), temperature=1.0, top_k=k)
+    if base is not None:
+        assert sample_logits._cache_size() <= base + 1
+    # semantics: top_k=1 at temperature>0 always picks the argmax
+    picks = {int(sample_logits(logits, jax.random.key(i), temperature=5.0, top_k=1)[0])
+             for i in range(20)}
+    assert picks == {4}

@@ -216,13 +216,15 @@ def test_generate_stream_close_cancels_decode(tiny_device, monkeypatch):
     produced = []
     real_generate = tiny_device.generate
 
-    def spy(tokens, max_new_tokens=32, on_token=None, stop=None):
+    def spy(tokens, max_new_tokens=32, on_token=None, stop=None, **kw):
         def slow_token(t):
             produced.append(t)
             on_token(t)
             time.sleep(0.02)
 
-        return real_generate(tokens, max_new_tokens, on_token=slow_token, stop=stop)
+        return real_generate(
+            tokens, max_new_tokens, on_token=slow_token, stop=stop, **kw
+        )
 
     monkeypatch.setattr(tiny_device, "generate", spy)
     it = tiny_device.generate_stream([1, 2, 3], max_new_tokens=100)
