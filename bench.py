@@ -54,9 +54,9 @@ def main() -> None:
     async def infer(ctx):
         payload = ctx.bind()
         state = await ctx.tpu.infer_async(payload["tokens"])
-        import numpy as np
-
-        return {"next_token": int(np.argmax(state["logits"]))}
+        # next_token was argmaxed on device; reading state["logits"] here
+        # would add a [V]-row device fetch per request
+        return {"next_token": state["next_token"]}
 
     app.post("/infer", infer)
     app.start()

@@ -59,7 +59,7 @@ def embed(ctx):
     import numpy as np
 
     if isinstance(out, dict):  # transformer prefill state
-        return {"next_token": int(np.argmax(out["logits"]))}
+        return {"next_token": out["next_token"]}
     return {"embedding": np.asarray(out).tolist()}
 
 
@@ -74,12 +74,7 @@ def generate_stream(ctx):
     from gofr_tpu.ops.sampling import Sampler
 
     try:
-        sampler = Sampler(
-            temperature=float(body.get("temperature", 0.0)),
-            top_k=int(body.get("top_k", 0)),
-            top_p=float(body.get("top_p", 1.0)),
-            seed=body.get("seed"),
-        )
+        sampler = Sampler.from_body(body)
     except (TypeError, ValueError) as exc:
         raise HTTPError(400, f"invalid sampling params: {exc}")
     tok = ctx.tpu.tokenizer

@@ -59,7 +59,7 @@ async def infer_handler(ctx):
     import numpy as np
 
     if isinstance(result, dict):  # transformer prefill state -> next token
-        return {"next_token": int(np.argmax(result["logits"]))}
+        return {"next_token": result["next_token"]}
     return {"y": np.asarray(result).tolist()}
 
 
@@ -109,12 +109,7 @@ def _sampler_from(body):
     from gofr_tpu.ops.sampling import Sampler
 
     try:
-        return Sampler(
-            temperature=float(body.get("temperature", 0.0)),
-            top_k=int(body.get("top_k", 0)),
-            top_p=float(body.get("top_p", 1.0)),
-            seed=body.get("seed"),
-        )
+        return Sampler.from_body(body)
     except (TypeError, ValueError) as exc:
         raise HTTPError(400, f"invalid sampling params: {exc}")
 

@@ -268,3 +268,35 @@ def decode_step(
 ) -> tuple[jnp.ndarray, dict]:
     """One autoregressive step: ``token`` [B, 1] -> logits [B, V] + cache."""
     return _forward_with_cache(params, token, cache, cfg, None)
+
+
+def decode_chunk(
+    params: dict,
+    token: jnp.ndarray,
+    cache: dict,
+    cfg: TransformerConfig,
+    n_steps: int,
+    key: jax.Array,
+    temperature: jnp.ndarray | float = 0.0,
+    top_k: jnp.ndarray | int = 0,
+    top_p: jnp.ndarray | float = 1.0,
+) -> tuple[jnp.ndarray, dict]:
+    """``n_steps`` autoregressive steps in ONE dispatch: decode + on-device
+    sampling under ``lax.scan``, so a whole chunk of tokens costs a single
+    host↔device round trip (the round trip, not the matmuls, dominates
+    decode on remote-attached devices). ``token`` [B, 1] is the last known
+    token; returns sampled tokens [B, n_steps] + the advanced cache.
+    temperature/top_k/top_p are dynamic (0 temperature = greedy)."""
+    from gofr_tpu.ops.sampling import sample_logits
+
+    def body(carry, _):
+        tok, c, k = carry
+        logits, c = decode_step(params, tok, c, cfg)
+        k, sub = jax.random.split(k)
+        nxt = sample_logits(logits, sub, temperature, top_k, top_p)  # [B]
+        return (nxt[:, None], c, k), nxt
+
+    (_, cache, _), toks = jax.lax.scan(
+        body, (token, cache, key), None, length=n_steps
+    )
+    return jnp.transpose(toks), cache  # [B, n_steps]

@@ -35,33 +35,38 @@ def sample_logits(
     recompile on the serving path."""
     logits = logits.astype(jnp.float32)
     b, v = logits.shape
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
     temperature = jnp.asarray(temperature, jnp.float32)
     top_p = jnp.asarray(top_p, jnp.float32)
     top_k = jnp.asarray(top_k, jnp.int32)
-    scaled = logits / jnp.maximum(temperature, 1e-6)
 
-    # top-k (dynamic): threshold at the k-th largest value
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)
-    kth = jnp.take_along_axis(sorted_desc, jnp.full((b, 1), k_idx), axis=-1)
-    scaled = jnp.where(scaled < kth, _NEG_INF, scaled)
+    def _greedy() -> jnp.ndarray:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    # nucleus over the top-k-filtered distribution (sequential warper
-    # semantics): drop tokens whose EXCLUSIVE cumulative probability (in
-    # descending order) has already reached top_p; the argmax token always
-    # survives (its exclusive cumsum is 0)
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
-    cutoff_logit = jnp.min(
-        jnp.where(cum < top_p, sorted_logits, jnp.inf), axis=-1, keepdims=True
-    )
-    scaled = jnp.where(scaled < cutoff_logit, _NEG_INF, scaled)
+    def _sampled() -> jnp.ndarray:
+        scaled = logits / jnp.maximum(temperature, 1e-6)
 
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(temperature <= 0.0, greedy, sampled)
+        # top-k (dynamic): threshold at the k-th largest value
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)
+        kth = jnp.take_along_axis(sorted_desc, jnp.full((b, 1), k_idx), axis=-1)
+        scaled = jnp.where(scaled < kth, _NEG_INF, scaled)
+
+        # nucleus over the top-k-filtered distribution (sequential warper
+        # semantics): drop tokens whose EXCLUSIVE cumulative probability
+        # (in descending order) has already reached top_p; the argmax
+        # token always survives (its exclusive cumsum is 0)
+        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+        cutoff_logit = jnp.min(
+            jnp.where(cum < top_p, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        scaled = jnp.where(scaled < cutoff_logit, _NEG_INF, scaled)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    # cond, not where: the greedy default (every /generate without a
+    # temperature) must not pay the two full-vocab sorts per step
+    return jax.lax.cond(temperature <= 0.0, _greedy, _sampled)
 
 
 class Sampler:
@@ -92,9 +97,26 @@ class Sampler:
             seed = secrets.randbits(63)
         self._key = jax.random.key(int(seed))
 
+    @classmethod
+    def from_body(cls, body: dict) -> "Sampler":
+        """Build from a request body's sampling keys (temperature, top_k,
+        top_p, seed) — the shared parse for HTTP/gRPC handlers. Raises
+        ValueError/TypeError on malformed values (map to a 400)."""
+        return cls(
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            seed=body.get("seed"),
+        )
+
     @property
     def greedy(self) -> bool:
         return self.temperature == 0.0
+
+    def take_key(self) -> jax.Array:
+        """Split off a fresh subkey (device-side sampling in decode_chunk)."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
 
     def pick(self, logits) -> int:
         """[V] or [1, V] logits -> one token id."""

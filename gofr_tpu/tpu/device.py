@@ -456,6 +456,7 @@ class _TransformerRunner:
 
         self.name = name
         self.cfg = CONFIGS[name]
+        self.decode_chunk_size = int(_env_default("DECODE_CHUNK", "8"))
         params = _load_or_init(
             model_path, lambda: init_transformer(jax.random.key(0), self.cfg)
         )
@@ -491,8 +492,23 @@ class _TransformerRunner:
             }
         cfg = self.cfg
         self._init_cache = init_cache
-        self._prefill = jax.jit(lambda p, t, c, l: prefill(p, t, c, cfg, l))
+        # prefill also argmaxes on device: the hot /infer path fetches [B]
+        # int32 next-token ids, never the [B, V] logits (the remote-attached
+        # device link charges ~per-round-trip + per-byte; see bench notes)
+        def _prefill_fn(p, t, c, l):
+            logits, new_cache = prefill(p, t, c, cfg, l)
+            return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32), new_cache
+
+        self._prefill = jax.jit(_prefill_fn)
         self._decode = jax.jit(lambda p, t, c: decode_step(p, t, c, cfg))
+        from gofr_tpu.models.transformer import decode_chunk
+
+        self._decode_chunk = jax.jit(
+            lambda p, t, c, key, temp, tk, tp, n: decode_chunk(
+                p, t, c, cfg, n, key, temp, tk, tp
+            ),
+            static_argnums=(7,),
+        )
         self.buckets = [b for b in self.SEQ_BUCKETS if b <= cfg.max_seq] or [cfg.max_seq]
         # preallocated zero caches per batch size: prefill never mutates its
         # input cache, so one shared zero cache per bsz removes per-batch
@@ -556,10 +572,18 @@ class _TransformerRunner:
         if self._token_sharding is not None:
             tokens_dev = jax.device_put(tokens_dev, self._token_sharding)
             lengths_dev = jax.device_put(lengths_dev, self._row_sharding)
-        logits, cache = self._prefill(self.params, tokens_dev, cache, lengths_dev)
-        logits = np.asarray(logits)
+        logits, next_ids, cache = self._prefill(
+            self.params, tokens_dev, cache, lengths_dev
+        )
+        # ONE tiny fetch ([bsz] int32) synchronizes the batch; logits stay
+        # on device (row views fetch lazily if a handler reads them) and
+        # cache rows slice lazily (only generate() needs them)
+        next_ids = np.asarray(next_ids)
         return [
-            {"logits": logits[i], "cache": _slice_cache(cache, i), "length": int(full_lengths[i])}
+            _PrefillState(
+                cache, logits, i,
+                next_token=int(next_ids[i]), length=int(full_lengths[i]),
+            )
             for i in range(n)
         ]
 
@@ -582,27 +606,50 @@ class _TransformerRunner:
             state = prefill_batcher.infer(ids)
         else:
             state = self.run_batch([ids])[0]
-        logits, cache = state["logits"], state["cache"]
         out: list[int] = []
-        token = sampler.pick(logits[-1] if logits.ndim > 1 else logits)
+        if sampler.greedy:
+            token = state["next_token"]  # device-argmaxed; no logits fetch
+        else:
+            token = sampler.pick(state["logits"])
         if ttft_cb:
             ttft_cb()
         out.append(token)
         if on_token:
             on_token(token)
+        if max_new_tokens <= 1:
+            return out
+        # chunked decode: N steps + on-device sampling per dispatch, one
+        # [1, N] fetch per chunk — the round trip, not the matmuls, bounds
+        # tokens/sec on remote-attached devices. Length is tracked on the
+        # HOST (prompt length + emitted count): reading cache["lengths"]
+        # back every step would cost a round trip per token.
+        cache = state["cache"]
+        # cache holds exactly the prompt; each decode step writes one more
+        # position, so the write head sits at cache_len
+        cache_len = state["length"]
+        state = None  # release the full-batch prefill buffers
         max_len = int(cache["k"].shape[2])
-        for _ in range(max_new_tokens - 1):
+        temp, tk, tp = sampler.temperature, sampler.top_k, sampler.top_p
+        while len(out) < max_new_tokens and cache_len < max_len:
             if stop is not None and stop.is_set():
                 break
-            if int(cache["lengths"][0]) >= max_len:
-                break
-            step_logits, cache = self._decode(
-                self.params, jnp.asarray([[token]], jnp.int32), cache
+            # always run the WARMED full chunk unless the cache boundary
+            # forces a short one — a max_new_tokens remainder must not
+            # compile a fresh scan length mid-request; surplus sampled
+            # tokens are simply discarded
+            n = min(self.decode_chunk_size, max_len - cache_len)
+            toks, cache = self._decode_chunk(
+                self.params, jnp.asarray([[token]], jnp.int32), cache,
+                sampler.take_key(), temp, tk, tp, n,
             )
-            token = sampler.pick(np.asarray(step_logits)[0])
-            out.append(token)
-            if on_token:
-                on_token(token)
+            chunk = [int(t) for t in np.asarray(toks)[0]]
+            take = min(n, max_new_tokens - len(out))
+            for t in chunk[:take]:
+                out.append(t)
+                if on_token:
+                    on_token(t)
+            token = chunk[take - 1]
+            cache_len += n
         return out
 
     def warmup(self) -> None:
@@ -620,11 +667,58 @@ class _TransformerRunner:
                 # its first real request
                 tokens = jax.device_put(tokens, self._token_sharding)
                 lengths = jax.device_put(lengths, self._row_sharding)
-            logits, cache = self._prefill(self.params, tokens, cache, lengths)
-            logits.block_until_ready()
+            logits, next_ids, cache = self._prefill(self.params, tokens, cache, lengths)
+            next_ids.block_until_ready()
         one = _slice_cache(cache, 0)
         step, _ = self._decode(self.params, jnp.zeros((1, 1), jnp.int32), one)
         step.block_until_ready()
+        # warm the full decode chunk (remainder sizes compile on demand)
+        toks, _ = self._decode_chunk(
+            self.params, jnp.zeros((1, 1), jnp.int32), one,
+            jax.random.key(0), 0.0, 0, 1.0, self.decode_chunk_size,
+        )
+        toks.block_until_ready()
+
+
+class _PrefillState(dict):
+    """Per-request prefill result with lazy fields: ``cache`` (row slice,
+    computed only when generate() continues the request) and ``logits``
+    (device row view — reading it is what triggers the device fetch).
+    ``next_token`` and ``length`` are plain host values."""
+
+    def __init__(self, full_cache: dict, full_logits: Any, index: int, **kw: Any):
+        super().__init__(**kw)
+        self._full_cache = full_cache
+        self._full_logits = full_logits
+        self._index = index
+
+    def __getitem__(self, key: str) -> Any:
+        if not dict.__contains__(self, key):
+            # materialize once, then DROP the full-batch reference — a
+            # request state must not pin the whole padded batch's cache
+            # and logits in HBM for its lifetime
+            if key == "cache":
+                dict.__setitem__(self, key, _slice_cache(self._full_cache, self._index))
+                self._full_cache = None
+            elif key == "logits":
+                dict.__setitem__(self, key, self._full_logits[self._index])
+                self._full_logits = None
+        return dict.__getitem__(self, key)
+
+    def __contains__(self, key: object) -> bool:
+        return key in ("cache", "logits") or dict.__contains__(self, key)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+
+def _env_default(key: str, default: str) -> str:
+    import os
+
+    return os.environ.get(key, default)
 
 
 def _slice_cache(cache: dict, i: int) -> dict:

@@ -40,6 +40,13 @@ def corpus_to_bin(text: str, tokenizer: Any, path: str, dtype: Any = None) -> in
         )
     ids = np.asarray(tokenizer.encode(text), dtype)
     ids.tofile(path)
+    # sidecar makes the flat file self-describing: TokenDataset reads the
+    # dtype from here, so an auto-selected uint32 can never be silently
+    # reinterpreted as uint16
+    import json
+
+    with open(path + ".meta.json", "w") as f:
+        json.dump({"dtype": dtype.name, "count": int(ids.size), "vocab_size": vocab}, f)
     return int(ids.size)
 
 
@@ -59,9 +66,11 @@ class TokenDataset:
         seq_len: int,
         batch_size: int,
         seed: int = 0,
-        dtype: Any = _DTYPE,
+        dtype: Any = None,
     ):
         if isinstance(path_or_array, str):
+            if dtype is None:
+                dtype = self._sidecar_dtype(path_or_array) or _DTYPE
             self.tokens = np.memmap(path_or_array, dtype=np.dtype(dtype), mode="r")
         else:
             self.tokens = np.asarray(path_or_array)
@@ -74,6 +83,20 @@ class TokenDataset:
         self.seq_len = seq_len
         self.batch_size = batch_size
         self.seed = seed
+
+    @staticmethod
+    def _sidecar_dtype(path: str):
+        import json
+        import os
+
+        meta = path + ".meta.json"
+        if not os.path.exists(meta):
+            return None
+        try:
+            with open(meta) as f:
+                return np.dtype(json.load(f)["dtype"])
+        except (OSError, KeyError, ValueError, TypeError):
+            return None
 
     def __len__(self) -> int:
         return int(self.tokens.size)

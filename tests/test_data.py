@@ -138,3 +138,18 @@ def test_corpus_to_bin_large_vocab_dtype(tmp_path):
     assert dtype_for_vocab(65537) == np.uint32
     with pytest.raises(ValueError, match="uint32"):
         corpus_to_bin("x", BigVocabTok(), path, dtype=np.uint16)
+
+
+def test_sidecar_dtype_auto_detected(tmp_path):
+    class BigVocabTok:
+        vocab_size = 100_000
+
+        def encode(self, text):
+            return [70_000, 2, 99_999, 5, 1, 2, 3, 4]
+
+    path = str(tmp_path / "auto.bin")
+    corpus_to_bin("x", BigVocabTok(), path)
+    # NO dtype arg: the sidecar must prevent uint16 misinterpretation
+    ds = TokenDataset(path, seq_len=4, batch_size=1)
+    assert int(ds.tokens[0]) == 70_000
+    assert int(ds.tokens[2]) == 99_999

@@ -170,7 +170,11 @@ def test_unknown_model_name(monkeypatch):
 def tiny_device():
     import os
 
-    env = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "4", "BATCH_TIMEOUT_MS": "2"}
+    # DECODE_CHUNK=1: token-granular stop/stream semantics for the
+    # cancellation tests (chunked decode is covered by
+    # test_chunked_decode_matches_stepwise)
+    env = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "4", "BATCH_TIMEOUT_MS": "2",
+           "DECODE_CHUNK": "1"}
     old = {k: os.environ.get(k) for k in env}
     os.environ.update(env)
     device = new_device(EnvConfig(), MockLogger(Level.DEBUG), Registry())
@@ -300,3 +304,25 @@ def test_out_of_range_ids_rejected(tiny_device):
 
     with pytest.raises(InvalidParamError, match="token ids"):
         tiny_device.infer({"tokens": [1, 2, 999999]})
+
+
+def test_chunked_decode_matches_stepwise(tiny_device):
+    # the default chunked decode (N steps per dispatch) must emit the same
+    # greedy sequence as token-at-a-time decode
+    import os
+
+    env = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "4", "BATCH_TIMEOUT_MS": "2",
+           "DECODE_CHUNK": "8"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        chunked = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            for prompt, n in (([1, 2, 3], 13), ([9] * 20, 8), ([4], 1)):
+                assert chunked.generate(prompt, max_new_tokens=n) == \
+                    tiny_device.generate(prompt, max_new_tokens=n), (prompt, n)
+        finally:
+            chunked.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
