@@ -300,3 +300,32 @@ def decode_chunk(
         body, (token, cache, key), None, length=n_steps
     )
     return jnp.transpose(toks), cache  # [B, n_steps]
+
+
+def decode_chunk_rows(
+    params: dict,
+    token: jnp.ndarray,
+    cache: dict,
+    cfg: TransformerConfig,
+    n_steps: int,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> tuple[jnp.ndarray, dict]:
+    """``decode_chunk`` with PER-ROW sampling params ([B] each) — the
+    continuous-batching decode pool runs many requests' decode in one
+    fixed-shape dispatch, each slot with its own temperature/top-k/top-p."""
+    from gofr_tpu.ops.sampling import sample_logits_rows
+
+    def body(carry, _):
+        tok, c, k = carry
+        logits, c = decode_step(params, tok, c, cfg)
+        k, sub = jax.random.split(k)
+        nxt = sample_logits_rows(logits, sub, temperature, top_k, top_p)
+        return (nxt[:, None], c, k), nxt
+
+    (_, cache, _), toks = jax.lax.scan(
+        body, (token, cache, key), None, length=n_steps
+    )
+    return jnp.transpose(toks), cache

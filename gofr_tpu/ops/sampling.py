@@ -69,6 +69,42 @@ def sample_logits(
     return jax.lax.cond(temperature <= 0.0, _greedy, _sampled)
 
 
+@jax.jit
+def sample_logits_rows(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-ROW sampling params: logits [B, V], temperature/top_k/top_p each
+    [B] -> [B] int32 ids. The continuous-batching decode pool mixes
+    requests with different sampling settings in one dispatch, so each row
+    carries its own knobs (rows with temperature 0 take their argmax)."""
+    logits = logits.astype(jnp.float32)
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temperature = jnp.asarray(temperature, jnp.float32).reshape(b, 1)
+    top_p = jnp.asarray(top_p, jnp.float32).reshape(b, 1)
+    top_k = jnp.asarray(top_k, jnp.int32).reshape(b)
+
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)  # [B]
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    scaled = jnp.where(scaled < kth, _NEG_INF, scaled)
+
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+    cutoff_logit = jnp.min(
+        jnp.where(cum < top_p, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    scaled = jnp.where(scaled < cutoff_logit, _NEG_INF, scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature[:, 0] <= 0.0, greedy, sampled)
+
+
 class Sampler:
     """Per-request sampling state: seeded key split per step. A plain
     Python object driven by the host decode loop (the [B, V] math above is
@@ -90,6 +126,7 @@ class Sampler:
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
+        self.seeded = seed is not None
         if seed is None:
             # unseeded requests must be genuinely random, not key(0)
             import secrets

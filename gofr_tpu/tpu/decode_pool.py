@@ -1,0 +1,232 @@
+"""Continuous batching for decode: a slot-based KV-cache pool.
+
+Prefill is batched by the DynamicBatcher; without this module each
+generation then decodes alone ([1, 1] dispatches), so N concurrent streams
+cost N round trips per token. The pool keeps ONE batched cache of
+``n_slots`` rows and a worker that decodes ALL active slots in a single
+fixed-shape chunked dispatch — N streams share one round trip per chunk,
+multiplying aggregate tokens/sec on round-trip-bound links.
+
+Mechanics:
+- a finished prefill row is copied into a free slot (one jitted
+  dynamic_update_slice per cache field);
+- the worker loop builds the [n_slots, 1] last-token array host-side,
+  dispatches ``decode_chunk_rows`` (per-slot sampling params), fetches the
+  [n_slots, chunk] ids, and routes each slot's tokens to its request;
+- inactive slots decode garbage in lockstep (fixed shapes = one compiled
+  executable) and are overwritten on reuse;
+- per-slot host-tracked lengths stop a slot at the cache bound.
+
+Requests with an explicit sampling seed bypass the pool (the per-request
+path reproduces exactly; pooled key order depends on co-tenants).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DONE = object()  # end-of-stream marker on a slot's token queue
+
+
+class PoolFailure:
+    """Pushed to every waiter when the worker dies; carries the cause so
+    request threads re-raise instead of silently truncating output."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class _Slot:
+    __slots__ = ("index", "token", "cache_len", "remaining", "out_queue", "stop")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.token = 0
+        self.cache_len = 0
+        self.remaining = 0
+        self.out_queue: Optional[queue.Queue] = None
+        self.stop: Optional[threading.Event] = None
+
+
+class DecodePool:
+    def __init__(
+        self,
+        params: Any,
+        cfg: Any,
+        init_cache: Any,
+        n_slots: int,
+        chunk: int,
+        metrics: Any = None,
+    ):
+        from gofr_tpu.models.transformer import decode_chunk_rows
+
+        self.cfg = cfg
+        self.params = params
+        self.n_slots = n_slots
+        self.chunk = chunk
+        self.max_len = cfg.max_seq
+        self.cache = init_cache(cfg, n_slots)
+        # donate the cache through both ops: the pool cache is the largest
+        # live buffer and must be updated in place, not copied per chunk
+        self._decode = jax.jit(
+            lambda p, t, c, key, temp, tk, tp: decode_chunk_rows(
+                p, t, c, cfg, chunk, key, temp, tk, tp
+            ),
+            donate_argnums=(2,),
+        )
+
+        def write_slot(pool: dict, row: dict, i) -> dict:
+            return {
+                "k": jax.lax.dynamic_update_slice_in_dim(pool["k"], row["k"], i, axis=1),
+                "v": jax.lax.dynamic_update_slice_in_dim(pool["v"], row["v"], i, axis=1),
+                "lengths": jax.lax.dynamic_update_slice(pool["lengths"], row["lengths"], (i,)),
+            }
+
+        self._write_slot = jax.jit(write_slot, donate_argnums=(0,))
+        self._slots = [_Slot(i) for i in range(n_slots)]
+        self._free = list(reversed(self._slots))
+        self._active: dict[int, _Slot] = {}
+        self._temps = np.zeros(n_slots, np.float32)
+        self._top_ks = np.zeros(n_slots, np.int32)
+        self._top_ps = np.ones(n_slots, np.float32)
+        self._key = jax.random.key(np.random.SeedSequence().entropy % (1 << 63))
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._closed = False
+        self._depth_gauge = (
+            metrics.gauge("gofr_tpu_decode_slots_active", "active decode slots")
+            if metrics is not None
+            else None
+        )
+        # warm the [n_slots]-shaped executable NOW: the first pooled request
+        # must not compile under the pool lock on the serving path
+        toks, self.cache = self._decode(
+            self.params, jnp.zeros((n_slots, 1), jnp.int32), self.cache,
+            jax.random.key(0), jnp.asarray(self._temps),
+            jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
+        )
+        toks.block_until_ready()
+        self.cache = init_cache(cfg, n_slots)  # reset the warmup writes
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # -- request side --------------------------------------------------------
+    def submit(
+        self,
+        row_cache: dict,
+        start_len: int,
+        first_token: int,
+        max_new: int,
+        sampler: Any,
+        stop: Optional[threading.Event] = None,
+    ) -> "queue.Queue":
+        """Claim a slot for a prefilled request; returns the queue its
+        decoded token ids (then DONE) arrive on. Raises queue.Full when all
+        slots are busy — callers fall back to the solo decode path."""
+        out: "queue.Queue" = queue.Queue()
+        with self._work:
+            if self._closed:
+                raise RuntimeError("decode pool closed")
+            if not self._free:
+                raise queue.Full("no free decode slots")
+            slot = self._free.pop()
+            slot.token = first_token
+            slot.cache_len = start_len
+            slot.remaining = max_new
+            slot.out_queue = out
+            slot.stop = stop
+            self._temps[slot.index] = sampler.temperature
+            self._top_ks[slot.index] = sampler.top_k
+            self._top_ps[slot.index] = sampler.top_p
+            # row caches write OUTSIDE the worker's dispatch window is
+            # avoided by doing it under the lock: the worker also holds the
+            # lock while reading self.cache
+            self.cache = self._write_slot(self.cache, row_cache, slot.index)
+            self._active[slot.index] = slot
+            if self._depth_gauge:
+                self._depth_gauge.set(len(self._active))
+            self._work.notify()
+        return out
+
+    # -- worker --------------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            self._loop()
+        except BaseException as exc:  # device/compile errors must not hang waiters
+            with self._work:
+                self._closed = True
+                for slot in self._active.values():
+                    if slot.out_queue is not None:
+                        slot.out_queue.put(PoolFailure(exc))
+                        slot.out_queue.put(DONE)
+                self._active.clear()
+                self._free = list(reversed(self._slots))
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                while not self._active and not self._closed:
+                    self._work.wait()
+                if self._closed:
+                    for slot in self._active.values():
+                        if slot.out_queue is not None:
+                            slot.out_queue.put(DONE)
+                    return
+                # snapshot: ONLY these slots are in this dispatch — a
+                # submit() landing during the fetch window below must wait
+                # for the NEXT chunk, not be accounted garbage from this one
+                dispatched = list(self._active.values())
+                tokens = np.zeros((self.n_slots, 1), np.int32)
+                for slot in dispatched:
+                    tokens[slot.index, 0] = slot.token
+                self._key, sub = jax.random.split(self._key)
+                toks_dev, self.cache = self._decode(
+                    self.params, jnp.asarray(tokens), self.cache, sub,
+                    jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                    jnp.asarray(self._top_ps),
+                )
+            # fetch OUTSIDE the lock: submissions land while the chunk's
+            # result crosses the link (they join the next chunk)
+            toks = np.asarray(toks_dev)
+            with self._work:
+                finished = []
+                for slot in dispatched:
+                    emitted = toks[slot.index]
+                    room = self.max_len - slot.cache_len  # valid steps this chunk
+                    slot.cache_len += self.chunk
+                    take = min(self.chunk, slot.remaining, max(room, 0))
+                    cancelled = slot.stop is not None and slot.stop.is_set()
+                    if not cancelled and slot.out_queue is not None:
+                        for t in emitted[:take]:
+                            slot.out_queue.put(int(t))
+                    slot.remaining -= take
+                    # next chunk continues from the LAST decoded token (the
+                    # cache advanced the full chunk regardless of take)
+                    slot.token = int(emitted[-1])
+                    if (
+                        cancelled
+                        or slot.remaining <= 0
+                        or slot.cache_len >= self.max_len
+                    ):
+                        finished.append(slot)
+                for slot in finished:
+                    if slot.out_queue is not None:
+                        slot.out_queue.put(DONE)
+                    slot.out_queue = None
+                    slot.stop = None
+                    del self._active[slot.index]
+                    self._free.append(slot)
+                if self._depth_gauge:
+                    self._depth_gauge.set(len(self._active))
+
+    def close(self) -> None:
+        with self._work:
+            self._closed = True
+            self._work.notify_all()
+        self._thread.join(timeout=5)

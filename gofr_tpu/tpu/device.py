@@ -101,8 +101,29 @@ class TPUDevice:
         self.runner = _build_runner(
             self.model_name, self.quant, self.model_path, self.max_batch,
             mesh=self.mesh,
+            decode_chunk=int(config.get_or_default("DECODE_CHUNK", "8")),
         )
         self.runner.warmup()
+        # continuous batching: concurrent decodes share one fixed-shape
+        # dispatch per chunk. Single-chip transformer serving only for now
+        # (a sharded pool cache needs its own placement story); seeded
+        # requests bypass it (device.generate routes them solo).
+        self.decode_pool = None
+        if (
+            hasattr(self.runner, "_init_cache")
+            and self.mesh is None
+            and config.get_or_default("DECODE_POOL", "on") != "off"
+        ):
+            from gofr_tpu.tpu.decode_pool import DecodePool
+
+            self.decode_pool = DecodePool(
+                self.runner.params,
+                self.runner.cfg,
+                self.runner._init_cache,
+                n_slots=int(config.get_or_default("DECODE_SLOTS", str(self.max_batch))),
+                chunk=self.runner.decode_chunk_size,
+                metrics=metrics,
+            )
         self.batcher = DynamicBatcher(
             self._run_batch,
             max_batch=self.max_batch,
@@ -161,7 +182,7 @@ class TPUDevice:
         try:
             out = self.runner.generate(
                 tokens, max_new_tokens, on_token=on_token, stop=stop,
-                sampler=sampler,
+                sampler=sampler, decode_pool=self.decode_pool,
                 prefill_batcher=self.batcher, ttft_cb=lambda: self._ttft.observe(
                     time.perf_counter() - start, model=self.model_name, op="generate"
                 ),
@@ -291,6 +312,8 @@ class TPUDevice:
 
     def close(self) -> None:
         self.batcher.close()
+        if getattr(self, "decode_pool", None) is not None:
+            self.decode_pool.close()
 
 
 def new_device(config: Any, logger: Any, metrics: Any) -> TPUDevice:
@@ -443,6 +466,7 @@ class _TransformerRunner:
         model_path: Optional[str],
         max_batch: int = 8,
         mesh: Optional[Any] = None,
+        decode_chunk: int = 8,
     ):
         self.max_batch = max_batch
         from gofr_tpu.models.llama import CONFIGS
@@ -456,7 +480,7 @@ class _TransformerRunner:
 
         self.name = name
         self.cfg = CONFIGS[name]
-        self.decode_chunk_size = int(_env_default("DECODE_CHUNK", "8"))
+        self.decode_chunk_size = decode_chunk
         params = _load_or_init(
             model_path, lambda: init_transformer(jax.random.key(0), self.cfg)
         )
@@ -594,6 +618,7 @@ class _TransformerRunner:
         on_token: Any = None,
         stop: Any = None,
         sampler: Any = None,
+        decode_pool: Any = None,
         prefill_batcher: Any = None,
         ttft_cb: Any = None,
     ) -> list[int]:
@@ -618,6 +643,33 @@ class _TransformerRunner:
             on_token(token)
         if max_new_tokens <= 1:
             return out
+
+        # continuous batching: unseeded requests decode in the shared pool
+        # (seeded ones need the exact per-request key sequence — solo path)
+        if decode_pool is not None and not sampler.seeded:
+            import queue as queue_mod
+
+            from gofr_tpu.tpu.decode_pool import DONE, PoolFailure
+
+            try:
+                slot_q = decode_pool.submit(
+                    state["cache"], state["length"], token,
+                    max_new_tokens - 1, sampler, stop,
+                )
+            except (queue_mod.Full, RuntimeError):
+                slot_q = None  # pool saturated/closed -> solo decode below
+            if slot_q is not None:
+                state = None
+                while True:
+                    item = slot_q.get()
+                    if item is DONE:
+                        break
+                    if isinstance(item, PoolFailure):
+                        raise item.exc
+                    out.append(item)
+                    if on_token:
+                        on_token(item)
+                return out
         # chunked decode: N steps + on-device sampling per dispatch, one
         # [1, N] fetch per chunk — the round trip, not the matmuls, bounds
         # tokens/sec on remote-attached devices. Length is tracked on the
@@ -715,12 +767,6 @@ class _PrefillState(dict):
             return default
 
 
-def _env_default(key: str, default: str) -> str:
-    import os
-
-    return os.environ.get(key, default)
-
-
 def _slice_cache(cache: dict, i: int) -> dict:
     return {
         "k": cache["k"][:, i : i + 1],
@@ -743,6 +789,7 @@ def _build_runner(
     model_path: Optional[str],
     max_batch: int = 8,
     mesh: Optional[Any] = None,
+    decode_chunk: int = 8,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
@@ -751,7 +798,9 @@ def _build_runner(
     if name.startswith("bert"):
         return _BertRunner(name, quant, model_path, max_batch)
     if name in CONFIGS:
-        return _TransformerRunner(name, quant, model_path, max_batch, mesh=mesh)
+        return _TransformerRunner(
+            name, quant, model_path, max_batch, mesh=mesh, decode_chunk=decode_chunk
+        )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "
         f"or one of {sorted(CONFIGS)}"

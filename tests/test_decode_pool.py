@@ -1,0 +1,183 @@
+"""Continuous-batching decode pool: correctness vs solo decode, slot
+reuse, saturation fallback, cancellation."""
+
+import os
+import threading
+
+import pytest
+
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.logging import Level
+from gofr_tpu.metrics import Registry
+from gofr_tpu.ops.sampling import Sampler
+from gofr_tpu.testutil import MockLogger
+from gofr_tpu.tpu.device import new_device
+
+
+def _device(**env):
+    defaults = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "4", "BATCH_TIMEOUT_MS": "1"}
+    defaults.update(env)
+    old = {k: os.environ.get(k) for k in defaults}
+    os.environ.update(defaults)
+    try:
+        return new_device(EnvConfig(), MockLogger(Level.INFO), Registry()), old
+    finally:
+        pass
+
+
+def _restore(old):
+    for k, v in old.items():
+        os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+@pytest.fixture(scope="module")
+def pooled():
+    dev, old = _device(DECODE_POOL="on", DECODE_SLOTS="4", DECODE_CHUNK="4")
+    yield dev
+    dev.close()
+    _restore(old)
+
+
+@pytest.fixture(scope="module")
+def solo():
+    dev, old = _device(DECODE_POOL="off", DECODE_CHUNK="4")
+    yield dev
+    dev.close()
+    _restore(old)
+
+
+def test_pool_enabled_by_default():
+    dev, old = _device()
+    try:
+        assert dev.decode_pool is not None
+    finally:
+        dev.close()
+        _restore(old)
+
+
+def test_pooled_greedy_matches_solo(pooled, solo):
+    for prompt, n in (([1, 2, 3], 11), ([7] * 30, 6), ([42], 1), ([5, 6], 4)):
+        assert pooled.generate(prompt, max_new_tokens=n) == \
+            solo.generate(prompt, max_new_tokens=n), (prompt, n)
+
+
+def test_concurrent_streams_share_the_pool(pooled, solo):
+    prompts = [[i + 1, i + 2, i + 3] for i in range(4)]
+    want = [solo.generate(p, max_new_tokens=9) for p in prompts]
+    got = [None] * 4
+
+    def run(i):
+        got[i] = pooled.generate(prompts[i], max_new_tokens=9)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert got == want
+
+
+def test_slots_recycle_across_many_requests(pooled, solo):
+    # 12 sequential requests through 4 slots: reuse must not leak state
+    for i in range(12):
+        prompt = [(i % 5) + 1, 2, 3]
+        assert pooled.generate(prompt, max_new_tokens=5) == \
+            solo.generate(prompt, max_new_tokens=5), i
+
+
+def test_pool_saturation_falls_back_to_solo(pooled, solo):
+    # 8 concurrent streams, 4 slots: the overflow must still complete
+    prompts = [[i + 1, 9, 9] for i in range(8)]
+    want = [solo.generate(p, max_new_tokens=7) for p in prompts]
+    got = [None] * 8
+
+    def run(i):
+        got[i] = pooled.generate(prompts[i], max_new_tokens=7)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert got == want
+
+
+def test_seeded_requests_bypass_pool(pooled):
+    s = Sampler(temperature=1.0, seed=5)
+    s2 = Sampler(temperature=1.0, seed=5)
+    a = pooled.generate([1, 2, 3], max_new_tokens=8, sampler=s)
+    b = pooled.generate([1, 2, 3], max_new_tokens=8, sampler=s2)
+    assert a == b  # exact reproducibility preserved
+
+
+def test_pooled_sampling_respects_top_k(pooled):
+    # temperature>0 unseeded goes through the pool with per-row params;
+    # top_k=1 must reduce to greedy
+    greedy = pooled.generate([4, 5, 6], max_new_tokens=6)
+    via_pool = pooled.generate(
+        [4, 5, 6], max_new_tokens=6, sampler=Sampler(temperature=5.0, top_k=1)
+    )
+    assert via_pool == greedy
+
+
+def test_pooled_cancellation_frees_slot(pooled):
+    stop = threading.Event()
+    seen = []
+
+    def on_token(t):
+        seen.append(t)
+        if len(seen) >= 2:
+            stop.set()
+
+    out = pooled.generate([1, 2, 3], max_new_tokens=200, on_token=on_token, stop=stop)
+    assert len(out) < 200
+    # slot must be free again: another full round completes
+    assert len(pooled.generate([1, 2, 3], max_new_tokens=5)) == 5
+
+
+def test_cache_bound_in_pool(pooled, solo):
+    # tiny max_seq=128; prompt 100 -> at most 28-ish decodes
+    out = pooled.generate(list(range(1, 100)), max_new_tokens=300)
+    want = solo.generate(list(range(1, 100)), max_new_tokens=300)
+    assert out == want
+    assert len(out) <= 30
+
+
+def test_submissions_during_fetch_window_join_next_chunk(pooled, solo):
+    # hammer the race: stagger many submissions so some land while the
+    # worker is mid-fetch; every stream must still match solo exactly
+    import time
+
+    prompts = [[(i % 7) + 1, 3, 9] for i in range(16)]
+    want = [solo.generate(p, max_new_tokens=9) for p in prompts]
+    got = [None] * len(prompts)
+
+    def run(i):
+        time.sleep(0.003 * i)  # staggered arrivals hit fetch windows
+        got[i] = pooled.generate(prompts[i], max_new_tokens=9)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert got == want
+
+
+def test_worker_death_fails_requests_not_hangs():
+    dev, old = _device(DECODE_POOL="on", DECODE_SLOTS="2", DECODE_CHUNK="2")
+    try:
+        pool = dev.decode_pool
+
+        def boom(*a, **k):
+            raise RuntimeError("device fell off")
+
+        pool._decode = boom
+        with pytest.raises(RuntimeError, match="device fell off"):
+            dev.generate([1, 2, 3], max_new_tokens=8)
+        # pool is closed; later requests fall back to solo and still work
+        out = dev.generate([1, 2, 3], max_new_tokens=4)
+        assert len(out) == 4
+    finally:
+        dev.close()
+        _restore(old)
