@@ -77,9 +77,17 @@ def generate_stream(ctx):
         sampler = Sampler.from_body(body)
     except (TypeError, ValueError) as exc:
         raise HTTPError(400, f"invalid sampling params: {exc}")
+    from gofr_tpu.ops.sampling import stop_tokens_from_body
+
+    try:
+        stop_tokens = stop_tokens_from_body(body)
+    except ValueError as exc:
+        raise HTTPError(400, str(exc))
     tok = ctx.tpu.tokenizer
     dec = tok.stream_decoder() if tok is not None else None
-    for token in ctx.tpu.generate_stream(tokens, max_new, sampler=sampler):
+    for token in ctx.tpu.generate_stream(
+        tokens, max_new, sampler=sampler, stop_tokens=stop_tokens
+    ):
         event = {"token": token}
         if dec is not None:
             event["text"] = dec.feed(token)

@@ -74,6 +74,7 @@ def generate_handler(ctx):
     tokens = _prompt_from(body)
     max_new = int(body.get("max_new_tokens") or 16)
     sampler = _sampler_from(body)
+    stop_tokens = _stop_tokens_from(body)
     tok = ctx.tpu.tokenizer
     if ctx.param("stream") == "true":
         from gofr_tpu.http.response import Stream
@@ -83,7 +84,9 @@ def generate_handler(ctx):
             # buffers until the character completes
             dec = tok.stream_decoder() if tok is not None else None
             try:
-                for token in ctx.tpu.generate_stream(tokens, max_new, sampler=sampler):
+                for token in ctx.tpu.generate_stream(
+                    tokens, max_new, sampler=sampler, stop_tokens=stop_tokens
+                ):
                     event = {"token": token}
                     if dec is not None:
                         event["text"] = dec.feed(token)
@@ -96,11 +99,20 @@ def generate_handler(ctx):
                 yield {"error": str(exc)}
 
         return Stream(events())
-    out = ctx.tpu.generate(tokens, max_new, sampler=sampler)
+    out = ctx.tpu.generate(tokens, max_new, sampler=sampler, stop_tokens=stop_tokens)
     result = {"tokens": out}
     if tok is not None:
         result["text"] = tok.decode(out)
     return result
+
+
+def _stop_tokens_from(body):
+    from gofr_tpu.ops.sampling import stop_tokens_from_body
+
+    try:
+        return stop_tokens_from_body(body)
+    except ValueError as exc:
+        raise HTTPError(400, str(exc))
 
 
 def _sampler_from(body):

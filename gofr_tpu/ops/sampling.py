@@ -105,6 +105,21 @@ def sample_logits_rows(
     return jnp.where(temperature[:, 0] <= 0.0, greedy, sampled)
 
 
+def stop_tokens_from_body(body: dict) -> Optional[list[int]]:
+    """Parse "stop_tokens" from a request body: a list of token ids that
+    end generation (the stop token itself is not emitted). Shared by the
+    HTTP/gRPC handlers, next to Sampler.from_body. Raises ValueError on a
+    malformed value (map to a 400)."""
+    stop_tokens = body.get("stop_tokens")
+    if stop_tokens is None:
+        return None
+    if not isinstance(stop_tokens, list) or not all(
+        isinstance(t, int) and not isinstance(t, bool) for t in stop_tokens
+    ):
+        raise ValueError('"stop_tokens" must be a list of token ids')
+    return stop_tokens
+
+
 class Sampler:
     """Per-request sampling state: seeded key split per step. A plain
     Python object driven by the host decode loop (the [B, V] math above is

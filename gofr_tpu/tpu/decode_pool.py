@@ -43,7 +43,10 @@ class PoolFailure:
 
 
 class _Slot:
-    __slots__ = ("index", "token", "cache_len", "remaining", "out_queue", "stop")
+    __slots__ = (
+        "index", "token", "cache_len", "remaining", "out_queue", "stop",
+        "stop_tokens",
+    )
 
     def __init__(self, index: int):
         self.index = index
@@ -52,6 +55,7 @@ class _Slot:
         self.remaining = 0
         self.out_queue: Optional[queue.Queue] = None
         self.stop: Optional[threading.Event] = None
+        self.stop_tokens: frozenset = frozenset()
 
 
 class DecodePool:
@@ -125,6 +129,7 @@ class DecodePool:
         max_new: int,
         sampler: Any,
         stop: Optional[threading.Event] = None,
+        stop_tokens: frozenset = frozenset(),
     ) -> "queue.Queue":
         """Claim a slot for a prefilled request; returns the queue its
         decoded token ids (then DONE) arrive on. Raises queue.Full when all
@@ -141,6 +146,7 @@ class DecodePool:
             slot.remaining = max_new
             slot.out_queue = out
             slot.stop = stop
+            slot.stop_tokens = frozenset(stop_tokens or ())
             self._temps[slot.index] = sampler.temperature
             self._top_ks[slot.index] = sampler.top_k
             self._top_ps[slot.index] = sampler.top_p
@@ -174,8 +180,12 @@ class DecodePool:
                 while not self._active and not self._closed:
                     self._work.wait()
                 if self._closed:
+                    # closing mid-stream is an ERROR for waiters, never a
+                    # silently-truncated "ok" result
+                    exc = RuntimeError("decode pool closed mid-generation")
                     for slot in self._active.values():
                         if slot.out_queue is not None:
+                            slot.out_queue.put(PoolFailure(exc))
                             slot.out_queue.put(DONE)
                     return
                 # snapshot: ONLY these slots are in this dispatch — a
@@ -202,8 +212,12 @@ class DecodePool:
                     slot.cache_len += self.chunk
                     take = min(self.chunk, slot.remaining, max(room, 0))
                     cancelled = slot.stop is not None and slot.stop.is_set()
+                    hit_stop_token = False
                     if not cancelled and slot.out_queue is not None:
                         for t in emitted[:take]:
+                            if int(t) in slot.stop_tokens:
+                                hit_stop_token = True  # ends stream, not emitted
+                                break
                             slot.out_queue.put(int(t))
                     slot.remaining -= take
                     # next chunk continues from the LAST decoded token (the
@@ -211,6 +225,7 @@ class DecodePool:
                     slot.token = int(emitted[-1])
                     if (
                         cancelled
+                        or hit_stop_token
                         or slot.remaining <= 0
                         or slot.cache_len >= self.max_len
                     ):

@@ -23,6 +23,7 @@ device liveness + memory stats, typed TPULog entries, Prometheus metrics
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Optional
@@ -98,10 +99,18 @@ class TPUDevice:
             "gofr_tpu_device_memory_bytes", "device memory", labels=("kind",)
         )
 
+        self._decode_chunk_cfg = int(config.get_or_default("DECODE_CHUNK", "8"))
+        self._pool_enabled = config.get_or_default("DECODE_POOL", "on") != "off"
+        self._pool_slots = int(config.get_or_default("DECODE_SLOTS", str(self.max_batch)))
+        self._build_stack()
+        self._last_reinit = 0.0
+        self._reinit_lock = threading.Lock()
+
+    def _build_stack(self) -> None:
+        """Construct (or reconstruct, on reinit) runner + pool + batcher."""
         self.runner = _build_runner(
             self.model_name, self.quant, self.model_path, self.max_batch,
-            mesh=self.mesh,
-            decode_chunk=int(config.get_or_default("DECODE_CHUNK", "8")),
+            mesh=self.mesh, decode_chunk=self._decode_chunk_cfg,
         )
         self.runner.warmup()
         # continuous batching: concurrent decodes share one fixed-shape
@@ -112,7 +121,7 @@ class TPUDevice:
         if (
             hasattr(self.runner, "_init_cache")
             and self.mesh is None
-            and config.get_or_default("DECODE_POOL", "on") != "off"
+            and self._pool_enabled
         ):
             from gofr_tpu.tpu.decode_pool import DecodePool
 
@@ -120,18 +129,17 @@ class TPUDevice:
                 self.runner.params,
                 self.runner.cfg,
                 self.runner._init_cache,
-                n_slots=int(config.get_or_default("DECODE_SLOTS", str(self.max_batch))),
+                n_slots=self._pool_slots,
                 chunk=self.runner.decode_chunk_size,
-                metrics=metrics,
+                metrics=self.metrics,
             )
         self.batcher = DynamicBatcher(
             self._run_batch,
             max_batch=self.max_batch,
             timeout_ms=self.timeout_ms,
-            metrics=metrics,
+            metrics=self.metrics,
             name=self.model_name,
         )
-        self._healthy = True
 
     # -- handler-facing API --------------------------------------------------
     def infer(self, payload: Any, timeout: float = 60.0) -> Any:
@@ -167,6 +175,7 @@ class TPUDevice:
         on_token: Optional[Any] = None,
         stop: Optional[Any] = None,
         sampler: Optional[Any] = None,
+        stop_tokens: Optional[Any] = None,
     ) -> list[int]:
         """Autoregressive generation (transformer models): prefill goes
         through the dynamic batcher (TTFT path); decode steps run per
@@ -175,14 +184,16 @@ class TPUDevice:
         when the client disconnects so the device stops doing unread work.
         ``tokens`` may be a str when a tokenizer is configured; ``sampler``
         (ops.sampling.Sampler) sets temperature/top-k/top-p — default
-        greedy."""
+        greedy. ``stop_tokens`` (iterable of ids) end generation; the stop
+        token itself is not emitted."""
         if isinstance(tokens, str):
             tokens = self._detokenize(tokens)["tokens"]
         start = time.perf_counter()
         try:
             out = self.runner.generate(
                 tokens, max_new_tokens, on_token=on_token, stop=stop,
-                sampler=sampler, decode_pool=self.decode_pool,
+                sampler=sampler, stop_tokens=stop_tokens,
+                decode_pool=self.decode_pool,
                 prefill_batcher=self.batcher, ttft_cb=lambda: self._ttft.observe(
                     time.perf_counter() - start, model=self.model_name, op="generate"
                 ),
@@ -196,6 +207,7 @@ class TPUDevice:
     def generate_stream(
         self, tokens: list[int], max_new_tokens: int = 32,
         sampler: Optional[Any] = None,
+        stop_tokens: Optional[Any] = None,
     ) -> Any:
         """Iterator of decoded token ids, yielded as they decode — the shared
         bridge for SSE and gRPC streaming transports. Closing the iterator
@@ -213,7 +225,7 @@ class TPUDevice:
             try:
                 self.generate(
                     tokens, max_new_tokens, on_token=out.put, stop=stop,
-                    sampler=sampler,
+                    sampler=sampler, stop_tokens=stop_tokens,
                 )
             except BaseException as exc:
                 failure.append(exc)
@@ -282,6 +294,48 @@ class TPUDevice:
             )
         )
 
+    # -- failure recovery (SURVEY.md §5: re-init on device loss) -------------
+    def reinit(self) -> None:
+        """Tear down and rebuild the device stack (runner, batcher, decode
+        pool) — the recovery path after device loss. In-flight requests on
+        the old stack fail with an error (never a silently-truncated 200);
+        params re-load from MODEL_PATH (or re-seed) exactly as at startup."""
+        with self._reinit_lock:
+            self._reinit_locked()
+
+    def _reinit_locked(self) -> None:
+        self.logger.warnf(
+            "reinitializing TPU device stack (model=%s)", self.model_name
+        )
+        # stamp FIRST: a rebuild that fails because the device is still
+        # gone must also hold off the next attempt (no rebuild storms)
+        self._last_reinit = time.monotonic()
+        for closer in (
+            lambda: self.batcher.close(),
+            lambda: self.decode_pool.close() if self.decode_pool else None,
+        ):
+            try:
+                closer()
+            except Exception:
+                pass  # the old stack may be wedged; rebuild regardless
+        self._build_stack()
+
+    def _maybe_auto_reinit(self) -> bool:
+        """At most one automatic rebuild per 30s window — whether the last
+        attempt succeeded or not (a dead device must not trigger a rebuild
+        storm). Check and rebuild are atomic: concurrent health probes
+        cannot interleave two rebuilds. Returns True on a successful
+        rebuild."""
+        with self._reinit_lock:
+            if time.monotonic() - self._last_reinit < 30.0:
+                return False
+            try:
+                self._reinit_locked()
+                return True
+            except Exception as exc:
+                self.logger.errorf("device reinit failed: %r", exc)
+                return False
+
     # -- health (north star: device liveness on /.well-known/health) ---------
     def health_check(self) -> Health:
         details: dict[str, Any] = {
@@ -303,12 +357,23 @@ class TPUDevice:
         except Exception:
             pass  # memory_stats unsupported on some backends
         try:
-            # tiny device round-trip proves the runtime is alive
-            probe = jnp.zeros((8,), jnp.float32) + 1.0
-            ok = bool(np.asarray(probe).sum() == 8.0)
+            ok = self._probe()
         except Exception as exc:
+            # device loss: attempt one rebuild (rate-limited) and re-probe
+            if self._maybe_auto_reinit():
+                try:
+                    if self._probe():
+                        return Health(UP, {**details, "reinitialized": True})
+                except Exception:
+                    pass
             return Health(DOWN, {**details, "error": str(exc)})
         return Health(UP if ok else DOWN, details)
+
+    @staticmethod
+    def _probe() -> bool:
+        # tiny device round-trip proves the runtime is alive
+        probe = jnp.zeros((8,), jnp.float32) + 1.0
+        return bool(np.asarray(probe).sum() == 8.0)
 
     def close(self) -> None:
         self.batcher.close()
@@ -618,6 +683,7 @@ class _TransformerRunner:
         on_token: Any = None,
         stop: Any = None,
         sampler: Any = None,
+        stop_tokens: Any = None,
         decode_pool: Any = None,
         prefill_batcher: Any = None,
         ttft_cb: Any = None,
@@ -626,6 +692,7 @@ class _TransformerRunner:
             from gofr_tpu.ops.sampling import Sampler
 
             sampler = Sampler()  # greedy
+        stop_tokens = frozenset(stop_tokens or ())
         ids = self.prepare(tokens)
         if prefill_batcher is not None:
             state = prefill_batcher.infer(ids)
@@ -638,6 +705,8 @@ class _TransformerRunner:
             token = sampler.pick(state["logits"])
         if ttft_cb:
             ttft_cb()
+        if token in stop_tokens:
+            return out  # stop tokens end generation and are not emitted
         out.append(token)
         if on_token:
             on_token(token)
@@ -655,6 +724,7 @@ class _TransformerRunner:
                 slot_q = decode_pool.submit(
                     state["cache"], state["length"], token,
                     max_new_tokens - 1, sampler, stop,
+                    stop_tokens=stop_tokens,
                 )
             except (queue_mod.Full, RuntimeError):
                 slot_q = None  # pool saturated/closed -> solo decode below
@@ -696,10 +766,16 @@ class _TransformerRunner:
             )
             chunk = [int(t) for t in np.asarray(toks)[0]]
             take = min(n, max_new_tokens - len(out))
+            stopped = False
             for t in chunk[:take]:
+                if t in stop_tokens:
+                    stopped = True
+                    break
                 out.append(t)
                 if on_token:
                     on_token(t)
+            if stopped:
+                break
             token = chunk[take - 1]
             cache_len += n
         return out

@@ -181,3 +181,59 @@ def test_worker_death_fails_requests_not_hangs():
     finally:
         dev.close()
         _restore(old)
+
+
+def test_stop_tokens_solo_and_pooled_agree(pooled, solo):
+    # pick a token the greedy continuation actually emits, use it as stop
+    full = solo.generate([1, 2, 3], max_new_tokens=10)
+    assert len(full) == 10
+    stop_tok = full[5]
+    want = full[: full.index(stop_tok)]
+    for dev in (solo, pooled):
+        got = dev.generate([1, 2, 3], max_new_tokens=10, stop_tokens=[stop_tok])
+        assert got == want, (dev is pooled, got, want)
+
+
+def test_stop_token_on_first_token(pooled, solo):
+    first = solo.generate([1, 2, 3], max_new_tokens=1)[0]
+    for dev in (solo, pooled):
+        assert dev.generate([1, 2, 3], max_new_tokens=10, stop_tokens=[first]) == []
+
+
+def test_stop_tokens_in_stream(pooled):
+    full = pooled.generate([1, 2, 3], max_new_tokens=10)
+    stop_tok = full[4]
+    got = list(pooled.generate_stream([1, 2, 3], max_new_tokens=10,
+                                      stop_tokens=[stop_tok]))
+    assert got == full[: full.index(stop_tok)]
+
+
+def test_pool_close_mid_stream_raises_not_truncates():
+    dev, old = _device(DECODE_POOL="on", DECODE_SLOTS="2", DECODE_CHUNK="2")
+    try:
+        import time
+
+        results = []
+
+        def run():
+            try:
+                results.append(("ok", dev.generate([1, 2, 3], max_new_tokens=10_000)))
+            except RuntimeError as exc:
+                results.append(("err", str(exc)))
+
+        t = threading.Thread(target=run)
+        t.start()
+        time.sleep(0.4)  # mid-stream (tiny max_seq keeps it bounded; chunk=2 is slow)
+        dev.decode_pool.close()
+        t.join(timeout=10)
+        assert results, "generation thread hung"
+        kind, value = results[0]
+        # either it finished before the close (cache bound) or it errored —
+        # never a silently truncated 'ok' shorter than the cache allows
+        if kind == "ok":
+            assert len(value) >= 100  # ran to the tiny cache bound
+        else:
+            assert "closed" in value
+    finally:
+        dev.close()
+        _restore(old)

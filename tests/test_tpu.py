@@ -326,3 +326,24 @@ def test_chunked_decode_matches_stepwise(tiny_device):
     finally:
         for k, v in old.items():
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_reinit_rebuilds_working_stack(tiny_device):
+    before = tiny_device.generate([1, 2, 3], max_new_tokens=5)
+    # wedge the stack the way device loss presents: runner calls fail
+    tiny_device.runner.run_batch = lambda payloads: (_ for _ in ()).throw(
+        RuntimeError("device lost")
+    )
+    tiny_device.batcher.close()
+    tiny_device.reinit()
+    after = tiny_device.generate([1, 2, 3], max_new_tokens=5)
+    assert after == before  # fresh stack, same params seed
+    h = tiny_device.health_check()
+    assert h.status == "UP"
+
+
+def test_auto_reinit_rate_limited(tiny_device):
+    import time as time_mod
+
+    tiny_device._last_reinit = time_mod.monotonic()
+    assert tiny_device._maybe_auto_reinit() is False  # within the 30s window
