@@ -67,40 +67,68 @@ def _cached_freqs(head_dim: int, max_seq: int, theta: float):
     return np.stack([np.cos(freqs), np.sin(freqs)], axis=-1).astype(np.float32)
 
 
-def init_transformer(key: jax.Array, cfg: TransformerConfig) -> dict:
+def init_transformer(
+    key: jax.Array, cfg: TransformerConfig, quantize: bool = False
+) -> dict:
     """Weight layout mirrors Llama-3 shapes; initialization is scaled
     truncated-normal (serving weights come from checkpoints; init exists for
-    tests and training-from-scratch)."""
+    tests and training-from-scratch).
+
+    ``quantize=True`` quantizes each matmul weight to int8 IMMEDIATELY
+    after creation, so peak device memory is the int8 model plus ONE bf16
+    weight — init-then-quantize of the full tree would peak at 3x the int8
+    size and OOM an 8B model on a 16GB chip. Values are bit-identical to
+    ``quantize_params(init_transformer(key, cfg))``."""
+    from gofr_tpu.models.quant import quantize_array
+
     n_keys = cfg.n_layers * 7 + 3
     keys = iter(jax.random.split(key, n_keys))
 
-    def dense(k: jax.Array, shape: tuple[int, ...], fan_in: int) -> jnp.ndarray:
-        return (jax.random.truncated_normal(k, -3, 3, shape) * (fan_in ** -0.5)).astype(cfg.dtype)
+    def dense(k: jax.Array, shape: tuple[int, ...], fan_in: int) -> Any:
+        w = (jax.random.truncated_normal(k, -3, 3, shape) * (fan_in ** -0.5)).astype(cfg.dtype)
+        return quantize_array(w) if quantize else w
 
     params: dict[str, Any] = {
-        "embed": dense(next(keys), (cfg.vocab_size, cfg.dim), cfg.dim),
+        # embeddings stay high precision (the quantization scheme's rule)
+        "embed": (
+            jax.random.truncated_normal(next(keys), -3, 3, (cfg.vocab_size, cfg.dim))
+            * (cfg.dim ** -0.5)
+        ).astype(cfg.dtype),
         "norm_f": jnp.ones((cfg.dim,), cfg.dtype),
         "lm_head": dense(next(keys), (cfg.dim, cfg.vocab_size), cfg.dim),
     }
-    layers = []
     kv_dim = cfg.n_kv_heads * cfg.head_dim
-    for _ in range(cfg.n_layers):
-        layers.append(
-            {
-                "attn_norm": jnp.ones((cfg.dim,), cfg.dtype),
-                "wq": dense(next(keys), (cfg.dim, cfg.dim), cfg.dim),
-                "wk": dense(next(keys), (cfg.dim, kv_dim), cfg.dim),
-                "wv": dense(next(keys), (cfg.dim, kv_dim), cfg.dim),
-                "wo": dense(next(keys), (cfg.dim, cfg.dim), cfg.dim),
-                "mlp_norm": jnp.ones((cfg.dim,), cfg.dtype),
-                "w_gate": dense(next(keys), (cfg.dim, cfg.hidden_dim), cfg.dim),
-                "w_up": dense(next(keys), (cfg.dim, cfg.hidden_dim), cfg.dim),
-                "w_down": dense(next(keys), (cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
-            }
-        )
-    # stack layers into one pytree level: [n_layers, ...] arrays, scanned in
-    # the forward — one compiled layer body instead of n_layers copies
-    params["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+    def make_layer() -> dict:
+        return {
+            "attn_norm": jnp.ones((cfg.dim,), cfg.dtype),
+            "wq": dense(next(keys), (cfg.dim, cfg.dim), cfg.dim),
+            "wk": dense(next(keys), (cfg.dim, kv_dim), cfg.dim),
+            "wv": dense(next(keys), (cfg.dim, kv_dim), cfg.dim),
+            "wo": dense(next(keys), (cfg.dim, cfg.dim), cfg.dim),
+            "mlp_norm": jnp.ones((cfg.dim,), cfg.dtype),
+            "w_gate": dense(next(keys), (cfg.dim, cfg.hidden_dim), cfg.dim),
+            "w_up": dense(next(keys), (cfg.dim, cfg.hidden_dim), cfg.dim),
+            "w_down": dense(next(keys), (cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
+        }
+
+    # layers live as ONE pytree level of [n_layers, ...] arrays, scanned in
+    # the forward — one compiled layer body instead of n_layers copies.
+    # Stacking is INCREMENTAL (preallocate + at[i].set, each layer freed
+    # after placement): jnp.stack of all layers at once would hold the
+    # whole model twice and OOM 8B-class models during boot.
+    # (Quantized {"q","scale"} dicts thread per-field through the tree maps.)
+    n = cfg.n_layers
+    first = make_layer()
+    stacked = jax.tree.map(
+        lambda x: jnp.zeros((n,) + x.shape, x.dtype).at[0].set(x), first
+    )
+    del first
+    for i in range(1, n):
+        layer = make_layer()
+        stacked = jax.tree.map(lambda s, x, i=i: s.at[i].set(x), stacked, layer)
+        del layer
+    params["layers"] = stacked
     return params
 
 

@@ -100,6 +100,8 @@ class TPUDevice:
         )
 
         self._decode_chunk_cfg = int(config.get_or_default("DECODE_CHUNK", "8"))
+        raw_max_seq = config.get("MODEL_MAX_SEQ")
+        self._max_seq_cfg = int(raw_max_seq) if raw_max_seq else None
         self._pool_enabled = config.get_or_default("DECODE_POOL", "on") != "off"
         self._pool_slots = int(config.get_or_default("DECODE_SLOTS", str(self.max_batch)))
         self._build_stack()
@@ -111,6 +113,7 @@ class TPUDevice:
         self.runner = _build_runner(
             self.model_name, self.quant, self.model_path, self.max_batch,
             mesh=self.mesh, decode_chunk=self._decode_chunk_cfg,
+            max_seq=self._max_seq_cfg,
         )
         self.runner.warmup()
         # continuous batching: concurrent decodes share one fixed-shape
@@ -532,6 +535,7 @@ class _TransformerRunner:
         max_batch: int = 8,
         mesh: Optional[Any] = None,
         decode_chunk: int = 8,
+        max_seq: Optional[int] = None,
     ):
         self.max_batch = max_batch
         from gofr_tpu.models.llama import CONFIGS
@@ -545,11 +549,25 @@ class _TransformerRunner:
 
         self.name = name
         self.cfg = CONFIGS[name]
+        if max_seq is not None and max_seq < self.cfg.max_seq:
+            # serving-side cache bound: a single chip can hold llama3-8b
+            # int8 only with a smaller KV allocation than the model's full
+            # context (MODEL_MAX_SEQ config key)
+            import dataclasses
+
+            self.cfg = dataclasses.replace(self.cfg, max_seq=max_seq)
         self.decode_chunk_size = decode_chunk
-        params = _load_or_init(
-            model_path, lambda: init_transformer(jax.random.key(0), self.cfg)
-        )
-        self.params = quantize_params(params) if quant else params
+        if model_path:
+            params = _load_or_init(
+                model_path, lambda: init_transformer(jax.random.key(0), self.cfg)
+            )
+            self.params = quantize_params(params) if quant else params
+        elif quant:
+            # quantize-during-init: peak memory = int8 model + ONE bf16
+            # weight (init-then-quantize would peak ~3x and OOM 8B on 16GB)
+            self.params = init_transformer(jax.random.key(0), self.cfg, quantize=True)
+        else:
+            self.params = init_transformer(jax.random.key(0), self.cfg)
         self.mesh = mesh
         self._token_sharding = None
         self._cache_shardings = None
@@ -866,6 +884,7 @@ def _build_runner(
     max_batch: int = 8,
     mesh: Optional[Any] = None,
     decode_chunk: int = 8,
+    max_seq: Optional[int] = None,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
@@ -875,7 +894,8 @@ def _build_runner(
         return _BertRunner(name, quant, model_path, max_batch)
     if name in CONFIGS:
         return _TransformerRunner(
-            name, quant, model_path, max_batch, mesh=mesh, decode_chunk=decode_chunk
+            name, quant, model_path, max_batch, mesh=mesh,
+            decode_chunk=decode_chunk, max_seq=max_seq,
         )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "

@@ -197,3 +197,19 @@ def test_named_configs_have_llama_shapes():
     cfg = CONFIGS["llama3-8b"]
     assert cfg.dim == 4096 and cfg.n_layers == 32 and cfg.n_kv_heads == 8
     assert CONFIGS["llama3-70b"].hidden_dim == 28672
+
+
+def test_quantized_init_matches_quantize_after():
+    import jax
+    import numpy as np
+
+    from gofr_tpu.models.llama import TINY
+    from gofr_tpu.models.quant import quantize_params
+    from gofr_tpu.models.transformer import init_transformer
+
+    a = init_transformer(jax.random.key(3), TINY, quantize=True)
+    b = quantize_params(init_transformer(jax.random.key(3), TINY))
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

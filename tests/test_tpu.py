@@ -347,3 +347,25 @@ def test_auto_reinit_rate_limited(tiny_device):
 
     tiny_device._last_reinit = time_mod.monotonic()
     assert tiny_device._maybe_auto_reinit() is False  # within the 30s window
+
+
+def test_model_max_seq_bounds_cache():
+    import os
+
+    env = {"MODEL_NAME": "tiny", "MODEL_MAX_SEQ": "64", "BATCH_MAX_SIZE": "2",
+           "BATCH_TIMEOUT_MS": "1", "MODEL_QUANT": "int8"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            assert device.runner.cfg.max_seq == 64
+            assert device.runner.buckets[-1] <= 64
+            out = device.generate(list(range(1, 50)), max_new_tokens=100)
+            assert len(out) <= 64 - 49 + 1  # bounded by the reduced cache
+            assert "quant=int8" in device.describe()
+        finally:
+            device.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
