@@ -9,7 +9,7 @@ is never sharded):
 
 | weight              | shape              | spec                      |
 |---------------------|--------------------|---------------------------|
-| embed               | [V, D]             | P('tp', 'fsdp')           |
+| embed               | [V, D]             | P(None, 'tp')             |
 | lm_head             | [D, V]             | P('fsdp', 'tp')           |
 | wq / wk / wv        | [L, D, H*hd]       | P(None, 'fsdp', 'tp')     |
 | wo                  | [L, D, D]          | P(None, 'tp', 'fsdp')     |
