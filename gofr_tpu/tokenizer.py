@@ -20,9 +20,48 @@ from __future__ import annotations
 
 import ctypes
 from collections import Counter
+from functools import lru_cache
 from typing import Optional
 
 SPECIAL_TOKENS = ("<pad>", "<bos>", "<eos>")
+
+# the GPT-2 byte-level BPE regex (public algorithm) — used when an HF
+# tokenizer.json requests ByteLevel pre-tokenization without its own pattern
+_GPT2_SPLIT = (
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
+)
+
+
+@lru_cache(maxsize=1)
+def _byte_unicode_tables() -> tuple[dict[int, str], dict[str, int]]:
+    """GPT-2 byte<->unicode mapping (public algorithm): printable bytes map
+    to themselves, the rest shift into U+0100.. so every byte has a visible
+    single-character representation inside HF vocab strings."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    byte_to_uni = {b: chr(c) for b, c in zip(bs, cs)}
+    uni_to_byte = {u: b for b, u in byte_to_uni.items()}
+    return byte_to_uni, uni_to_byte
+
+
+def _hf_token_bytes(token: str) -> Optional[bytes]:
+    """HF vocab string -> raw bytes; None when the string contains
+    characters outside the byte-level alphabet (added/special tokens)."""
+    _, uni_to_byte = _byte_unicode_tables()
+    try:
+        return bytes(uni_to_byte[ch] for ch in token)
+    except KeyError:
+        return None
 
 
 class Tokenizer:
@@ -41,6 +80,14 @@ class Tokenizer:
             self.merges.append((left, right))
             self._pieces.append(self._pieces[left] + self._pieces[right])
         self.n_special = n_special
+        # HF interop (from_hf_json): internal ids (byte ids + dense merge
+        # ranks) translate to the checkpoint's external ids at the API edge
+        self._ext_of: Optional[list[int]] = None  # internal id -> external
+        self._int_of: Optional[dict[int, int]] = None  # external -> internal
+        self._ext_vocab: Optional[int] = None
+        self._special_ids: dict[str, int] = {}  # "bos"/"eos"/"pad" -> ext id
+        self._token_ids: dict[str, int] = {}  # special content -> ext id
+        self._pretok = None  # compiled split regex (HF pre-tokenizer)
         self._native = None
         self._handle = None
         from gofr_tpu import native
@@ -72,6 +119,83 @@ class Tokenizer:
                         continue  # header/comment lines are skipped
         return cls(merges, n_special)
 
+    @classmethod
+    def from_hf_json(cls, path: str) -> "Tokenizer":
+        """Load an HF ``tokenizer.json`` (byte-level BPE: GPT-2/Llama-3
+        family). The merge list translates rank-for-rank onto this BPE; the
+        vocab supplies the external-id mapping so encode/decode speak the
+        checkpoint's ids. The file's own Split pre-tokenizer regex is
+        honored (merges never cross pre-token boundaries, matching HF
+        exactly); ByteLevel-only tokenizers get the published GPT-2
+        pattern."""
+        import json
+
+        with open(path) as f:
+            spec = json.load(f)
+        model = spec.get("model", {})
+        if model.get("type") != "BPE":
+            raise ValueError(
+                f"{path}: model.type={model.get('type')!r} — only byte-level "
+                "BPE tokenizer.json files are supported"
+            )
+        vocab: dict[str, int] = model["vocab"]
+        byte_to_uni, _ = _byte_unicode_tables()
+
+        # internal piece table: byte ids 0..255, then one id per merge
+        piece_ids: dict[bytes, int] = {bytes([b]): b for b in range(256)}
+        merges: list[tuple[int, int]] = []
+        raw_merges = model.get("merges", [])
+        for entry in raw_merges:
+            if isinstance(entry, str):
+                left_s, _, right_s = entry.partition(" ")
+            else:
+                left_s, right_s = entry
+            left_b = _hf_token_bytes(left_s)
+            right_b = _hf_token_bytes(right_s)
+            if left_b is None or right_b is None:
+                continue
+            left = piece_ids.get(left_b)
+            right = piece_ids.get(right_b)
+            if left is None or right is None:
+                continue  # references a piece never built (filtered merge)
+            piece_ids[left_b + right_b] = 256 + len(merges)
+            merges.append((left, right))
+
+        tok = cls(merges, n_special=0)
+
+        # internal -> external ids via the vocab strings
+        ext_of = [-1] * (256 + len(tok.merges))
+        for token_str, ext_id in vocab.items():
+            raw = _hf_token_bytes(token_str)
+            if raw is None:
+                continue
+            internal = piece_ids.get(raw)
+            if internal is not None and internal < len(ext_of):
+                ext_of[internal] = ext_id
+        tok._ext_of = ext_of
+        tok._int_of = {e: i for i, e in enumerate(ext_of) if e >= 0}
+        max_ext = max((e for e in ext_of if e >= 0), default=-1)
+
+        # added/special tokens (bos/eos/pad by conventional content)
+        for added in spec.get("added_tokens", []):
+            content, ext_id = added.get("content"), added.get("id")
+            if content is None or ext_id is None:
+                continue
+            tok._token_ids[content] = ext_id
+            max_ext = max(max_ext, ext_id)
+        for name, candidates in (
+            ("bos", ("<|begin_of_text|>", "<s>", "<bos>", "<|startoftext|>")),
+            ("eos", ("<|end_of_text|>", "</s>", "<eos>", "<|endoftext|>")),
+            ("pad", ("<pad>", "<|pad|>", "<|finetune_right_pad_id|>")),
+        ):
+            for cand in candidates:
+                if cand in tok._token_ids:
+                    tok._special_ids[name] = tok._token_ids[cand]
+                    break
+        tok._ext_vocab = max_ext + 1
+        tok._pretok = _compile_pretokenizer(spec.get("pre_tokenizer"))
+        return tok
+
     def save(self, path: str) -> None:
         with open(path, "w") as f:
             for left, right in self.merges:
@@ -80,6 +204,8 @@ class Tokenizer:
     # -- properties ----------------------------------------------------------
     @property
     def vocab_size(self) -> int:
+        if self._ext_vocab is not None:
+            return self._ext_vocab
         return 256 + len(self.merges) + self.n_special
 
     @property
@@ -87,20 +213,64 @@ class Tokenizer:
         return "native" if self._native is not None else "python"
 
     def special_id(self, name: str) -> int:
-        """pad/bos/eos ids sit at the top of the id space."""
+        """pad/bos/eos ids: the checkpoint's (HF tokenizer.json) or the top
+        of the native id space."""
+        if self._special_ids:
+            try:
+                return self._special_ids[name]
+            except KeyError:
+                raise ValueError(f"tokenizer has no {name} token") from None
         idx = SPECIAL_TOKENS.index(f"<{name}>")
         if idx >= self.n_special:
             raise ValueError(f"tokenizer has no <{name}> (n_special={self.n_special})")
         return 256 + len(self.merges) + idx
 
+    def token_id(self, content: str) -> Optional[int]:
+        """External id of an added/special token by its literal content
+        (e.g. ``"<|eot_id|>"``); None when absent."""
+        return self._token_ids.get(content)
+
     # -- encode / decode -----------------------------------------------------
     def encode(self, text: str | bytes) -> list[int]:
+        if self._pretok is not None and isinstance(text, bytes):
+            # HF pre-tokenization is defined on text; bytes must not
+            # silently bypass it (ids would diverge from the HF library).
+            # Invalid UTF-8 raises rather than encode out-of-distribution.
+            text = text.decode("utf-8")
+        if self._pretok is not None and isinstance(text, str):
+            # HF semantics: BPE runs per pre-token chunk, merges never
+            # cross chunk boundaries. finditer + explicit gap handling:
+            # findall would return group captures for patterns with
+            # capturing groups and silently DROP unmatched spans — every
+            # input byte must reach the encoder.
+            ids: list[int] = []
+            pos = 0
+            for m in self._pretok.finditer(text):
+                if m.start() > pos:
+                    ids.extend(self._encode_raw(text[pos : m.start()].encode("utf-8")))
+                if m.group(0):
+                    ids.extend(self._encode_raw(m.group(0).encode("utf-8")))
+                pos = m.end()
+            if pos < len(text):
+                ids.extend(self._encode_raw(text[pos:].encode("utf-8")))
+            return self._map_out(ids)
         data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
+        return self._map_out(self._encode_raw(data))
+
+    def _encode_raw(self, data: bytes) -> list[int]:
         if self._native is not None:
             return self._encode_native(data)
         return self._encode_python(data)
 
+    def _map_out(self, ids: list[int]) -> list[int]:
+        if self._ext_of is None:
+            return ids
+        return [self._ext_of[i] for i in ids if self._ext_of[i] >= 0]
+
     def decode(self, ids: list[int]) -> str:
+        if self._int_of is not None:
+            # external ids without a byte-level piece (specials) carry no text
+            ids = [self._int_of[i] for i in ids if i in self._int_of]
         if self._native is not None:
             data = self._decode_native(ids)
         else:
@@ -192,6 +362,11 @@ class StreamDecoder:
         self._dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
 
     def feed(self, token_id: int) -> str:
+        if self._tok._int_of is not None:
+            internal = self._tok._int_of.get(token_id)
+            if internal is None:
+                return ""  # special/oob external ids carry no bytes
+            token_id = internal
         pieces = self._tok._pieces
         if not 0 <= token_id < len(pieces):
             return ""  # special/oob ids carry no bytes
@@ -237,11 +412,38 @@ def train_bpe(
     return Tokenizer(merges, n_special)
 
 
+def _compile_pretokenizer(pre: Optional[dict]):
+    """Compile the Split regex out of an HF pre_tokenizer spec (Sequence /
+    Split / ByteLevel). Returns a compiled ``regex`` pattern or None (no
+    pre-splitting: BPE over the whole byte string)."""
+    if not pre:
+        return None
+    try:
+        import regex
+    except ImportError:  # pragma: no cover - regex ships in this image
+        return None
+    nodes = [pre]
+    if pre.get("type") == "Sequence":
+        nodes = pre.get("pretokenizers", [])
+    for node in nodes:
+        if node.get("type") == "Split":
+            pattern = node.get("pattern", {})
+            if "Regex" in pattern:
+                return regex.compile(pattern["Regex"])
+    for node in nodes:
+        if node.get("type") == "ByteLevel" and node.get("use_regex", True):
+            return regex.compile(_GPT2_SPLIT)
+    return None
+
+
 def load_tokenizer(config) -> Optional[Tokenizer]:
-    """Container wiring: TOKENIZER_PATH (merges file) > TOKENIZER=byte >
-    None (id-only endpoints)."""
+    """Container wiring: TOKENIZER_PATH (HF tokenizer.json when the file is
+    .json, else a merges file) > TOKENIZER=byte > None (id-only
+    endpoints)."""
     path = config.get("TOKENIZER_PATH")
     if path:
+        if path.endswith(".json"):
+            return Tokenizer.from_hf_json(path)
         return Tokenizer.from_file(path)
     if config.get_or_default("TOKENIZER", "") == "byte":
         return Tokenizer.byte_level()

@@ -1,0 +1,178 @@
+"""Real-model ingestion: safetensors parsing + HF-Llama weight mapping.
+
+The writer oracle is the `safetensors` library (independent implementation:
+our reader is a from-scratch mmap parser), the tree oracle is
+init_transformer + export_llama_hf round-trips."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.models.ingest import (
+    Checkpoint,
+    SafetensorsFile,
+    export_llama_hf,
+    is_safetensors_path,
+    iter_hf_llama_tensors,
+    load_llama_params,
+)
+from gofr_tpu.models.llama import TINY
+from gofr_tpu.models.transformer import init_transformer, transformer_forward
+
+TOKENS = jnp.asarray([[5, 3, 8, 1, 9, 2]], jnp.int32)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return init_transformer(jax.random.key(7), TINY)
+
+
+@pytest.fixture(scope="module")
+def hf_dict(tiny_params):
+    return export_llama_hf(tiny_params, TINY)
+
+
+def _save(path, tensors):
+    from safetensors.numpy import save_file
+
+    save_file({k: np.ascontiguousarray(v) for k, v in tensors.items()}, path)
+
+
+def test_safetensors_file_reader(tmp_path, hf_dict):
+    path = str(tmp_path / "model.safetensors")
+    _save(path, hf_dict)
+    sf = SafetensorsFile(path)
+    assert set(sf.names()) == set(hf_dict)
+    for name, ref in hf_dict.items():
+        got = sf.tensor(name)
+        assert got.dtype == ref.dtype and got.shape == ref.shape
+        np.testing.assert_array_equal(got, ref)
+    with pytest.raises(KeyError, match="nope"):
+        sf.tensor("nope")
+    sf.close()
+
+
+def test_load_llama_roundtrip_single_file(tmp_path, tiny_params, hf_dict):
+    path = str(tmp_path / "model.safetensors")
+    _save(path, hf_dict)
+    loaded = load_llama_params(path, TINY)
+    ref = transformer_forward(tiny_params, TOKENS, TINY)
+    got = transformer_forward(loaded, TOKENS, TINY)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_load_llama_sharded_with_index(tmp_path, tiny_params, hf_dict):
+    names = sorted(hf_dict)
+    half = len(names) // 2
+    shard_of = {}
+    for shard, chunk in (("model-00001-of-00002.safetensors", names[:half]),
+                         ("model-00002-of-00002.safetensors", names[half:])):
+        _save(str(tmp_path / shard), {n: hf_dict[n] for n in chunk})
+        for n in chunk:
+            shard_of[n] = shard
+    with open(tmp_path / "model.safetensors.index.json", "w") as f:
+        json.dump({"weight_map": shard_of}, f)
+    loaded = load_llama_params(str(tmp_path), TINY)
+    ref = transformer_forward(tiny_params, TOKENS, TINY)
+    got = transformer_forward(loaded, TOKENS, TINY)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_missing_tensor_named(tmp_path, hf_dict):
+    broken = {k: v for k, v in hf_dict.items()
+              if k != "model.layers.1.mlp.down_proj.weight"}
+    path = str(tmp_path / "model.safetensors")
+    _save(path, broken)
+    with pytest.raises(KeyError, match="model.layers.1.mlp.down_proj.weight"):
+        load_llama_params(path, TINY)
+
+
+def test_shape_mismatch_named(tmp_path, hf_dict):
+    import dataclasses
+
+    wrong = dataclasses.replace(TINY, hidden_dim=96)
+    path = str(tmp_path / "model.safetensors")
+    _save(path, hf_dict)
+    with pytest.raises(ValueError, match="gate_proj"):
+        load_llama_params(path, wrong)
+
+
+def test_tied_embeddings_fallback(tmp_path, tiny_params, hf_dict):
+    tied = {k: v for k, v in hf_dict.items() if k != "lm_head.weight"}
+    path = str(tmp_path / "model.safetensors")
+    _save(path, tied)
+    loaded = load_llama_params(path, TINY)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["lm_head"]), np.asarray(loaded["embed"]).T
+    )
+
+
+def test_quantize_during_load(tmp_path, tiny_params, hf_dict):
+    from gofr_tpu.models.quant import quantize_params
+
+    path = str(tmp_path / "model.safetensors")
+    _save(path, hf_dict)
+    loaded = load_llama_params(path, TINY, quantize=True)
+    assert set(loaded["layers"]["wq"]) == {"q", "scale"}
+    ref = transformer_forward(quantize_params(tiny_params), TOKENS, TINY)
+    got = transformer_forward(loaded, TOKENS, TINY)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_iter_covers_full_tree(tmp_path, tiny_params, hf_dict):
+    path = str(tmp_path / "model.safetensors")
+    _save(path, hf_dict)
+    ckpt = Checkpoint(path)
+    paths = {p for p, _ in iter_hf_llama_tensors(ckpt, TINY)}
+    ckpt.close()
+    expected = {("embed",), ("norm_f",), ("lm_head",)}
+    for key in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                "attn_norm", "mlp_norm"):
+        for i in range(TINY.n_layers):
+            expected.add(("layers", key, i))
+    assert paths == expected
+
+
+def test_is_safetensors_path(tmp_path, hf_dict):
+    f = str(tmp_path / "model.safetensors")
+    _save(f, hf_dict)
+    assert is_safetensors_path(f)
+    assert is_safetensors_path(str(tmp_path))  # dir containing shards
+    assert not is_safetensors_path(None)
+    orbax_dir = tmp_path / "orbax"
+    orbax_dir.mkdir()
+    assert not is_safetensors_path(str(orbax_dir))
+
+
+def test_device_boots_from_safetensors(tmp_path, hf_dict, tiny_params):
+    """The verdict's done-criterion: MODEL_PATH=*.safetensors boots and
+    serves (device routes to the HF loader)."""
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.logging import Level
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.testutil import MockLogger
+    from gofr_tpu.tpu.device import new_device
+
+    path = str(tmp_path / "model.safetensors")
+    _save(path, hf_dict)
+    env = {"MODEL_NAME": "tiny", "MODEL_PATH": path, "BATCH_MAX_SIZE": "2",
+           "BATCH_TIMEOUT_MS": "1", "DECODE_POOL": "off"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            out = device.infer({"tokens": [5, 3, 8, 1, 9, 2]})
+            ref = transformer_forward(tiny_params, TOKENS, TINY)
+            np.testing.assert_allclose(
+                np.asarray(out["logits"]), np.asarray(ref)[0, -1], rtol=1e-4, atol=1e-4
+            )
+        finally:
+            device.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)

@@ -194,3 +194,89 @@ def test_native_parser_robustness_direct_abi():
         assert list(buf[:n]) == [256, 257]
     finally:
         lib.gofr_tok_free(h)
+
+
+# -- HF tokenizer.json interop (real-model ingestion) -------------------------
+
+@pytest.fixture(scope="module")
+def hf_json_path(tmp_path_factory):
+    """Train a real byte-level BPE with the HF `tokenizers` library (the
+    independent oracle) and save its tokenizer.json."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import decoders, models, pre_tokenizers, trainers
+
+    hf = tokenizers.Tokenizer(models.BPE())
+    hf.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    hf.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400,
+        special_tokens=["<|begin_of_text|>", "<|end_of_text|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    hf.train_from_iterator([CORPUS], trainer)
+    path = str(tmp_path_factory.mktemp("hf") / "tokenizer.json")
+    hf.save(path)
+    return path
+
+
+def test_hf_json_encode_matches_hf_library(hf_json_path):
+    import tokenizers
+
+    hf = tokenizers.Tokenizer.from_file(hf_json_path)
+    ours = Tokenizer.from_hf_json(hf_json_path)
+    for text in (
+        "the quick brown fox",
+        "überraschung! the lazier dog",
+        "  leading spaces and   runs",
+        "punctuation, too! (yes?)",
+        CORPUS[:200],
+    ):
+        assert ours.encode(text) == hf.encode(text).ids, text
+
+
+def test_hf_json_decode_roundtrip(hf_json_path):
+    ours = Tokenizer.from_hf_json(hf_json_path)
+    text = "the quick brown fox — überraschung!"
+    assert ours.decode(ours.encode(text)) == text
+
+
+def test_hf_json_specials_and_vocab(hf_json_path):
+    import tokenizers
+
+    hf = tokenizers.Tokenizer.from_file(hf_json_path)
+    ours = Tokenizer.from_hf_json(hf_json_path)
+    assert ours.vocab_size == hf.get_vocab_size()
+    assert ours.special_id("bos") == hf.token_to_id("<|begin_of_text|>")
+    assert ours.special_id("eos") == hf.token_to_id("<|end_of_text|>")
+    assert ours.token_id("<|begin_of_text|>") == hf.token_to_id("<|begin_of_text|>")
+    with pytest.raises(ValueError, match="no pad"):
+        ours.special_id("pad")
+
+
+def test_hf_json_stream_decoder_skips_specials(hf_json_path):
+    ours = Tokenizer.from_hf_json(hf_json_path)
+    ids = ours.encode("the fox")
+    dec = ours.stream_decoder()
+    text = "".join(dec.feed(i) for i in [ours.special_id("bos"), *ids])
+    text += dec.flush()
+    assert text == "the fox"
+
+
+def test_hf_json_rejects_non_bpe(tmp_path):
+    import json as json_mod
+
+    path = str(tmp_path / "tokenizer.json")
+    with open(path, "w") as f:
+        json_mod.dump({"model": {"type": "Unigram", "vocab": []}}, f)
+    with pytest.raises(ValueError, match="Unigram"):
+        Tokenizer.from_hf_json(path)
+
+
+def test_load_tokenizer_routes_hf_json(hf_json_path, monkeypatch):
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.tokenizer import load_tokenizer
+
+    monkeypatch.setenv("TOKENIZER_PATH", hf_json_path)
+    tok = load_tokenizer(EnvConfig())
+    assert tok is not None and tok._ext_of is not None
