@@ -29,6 +29,10 @@ from gofr_tpu.handler import (
     health_handler,
     make_endpoint,
     metrics_handler,
+    profiler_start_handler,
+    profiler_status_handler,
+    profiler_stop_handler,
+    ready_handler,
 )
 from gofr_tpu.http.middleware import (
     cors_middleware,
@@ -132,8 +136,13 @@ class App:
     def _install_default_routes(self) -> None:
         # parity: gofr.go:102-107
         self.router.add("GET", "/.well-known/health", make_endpoint(health_handler, self.container))
+        self.router.add("GET", "/.well-known/ready", make_endpoint(ready_handler, self.container))
         self.router.add("GET", "/favicon.ico", make_endpoint(favicon_handler, self.container))
         self.router.add("GET", "/metrics", make_endpoint(metrics_handler, self.container))
+        # device profiler admin surface (off the serving hot path)
+        self.router.add("GET", "/admin/profiler", make_endpoint(profiler_status_handler, self.container))
+        self.router.add("POST", "/admin/profiler/start", make_endpoint(profiler_start_handler, self.container))
+        self.router.add("POST", "/admin/profiler/stop", make_endpoint(profiler_stop_handler, self.container))
         self.router.set_not_found(make_endpoint(catch_all_handler, self.container))
 
     def run(self) -> None:

@@ -73,9 +73,66 @@ def catch_all_handler(_: Context) -> None:
     raise RouteNotFoundError()
 
 
+def ready_handler(ctx: Context) -> Response:
+    """Readiness probe, distinct from /.well-known/health (liveness): 503
+    while the TPU stack is still booting (warmup compiles), with the current
+    boot stage in the body so a slow cold boot is observable; 200 once
+    requests would be served without blocking. Apps without a TPU datasource
+    are ready as soon as the server listens."""
+    import json
+
+    tpu = ctx.container.tpu
+    if tpu is None or tpu.ready():
+        status, state = 200, {"state": "ready"}
+    else:
+        status, state = 503, dict(tpu.boot_status)
+    return Response(
+        status=status,
+        headers={"Content-Type": "application/json"},
+        body=json.dumps(state).encode("utf-8"),
+    )
+
+
 def metrics_handler(ctx: Context) -> Response:
     return Response(
         status=200,
         headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
         body=ctx.container.metrics.expose().encode("utf-8"),
     )
+
+
+# -- device profiler admin surface (SURVEY.md §5: profiling hooks) -----------
+
+def profiler_status_handler(_: Context) -> Any:
+    from gofr_tpu.profiling import profiler
+
+    return profiler().status()
+
+
+def profiler_start_handler(ctx: Context) -> Any:
+    from gofr_tpu.errors import HTTPError
+    from gofr_tpu.profiling import profiler
+
+    body = {}
+    try:
+        body = ctx.bind() or {}
+    except Exception:
+        pass  # empty body is fine
+    if not isinstance(body, dict):
+        from gofr_tpu.errors import InvalidParamError
+
+        raise InvalidParamError('body (expected {"dir": ...} or empty)')
+    try:
+        return profiler().start(body.get("dir"))
+    except RuntimeError as exc:
+        raise HTTPError(409, str(exc)) from exc
+
+
+def profiler_stop_handler(_: Context) -> Any:
+    from gofr_tpu.errors import HTTPError
+    from gofr_tpu.profiling import profiler
+
+    try:
+        return profiler().stop()
+    except RuntimeError as exc:
+        raise HTTPError(409, str(exc)) from exc

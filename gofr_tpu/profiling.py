@@ -1,0 +1,84 @@
+"""Device profiling: JAX/XLA trace capture behind admin endpoints.
+
+SURVEY.md §5: the reference has no continuous profiler (no pprof
+endpoints); the TPU build adds device profiling via the runtime's profiler
+hooks. ``jax.profiler.start_trace`` captures XLA device traces (HLO
+timelines, memory viewer data) into a TensorBoard-compatible directory;
+the admin endpoints (handler.py: POST /admin/profiler/start|stop, GET
+/admin/profiler) drive it on a live serving process, so a production TTFT
+regression can be traced without redeploying.
+
+Per-batch device time is additionally recorded as a span tag on every
+dispatched batch (tpu/device.py ``tpu-batch`` spans) — the always-on,
+cheap signal; full traces are the on-demand deep dive.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Optional
+
+
+class Profiler:
+    """Thread-safe wrapper around one active jax.profiler trace session."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._dir: Optional[str] = None
+        self._started_at: Optional[float] = None
+
+    def start(self, log_dir: Optional[str] = None) -> dict[str, Any]:
+        import jax
+
+        with self._lock:
+            if self._dir is not None:
+                raise RuntimeError(f"profiler already tracing into {self._dir}")
+            log_dir = log_dir or os.environ.get("PROFILE_DIR") or tempfile.mkdtemp(
+                prefix="gofr-profile-"
+            )
+            os.makedirs(log_dir, exist_ok=True)
+            jax.profiler.start_trace(log_dir)
+            self._dir = log_dir
+            self._started_at = time.time()
+            return {"state": "tracing", "dir": log_dir}
+
+    def stop(self) -> dict[str, Any]:
+        import jax
+
+        with self._lock:
+            if self._dir is None:
+                raise RuntimeError("profiler is not tracing")
+            # clear state BEFORE stop_trace: if collection fails the
+            # profiler must not wedge in "tracing" forever (the endpoint
+            # exists to debug live processes; restarting defeats it)
+            log_dir, self._dir = self._dir, None
+            elapsed = time.time() - (self._started_at or time.time())
+            self._started_at = None
+            jax.profiler.stop_trace()
+        files = []
+        for root, _, names in os.walk(log_dir):
+            files.extend(os.path.relpath(os.path.join(root, n), log_dir) for n in names)
+        return {
+            "state": "stopped", "dir": log_dir,
+            "seconds": round(elapsed, 2), "artifacts": sorted(files),
+        }
+
+    def status(self) -> dict[str, Any]:
+        with self._lock:
+            if self._dir is None:
+                return {"state": "idle"}
+            return {
+                "state": "tracing", "dir": self._dir,
+                "seconds": round(time.time() - (self._started_at or 0), 2),
+            }
+
+
+_PROFILER = Profiler()
+
+
+def profiler() -> Profiler:
+    """Process-wide profiler (the device runtime is process-wide too)."""
+    return _PROFILER

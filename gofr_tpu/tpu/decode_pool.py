@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from time import perf_counter as _perf_counter
 from typing import Any, Optional
 
 import jax
@@ -67,6 +68,10 @@ class DecodePool:
         n_slots: int,
         chunk: int,
         metrics: Any = None,
+        cache_shardings: Any = None,
+        n_params: Any = None,
+        peak_flops: Any = None,
+        model: str = "",
     ):
         from gofr_tpu.models.transformer import decode_chunk_rows
 
@@ -75,7 +80,15 @@ class DecodePool:
         self.n_slots = n_slots
         self.chunk = chunk
         self.max_len = cfg.max_seq
-        self.cache = init_cache(cfg, n_slots)
+        # under a serving mesh the pool cache takes the SAME placement as
+        # the prefill cache (slot axis over dp/fsdp, kv heads over tp) so
+        # the pooled decode compiles as one SPMD program — row caches
+        # written in from prefill already live on the same mesh
+        self._cache_shardings = cache_shardings
+        self.cache = self._place(init_cache(cfg, n_slots))
+        self._n_params = n_params
+        self._peak = peak_flops
+        self._model = model
         # donate the cache through both ops: the pool cache is the largest
         # live buffer and must be updated in place, not copied per chunk
         self._decode = jax.jit(
@@ -108,6 +121,16 @@ class DecodePool:
             if metrics is not None
             else None
         )
+        self._mfu_gauge = self._tokens_counter = None
+        if metrics is not None and n_params and peak_flops:
+            self._mfu_gauge = metrics.gauge(
+                "gofr_tpu_mfu",
+                "model FLOPs utilization of the last dispatch (2*N*tokens/time/peak)",
+                labels=("model", "op"),
+            )
+            self._tokens_counter = metrics.counter(
+                "gofr_tpu_tokens_total", "tokens processed", labels=("model", "op")
+            )
         # warm the [n_slots]-shaped executable NOW: the first pooled request
         # must not compile under the pool lock on the serving path
         toks, self.cache = self._decode(
@@ -116,9 +139,14 @@ class DecodePool:
             jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
         )
         toks.block_until_ready()
-        self.cache = init_cache(cfg, n_slots)  # reset the warmup writes
+        self.cache = self._place(init_cache(cfg, n_slots))  # reset the warmup writes
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+
+    def _place(self, cache: dict) -> dict:
+        if self._cache_shardings is None:
+            return cache
+        return {k: jax.device_put(v, self._cache_shardings[k]) for k, v in cache.items()}
 
     # -- request side --------------------------------------------------------
     def submit(
@@ -196,6 +224,7 @@ class DecodePool:
                 for slot in dispatched:
                     tokens[slot.index, 0] = slot.token
                 self._key, sub = jax.random.split(self._key)
+                dispatch_start = _perf_counter()
                 toks_dev, self.cache = self._decode(
                     self.params, jnp.asarray(tokens), self.cache, sub,
                     jnp.asarray(self._temps), jnp.asarray(self._top_ks),
@@ -204,8 +233,10 @@ class DecodePool:
             # fetch OUTSIDE the lock: submissions land while the chunk's
             # result crosses the link (they join the next chunk)
             toks = np.asarray(toks_dev)
+            dispatch_elapsed = _perf_counter() - dispatch_start
             with self._work:
                 finished = []
+                delivered = 0  # tokens actually owed to requests this chunk
                 for slot in dispatched:
                     emitted = toks[slot.index]
                     room = self.max_len - slot.cache_len  # valid steps this chunk
@@ -219,6 +250,7 @@ class DecodePool:
                                 hit_stop_token = True  # ends stream, not emitted
                                 break
                             slot.out_queue.put(int(t))
+                            delivered += 1  # only tokens a request received
                     slot.remaining -= take
                     # next chunk continues from the LAST decoded token (the
                     # cache advanced the full chunk regardless of take)
@@ -239,6 +271,17 @@ class DecodePool:
                     self._free.append(slot)
                 if self._depth_gauge:
                     self._depth_gauge.set(len(self._active))
+                if self._mfu_gauge is not None and delivered:
+                    from gofr_tpu.tpu.flops import mfu
+
+                    # useful tokens only: steps delivered to requests (NOT
+                    # slots × chunk — trailing discarded steps and garbage
+                    # rows are real compute but not useful throughput)
+                    self._mfu_gauge.set(
+                        mfu(self._n_params, delivered, dispatch_elapsed, self._peak),
+                        model=self._model, op="decode",
+                    )
+                    self._tokens_counter.inc(delivered, model=self._model, op="decode")
 
     def close(self) -> None:
         with self._work:

@@ -4,8 +4,13 @@ batching, health, query logging, metrics.
 Config keys (SURVEY.md §2 #22 TPU-native additions):
 - ``MODEL_NAME``: mlp | bert-tiny | bert-base | tiny | small | llama3-8b |
   llama3-70b (transformer names from gofr_tpu.models.llama.CONFIGS)
-- ``MODEL_PATH``: optional orbax checkpoint dir (absent -> seeded init)
+- ``MODEL_PATH``: optional checkpoint — an HF safetensors file/dir (routed
+  through models/ingest.py) or an orbax dir (absent -> seeded init)
 - ``MODEL_QUANT``: "int8" for weight-only quantized serving
+- ``MODEL_BUCKETS``: comma-separated sequence buckets to compile at boot
+  (default: the SEQ_BUCKETS ladder up to max_seq)
+- ``TPU_BOOT``: "background" boots the stack off-thread; the server
+  accepts immediately and /.well-known/ready reports warmup progress
 - ``BATCH_MAX_SIZE`` / ``BATCH_TIMEOUT_MS``: batcher shape
 - ``TPU_MESH``: multi-chip serving mesh, e.g. "tp=4" (llama3-8b on
   v5e-4: Megatron-sharded weights + tp-sharded KV heads) or "tp=4,dp=4"
@@ -98,36 +103,133 @@ class TPUDevice:
         self._mem_gauge = metrics.gauge(
             "gofr_tpu_device_memory_bytes", "device memory", labels=("kind",)
         )
+        from gofr_tpu.tpu.flops import device_peak_flops
+
+        # MFU denominator = aggregate peak of the chips actually serving
+        # (mesh size under TPU_MESH, else one chip)
+        n_chips = self.mesh.size if self.mesh is not None else 1
+        self.peak_flops = device_peak_flops(str(self.device_kind), self.platform) * n_chips
+        self._mfu_gauge = metrics.gauge(
+            "gofr_tpu_mfu",
+            "model FLOPs utilization of the last dispatch (2*N*tokens/time/peak)",
+            labels=("model", "op"),
+        )
+        self._tokens_counter = metrics.counter(
+            "gofr_tpu_tokens_total", "tokens processed", labels=("model", "op")
+        )
 
         self._decode_chunk_cfg = int(config.get_or_default("DECODE_CHUNK", "8"))
         raw_max_seq = config.get("MODEL_MAX_SEQ")
         self._max_seq_cfg = int(raw_max_seq) if raw_max_seq else None
+        raw_buckets = config.get_or_default("MODEL_BUCKETS", "").strip()
+        # MODEL_BUCKETS="64,512" bounds which sequence buckets exist (each
+        # bucket is one ahead-of-time prefill compile at boot — flagship
+        # boots compile only what they will serve)
+        self._buckets_cfg = (
+            tuple(sorted(int(b) for b in raw_buckets.split(","))) if raw_buckets else None
+        )
+        if self._buckets_cfg and self._buckets_cfg[0] <= 0:
+            raise ValueError(
+                f"MODEL_BUCKETS entries must be positive, got {raw_buckets!r} "
+                "(a zero-width bucket would silently serve empty prefills)"
+            )
         self._pool_enabled = config.get_or_default("DECODE_POOL", "on") != "off"
         self._pool_slots = int(config.get_or_default("DECODE_SLOTS", str(self.max_batch)))
-        self._build_stack()
         self._last_reinit = 0.0
         self._reinit_lock = threading.Lock()
+        # boot status: surfaced by /.well-known/ready and health details so
+        # a slow cold boot (8B-class warmup compiles) is observable, never
+        # indistinguishable from a hang
+        self.boot_status: dict[str, Any] = {"state": "booting", "detail": ""}
+        self._ready = threading.Event()
+        self._boot_error: Optional[BaseException] = None
+        self._closed = False
+        if config.get_or_default("TPU_BOOT", "") == "background":
+            # serve /.well-known/ready (503 warming) while compiles run
+            threading.Thread(
+                target=self._boot, name="gofr-tpu-boot", daemon=True
+            ).start()
+        else:
+            self._boot()
+
+    def _boot(self) -> None:
+        try:
+            self._build_stack()
+        except BaseException as exc:
+            self._boot_error = exc
+            self.boot_status = {"state": "failed", "detail": repr(exc)}
+            self._ready.set()
+            if threading.current_thread().name == "gofr-tpu-boot":
+                self.logger.errorf("TPU boot failed: %r", exc)
+                return
+            raise
+        if self._closed:
+            # the device was closed while the background boot compiled —
+            # tear down the freshly built stack instead of leaking its
+            # worker threads and device buffers
+            self._boot_error = RuntimeError("device closed during boot")
+            self.boot_status = {"state": "closed", "detail": ""}
+            self._teardown_stack()
+            self._ready.set()
+            return
+        self.boot_status = {"state": "ready", "detail": ""}
+        self._ready.set()
+
+    def _teardown_stack(self) -> None:
+        for closer in (
+            lambda: self.batcher.close() if getattr(self, "batcher", None) else None,
+            lambda: self.decode_pool.close() if getattr(self, "decode_pool", None) else None,
+        ):
+            try:
+                closer()
+            except Exception:
+                pass
+
+    # -- readiness (distinct from liveness/health) ---------------------------
+    def ready(self) -> bool:
+        return self._ready.is_set() and self._boot_error is None
+
+    def wait_ready(self, timeout: Optional[float] = None) -> None:
+        """Block until the boot (warmup compiles) finished; re-raise the
+        boot error if it failed. Request paths call this so handlers block
+        (rather than crash) during a background boot."""
+        if not self._ready.wait(timeout):
+            raise TimeoutError(
+                f"TPU boot still {self.boot_status['state']} "
+                f"({self.boot_status['detail']}) after {timeout}s"
+            )
+        if self._boot_error is not None:
+            raise RuntimeError("TPU boot failed") from self._boot_error
 
     def _build_stack(self) -> None:
         """Construct (or reconstruct, on reinit) runner + pool + batcher."""
+        self._boot_progress("building runner (model init / checkpoint load)")
         self.runner = _build_runner(
             self.model_name, self.quant, self.model_path, self.max_batch,
             mesh=self.mesh, decode_chunk=self._decode_chunk_cfg,
-            max_seq=self._max_seq_cfg,
+            max_seq=self._max_seq_cfg, buckets=self._buckets_cfg,
         )
-        self.runner.warmup()
+        self.runner.warmup(progress=self._boot_progress)
         # continuous batching: concurrent decodes share one fixed-shape
-        # dispatch per chunk. Single-chip transformer serving only for now
-        # (a sharded pool cache needs its own placement story); seeded
-        # requests bypass it (device.generate routes them solo).
+        # dispatch per chunk; seeded requests bypass it (device.generate
+        # routes them solo — the per-request key sequence must reproduce).
         self.decode_pool = None
-        if (
-            hasattr(self.runner, "_init_cache")
-            and self.mesh is None
-            and self._pool_enabled
-        ):
+        pool_ok = self._pool_enabled
+        if pool_ok and self.mesh is not None:
+            rows = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
+            if self._pool_slots % rows:
+                self.logger.warnf(
+                    "decode pool disabled: DECODE_SLOTS=%d not divisible by "
+                    "dp*fsdp=%d (pool cache shards its slot axis)",
+                    self._pool_slots, rows,
+                )
+                pool_ok = False
+        if hasattr(self.runner, "_init_cache") and pool_ok:
             from gofr_tpu.tpu.decode_pool import DecodePool
 
+            self._boot_progress(
+                f"warming decode pool ({self._pool_slots} slots)"
+            )
             self.decode_pool = DecodePool(
                 self.runner.params,
                 self.runner.cfg,
@@ -135,6 +237,10 @@ class TPUDevice:
                 n_slots=self._pool_slots,
                 chunk=self.runner.decode_chunk_size,
                 metrics=self.metrics,
+                cache_shardings=getattr(self.runner, "_cache_shardings", None),
+                n_params=getattr(self.runner, "n_params", None),
+                peak_flops=self.peak_flops,
+                model=self.model_name,
             )
         self.batcher = DynamicBatcher(
             self._run_batch,
@@ -144,15 +250,27 @@ class TPUDevice:
             name=self.model_name,
         )
 
+    def _boot_progress(self, detail: str) -> None:
+        """Per-stage boot progress: logged AND surfaced on the readiness
+        endpoint, so an 8B cold boot shows which compile it is on."""
+        if self.boot_status["state"] != "ready":
+            self.boot_status = {"state": "warming", "detail": detail}
+        self.logger.infof("TPU boot [%s]: %s", self.model_name, detail)
+
     # -- handler-facing API --------------------------------------------------
     def infer(self, payload: Any, timeout: float = 60.0) -> Any:
         """Blocking single inference (sync handlers). Payload shape depends
         on the model: MLP -> feature vector; bert -> {"tokens": [...]};
         transformer -> {"tokens": [...]} returning next-token logits argmax."""
+        wait_start = time.perf_counter()
+        self.wait_ready(timeout)
+        # the batcher gets what REMAINS of the caller's deadline (waiting
+        # out a cold boot must not double the timeout budget)
+        remaining = max(0.001, timeout - (time.perf_counter() - wait_start))
         start = time.perf_counter()
         span = get_tracer().start_span(f"tpu-{self.model_name}", activate=False)
         try:
-            result = self.batcher.infer(self._prepare(payload), timeout=timeout)
+            result = self.batcher.infer(self._prepare(payload), timeout=remaining)
             self._observe("infer", "ok", start)
             return result
         except Exception:
@@ -162,6 +280,12 @@ class TPUDevice:
             span.end()
 
     async def infer_async(self, payload: Any) -> Any:
+        if not self._ready.is_set():
+            import asyncio
+
+            await asyncio.get_running_loop().run_in_executor(None, self.wait_ready, 600.0)
+        elif self._boot_error is not None:
+            raise RuntimeError("TPU boot failed") from self._boot_error
         start = time.perf_counter()
         try:
             result = await self.batcher.infer_async(self._prepare(payload))
@@ -189,6 +313,7 @@ class TPUDevice:
         (ops.sampling.Sampler) sets temperature/top-k/top-p — default
         greedy. ``stop_tokens`` (iterable of ids) end generation; the stop
         token itself is not emitted."""
+        self.wait_ready(600.0)
         if isinstance(tokens, str):
             tokens = self._detokenize(tokens)["tokens"]
         start = time.perf_counter()
@@ -272,11 +397,33 @@ class TPUDevice:
 
     def _run_batch(self, payloads: list[Any]) -> list[Any]:
         start = time.perf_counter()
-        results = self.runner.run_batch(payloads)
-        elapsed_us = int((time.perf_counter() - start) * 1e6)
+        span = get_tracer().start_span("tpu-batch", activate=False)
+        try:
+            results = self.runner.run_batch(payloads)
+        finally:
+            elapsed = time.perf_counter() - start
+            # device time per batch as span attributes (SURVEY.md §5
+            # profiling hooks — the always-on cheap signal; full XLA traces
+            # via /admin/profiler)
+            span.set_tag("tpu.batch_size", len(payloads))
+            span.set_tag("tpu.device_time_us", int(elapsed * 1e6))
+            span.set_tag("tpu.model", self.model_name)
+            span.end()
         self.logger.debug(
-            TPULog(self.model_name, "batch", len(payloads), elapsed_us)
+            TPULog(self.model_name, "batch", len(payloads), int(elapsed * 1e6))
         )
+        n_params = getattr(self.runner, "n_params", None)
+        if n_params:
+            from gofr_tpu.tpu.flops import mfu
+
+            # real (un-padded) prompt tokens; payloads are prepared id rows
+            tokens = sum(int(getattr(p, "size", 0)) for p in payloads)
+            if tokens:
+                self._tokens_counter.inc(tokens, model=self.model_name, op="prefill")
+                self._mfu_gauge.set(
+                    mfu(n_params, tokens, elapsed, self.peak_flops),
+                    model=self.model_name, op="prefill",
+                )
         return results
 
     def _observe(self, op: str, status: str, start: float) -> None:
@@ -313,15 +460,13 @@ class TPUDevice:
         # stamp FIRST: a rebuild that fails because the device is still
         # gone must also hold off the next attempt (no rebuild storms)
         self._last_reinit = time.monotonic()
-        for closer in (
-            lambda: self.batcher.close(),
-            lambda: self.decode_pool.close() if self.decode_pool else None,
-        ):
-            try:
-                closer()
-            except Exception:
-                pass  # the old stack may be wedged; rebuild regardless
+        self._teardown_stack()  # the old stack may be wedged; rebuild regardless
         self._build_stack()
+        # a successful rebuild recovers a failed background boot too:
+        # requests unblock and /.well-known/ready flips to 200
+        self._boot_error = None
+        self.boot_status = {"state": "ready", "detail": ""}
+        self._ready.set()
 
     def _maybe_auto_reinit(self) -> bool:
         """At most one automatic rebuild per 30s window — whether the last
@@ -347,6 +492,16 @@ class TPUDevice:
             "device_count": len(self.devices),
             "model": self.model_name,
         }
+        if not self._ready.is_set():
+            # still booting: the device is alive (liveness UP) but not
+            # serving yet — readiness is the /.well-known/ready gate
+            return Health(UP, {**details, "boot": dict(self.boot_status)})
+        if self._boot_error is not None:
+            # failed boot: the same rate-limited rebuild path as device
+            # loss (a transient init failure must not be terminal)
+            if self._maybe_auto_reinit():
+                return Health(UP, {**details, "reinitialized": True})
+            return Health(DOWN, {**details, "boot": dict(self.boot_status)})
         try:
             stats = self.devices[0].memory_stats() or {}
             used = stats.get("bytes_in_use")
@@ -379,9 +534,8 @@ class TPUDevice:
         return bool(np.asarray(probe).sum() == 8.0)
 
     def close(self) -> None:
-        self.batcher.close()
-        if getattr(self, "decode_pool", None) is not None:
-            self.decode_pool.close()
+        self._closed = True  # an in-flight background boot self-tears-down
+        self._teardown_stack()
 
 
 def new_device(config: Any, logger: Any, metrics: Any) -> TPUDevice:
@@ -452,9 +606,11 @@ class _MLPRunner:
         out = np.asarray(self._fwd(self.params, jnp.asarray(batch)))
         return [out[i] for i in range(n)]
 
-    def warmup(self) -> None:
+    def warmup(self, progress: Any = None) -> None:
         b = 1
         while b <= next_pow2(self.max_batch):
+            if progress:
+                progress(f"compiling mlp forward (batch {b})")
             self._fwd(self.params, jnp.zeros((b, self.cfg.in_dim))).block_until_ready()
             b *= 2
 
@@ -504,9 +660,11 @@ class _BertRunner:
         out = np.asarray(self._embed(self.params, jnp.asarray(batch), jnp.asarray(mask)))
         return [out[i] for i in range(n)]
 
-    def warmup(self) -> None:
+    def warmup(self, progress: Any = None) -> None:
         b = 1
         while b <= next_pow2(self.max_batch):
+            if progress:
+                progress(f"compiling bert embed (batch {b})")
             t = jnp.zeros((b, self.bucket), jnp.int32)
             m = jnp.ones((b, self.bucket), jnp.int32)
             self._embed(self.params, t, m).block_until_ready()
@@ -536,6 +694,7 @@ class _TransformerRunner:
         mesh: Optional[Any] = None,
         decode_chunk: int = 8,
         max_seq: Optional[int] = None,
+        buckets: Optional[tuple[int, ...]] = None,
     ):
         self.max_batch = max_batch
         from gofr_tpu.models.llama import CONFIGS
@@ -557,7 +716,13 @@ class _TransformerRunner:
 
             self.cfg = dataclasses.replace(self.cfg, max_seq=max_seq)
         self.decode_chunk_size = decode_chunk
-        if model_path:
+        from gofr_tpu.models.ingest import is_safetensors_path, load_llama_params
+
+        if model_path and is_safetensors_path(model_path):
+            # HF checkpoint: quantization happens DURING load (one layer in
+            # flight), same peak-memory contract as quantize-during-init
+            self.params = load_llama_params(model_path, self.cfg, quantize=quant)
+        elif model_path:
             params = _load_or_init(
                 model_path, lambda: init_transformer(jax.random.key(0), self.cfg)
             )
@@ -616,7 +781,11 @@ class _TransformerRunner:
             ),
             static_argnums=(7,),
         )
-        self.buckets = [b for b in self.SEQ_BUCKETS if b <= cfg.max_seq] or [cfg.max_seq]
+        from gofr_tpu.tpu.flops import transformer_param_count
+
+        self.n_params = transformer_param_count(cfg)
+        bucket_source = buckets if buckets else self.SEQ_BUCKETS
+        self.buckets = [b for b in bucket_source if b <= cfg.max_seq] or [cfg.max_seq]
         # preallocated zero caches per batch size: prefill never mutates its
         # input cache, so one shared zero cache per bsz removes per-batch
         # allocation dispatches (the tunneled device link makes every
@@ -798,12 +967,17 @@ class _TransformerRunner:
             cache_len += n
         return out
 
-    def warmup(self) -> None:
+    def warmup(self, progress: Any = None) -> None:
         # one compiled prefill per sequence bucket (batch fixed at
         # max_batch), plus the b=1 decode step — nothing compiles on the
         # serving path afterwards
         b = next_pow2(self.max_batch)
-        for bucket in self.buckets:
+        for i, bucket in enumerate(self.buckets):
+            if progress:
+                progress(
+                    f"compiling prefill bucket {bucket} (batch {b}, "
+                    f"{i + 1}/{len(self.buckets)})"
+                )
             cache = self._zero_cache(b)
             tokens = jnp.zeros((b, bucket), jnp.int32)
             lengths = jnp.ones((b,), jnp.int32)
@@ -815,10 +989,14 @@ class _TransformerRunner:
                 lengths = jax.device_put(lengths, self._row_sharding)
             logits, next_ids, cache = self._prefill(self.params, tokens, cache, lengths)
             next_ids.block_until_ready()
+        if progress:
+            progress("compiling decode step")
         one = _slice_cache(cache, 0)
         step, _ = self._decode(self.params, jnp.zeros((1, 1), jnp.int32), one)
         step.block_until_ready()
         # warm the full decode chunk (remainder sizes compile on demand)
+        if progress:
+            progress(f"compiling decode chunk ({self.decode_chunk_size} steps)")
         toks, _ = self._decode_chunk(
             self.params, jnp.zeros((1, 1), jnp.int32), one,
             jax.random.key(0), 0.0, 0, 1.0, self.decode_chunk_size,
@@ -885,6 +1063,7 @@ def _build_runner(
     mesh: Optional[Any] = None,
     decode_chunk: int = 8,
     max_seq: Optional[int] = None,
+    buckets: Optional[tuple[int, ...]] = None,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
@@ -895,7 +1074,7 @@ def _build_runner(
     if name in CONFIGS:
         return _TransformerRunner(
             name, quant, model_path, max_batch, mesh=mesh,
-            decode_chunk=decode_chunk, max_seq=max_seq,
+            decode_chunk=decode_chunk, max_seq=max_seq, buckets=buckets,
         )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "

@@ -103,6 +103,34 @@ def test_default_routes(app):
         assert e.code == 404
 
 
+def test_readiness_route(app):
+    """/.well-known/ready is distinct from health: 200 once serving, 503
+    with the current boot stage while the TPU stack warms up."""
+    app.start()
+    base = f"http://127.0.0.1:{app.http_port}"
+    status, body, _ = _get(base + "/.well-known/ready")
+    assert status == 200
+    assert json.loads(body) == {"state": "ready"}  # no TPU: ready at listen
+
+    class Warming:
+        boot_status = {"state": "warming", "detail": "compiling prefill bucket 64"}
+
+        def ready(self):
+            return False
+
+    app.container.tpu = Warming()
+    try:
+        urllib.request.urlopen(base + "/.well-known/ready", timeout=5)
+        raise AssertionError("expected 503")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        payload = json.loads(e.read())
+        assert payload["state"] == "warming"
+        assert "bucket 64" in payload["detail"]
+    finally:
+        app.container.tpu = None
+
+
 def test_post_bind_and_raw(app):
     def create(ctx):
         data = ctx.bind()

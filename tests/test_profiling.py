@@ -1,0 +1,129 @@
+"""Device profiler: jax trace capture via the admin endpoints and the
+per-batch device-time span tags (SURVEY.md §5 profiling hooks)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import gofr_tpu
+from gofr_tpu.profiling import Profiler
+
+
+def test_profiler_lifecycle(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    p = Profiler()
+    assert p.status() == {"state": "idle"}
+    out = p.start(str(tmp_path / "trace"))
+    assert out["state"] == "tracing"
+    # some device work so the trace has content
+    jnp.ones((8, 8)).sum().block_until_ready()
+    jax.effects_barrier()
+    assert p.status()["state"] == "tracing"
+    stopped = p.stop()
+    assert stopped["state"] == "stopped"
+    assert stopped["artifacts"], "trace capture produced no artifact files"
+    assert p.status() == {"state": "idle"}
+
+
+def test_profiler_double_start_rejected(tmp_path):
+    p = Profiler()
+    p.start(str(tmp_path / "t"))
+    with pytest.raises(RuntimeError, match="already tracing"):
+        p.start(str(tmp_path / "t2"))
+    p.stop()
+    with pytest.raises(RuntimeError, match="not tracing"):
+        p.stop()
+
+
+@pytest.fixture
+def app(free_port, monkeypatch, tmp_path):
+    monkeypatch.setenv("HTTP_PORT", str(free_port()))
+    monkeypatch.setenv("LOG_LEVEL", "FATAL")
+    for key in ("REDIS_HOST", "DB_NAME", "DB_HOST", "TPU_ENABLED", "MODEL_NAME"):
+        monkeypatch.delenv(key, raising=False)
+    monkeypatch.chdir(tmp_path)
+    application = gofr_tpu.new()
+    yield application
+    application.shutdown()
+
+
+def test_admin_profiler_endpoints(app, tmp_path):
+    app.start()
+    base = f"http://127.0.0.1:{app.http_port}"
+
+    def call(method, path, body=None):
+        req = urllib.request.Request(
+            base + path, method=method,
+            data=json.dumps(body).encode() if body else None,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())["data"]
+
+    assert call("GET", "/admin/profiler") == {"state": "idle"}
+    trace_dir = str(tmp_path / "prof")
+    started = call("POST", "/admin/profiler/start", {"dir": trace_dir})
+    assert started["state"] == "tracing" and started["dir"] == trace_dir
+    # duplicate start -> 409, not a crash
+    try:
+        call("POST", "/admin/profiler/start")
+        raise AssertionError("expected 409")
+    except urllib.error.HTTPError as e:
+        assert e.code == 409
+    import jax.numpy as jnp
+
+    jnp.ones((4, 4)).sum().block_until_ready()
+    stopped = call("POST", "/admin/profiler/stop")
+    assert stopped["state"] == "stopped"
+    assert stopped["artifacts"]
+    assert call("GET", "/admin/profiler") == {"state": "idle"}
+
+
+def test_batch_span_tags(monkeypatch):
+    """Every dispatched batch records device time on a tpu-batch span."""
+    import os
+
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.logging import Level
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.testutil import MockLogger
+    from gofr_tpu.tpu.device import new_device
+    from gofr_tpu.tracing import get_tracer
+
+    env = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2", "BATCH_TIMEOUT_MS": "1",
+           "DECODE_POOL": "off"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    spans = []
+    unpatch = None
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        tracer = get_tracer()
+        orig = tracer.start_span
+
+        def spy(name, **kw):
+            span = orig(name, **kw)
+            if name == "tpu-batch":
+                spans.append(span)
+            return span
+
+        tracer.start_span = spy
+        unpatch = lambda: setattr(tracer, "start_span", orig)  # noqa: E731
+        try:
+            device.infer({"tokens": [1, 2, 3]})
+            assert spans, "no tpu-batch span recorded"
+            tags = spans[-1].tags
+            assert tags["tpu.batch_size"] == "1"
+            assert int(tags["tpu.device_time_us"]) > 0
+            assert tags["tpu.model"] == "tiny"
+        finally:
+            device.close()
+    finally:
+        if unpatch:
+            unpatch()
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)

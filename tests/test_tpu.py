@@ -349,6 +349,107 @@ def test_auto_reinit_rate_limited(tiny_device):
     assert tiny_device._maybe_auto_reinit() is False  # within the 30s window
 
 
+def test_model_buckets_limits_warmup_compiles():
+    import os
+
+    env = {"MODEL_NAME": "tiny", "MODEL_BUCKETS": "64", "BATCH_MAX_SIZE": "2",
+           "BATCH_TIMEOUT_MS": "1"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            assert device.runner.buckets == [64]
+            out = device.generate([1, 2, 3], max_new_tokens=4)
+            assert len(out) == 4
+        finally:
+            device.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_mfu_gauge_and_token_counter(tiny_device):
+    tiny_device.infer({"tokens": [1, 2, 3, 4, 5]})
+    text = tiny_device.metrics.expose()
+    assert 'gofr_tpu_mfu{model="tiny",op="prefill"}' in text
+    assert 'gofr_tpu_tokens_total{model="tiny",op="prefill"}' in text
+    from gofr_tpu.tpu.flops import transformer_param_count
+
+    # analytic count matches the materialized tree
+    import jax
+
+    n_leaf = sum(
+        int(np.prod(x.shape))
+        for x in jax.tree.leaves(tiny_device.runner.params)
+    )
+    assert transformer_param_count(tiny_device.runner.cfg) == n_leaf
+
+
+def test_background_boot_and_readiness():
+    import os
+
+    env = {"MODEL_NAME": "tiny", "TPU_BOOT": "background", "BATCH_MAX_SIZE": "2",
+           "BATCH_TIMEOUT_MS": "1"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            # health is UP (alive) even before ready; requests block until
+            # warm instead of crashing
+            assert device.health_check().status == "UP"
+            out = device.generate([1, 2, 3], max_new_tokens=4)
+            assert len(out) == 4
+            assert device.ready()
+            assert device.boot_status["state"] == "ready"
+        finally:
+            device.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_failed_background_boot_recovers(monkeypatch):
+    """A transient init failure in a background boot is not terminal: the
+    health check's rate-limited rebuild path recovers the stack and flips
+    readiness back."""
+    import os
+
+    import gofr_tpu.tpu.device as device_mod
+
+    calls = {"n": 0}
+    orig = device_mod._build_runner
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient init failure")
+        return orig(*a, **k)
+
+    monkeypatch.setattr(device_mod, "_build_runner", flaky)
+    env = {"MODEL_NAME": "tiny", "TPU_BOOT": "background", "BATCH_MAX_SIZE": "2",
+           "BATCH_TIMEOUT_MS": "1", "DECODE_POOL": "off"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            assert device._ready.wait(30)
+            assert not device.ready()
+            assert device.boot_status["state"] == "failed"
+            device._last_reinit = -1e9  # bypass the 30s rate limit for the test
+            h = device.health_check()
+            assert h.status == "UP" and h.details.get("reinitialized")
+            assert device.ready()
+            assert len(device.generate([1, 2, 3], max_new_tokens=3)) == 3
+        finally:
+            device.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
 def test_model_max_seq_bounds_cache():
     import os
 

@@ -89,6 +89,43 @@ def test_dp_tp_mesh_infer():
         d.close()
 
 
+def test_pool_active_under_mesh(sharded):
+    # continuous batching no longer disabled by a mesh (round-2 verdict #4)
+    assert sharded.decode_pool is not None
+
+
+def test_pooled_sharded_matches_solo_sharded(sharded):
+    solo = _device(MODEL_NAME="tiny", BATCH_MAX_SIZE="4", BATCH_TIMEOUT_MS="1",
+                   TPU_MESH="tp=2", DECODE_POOL="off")
+    try:
+        assert solo.decode_pool is None
+        a = solo.generate(PROMPT["tokens"], max_new_tokens=8)
+    finally:
+        solo.close()
+    b = sharded.generate(PROMPT["tokens"], max_new_tokens=8)
+    assert a == b
+
+
+def test_pooled_generate_under_dp_mesh():
+    d = _device(MODEL_NAME="tiny", BATCH_MAX_SIZE="4", BATCH_TIMEOUT_MS="1",
+                TPU_MESH="tp=2,dp=2", DECODE_SLOTS="4")
+    try:
+        assert d.decode_pool is not None  # 4 slots over dp*fsdp=2
+        out = d.generate(PROMPT["tokens"], max_new_tokens=6)
+        assert len(out) == 6
+    finally:
+        d.close()
+
+
+def test_pool_disabled_on_indivisible_slots():
+    d = _device(MODEL_NAME="tiny", BATCH_MAX_SIZE="4", BATCH_TIMEOUT_MS="1",
+                TPU_MESH="tp=2,dp=4", DECODE_SLOTS="3")
+    try:
+        assert d.decode_pool is None  # 3 slots can't shard over dp=4
+    finally:
+        d.close()
+
+
 def test_kv_head_divisibility_enforced():
     with pytest.raises(ValueError, match="n_kv_heads"):
         _device(MODEL_NAME="tiny", TPU_MESH="tp=4")  # tiny has 2 kv heads
