@@ -195,29 +195,32 @@ class DB:
             self._conns.clear()
 
 
-def new_sql(config: Any, logger: Any = None) -> DB:
+def new_sql(config: Any, logger: Any = None) -> Any:
     """Config-driven constructor (parity: sql/sql.go:19-38).
 
     DB_DIALECT=sqlite (default): DB_NAME is the database path (or
-    ``:memory:``). DB_DIALECT=mysql requires a MySQL DB-API driver, which
-    this environment does not ship — raising keeps the container's
-    degraded-startup contract."""
+    ``:memory:``). DB_DIALECT=mysql: the from-scratch wire-protocol client
+    (datasource/mysql.py) over DB_HOST/DB_PORT/DB_USER/DB_PASSWORD/DB_NAME
+    — the same env keys the reference DSN uses (sql.go:19-37). Connect
+    failures raise; the container logs and degrades."""
     dialect = (config.get_or_default("DB_DIALECT", "sqlite") or "sqlite").lower()
     if dialect == "sqlite":
         name = config.get_or_default("DB_NAME", ":memory:")
         return DB(name, logger)
     if dialect == "mysql":
-        try:
-            import MySQLdb  # noqa: F401  (not shipped; documents the gate)
-        except ImportError as exc:
-            raise RuntimeError(
-                "DB_DIALECT=mysql requires a MySQL driver (MySQLdb/pymysql); "
-                "none is installed — use DB_DIALECT=sqlite"
-            ) from exc
-        raise RuntimeError("mysql dialect wiring not implemented in this build")
+        from gofr_tpu.datasource.mysql import MySQLDB
+
+        return MySQLDB(
+            host=config.get_or_default("DB_HOST", "127.0.0.1"),
+            port=int(config.get_or_default("DB_PORT", "3306")),
+            user=config.get_or_default("DB_USER", "root"),
+            password=config.get_or_default("DB_PASSWORD", ""),
+            database=config.get_or_default("DB_NAME", ""),
+            logger=logger,
+        )
     raise RuntimeError(f"unsupported DB_DIALECT '{dialect}'")
 
 
-def new_mysql(config: Any, logger: Any = None) -> DB:
+def new_mysql(config: Any, logger: Any = None) -> Any:
     """Parity alias: sql.go:19 NewMYSQL."""
     return new_sql(config, logger)

@@ -113,8 +113,12 @@ def test_new_sql_dialect_gating(monkeypatch, tmp_path):
     database.execute("CREATE TABLE t (x INTEGER)")
     database.close()
 
+    # mysql dialect routes to the wire-protocol client (tests/test_mysql.py
+    # covers it against minimysql); a dead port surfaces as a connect error
     monkeypatch.setenv("DB_DIALECT", "mysql")
-    with pytest.raises(RuntimeError, match="MySQL driver"):
+    monkeypatch.setenv("DB_HOST", "127.0.0.1")
+    monkeypatch.setenv("DB_PORT", "1")
+    with pytest.raises(OSError):
         new_sql(EnvConfig(), MockLogger())
 
     monkeypatch.setenv("DB_DIALECT", "cockroach")
