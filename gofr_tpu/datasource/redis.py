@@ -57,7 +57,8 @@ class _Connection:
             pass
 
     # -- RESP2 wire format ---------------------------------------------------
-    def send_command(self, args: tuple) -> None:
+    @staticmethod
+    def encode_command(args: tuple) -> bytes:
         out = [b"*%d\r\n" % len(args)]
         for arg in args:
             if isinstance(arg, bytes):
@@ -69,7 +70,10 @@ class _Connection:
             else:
                 data = str(arg).encode("utf-8")
             out.append(b"$%d\r\n%s\r\n" % (len(data), data))
-        self.sock.sendall(b"".join(out))
+        return b"".join(out)
+
+    def send_command(self, args: tuple) -> None:
+        self.sock.sendall(self.encode_command(args))
 
     def _read_line(self) -> bytes:
         while b"\r\n" not in self.buf:
@@ -111,7 +115,56 @@ class _Connection:
         raise RedisError(f"unexpected RESP type: {line[:32]!r}")
 
 
-class RedisClient:
+class _Commands:
+    """Command surface shared by the client (immediate execution) and
+    Pipeline (queued execution): each method routes through ``_do``."""
+
+    def _do(self, *args: Any) -> Any:
+        raise NotImplementedError
+
+    def get(self, key: str) -> Any:
+        return self._do("GET", key)
+
+    def set(self, key: str, value: Any, ex: Optional[int] = None) -> Any:
+        if ex is not None:
+            return self._do("SET", key, value, "EX", ex)
+        return self._do("SET", key, value)
+
+    def delete(self, *keys: str) -> int:
+        return self._do("DEL", *keys)
+
+    def exists(self, *keys: str) -> int:
+        return self._do("EXISTS", *keys)
+
+    def incr(self, key: str) -> int:
+        return self._do("INCR", key)
+
+    def expire(self, key: str, seconds: int) -> int:
+        return self._do("EXPIRE", key, seconds)
+
+    def ttl(self, key: str) -> int:
+        return self._do("TTL", key)
+
+    def keys(self, pattern: str = "*") -> list:
+        return self._do("KEYS", pattern)
+
+    def hset(self, key: str, field: str, value: Any) -> int:
+        return self._do("HSET", key, field, value)
+
+    def hget(self, key: str, field: str) -> Any:
+        return self._do("HGET", key, field)
+
+    def lpush(self, key: str, *values: Any) -> int:
+        return self._do("LPUSH", key, *values)
+
+    def rpop(self, key: str) -> Any:
+        return self._do("RPOP", key)
+
+    def flushdb(self) -> Any:
+        return self._do("FLUSHDB")
+
+
+class RedisClient(_Commands):
     """Thread-safe pooled client. Commands return decoded replies (bulk
     strings as ``str`` where valid UTF-8, else bytes)."""
 
@@ -190,50 +243,66 @@ class RedisClient:
             return [self._decode(r) for r in reply]
         return reply
 
-    # -- convenience commands (the surface the examples use) ------------------
-    def get(self, key: str) -> Any:
-        return self.execute("GET", key)
-
-    def set(self, key: str, value: Any, ex: Optional[int] = None) -> Any:
-        if ex is not None:
-            return self.execute("SET", key, value, "EX", ex)
-        return self.execute("SET", key, value)
-
-    def delete(self, *keys: str) -> int:
-        return self.execute("DEL", *keys)
-
-    def exists(self, *keys: str) -> int:
-        return self.execute("EXISTS", *keys)
-
-    def incr(self, key: str) -> int:
-        return self.execute("INCR", key)
-
-    def expire(self, key: str, seconds: int) -> int:
-        return self.execute("EXPIRE", key, seconds)
-
-    def ttl(self, key: str) -> int:
-        return self.execute("TTL", key)
-
-    def keys(self, pattern: str = "*") -> list:
-        return self.execute("KEYS", pattern)
-
-    def hset(self, key: str, field: str, value: Any) -> int:
-        return self.execute("HSET", key, field, value)
-
-    def hget(self, key: str, field: str) -> Any:
-        return self.execute("HGET", key, field)
-
-    def lpush(self, key: str, *values: Any) -> int:
-        return self.execute("LPUSH", key, *values)
-
-    def rpop(self, key: str) -> Any:
-        return self.execute("RPOP", key)
-
-    def flushdb(self) -> Any:
-        return self.execute("FLUSHDB")
+    # -- convenience commands route through _Commands -------------------------
+    def _do(self, *args: Any) -> Any:
+        return self.execute(*args)
 
     def ping(self) -> bool:
         return self.execute("PING") == "PONG"
+
+    # -- pipelining (parity: redis/hook.go:38-58 logs pipelined batches) ------
+    def pipeline(self) -> "Pipeline":
+        """Queue commands and flush them in ONE round trip::
+
+            with r.pipeline() as p:
+                p.set("a", 1)
+                p.incr("counter")
+            # p.results == ["OK", 2]
+
+        or explicitly: ``results = p.execute()``."""
+        return Pipeline(self)
+
+    def _execute_pipeline(self, cmds: list[tuple], raise_on_error: bool) -> list:
+        """Send every queued command in one write, then read all replies —
+        one round trip total. Per-command server errors are captured (all
+        replies are always drained) and re-raised after the batch unless
+        ``raise_on_error=False``."""
+        if not cmds:
+            return []
+        summary = f"pipeline[{len(cmds)}] " + " | ".join(
+            " ".join(str(a) for a in cmd)[:48] for cmd in cmds[:8]
+        )
+        start = time.perf_counter()
+        span = get_tracer().start_span("redis-pipeline", activate=False)
+        span.set_tag("db.system", "redis")
+        span.set_tag("db.statement", summary[:256])
+        span.set_tag("db.redis.pipeline_length", len(cmds))
+        conn = self._get()
+        try:
+            conn.sock.sendall(b"".join(_Connection.encode_command(c) for c in cmds))
+            replies: list[Any] = []
+            for _ in cmds:
+                try:
+                    replies.append(conn.read_reply())
+                except RedisServerError as exc:
+                    replies.append(exc)
+            self._put(conn)
+        except (OSError, RedisError) as exc:
+            conn.close()
+            raise RedisError(f"redis pipeline: {exc}") from exc
+        finally:
+            span.end()
+            if self.logger is not None:
+                elapsed_us = int((time.perf_counter() - start) * 1e6)
+                self.logger.debug(RedisLog(command=summary[:128], duration_us=elapsed_us))
+        results = [
+            r if isinstance(r, RedisServerError) else self._decode(r) for r in replies
+        ]
+        if raise_on_error:
+            for r in results:
+                if isinstance(r, RedisServerError):
+                    raise r
+        return results
 
     # -- health (parity: redis/health.go:10-30) -------------------------------
     def health_check(self) -> Health:
@@ -260,6 +329,40 @@ class RedisClient:
                 self._pool.get_nowait().close()
             except queue.Empty:
                 break
+
+
+class Pipeline(_Commands):
+    """Queued command batch; ``execute()`` (or clean ``with``-exit) flushes
+    everything in one round trip. Command methods return the pipeline for
+    chaining; replies come back as a list in command order."""
+
+    def __init__(self, client: RedisClient):
+        self._client = client
+        self._cmds: list[tuple] = []
+        self.results: Optional[list] = None
+
+    def _do(self, *args: Any) -> "Pipeline":
+        self._cmds.append(args)
+        return self
+
+    def command(self, *args: Any) -> "Pipeline":
+        """Queue an arbitrary command (the generic escape hatch)."""
+        return self._do(*args)
+
+    def __len__(self) -> int:
+        return len(self._cmds)
+
+    def execute(self, raise_on_error: bool = True) -> list:
+        cmds, self._cmds = self._cmds, []
+        self.results = self._client._execute_pipeline(cmds, raise_on_error)
+        return self.results
+
+    def __enter__(self) -> "Pipeline":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.execute()
 
 
 def new_client(host: str, port: int = 6379, logger: Any = None) -> RedisClient:

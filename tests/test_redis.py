@@ -110,3 +110,50 @@ def test_concurrent_clients(client):
     for t in threads:
         t.join()
     assert not errors
+
+
+# -- pipelining (parity: redis/hook.go:38-58 pipeline logging) ----------------
+
+def test_pipeline_one_round_trip_in_order(client):
+    c, logger = client
+    p = c.pipeline()
+    p.set("pa", "1").incr("pc").get("pa").command("ECHO", "hi")
+    assert len(p) == 4
+    results = p.execute()
+    assert results == ["OK", 1, "1", "hi"]
+    assert p.results == results
+    assert len(p) == 0  # queue drained
+    assert "pipeline[4]" in logger.output  # batched RedisLog entry
+
+
+def test_pipeline_context_manager(client):
+    c, _ = client
+    with c.pipeline() as p:
+        p.set("cm", "x")
+        p.get("cm")
+    assert p.results == ["OK", "x"]
+
+
+def test_pipeline_error_drains_and_raises(client):
+    c, _ = client
+    p = c.pipeline()
+    p.set("pe", "v").command("INCR", "pe").get("pe")
+    with pytest.raises(RedisServerError):
+        p.execute()
+    # all replies were drained: the connection stays usable
+    assert c.get("pe") == "v"
+
+
+def test_pipeline_errors_returned_when_not_raising(client):
+    c, _ = client
+    p = c.pipeline()
+    p.set("pr", "v").command("INCR", "pr").get("pr")
+    results = p.execute(raise_on_error=False)
+    assert results[0] == "OK"
+    assert isinstance(results[1], RedisServerError)
+    assert results[2] == "v"
+
+
+def test_empty_pipeline(client):
+    c, _ = client
+    assert c.pipeline().execute() == []
