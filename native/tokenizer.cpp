@@ -87,8 +87,9 @@ struct Tokenizer {
         };
         std::priority_queue<Cand, std::vector<Cand>, std::greater<Cand>> heap;
         auto push_cand = [&](int64_t i) {
+            if (i < 0) return;  // leftmost symbol has prev == -1
             int64_t j = next[i];
-            if (i < 0 || j < 0) return;
+            if (j < 0) return;
             auto it = ranks.find({ids[i], ids[j]});
             if (it != ranks.end()) heap.push({it->second, i, ids[i], ids[j]});
         };

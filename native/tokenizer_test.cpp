@@ -1,0 +1,112 @@
+// Threaded sanity/race harness for the native boundary (tokenizer + batch
+// packing). Built with -fsanitize=thread in CI (the framework's analogue of
+// `go test -race`, which the reference pipeline omits — SURVEY.md §5): a
+// shared Tokenizer handle is exercised from many threads exactly as the
+// serving process does (one handle, per-request encode/decode on handler
+// threads; pack_rows on the batcher thread), with results checked against a
+// single-threaded reference.
+//
+// Build:  g++ -std=c++17 -O1 -g -fsanitize=thread   tokenizer.cpp tokenizer_test.cpp -o tok_test -lpthread
+//    or:  g++ -std=c++17 -O1 -g -fsanitize=undefined tokenizer.cpp tokenizer_test.cpp -o tok_test -lpthread
+// Run: ./tok_test   (exit 0 = clean; sanitizer reports fail the process)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* gofr_tok_new(const char* merges, int64_t merges_len, int32_t n_special);
+void gofr_tok_free(void* handle);
+int32_t gofr_tok_vocab_size(void* handle);
+int64_t gofr_tok_encode(void* handle, const uint8_t* text, int64_t text_len,
+                        int32_t* out, int64_t out_cap);
+int64_t gofr_tok_decode(void* handle, const int32_t* ids, int64_t n,
+                        uint8_t* out, int64_t out_cap);
+void gofr_pack_rows(const int32_t* flat, const int64_t* row_lens, int64_t n_rows,
+                    int64_t width, int32_t pad_id, int32_t* out, int32_t* out_lens);
+}
+
+namespace {
+
+constexpr int kThreads = 8;
+constexpr int kIters = 400;
+
+std::vector<int32_t> encode(void* tok, const std::string& text) {
+    std::vector<int32_t> ids(text.size() + 1);
+    int64_t n = gofr_tok_encode(tok, reinterpret_cast<const uint8_t*>(text.data()),
+                                static_cast<int64_t>(text.size()), ids.data(),
+                                static_cast<int64_t>(ids.size()));
+    ids.resize(static_cast<size_t>(n));
+    return ids;
+}
+
+std::string decode(void* tok, const std::vector<int32_t>& ids) {
+    std::vector<uint8_t> buf(ids.size() * 8 + 1);
+    int64_t n = gofr_tok_decode(tok, ids.data(), static_cast<int64_t>(ids.size()),
+                                buf.data(), static_cast<int64_t>(buf.size()));
+    return std::string(reinterpret_cast<char*>(buf.data()), static_cast<size_t>(n));
+}
+
+}  // namespace
+
+int main() {
+    // a few byte-pair merges over ASCII so encode actually merges
+    const char* merges = "116 104\n256 101\n32 257\n101 32\n111 110\n";
+    void* tok = gofr_tok_new(merges, static_cast<int64_t>(strlen(merges)), 3);
+    if (tok == nullptr) {
+        fprintf(stderr, "gofr_tok_new failed\n");
+        return 1;
+    }
+
+    const std::string texts[] = {
+        "the quick brown fox jumps over the lazy dog",
+        "on the theory of everything, then and now",
+        std::string(512, 'a') + " the end",
+    };
+    // single-threaded reference results
+    std::vector<std::vector<int32_t>> ref_ids;
+    std::vector<std::string> ref_text;
+    for (const auto& t : texts) {
+        ref_ids.push_back(encode(tok, t));
+        ref_text.push_back(decode(tok, ref_ids.back()));
+    }
+
+    int failures = 0;
+    std::vector<std::thread> threads;
+    threads.reserve(kThreads);
+    for (int w = 0; w < kThreads; ++w) {
+        threads.emplace_back([&, w] {
+            for (int i = 0; i < kIters; ++i) {
+                const size_t which = static_cast<size_t>((w + i) % 3);
+                auto ids = encode(tok, texts[which]);
+                if (ids != ref_ids[which]) {
+                    __atomic_fetch_add(&failures, 1, __ATOMIC_SEQ_CST);
+                }
+                if (decode(tok, ids) != ref_text[which]) {
+                    __atomic_fetch_add(&failures, 1, __ATOMIC_SEQ_CST);
+                }
+                // pack_rows with thread-local buffers (the batcher calls it
+                // with its own arrays; the shared state is only the code)
+                int32_t flat[6] = {1, 2, 3, 4, 5, 6};
+                int64_t lens[2] = {4, 2};
+                int32_t out[2 * 4];
+                int32_t out_lens[2];
+                gofr_pack_rows(flat, lens, 2, 4, 0, out, out_lens);
+                if (out_lens[0] != 4 || out_lens[1] != 2 || out[4] != 5) {
+                    __atomic_fetch_add(&failures, 1, __ATOMIC_SEQ_CST);
+                }
+            }
+        });
+    }
+    for (auto& t : threads) t.join();
+    gofr_tok_free(tok);
+    if (failures != 0) {
+        fprintf(stderr, "tokenizer_test: %d mismatches under concurrency\n", failures);
+        return 1;
+    }
+    printf("tokenizer_test: OK (%d threads x %d iters)\n", kThreads, kIters);
+    return 0;
+}
