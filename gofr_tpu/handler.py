@@ -43,9 +43,15 @@ def make_endpoint(func: Handler, container: Any) -> Callable:
                 else:
                     loop = asyncio.get_running_loop()
                     # propagate the active span (contextvars) into the worker
-                    # thread so ctx.trace_id / child spans nest correctly
+                    # thread so ctx.trace_id / child spans nest correctly.
+                    # The container's dedicated pool, NOT the loop default:
+                    # sync handlers block (generations run seconds) and the
+                    # default executor is cpu_count+4 threads — it silently
+                    # serializes requests on small serving VMs.
                     call = contextvars.copy_context().run
-                    result = await loop.run_in_executor(None, call, func, ctx)
+                    result = await loop.run_in_executor(
+                        container.handler_executor, call, func, ctx
+                    )
                 error = None
             except Exception as exc:  # handler errors -> enveloped response
                 result, error = None, exc
@@ -53,7 +59,7 @@ def make_endpoint(func: Handler, container: Any) -> Callable:
             # unknown errors are 500s; log them (parity with the reference's
             # responder hiding internals behind a generic message)
             container.logger.errorf("handler error on %s %s: %r", request.method, request.path, error)
-        return respond(result, error)
+        return respond(result, error, executor=container.handler_executor)
 
     return endpoint
 

@@ -43,21 +43,25 @@ def _frame_sse(item: Any) -> bytes:
     return ("data: " + data + "\n\n").encode("utf-8")
 
 
-async def _sse_iter(stream: Stream) -> AsyncIterator[bytes]:
+async def _sse_iter(stream: Stream, executor: Any = None) -> AsyncIterator[bytes]:
     events = stream.events
     if hasattr(events, "__aiter__"):
         async for item in events:  # type: ignore[union-attr]
             yield _frame_sse(item) if stream.sse else _to_bytes(item)
     else:
         # Sync generators (e.g. blocking token decode) must not stall the
-        # event loop between yields; pull each item on a worker thread.
+        # event loop between yields; pull each item on a worker thread —
+        # the CALLER-provided pool (container.handler_executor), because a
+        # stream's blocking next() holds its thread for the full
+        # inter-token wait and asyncio's cpu_count+4 default executor
+        # caps concurrent streams at a handful on small serving VMs.
         import asyncio
 
         loop = asyncio.get_running_loop()
         iterator = iter(events)  # type: ignore[arg-type]
         sentinel = object()
         while True:
-            item = await loop.run_in_executor(None, next, iterator, sentinel)
+            item = await loop.run_in_executor(executor, next, iterator, sentinel)
             if item is sentinel:
                 break
             yield _frame_sse(item) if stream.sse else _to_bytes(item)
@@ -71,8 +75,12 @@ def _to_bytes(item: Any) -> bytes:
     return _json_bytes(item)
 
 
-def respond(result: Any, error: Optional[BaseException]) -> Response:
-    """Parity: http/responder.go:19-41 (Respond's type switch)."""
+def respond(
+    result: Any, error: Optional[BaseException], executor: Any = None
+) -> Response:
+    """Parity: http/responder.go:19-41 (Respond's type switch).
+    ``executor``: thread pool for pulling sync Stream items (the handler
+    adapter passes the container's I/O-sized pool)."""
     if error is not None:
         status = status_from_error(error)
         if status == 500 and not hasattr(error, "status_code"):
@@ -98,7 +106,7 @@ def respond(result: Any, error: Optional[BaseException]) -> Response:
             "Cache-Control": "no-cache",
             "X-Accel-Buffering": "no",
         }
-        return Response(status=200, headers=headers, stream=_sse_iter(result))
+        return Response(status=200, headers=headers, stream=_sse_iter(result, executor))
 
     body = _json_bytes({"data": result})
     return Response(status=200, headers={"Content-Type": _JSON}, body=body)

@@ -330,6 +330,31 @@ def decode_chunk(
     return jnp.transpose(toks), cache  # [B, n_steps]
 
 
+def decode_chunk_pool(
+    params: dict,
+    token: jnp.ndarray,
+    cache: dict,
+    cfg: TransformerConfig,
+    n_steps: int,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jax.Array, dict]:
+    """``decode_chunk_rows`` plus the on-device RNG advance and the
+    feed-forward token slice, so one pooled chunk is exactly ONE dispatch:
+    on tunneled/remote devices every extra tiny host-driven op (a key
+    split, a [B,1] slice) costs a dispatch round trip — measured ~135ms of
+    overhead per chunk on a v5e tunnel, nearly the chunk's own compute.
+    Returns (sampled tokens [B, n_steps], next input token [B, 1],
+    advanced key, cache)."""
+    key, sub = jax.random.split(key)
+    toks, cache = decode_chunk_rows(
+        params, token, cache, cfg, n_steps, sub, temperature, top_k, top_p
+    )
+    return toks, toks[:, -1:], key, cache
+
+
 def decode_chunk_rows(
     params: dict,
     token: jnp.ndarray,

@@ -786,6 +786,9 @@ class _TransformerRunner:
         self.n_params = transformer_param_count(cfg)
         bucket_source = buckets if buckets else self.SEQ_BUCKETS
         self.buckets = [b for b in bucket_source if b <= cfg.max_seq] or [cfg.max_seq]
+        # shared key for greedy decode (temperature 0 ignores it): skips a
+        # per-chunk split op, which costs a dispatch on tunneled links
+        self._greedy_key = jax.random.key(0)
         # preallocated zero caches per batch size: prefill never mutates its
         # input cache, so one shared zero cache per bsz removes per-batch
         # allocation dispatches (the tunneled device link makes every
@@ -913,7 +916,13 @@ class _TransformerRunner:
                     max_new_tokens - 1, sampler, stop,
                     stop_tokens=stop_tokens,
                 )
-            except (queue_mod.Full, RuntimeError):
+            except (queue_mod.Full, RuntimeError) as exc:
+                from gofr_tpu.tpu.decode_pool import _POOL_DEBUG
+
+                if _POOL_DEBUG:
+                    import sys as _sys
+
+                    print(f"[pool] submit fallback: {exc!r}", file=_sys.stderr, flush=True)
                 slot_q = None  # pool saturated/closed -> solo decode below
             if slot_q is not None:
                 state = None
@@ -923,15 +932,30 @@ class _TransformerRunner:
                         break
                     if isinstance(item, PoolFailure):
                         raise item.exc
-                    out.append(item)
-                    if on_token:
-                        on_token(item)
+                    for t in item:  # one burst list per decoded chunk
+                        out.append(t)
+                        if on_token:
+                            on_token(t)
+                        if stop is not None and stop.is_set():
+                            # emission stops HERE even though the pipelined
+                            # pool already queued more; the pool frees the
+                            # slot at its next delivery (it checks stop too)
+                            return out
                 return out
         # chunked decode: N steps + on-device sampling per dispatch, one
         # [1, N] fetch per chunk — the round trip, not the matmuls, bounds
         # tokens/sec on remote-attached devices. Length is tracked on the
         # HOST (prompt length + emitted count): reading cache["lengths"]
         # back every step would cost a round trip per token.
+        #
+        # PIPELINED: the feed-forward token stays on device (the next
+        # chunk's input is this chunk's last sampled column), so chunk N+1
+        # dispatches before chunk N's tokens are fetched — the fetch
+        # overlaps the next chunk's compute instead of idling the device
+        # one round trip per chunk. Stop conditions lag by at most one
+        # speculative chunk, whose results are simply abandoned.
+        from collections import deque
+
         cache = state["cache"]
         # cache holds exactly the prompt; each decode step writes one more
         # position, so the write head sits at cache_len
@@ -939,21 +963,36 @@ class _TransformerRunner:
         state = None  # release the full-batch prefill buffers
         max_len = int(cache["k"].shape[2])
         temp, tk, tp = sampler.temperature, sampler.top_k, sampler.top_p
-        while len(out) < max_new_tokens and cache_len < max_len:
-            if stop is not None and stop.is_set():
+        pending: "deque" = deque()  # (toks_dev, n_steps)
+        token_dev = jnp.asarray([[token]], jnp.int32)
+        steps_in_flight = 0
+        stopped = False
+        while not stopped:
+            while (
+                not (stop is not None and stop.is_set())
+                and len(pending) < 2
+                and steps_in_flight < max_new_tokens - len(out)
+                and cache_len + steps_in_flight < max_len
+            ):
+                # always run the WARMED full chunk unless the cache
+                # boundary forces a short one — a max_new_tokens remainder
+                # must not compile a fresh scan length mid-request;
+                # surplus sampled tokens are simply discarded
+                n = min(self.decode_chunk_size, max_len - cache_len - steps_in_flight)
+                key = self._greedy_key if sampler.greedy else sampler.take_key()
+                toks_dev, cache = self._decode_chunk(
+                    self.params, token_dev, cache, key, temp, tk, tp, n,
+                )
+                token_dev = toks_dev[:, -1:]
+                pending.append((toks_dev, n))
+                steps_in_flight += n
+            if not pending:
                 break
-            # always run the WARMED full chunk unless the cache boundary
-            # forces a short one — a max_new_tokens remainder must not
-            # compile a fresh scan length mid-request; surplus sampled
-            # tokens are simply discarded
-            n = min(self.decode_chunk_size, max_len - cache_len)
-            toks, cache = self._decode_chunk(
-                self.params, jnp.asarray([[token]], jnp.int32), cache,
-                sampler.take_key(), temp, tk, tp, n,
-            )
-            chunk = [int(t) for t in np.asarray(toks)[0]]
+            toks_dev, n = pending.popleft()
+            chunk = [int(t) for t in np.asarray(toks_dev)[0]]
+            steps_in_flight -= n
+            cache_len += n
             take = min(n, max_new_tokens - len(out))
-            stopped = False
             for t in chunk[:take]:
                 if t in stop_tokens:
                     stopped = True
@@ -961,10 +1000,11 @@ class _TransformerRunner:
                 out.append(t)
                 if on_token:
                     on_token(t)
-            if stopped:
-                break
-            token = chunk[take - 1]
-            cache_len += n
+                if stop is not None and stop.is_set():
+                    stopped = True  # on_token may set stop mid-burst
+                    break
+            if len(out) >= max_new_tokens:
+                stopped = True
         return out
 
     def warmup(self, progress: Any = None) -> None:

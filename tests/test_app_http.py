@@ -103,6 +103,38 @@ def test_default_routes(app):
         assert e.code == 404
 
 
+def test_blocking_sync_handlers_run_concurrently(app):
+    """Sync handlers use the container's dedicated pool, not asyncio's
+    cpu_count+4 default executor: 10 handlers blocking simultaneously must
+    all be IN their handler body at once, even on a 1-CPU host (where the
+    default executor would cap concurrency at 5 and queue the rest)."""
+    import threading
+
+    entered = threading.Semaphore(0)
+    release = threading.Event()
+
+    def blocker(ctx):
+        entered.release()
+        release.wait(10)
+        return "ok"
+
+    app.get("/block", blocker)
+    app.start()
+    base = f"http://127.0.0.1:{app.http_port}"
+    threads = [
+        threading.Thread(target=lambda: _get(base + "/block")) for _ in range(10)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        all_entered = all(entered.acquire(timeout=10) for _ in range(10))
+    finally:
+        release.set()
+        for t in threads:
+            t.join(15)
+    assert all_entered, "blocking handlers serialized by an undersized executor"
+
+
 def test_readiness_route(app):
     """/.well-known/ready is distinct from health: 200 once serving, 503
     with the current boot stage while the TPU stack warms up."""
