@@ -299,8 +299,15 @@ class DecodePool:
             # throughput denominator: the interval between consecutive
             # deliveries at steady state (dispatch->fetch spans ~2 chunk
             # computes when the pipeline is full and would halve the MFU
-            # gauge); after an idle gap, fall back to this chunk's own span
-            dispatch_elapsed = fetch_done - max(dispatch_start, last_fetch_done)
+            # gauge); after an idle gap, fall back to this chunk's own
+            # span. Floor at span/depth: a host stall can make both
+            # in-flight chunks finish before the next fetch, shrinking the
+            # inter-delivery gap to ~0 and spiking the gauge past reality.
+            span = fetch_done - dispatch_start
+            dispatch_elapsed = max(
+                fetch_done - max(dispatch_start, last_fetch_done),
+                span / PIPELINE_DEPTH,
+            )
             last_fetch_done = fetch_done
             with self._work:
                 self._deliver(records, toks, dispatch_elapsed)

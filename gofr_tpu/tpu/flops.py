@@ -69,3 +69,10 @@ def mfu(n_params: int, tokens: float, seconds: float, peak: float) -> float:
     if seconds <= 0 or peak <= 0:
         return 0.0
     return (2.0 * n_params * tokens) / seconds / peak
+
+
+def train_mfu(n_params: int, tokens: float, seconds: float, peak: float) -> float:
+    """Training MFU: 6·N·tokens (forward 2N + backward 4N) / seconds /
+    aggregate peak. Rematerialized forwards are NOT counted (standard MFU
+    convention: model FLOPs, not hardware FLOPs)."""
+    return 3.0 * mfu(n_params, tokens, seconds, peak)

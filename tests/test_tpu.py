@@ -470,3 +470,13 @@ def test_model_max_seq_bounds_cache():
     finally:
         for k, v in old.items():
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_flops_helpers():
+    from gofr_tpu.tpu.flops import device_peak_flops, mfu, train_mfu
+
+    assert device_peak_flops("TPU v5 lite", "tpu") == 197e12
+    assert device_peak_flops("TPU v4", "tpu") == 275e12
+    assert device_peak_flops("unknown", "cpu") == 100e9
+    assert mfu(100, 10, 0.0, 1e3) == 0.0  # degenerate inputs never divide by 0
+    assert train_mfu(100, 10, 1.0, 1e12) == pytest.approx(3 * mfu(100, 10, 1.0, 1e12))
