@@ -72,7 +72,16 @@ class Container:
             from gofr_tpu.tpu import new_device
 
             self.tpu = new_device(self.config, self.logger, self.metrics)
-            self.logger.infof("TPU datasource ready: %s", self.tpu.describe())
+            if self.tpu.ready():
+                self.logger.infof("TPU datasource ready: %s", self.tpu.describe())
+            else:
+                # background boot: the device logs its describe() line
+                # itself once the probe + warmup finish
+                self.logger.infof(
+                    "TPU datasource booting in background (model=%s); "
+                    "readiness at /.well-known/ready",
+                    self.config.get("MODEL_NAME"),
+                )
         except Exception as exc:
             self.logger.errorf("could not initialize TPU datasource, error: %s", exc)
             self.tpu = None

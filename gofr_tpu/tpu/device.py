@@ -85,14 +85,24 @@ class TPUDevice:
 
         self.tokenizer = load_tokenizer(config)
 
-        self.devices = jax.devices()
-        self.platform = self.devices[0].platform
-        self.device_kind = getattr(self.devices[0], "device_kind", self.platform)
-        self.mesh = _mesh_from_topology(
+        # devices are NOT touched here: jax.devices() blocks on runtime
+        # init, and on a wedged remote tunnel that would hang app
+        # construction before the server ever listens. _boot probes them
+        # (off-thread under TPU_BOOT=background), so a dead device shows
+        # up as a 503 readiness with a "probing device runtime" stage
+        # instead of a silent hang.
+        self._mesh_request = (
             config.get_or_default("TPU_MESH", "")
-            or config.get_or_default("TPU_TOPOLOGY", ""),
-            self.devices,
+            or config.get_or_default("TPU_TOPOLOGY", "")
         )
+        # syntax/axis validation is device-free and fails FAST here; only
+        # the device-count check and mesh construction defer to the probe
+        _parse_mesh_request(self._mesh_request)
+        self.devices: list = []
+        self.platform = "pending"
+        self.device_kind = "pending"
+        self.mesh = None
+        self.peak_flops = 0.0
 
         self._requests = metrics.counter(
             "gofr_tpu_requests_total", "TPU inference requests", labels=("model", "op", "status")
@@ -103,12 +113,6 @@ class TPUDevice:
         self._mem_gauge = metrics.gauge(
             "gofr_tpu_device_memory_bytes", "device memory", labels=("kind",)
         )
-        from gofr_tpu.tpu.flops import device_peak_flops
-
-        # MFU denominator = aggregate peak of the chips actually serving
-        # (mesh size under TPU_MESH, else one chip)
-        n_chips = self.mesh.size if self.mesh is not None else 1
-        self.peak_flops = device_peak_flops(str(self.device_kind), self.platform) * n_chips
         self._mfu_gauge = metrics.gauge(
             "gofr_tpu_mfu",
             "model FLOPs utilization of the last dispatch (2*N*tokens/time/peak)",
@@ -152,8 +156,24 @@ class TPUDevice:
         else:
             self._boot()
 
+    def _probe_devices(self) -> None:
+        """First touch of the device runtime (can block/fail on a wedged
+        tunnel — that is WHY it lives in _boot, not __init__)."""
+        self._boot_progress("probing device runtime")
+        self.devices = jax.devices()
+        self.platform = self.devices[0].platform
+        self.device_kind = getattr(self.devices[0], "device_kind", self.platform)
+        self.mesh = _mesh_from_topology(self._mesh_request, self.devices)
+        from gofr_tpu.tpu.flops import device_peak_flops
+
+        # MFU denominator = aggregate peak of the chips actually serving
+        # (mesh size under TPU_MESH, else one chip)
+        n_chips = self.mesh.size if self.mesh is not None else 1
+        self.peak_flops = device_peak_flops(str(self.device_kind), self.platform) * n_chips
+
     def _boot(self) -> None:
         try:
+            self._probe_devices()
             self._build_stack()
         except BaseException as exc:
             self._boot_error = exc
@@ -174,6 +194,10 @@ class TPUDevice:
             return
         self.boot_status = {"state": "ready", "detail": ""}
         self._ready.set()
+        if threading.current_thread().name == "gofr-tpu-boot":
+            # the accurate device-topology line operators grep for — the
+            # container's construction-time log could only say "booting"
+            self.logger.infof("TPU datasource ready: %s", self.describe())
 
     def _teardown_stack(self) -> None:
         for closer in (
@@ -461,6 +485,10 @@ class TPUDevice:
         # gone must also hold off the next attempt (no rebuild storms)
         self._last_reinit = time.monotonic()
         self._teardown_stack()  # the old stack may be wedged; rebuild regardless
+        # re-probe ALWAYS: a boot that failed during the probe stage left
+        # devices/mesh/peak unset, and a device-loss reinit wants fresh
+        # runtime state anyway (jax caches make this cheap when healthy)
+        self._probe_devices()
         self._build_stack()
         # a successful rebuild recovers a failed background boot too:
         # requests unblock and /.well-known/ready flips to 200
@@ -543,16 +571,17 @@ def new_device(config: Any, logger: Any, metrics: Any) -> TPUDevice:
     return TPUDevice(config, logger, metrics)
 
 
-def _mesh_from_topology(topology: str, devices: list) -> Optional[Any]:
-    """Parse ``TPU_MESH`` ("tp=4", "tp=4,dp=4", "fsdp=2,tp=2") into a
-    serving mesh over the local devices; empty/unset -> None (single chip).
-    Values without "=" (e.g. the "1x1"/"2x4" physical-grid strings TPU VMs
-    export as TPU_TOPOLOGY) are not mesh requests -> None."""
+def _parse_mesh_request(topology: str) -> Optional[dict[str, int]]:
+    """Device-free parse/validation of ``TPU_MESH`` ("tp=4", "tp=4,dp=4",
+    "fsdp=2,tp=2"); empty/unset -> None (single chip). Values without "="
+    (e.g. the "1x1"/"2x4" physical-grid strings TPU VMs export as
+    TPU_TOPOLOGY) are not mesh requests -> None. Raises on malformed
+    entries and unsupported axes — called eagerly at construction so a
+    config typo fails at startup, not minutes later behind a background
+    boot."""
     topology = topology.strip()
     if not topology or "=" not in topology:
         return None
-    from gofr_tpu.parallel.mesh import make_mesh, mesh_shape_for
-
     kwargs: dict[str, int] = {}
     for part in topology.split(","):
         key, _, val = part.strip().partition("=")
@@ -568,11 +597,23 @@ def _mesh_from_topology(topology: str, devices: list) -> Optional[Any]:
                 f"TPU_MESH entry '{part.strip()}' is malformed — expected "
                 "axis=int, e.g. 'tp=4,dp=2'"
             ) from None
+    return kwargs
+
+
+def _mesh_from_topology(topology: str, devices: list) -> Optional[Any]:
+    """Build the serving mesh for a parsed ``TPU_MESH`` request over the
+    local devices (the device-count check lives here, with the probe)."""
+    kwargs = _parse_mesh_request(topology)
+    if kwargs is None:
+        return None
+    from gofr_tpu.parallel.mesh import make_mesh, mesh_shape_for
+
+    kwargs = dict(kwargs)
     dp = kwargs.pop("dp", 1)
     n = dp * kwargs.get("fsdp", 1) * kwargs.get("tp", 1)
     if n > len(devices):
         raise ValueError(
-            f"TPU_MESH '{topology}' needs {n} devices, have {len(devices)}"
+            f"TPU_MESH '{topology.strip()}' needs {n} devices, have {len(devices)}"
         )
     return make_mesh(mesh_shape_for(n, **kwargs), devices=devices[:n])
 

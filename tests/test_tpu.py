@@ -450,6 +450,54 @@ def test_failed_background_boot_recovers(monkeypatch):
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
 
 
+def test_wedged_device_probe_does_not_block_construction(monkeypatch):
+    """jax.devices() can hang on a wedged remote tunnel; with
+    TPU_BOOT=background the constructor must return immediately and
+    readiness must report the probing stage (the driver-bench postmortem:
+    a hang before the server listens emits no diagnostics at all)."""
+    import os
+
+    import gofr_tpu.tpu.device as device_mod
+
+    release = threading.Event()
+    real_devices = device_mod.jax.devices
+
+    def blocking_devices(*a, **k):
+        release.wait(30)
+        return real_devices(*a, **k)
+
+    monkeypatch.setattr(device_mod.jax, "devices", blocking_devices)
+    env = {"MODEL_NAME": "tiny", "TPU_BOOT": "background", "BATCH_MAX_SIZE": "2",
+           "BATCH_TIMEOUT_MS": "1", "DECODE_POOL": "off"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        start = time.perf_counter()
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        construction = time.perf_counter() - start
+        try:
+            assert construction < 5.0  # not blocked on the wedged probe
+            assert not device.ready()
+            # poll: the boot thread may not have been scheduled yet
+            deadline = time.perf_counter() + 10
+            while (
+                device.boot_status["detail"] != "probing device runtime"
+                and time.perf_counter() < deadline
+            ):
+                time.sleep(0.01)
+            assert device.boot_status["detail"] == "probing device runtime"
+            assert device.health_check().status == "UP"  # alive, not ready
+            release.set()
+            device.wait_ready(60)
+            assert len(device.generate([1, 2, 3], max_new_tokens=3)) == 3
+        finally:
+            release.set()
+            device.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
 def test_model_max_seq_bounds_cache():
     import os
 
