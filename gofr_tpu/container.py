@@ -71,17 +71,21 @@ class Container:
         try:
             from gofr_tpu.tpu import new_device
 
+            # the multi-host join happens inside the device BOOT path
+            # (before its device probe): jax.distributed.initialize blocks
+            # until peers arrive, and blocking container wiring would hang
+            # the server before it listens — the exact failure
+            # TPU_BOOT=background exists to avoid
             self.tpu = new_device(self.config, self.logger, self.metrics)
-            if self.tpu.ready():
-                self.logger.infof("TPU datasource ready: %s", self.tpu.describe())
-            else:
-                # background boot: the device logs its describe() line
-                # itself once the probe + warmup finish
+            if self.config.get_or_default("TPU_BOOT", "") == "background":
+                # the device logs its describe() line once probe+warmup end
                 self.logger.infof(
                     "TPU datasource booting in background (model=%s); "
                     "readiness at /.well-known/ready",
                     self.config.get("MODEL_NAME"),
                 )
+            else:
+                self.logger.infof("TPU datasource ready: %s", self.tpu.describe())
         except Exception as exc:
             self.logger.errorf("could not initialize TPU datasource, error: %s", exc)
             self.tpu = None

@@ -1,0 +1,117 @@
+"""Multi-host runtime initialization: the DCN coordination layer.
+
+SURVEY.md §2/§5 first-class checklist ("distributed communication
+backend"): intra-slice collectives ride ICI inside compiled executables
+(GSPMD emits them; the framework never issues collectives), but a
+multi-host deployment (llama3-70b DP/TP over v5e-16, BASELINE config 4)
+needs every process to join ONE runtime so jax.devices() spans the slice
+and pjit compiles global SPMD programs. The reference's analogue is NCCL/
+MPI bootstrap; here it is the JAX distributed service (gRPC over DCN) —
+one coordinator, N processes.
+
+Config keys (12-factor, same mechanism as every other datasource):
+
+- ``TPU_COORDINATOR``   host:port of process 0 (unset -> single host)
+- ``TPU_NUM_PROCESSES`` world size
+- ``TPU_PROCESS_ID``    this process's rank
+
+``examples/http-server`` on a v5e-16 becomes: same binary on each host,
+same env except TPU_PROCESS_ID; application-level coordination (health
+fan-out, request routing) stays on the framework's own inter-service
+HTTP/gRPC clients (gofr_tpu.service) — the split SURVEY.md §2 prescribes.
+
+Tested without a cluster (tests/test_multihost.py): two local processes
+join a coordinator on localhost with CPU devices — the same fake-backend
+strategy the reference uses for Redis/SQL (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+_lock = threading.Lock()
+_initialized = False
+
+
+def init_from_config(config: Any, logger: Any = None) -> bool:
+    """Join the multi-host runtime when ``TPU_COORDINATOR`` is configured.
+    Returns True when distributed init ran (or already had). Idempotent;
+    raising is left to the caller's degraded-startup policy."""
+    global _initialized
+    coordinator = config.get("TPU_COORDINATOR")
+    if not coordinator:
+        return False
+    with _lock:
+        if _initialized:
+            return True
+        import jax
+
+        num_processes = int(config.get_or_default("TPU_NUM_PROCESSES", "1"))
+        process_id = int(config.get_or_default("TPU_PROCESS_ID", "0"))
+        if logger is not None:
+            logger.infof(
+                "joining multi-host runtime: coordinator=%s process %d/%d",
+                coordinator, process_id, num_processes,
+            )
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+        return True
+
+
+def process_info() -> dict[str, int]:
+    """Rank/world/device counts of the joined runtime (health details)."""
+    import jax
+
+    return {
+        "process_id": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def shutdown() -> None:
+    global _initialized
+    with _lock:
+        if not _initialized:
+            return
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        finally:
+            _initialized = False
+
+
+def global_psum_check() -> Optional[float]:
+    """One cross-host collective as a liveness probe: sums 1 over every
+    global device — equals the global device count iff all hosts
+    participate. Returns None on single-process runtimes.
+
+    SPMD: EVERY process must call this at the same point (e.g. a
+    coordinated startup check), exactly like any jit over a global mesh —
+    calling it from one host's request handler would block forever
+    waiting for peers. Per-host liveness belongs on /.well-known/health
+    fanned out over the service layer instead."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    if jax.process_count() <= 1:
+        return None
+    devices = jax.devices()
+    mesh = Mesh(devices, ("dp",))
+    ones = jax.make_array_from_callback(
+        (len(devices),),
+        NamedSharding(mesh, P("dp")),
+        lambda idx: jnp.ones((1,), jnp.float32),
+    )
+    total = jax.jit(
+        lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P())
+    )(ones)
+    return float(total)

@@ -76,6 +76,7 @@ class TPUDevice:
     def __init__(self, config: Any, logger: Any, metrics: Any):
         self.logger = logger
         self.metrics = metrics
+        self._config = config
         self.model_name = config.get_or_default("MODEL_NAME", "mlp")
         self.max_batch = int(config.get_or_default("BATCH_MAX_SIZE", "8"))
         self.timeout_ms = float(config.get_or_default("BATCH_TIMEOUT_MS", "5"))
@@ -147,6 +148,9 @@ class TPUDevice:
         self.boot_status: dict[str, Any] = {"state": "booting", "detail": ""}
         self._ready = threading.Event()
         self._boot_error: Optional[BaseException] = None
+        # ValueError-class boot failures (mesh/bucket/config validation)
+        # are permanent: auto-reinit never retries them
+        self._boot_error_permanent = False
         self._closed = False
         if config.get_or_default("TPU_BOOT", "") == "background":
             # serve /.well-known/ready (503 warming) while compiles run
@@ -158,7 +162,17 @@ class TPUDevice:
 
     def _probe_devices(self) -> None:
         """First touch of the device runtime (can block/fail on a wedged
-        tunnel — that is WHY it lives in _boot, not __init__)."""
+        tunnel — that is WHY it lives in _boot, not __init__). Multi-host
+        runtimes join here first: jax.distributed.initialize blocks until
+        peers arrive, and jax.devices() must span the slice afterwards."""
+        from gofr_tpu.parallel import multihost
+
+        if self._config.get("TPU_COORDINATOR"):
+            self._boot_progress("joining multi-host runtime")
+            if multihost.init_from_config(self._config, self.logger):
+                self.logger.infof(
+                    "multi-host runtime joined: %s", multihost.process_info()
+                )
         self._boot_progress("probing device runtime")
         self.devices = jax.devices()
         self.platform = self.devices[0].platform
@@ -177,6 +191,7 @@ class TPUDevice:
             self._build_stack()
         except BaseException as exc:
             self._boot_error = exc
+            self._boot_error_permanent = isinstance(exc, ValueError)
             self.boot_status = {"state": "failed", "detail": repr(exc)}
             self._ready.set()
             if threading.current_thread().name == "gofr-tpu-boot":
@@ -493,24 +508,39 @@ class TPUDevice:
         # a successful rebuild recovers a failed background boot too:
         # requests unblock and /.well-known/ready flips to 200
         self._boot_error = None
+        self._boot_error_permanent = False
         self.boot_status = {"state": "ready", "detail": ""}
         self._ready.set()
 
     def _maybe_auto_reinit(self) -> bool:
         """At most one automatic rebuild per 30s window — whether the last
         attempt succeeded or not (a dead device must not trigger a rebuild
-        storm). Check and rebuild are atomic: concurrent health probes
-        cannot interleave two rebuilds. Returns True on a successful
-        rebuild."""
-        with self._reinit_lock:
+        storm). Permanent config errors (ValueError from mesh/bucket
+        validation) never retry: rebuilding cannot fix a typo, and a 30s
+        error loop for the process lifetime helps nobody. The lock acquire
+        is NON-blocking: if a rebuild (or a probe hung on a wedged tunnel)
+        is already in flight, this health probe reports DOWN immediately
+        instead of queueing behind it — /.well-known/health must never
+        stop answering. Returns True on a successful rebuild."""
+        if self._boot_error_permanent:
+            return False
+        if not self._reinit_lock.acquire(blocking=False):
+            return False  # rebuild already in progress; don't pile up
+        try:
             if time.monotonic() - self._last_reinit < 30.0:
                 return False
             try:
                 self._reinit_locked()
                 return True
+            except ValueError as exc:  # config-class: retrying cannot help
+                self._boot_error_permanent = True
+                self.logger.errorf("device reinit failed permanently: %r", exc)
+                return False
             except Exception as exc:
                 self.logger.errorf("device reinit failed: %r", exc)
                 return False
+        finally:
+            self._reinit_lock.release()
 
     # -- health (north star: device liveness on /.well-known/health) ---------
     def health_check(self) -> Health:
@@ -608,7 +638,6 @@ def _mesh_from_topology(topology: str, devices: list) -> Optional[Any]:
         return None
     from gofr_tpu.parallel.mesh import make_mesh, mesh_shape_for
 
-    kwargs = dict(kwargs)
     dp = kwargs.pop("dp", 1)
     n = dp * kwargs.get("fsdp", 1) * kwargs.get("tp", 1)
     if n > len(devices):

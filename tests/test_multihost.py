@@ -1,0 +1,75 @@
+"""Multi-host runtime without a cluster: two REAL processes join a local
+coordinator on the CPU backend and run one cross-host collective — the
+reference's fake-backend test strategy (SURVEY.md §4) applied to the
+distributed bootstrap (the framework's NCCL/MPI-equivalent)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)  # one device per process
+    sys.path.insert(0, "@@REPO@@")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.parallel import multihost
+
+    cfg = EnvConfig()
+    assert multihost.init_from_config(cfg) is True
+    assert multihost.init_from_config(cfg) is True  # idempotent
+    info = multihost.process_info()
+    assert info["process_count"] == 2, info
+    assert info["global_devices"] == 2 * info["local_devices"], info
+    total = multihost.global_psum_check()
+    assert total == info["global_devices"], (total, info)
+    print(f"rank {info['process_id']} OK total={total}", flush=True)
+    multihost.shutdown()
+    """
+)
+
+
+def test_two_process_runtime_and_collective(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.replace("@@REPO@@", REPO))
+    procs = []
+    for rank in range(2):
+        env = dict(
+            os.environ,
+            TPU_COORDINATOR=f"127.0.0.1:{port}",
+            TPU_NUM_PROCESSES="2",
+            TPU_PROCESS_ID=str(rank),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outputs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        outputs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert "OK total=2" in out, out
+
+
+def test_single_host_is_noop():
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.parallel import multihost
+
+    assert "TPU_COORDINATOR" not in os.environ
+    assert multihost.init_from_config(EnvConfig()) is False
