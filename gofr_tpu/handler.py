@@ -109,9 +109,29 @@ def metrics_handler(ctx: Context) -> Response:
 
 # -- device profiler admin surface (SURVEY.md §5: profiling hooks) -----------
 
-def profiler_status_handler(_: Context) -> Any:
+def _check_admin(ctx: Context) -> None:
+    """ADMIN_TOKEN (optional) gates the admin surface: when configured,
+    requests need ``Authorization: Bearer <token>``. Unset keeps the
+    open-by-default posture of the reference's built-in routes."""
+    token = ctx.container.config.get("ADMIN_TOKEN")
+    if not token:
+        return
+    import hmac
+
+    header = ctx.request.header("Authorization") or ""
+    # compare BYTES: compare_digest raises TypeError on non-ASCII str
+    # (a mangled header must 401, not 500)
+    expected = f"Bearer {token}".encode("utf-8")
+    if not hmac.compare_digest(header.encode("utf-8", "replace"), expected):
+        from gofr_tpu.errors import UnauthenticatedError
+
+        raise UnauthenticatedError("admin token required")
+
+
+def profiler_status_handler(ctx: Context) -> Any:
     from gofr_tpu.profiling import profiler
 
+    _check_admin(ctx)
     return profiler().status()
 
 
@@ -119,6 +139,7 @@ def profiler_start_handler(ctx: Context) -> Any:
     from gofr_tpu.errors import HTTPError
     from gofr_tpu.profiling import profiler
 
+    _check_admin(ctx)
     body = {}
     try:
         body = ctx.bind() or {}
@@ -134,10 +155,11 @@ def profiler_start_handler(ctx: Context) -> Any:
         raise HTTPError(409, str(exc)) from exc
 
 
-def profiler_stop_handler(_: Context) -> Any:
+def profiler_stop_handler(ctx: Context) -> Any:
     from gofr_tpu.errors import HTTPError
     from gofr_tpu.profiling import profiler
 
+    _check_admin(ctx)
     try:
         return profiler().stop()
     except RuntimeError as exc:

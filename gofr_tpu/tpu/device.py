@@ -753,7 +753,11 @@ class _TransformerRunner:
     pinned to dp — the jitted prefill/decode then compile as SPMD programs
     with GSPMD-inserted ICI collectives. Without a mesh: single chip."""
 
-    SEQ_BUCKETS = (64, 128, 256, 512, 1024, 2048)
+    # ladder reaches the model family's full context: a ladder capped
+    # short of max_seq would silently truncate long prompts to the top
+    # bucket (prepare() keeps the LAST tokens). MODEL_BUCKETS restricts
+    # this when a deployment only serves shorter prompts.
+    SEQ_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
     def __init__(
         self,

@@ -135,6 +135,59 @@ def test_blocking_sync_handlers_run_concurrently(app):
     assert all_entered, "blocking handlers serialized by an undersized executor"
 
 
+def test_many_concurrent_sse_streams_progress(app):
+    """SSE pulls run on the container's I/O-sized pool: 8 streams that
+    each BLOCK between events must all deliver their first event
+    concurrently, even on a 1-CPU host where asyncio's default executor
+    (cpu_count+4 threads) would starve streams 6+."""
+    import http.client
+    import threading
+
+    release = threading.Event()
+
+    def stream_handler(ctx):
+        def events():
+            yield "first"
+            release.wait(10)  # hold the stream (and its pull thread) open
+            yield "last"
+
+        return Stream(events())
+
+    app.get("/events", stream_handler)
+    app.start()
+    n = 8
+    got_first = threading.Semaphore(0)
+    failures = []
+
+    def client():
+        conn = http.client.HTTPConnection("127.0.0.1", app.http_port, timeout=15)
+        try:
+            conn.request("GET", "/events")
+            resp = conn.getresponse()
+            line = resp.fp.readline()
+            while line and not line.startswith(b"data:"):
+                line = resp.fp.readline()
+            if b"first" in line:
+                got_first.release()
+            else:
+                failures.append(line)
+        except Exception as exc:  # pragma: no cover
+            failures.append(exc)
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client) for _ in range(n)]
+    for t in threads:
+        t.start()
+    try:
+        all_first = all(got_first.acquire(timeout=10) for _ in range(n))
+    finally:
+        release.set()
+        for t in threads:
+            t.join(15)
+    assert all_first and not failures, failures
+
+
 def test_readiness_route(app):
     """/.well-known/ready is distinct from health: 200 once serving, 503
     with the current boot stage while the TPU stack warms up."""

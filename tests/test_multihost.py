@@ -59,9 +59,17 @@ def test_two_process_runtime_and_collective(tmp_path):
             )
         )
     outputs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=120)
-        outputs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outputs.append(out)
+    finally:
+        # a rank that died pre-join leaves its peer blocked in
+        # initialize() forever — never leak it into the CI runner
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=10)
     for rank, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert "OK total=2" in out, out

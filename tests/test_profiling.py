@@ -127,3 +127,41 @@ def test_batch_span_tags(monkeypatch):
             unpatch()
         for k, v in old.items():
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_admin_token_gates_profiler(free_port, monkeypatch, tmp_path):
+    import gofr_tpu
+
+    monkeypatch.setenv("HTTP_PORT", str(free_port()))
+    monkeypatch.setenv("LOG_LEVEL", "FATAL")
+    monkeypatch.setenv("ADMIN_TOKEN", "s3cret")
+    for key in ("REDIS_HOST", "DB_NAME", "DB_HOST", "TPU_ENABLED", "MODEL_NAME"):
+        monkeypatch.delenv(key, raising=False)
+    monkeypatch.chdir(tmp_path)
+    application = gofr_tpu.new()
+    application.start()
+    base = f"http://127.0.0.1:{application.http_port}"
+    try:
+        try:
+            urllib.request.urlopen(base + "/admin/profiler", timeout=5)
+            raise AssertionError("expected 401")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+        req = urllib.request.Request(
+            base + "/admin/profiler",
+            headers={"Authorization": "Bearer s3cret"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["data"] == {"state": "idle"}
+        # wrong token also rejected
+        req = urllib.request.Request(
+            base + "/admin/profiler",
+            headers={"Authorization": "Bearer wrong"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            raise AssertionError("expected 401")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+    finally:
+        application.shutdown()

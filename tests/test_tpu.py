@@ -528,3 +528,14 @@ def test_flops_helpers():
     assert device_peak_flops("unknown", "cpu") == 100e9
     assert mfu(100, 10, 0.0, 1e3) == 0.0  # degenerate inputs never divide by 0
     assert train_mfu(100, 10, 1.0, 1e12) == pytest.approx(3 * mfu(100, 10, 1.0, 1e12))
+
+
+def test_seq_bucket_ladder_covers_full_context():
+    """The bucket ladder must reach the model family's max context: a
+    ladder capped short silently truncates long prompts to its top
+    bucket (prepare keeps the LAST tokens, so the user would see answers
+    computed from a suffix with no error)."""
+    from gofr_tpu.models.llama import LLAMA3_8B
+    from gofr_tpu.tpu.device import _TransformerRunner
+
+    assert _TransformerRunner.SEQ_BUCKETS[-1] >= LLAMA3_8B.max_seq
