@@ -45,10 +45,18 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"
+    # KV-cache storage dtype (None -> dtype). float8_e4m3fn halves cache
+    # HBM per token — 2x context length or decode slots on a capacity-
+    # bound chip. Writes cast on merge; attention upcasts at its boundary.
+    kv_dtype: Any = None
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+    @property
+    def cache_dtype(self) -> Any:
+        return self.kv_dtype or self.dtype
 
 
 @functools.lru_cache(maxsize=16)
@@ -229,8 +237,8 @@ def init_cache(cfg: TransformerConfig, batch: int, max_seq: int | None = None) -
         )
     shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
     return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
+        "k": jnp.zeros(shape, cfg.cache_dtype),
+        "v": jnp.zeros(shape, cfg.cache_dtype),
         "lengths": jnp.zeros((batch,), jnp.int32),
     }
 

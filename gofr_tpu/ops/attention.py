@@ -35,6 +35,13 @@ def attention(
     scale: Optional[float] = None,
     impl: str = "auto",
 ) -> jnp.ndarray:
+    if k.dtype != q.dtype:
+        # low-precision KV cache (float8_e4m3fn via cfg.kv_dtype): upcast
+        # at the attention boundary — capacity is the win (2x tokens per
+        # HBM byte); a fused low-precision cache read in the kernel is the
+        # follow-on traffic optimization
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
     if impl == "auto":
         # arbitrary masks stay on the XLA path (kv_lens is fine: the flash
         # kernel bounds its KV loop with it)

@@ -7,6 +7,8 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
 - ``MODEL_PATH``: optional checkpoint — an HF safetensors file/dir (routed
   through models/ingest.py) or an orbax dir (absent -> seeded init)
 - ``MODEL_QUANT``: "int8" for weight-only quantized serving
+- ``MODEL_KV_DTYPE``: "f8" stores the KV cache in float8_e4m3fn (2x
+  context length or decode slots per HBM byte, small accuracy cost)
 - ``MODEL_BUCKETS``: comma-separated sequence buckets to compile at boot
   (default: the SEQ_BUCKETS ladder up to max_seq)
 - ``TPU_BOOT``: "background" boots the stack off-thread; the server
@@ -126,6 +128,18 @@ class TPUDevice:
         self._decode_chunk_cfg = int(config.get_or_default("DECODE_CHUNK", "8"))
         raw_max_seq = config.get("MODEL_MAX_SEQ")
         self._max_seq_cfg = int(raw_max_seq) if raw_max_seq else None
+        # MODEL_KV_DTYPE=f8 stores the KV cache in float8_e4m3fn — half the
+        # HBM per cached token, so 2x MODEL_MAX_SEQ (or decode slots) on a
+        # capacity-bound chip at a small accuracy cost
+        kv_raw = config.get_or_default("MODEL_KV_DTYPE", "").strip().lower()
+        if kv_raw in ("", "bf16", "bfloat16"):
+            self._kv_dtype = None
+        elif kv_raw in ("f8", "fp8", "float8", "float8_e4m3fn"):
+            self._kv_dtype = jnp.float8_e4m3fn
+        else:
+            raise ValueError(
+                f"MODEL_KV_DTYPE '{kv_raw}' not supported — use bf16 or f8"
+            )
         raw_buckets = config.get_or_default("MODEL_BUCKETS", "").strip()
         # MODEL_BUCKETS="64,512" bounds which sequence buckets exist (each
         # bucket is one ahead-of-time prefill compile at boot — flagship
@@ -247,6 +261,7 @@ class TPUDevice:
             self.model_name, self.quant, self.model_path, self.max_batch,
             mesh=self.mesh, decode_chunk=self._decode_chunk_cfg,
             max_seq=self._max_seq_cfg, buckets=self._buckets_cfg,
+            kv_dtype=self._kv_dtype,
         )
         self.runner.warmup(progress=self._boot_progress)
         # continuous batching: concurrent decodes share one fixed-shape
@@ -769,6 +784,7 @@ class _TransformerRunner:
         decode_chunk: int = 8,
         max_seq: Optional[int] = None,
         buckets: Optional[tuple[int, ...]] = None,
+        kv_dtype: Optional[Any] = None,
     ):
         self.max_batch = max_batch
         from gofr_tpu.models.llama import CONFIGS
@@ -782,13 +798,18 @@ class _TransformerRunner:
 
         self.name = name
         self.cfg = CONFIGS[name]
+        overrides: dict[str, Any] = {}
         if max_seq is not None and max_seq < self.cfg.max_seq:
             # serving-side cache bound: a single chip can hold llama3-8b
             # int8 only with a smaller KV allocation than the model's full
             # context (MODEL_MAX_SEQ config key)
+            overrides["max_seq"] = max_seq
+        if kv_dtype is not None:
+            overrides["kv_dtype"] = kv_dtype
+        if overrides:
             import dataclasses
 
-            self.cfg = dataclasses.replace(self.cfg, max_seq=max_seq)
+            self.cfg = dataclasses.replace(self.cfg, **overrides)
         self.decode_chunk_size = decode_chunk
         from gofr_tpu.models.ingest import is_safetensors_path, load_llama_params
 
@@ -1178,6 +1199,7 @@ def _build_runner(
     decode_chunk: int = 8,
     max_seq: Optional[int] = None,
     buckets: Optional[tuple[int, ...]] = None,
+    kv_dtype: Optional[Any] = None,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
@@ -1189,6 +1211,7 @@ def _build_runner(
         return _TransformerRunner(
             name, quant, model_path, max_batch, mesh=mesh,
             decode_chunk=decode_chunk, max_seq=max_seq, buckets=buckets,
+            kv_dtype=kv_dtype,
         )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "

@@ -103,3 +103,28 @@ def test_attention_decode_offset():
     v2 = v.at[:, 4:].set(55.0)
     out2 = attention(q, k2, v2, causal=True, q_offset=3, impl="xla")
     np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-6)
+
+
+def test_attention_low_precision_kv_close_to_full():
+    """float8 KV upcasts at the attention boundary; outputs stay within
+    float8's quantization error of the full-precision result."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gofr_tpu.ops.attention import attention
+
+    key = jax.random.key(11)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (2, 6, 4, 8), jnp.float32)
+    k = jax.random.normal(kk, (2, 6, 2, 8), jnp.float32)
+    v = jax.random.normal(kv, (2, 6, 2, 8), jnp.float32)
+    full = attention(q, k, v, causal=True, impl="xla")
+    low = attention(
+        q, k.astype(jnp.float8_e4m3fn), v.astype(jnp.float8_e4m3fn),
+        causal=True, impl="xla",
+    )
+    assert low.dtype == q.dtype
+    np.testing.assert_allclose(
+        np.asarray(low), np.asarray(full), atol=0.2, rtol=0.2
+    )

@@ -539,3 +539,39 @@ def test_seq_bucket_ladder_covers_full_context():
     from gofr_tpu.tpu.device import _TransformerRunner
 
     assert _TransformerRunner.SEQ_BUCKETS[-1] >= LLAMA3_8B.max_seq
+
+
+def test_f8_kv_cache_serving():
+    """MODEL_KV_DTYPE=f8 stores the cache in float8 (2x tokens per HBM
+    byte): serving and the pooled decode run end-to-end on it."""
+    import os
+
+    import jax.numpy as jnp
+
+    env = {"MODEL_NAME": "tiny", "MODEL_KV_DTYPE": "f8", "BATCH_MAX_SIZE": "2",
+           "BATCH_TIMEOUT_MS": "1", "DECODE_SLOTS": "2"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            assert device.runner.cfg.cache_dtype == jnp.float8_e4m3fn
+            assert device.runner._zero_cache(2)["k"].dtype == jnp.float8_e4m3fn
+            assert device.decode_pool is not None  # pool cache is f8 too
+            assert device.decode_pool.cache["k"].dtype == jnp.float8_e4m3fn
+            out = device.generate([1, 2, 3, 4], max_new_tokens=8)
+            assert len(out) == 8 and all(0 <= t < 256 for t in out)
+            again = device.generate([1, 2, 3, 4], max_new_tokens=8)
+            assert again == out  # still deterministic under greedy
+        finally:
+            device.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_bad_kv_dtype_rejected(monkeypatch):
+    monkeypatch.setenv("MODEL_NAME", "tiny")
+    monkeypatch.setenv("MODEL_KV_DTYPE", "int4")
+    with pytest.raises(ValueError, match="MODEL_KV_DTYPE"):
+        new_device(EnvConfig(), MockLogger(), Registry())
