@@ -716,6 +716,9 @@ class _BertRunner:
         else:
             self.cfg = BertConfig()
         self.bucket = 128 if self.cfg.max_seq >= 128 else self.cfg.max_seq
+        from gofr_tpu.tpu.flops import bert_param_count
+
+        self.n_params = bert_param_count(self.cfg)  # MFU gauge (config 2)
         params = _load_or_init(model_path, lambda: init_bert(jax.random.key(0), self.cfg))
         self.params = quantize_params(params) if quant else params
         cfg = self.cfg

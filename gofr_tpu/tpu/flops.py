@@ -63,6 +63,21 @@ def transformer_param_count(cfg: Any) -> int:
     return cfg.vocab_size * d + d * cfg.vocab_size + d + cfg.n_layers * per_layer
 
 
+def bert_param_count(cfg: Any) -> int:
+    """Analytic parameter count for models/bert.py's layout (init_bert):
+    tok/pos embeds + final norm + per-layer {wqkv, wo, w_in/b_in,
+    w_out/b_out, 2 norms with biases}."""
+    d, f = cfg.dim, cfg.hidden_dim
+    per_layer = (
+        d * 3 * d  # wqkv
+        + d * d  # wo
+        + d * f + f  # w_in, b_in
+        + f * d + d  # w_out, b_out
+        + 4 * d  # two layer norms (weight + bias each)
+    )
+    return cfg.vocab_size * d + cfg.max_seq * d + 2 * d + cfg.n_layers * per_layer
+
+
 def mfu(n_params: int, tokens: float, seconds: float, peak: float) -> float:
     """Fraction of peak achieved processing ``tokens`` in ``seconds``:
     2·N·tokens / seconds / peak."""

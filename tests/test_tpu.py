@@ -575,3 +575,30 @@ def test_bad_kv_dtype_rejected(monkeypatch):
     monkeypatch.setenv("MODEL_KV_DTYPE", "int4")
     with pytest.raises(ValueError, match="MODEL_KV_DTYPE"):
         new_device(EnvConfig(), MockLogger(), Registry())
+
+
+def test_bert_param_count_matches_tree():
+    import jax
+
+    from gofr_tpu.models.bert import BertConfig, init_bert
+    from gofr_tpu.tpu.flops import bert_param_count
+
+    cfg = BertConfig(vocab_size=512, dim=64, n_layers=2, n_heads=2,
+                     hidden_dim=128, max_seq=64)
+    tree = init_bert(jax.random.key(0), cfg)
+    n_leaf = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+    assert bert_param_count(cfg) == n_leaf
+
+
+def test_bert_serving_reports_mfu(monkeypatch):
+    monkeypatch.setenv("MODEL_NAME", "bert-tiny")
+    monkeypatch.setenv("BATCH_MAX_SIZE", "2")
+    monkeypatch.setenv("BATCH_TIMEOUT_MS", "1")
+    device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+    try:
+        out = device.infer({"tokens": [1, 2, 3]})
+        assert np.isfinite(np.asarray(out)).all()
+        text = device.metrics.expose()
+        assert 'gofr_tpu_mfu{model="bert-tiny",op="prefill"}' in text
+    finally:
+        device.close()
