@@ -20,9 +20,12 @@ was measured (rc 0 only if the headline p50 exists); LOG_LEVEL=ERROR keeps
 server-side causes visible on stderr.
 
 Env overrides: BENCH_MODEL (default "llama3-8b"), BENCH_CLIENTS,
-BENCH_REQUESTS, BENCH_PROMPT_LEN, BENCH_DECODE_TOKENS, BENCH_BOOT_TIMEOUT,
-plus any framework config key (MODEL_QUANT, MODEL_MAX_SEQ, MODEL_BUCKETS,
-BATCH_MAX_SIZE, DECODE_SLOTS...).
+BENCH_REQUESTS, BENCH_PROMPT_LEN, BENCH_DECODE_TOKENS,
+BENCH_DECODE_STREAMS (concurrent generations in the decode phase;
+defaults to the decode-pool slot count — weight streaming per chunk is
+the bound, so tokens/sec scales with slots until HBM runs out),
+BENCH_BOOT_TIMEOUT, plus any framework config key (MODEL_QUANT,
+MODEL_MAX_SEQ, MODEL_BUCKETS, BATCH_MAX_SIZE, DECODE_SLOTS...).
 """
 
 from __future__ import annotations
@@ -75,6 +78,19 @@ def main() -> int:
         # fits one v5e chip beside them (tpu/device.py MODEL_MAX_SEQ path)
         os.environ.setdefault("MODEL_QUANT", "int8")
         os.environ.setdefault("MODEL_MAX_SEQ", "512")
+        # decode is weight-streaming-bound: every pooled chunk reads the
+        # full int8 model once regardless of how many slots decode in
+        # lockstep, so aggregate tok/s scales ~linearly with slots (8GB
+        # weights + 32 x 64MB cache rows fit a 16GB chip comfortably)
+        os.environ.setdefault("DECODE_SLOTS", "32")
+    # default decode concurrency = the server's actual pool slot count
+    # (DECODE_SLOTS if set, else the device's BATCH_MAX_SIZE default) so
+    # the decode phase fills the pool exactly
+    decode_streams = max(1, int(
+        os.environ.get("BENCH_DECODE_STREAMS")
+        or os.environ.get("DECODE_SLOTS")
+        or os.environ["BATCH_MAX_SIZE"]
+    ))
     max_seq_env = os.environ.get("MODEL_MAX_SEQ")
     max_seq = int(max_seq_env) if max_seq_env else 1 << 30
     # compile ONLY the bucket this bench serves (plus headroom bucket for
@@ -94,7 +110,7 @@ def main() -> int:
     rc = 1
     try:
         rc = _run(result, errors, model, clients, n_requests, prompt_len,
-                  decode_tokens, boot_timeout)
+                  decode_tokens, boot_timeout, decode_streams)
     except BaseException as exc:
         errors.append(f"{type(exc).__name__}: {exc}")
         traceback.print_exc(file=sys.stderr)
@@ -108,7 +124,7 @@ def main() -> int:
 
 
 def _run(result, errors, model, clients, n_requests, prompt_len,
-         decode_tokens, boot_timeout) -> int:
+         decode_tokens, boot_timeout, decode_streams) -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
     import jax
@@ -219,12 +235,18 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
 
         # -- phase: decode tok/s through the transport ------------------------
         try:
+            log(f"decode phase: {decode_streams} concurrent streams x "
+                f"{decode_tokens} tokens")
+            result["decode_streams"] = decode_streams
             result["decode_tok_per_sec"] = _measure_decode(
-                post, clients, prompt_len, decode_tokens
+                post, decode_streams, prompt_len, decode_tokens
             )
             result["mfu_decode"] = _scrape_mfu(base, model, "decode")
+            result["mbu_decode"] = _scrape_gauge(
+                base, f'gofr_tpu_mbu{{model="{model}",op="decode"}}'
+            )
             log(f"decode {result['decode_tok_per_sec']} tok/s "
-                f"(mfu {result['mfu_decode']})")
+                f"(mfu {result['mfu_decode']} mbu {result['mbu_decode']})")
         except Exception as exc:
             errors.append(f"decode phase: {_describe_http_error(exc)}")
             traceback.print_exc(file=sys.stderr)
@@ -336,10 +358,13 @@ def _describe_http_error(exc: Exception) -> str:
 
 def _scrape_mfu(base: str, model: str, op: str) -> float | None:
     """Read the device-maintained MFU gauge off /metrics."""
+    return _scrape_gauge(base, f'gofr_tpu_mfu{{model="{model}",op="{op}"}}')
+
+
+def _scrape_gauge(base: str, needle: str) -> float | None:
     try:
         with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
             text = r.read().decode()
-        needle = f'gofr_tpu_mfu{{model="{model}",op="{op}"}}'
         for line in text.splitlines():
             if line.startswith(needle):
                 return round(float(line.rsplit(" ", 1)[1]), 4)

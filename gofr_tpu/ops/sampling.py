@@ -45,23 +45,28 @@ def sample_logits(
     def _sampled() -> jnp.ndarray:
         scaled = logits / jnp.maximum(temperature, 1e-6)
 
-        # top-k (dynamic): threshold at the k-th largest value
+        # ONE full-vocab sort serves both filters (a [B, V] sort is the
+        # expensive op here — V is 128K for llama3): top-k thresholds at
+        # the k-th largest value, and the nucleus cutoff is computed in
+        # the same sorted space (masking below the top-k threshold there
+        # is order-preserving, so no second sort of the filtered array).
         sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
         k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)
         kth = jnp.take_along_axis(sorted_desc, jnp.full((b, 1), k_idx), axis=-1)
-        scaled = jnp.where(scaled < kth, _NEG_INF, scaled)
+        sorted_k = jnp.where(sorted_desc < kth, _NEG_INF, sorted_desc)
 
         # nucleus over the top-k-filtered distribution (sequential warper
         # semantics): drop tokens whose EXCLUSIVE cumulative probability
         # (in descending order) has already reached top_p; the argmax
         # token always survives (its exclusive cumsum is 0)
-        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        probs = jax.nn.softmax(sorted_k, axis=-1)
         cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
         cutoff_logit = jnp.min(
-            jnp.where(cum < top_p, sorted_logits, jnp.inf), axis=-1, keepdims=True
+            jnp.where(cum < top_p, sorted_k, jnp.inf), axis=-1, keepdims=True
         )
-        scaled = jnp.where(scaled < cutoff_logit, _NEG_INF, scaled)
+        scaled = jnp.where(
+            scaled < jnp.maximum(kth, cutoff_logit), _NEG_INF, scaled
+        )
         return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
     # cond, not where: the greedy default (every /generate without a
@@ -88,21 +93,30 @@ def sample_logits_rows(
     top_p = jnp.asarray(top_p, jnp.float32).reshape(b, 1)
     top_k = jnp.asarray(top_k, jnp.int32).reshape(b)
 
-    scaled = logits / jnp.maximum(temperature, 1e-6)
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)  # [B]
-    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
-    scaled = jnp.where(scaled < kth, _NEG_INF, scaled)
+    def _mixed() -> jnp.ndarray:
+        # same single-sort composition as sample_logits, with [B]-shaped
+        # knobs; see there for the order-preservation argument
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)  # [B]
+        kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+        sorted_k = jnp.where(sorted_desc < kth, _NEG_INF, sorted_desc)
 
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
-    cutoff_logit = jnp.min(
-        jnp.where(cum < top_p, sorted_logits, jnp.inf), axis=-1, keepdims=True
-    )
-    scaled = jnp.where(scaled < cutoff_logit, _NEG_INF, scaled)
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(temperature[:, 0] <= 0.0, greedy, sampled)
+        probs = jax.nn.softmax(sorted_k, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+        cutoff_logit = jnp.min(
+            jnp.where(cum < top_p, sorted_k, jnp.inf), axis=-1, keepdims=True
+        )
+        filtered = jnp.where(
+            scaled < jnp.maximum(kth, cutoff_logit), _NEG_INF, scaled
+        )
+        sampled = jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
+        return jnp.where(temperature[:, 0] <= 0.0, greedy, sampled)
+
+    # cond, not where: an all-greedy batch (the common pool state — every
+    # /generate without a temperature) must not pay a full-vocab sort per
+    # decode step; the pool dispatches this inside every chunk
+    return jax.lax.cond(jnp.all(temperature <= 0.0), lambda: greedy, _mixed)
 
 
 def stop_tokens_from_body(body: dict) -> Optional[list[int]]:

@@ -102,6 +102,7 @@ class DecodePool:
         cache_shardings: Any = None,
         n_params: Any = None,
         peak_flops: Any = None,
+        peak_hbm_bw: Any = None,
         model: str = "",
     ):
         from gofr_tpu.models.transformer import decode_chunk_pool
@@ -164,7 +165,7 @@ class DecodePool:
             if metrics is not None
             else None
         )
-        self._mfu_gauge = self._tokens_counter = None
+        self._mfu_gauge = self._tokens_counter = self._mbu_gauge = None
         if metrics is not None and n_params and peak_flops:
             self._mfu_gauge = metrics.gauge(
                 "gofr_tpu_mfu",
@@ -173,6 +174,23 @@ class DecodePool:
             )
             self._tokens_counter = metrics.counter(
                 "gofr_tpu_tokens_total", "tokens processed", labels=("model", "op")
+            )
+        self._peak_bw = peak_hbm_bw
+        if metrics is not None and peak_hbm_bw:
+            from gofr_tpu.tpu.flops import tree_bytes
+
+            # decode is bandwidth-bound: each step streams the full weight
+            # set plus the pool's KV window (static shapes — XLA reads the
+            # whole masked window), so MBU, not MFU, says how close the
+            # pooled decode runs to the hardware roofline
+            self._bytes_per_step = tree_bytes(params) + tree_bytes(
+                {"k": self.cache["k"], "v": self.cache["v"]}
+            )
+            self._mbu_gauge = metrics.gauge(
+                "gofr_tpu_mbu",
+                "HBM bandwidth utilization of the decode loop "
+                "(weights+KV bytes per step / time / peak bandwidth)",
+                labels=("model", "op"),
             )
         # warm the [n_slots]-shaped executable NOW: the first pooled request
         # must not compile under the pool lock on the serving path
@@ -364,6 +382,19 @@ class DecodePool:
                     slot.request = None
                     del self._active[index]
                     self._free.append(slot)
+                    # reset the slot's sampling knobs to greedy: one past
+                    # sampled request must not keep jnp.all(temps <= 0)
+                    # false forever and defeat the all-greedy fast path in
+                    # sample_logits_rows (a full-vocab sort per step)
+                    if (
+                        self._temps[index] != 0.0
+                        or self._top_ks[index] != 0
+                        or self._top_ps[index] != 1.0
+                    ):
+                        self._temps[index] = 0.0
+                        self._top_ks[index] = 0
+                        self._top_ps[index] = 1.0
+                        self._sampling_dirty = True
         if self._depth_gauge:
             self._depth_gauge.set(len(self._active))
         if self._mfu_gauge is not None and delivered:
@@ -379,6 +410,16 @@ class DecodePool:
                 model=self._model, op="decode",
             )
             self._tokens_counter.inc(delivered, model=self._model, op="decode")
+        if self._mbu_gauge is not None:
+            from gofr_tpu.tpu.flops import mbu
+
+            # bandwidth view of the same interval: a full chunk of steps
+            # streamed weights+KV once per step, whatever fraction of the
+            # emitted tokens was useful
+            self._mbu_gauge.set(
+                mbu(self._bytes_per_step * self.chunk, elapsed, self._peak_bw),
+                model=self._model, op="decode",
+            )
 
     def close(self) -> None:
         with self._work:

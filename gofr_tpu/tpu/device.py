@@ -106,6 +106,7 @@ class TPUDevice:
         self.device_kind = "pending"
         self.mesh = None
         self.peak_flops = 0.0
+        self.peak_hbm_bw = 0.0
 
         self._requests = metrics.counter(
             "gofr_tpu_requests_total", "TPU inference requests", labels=("model", "op", "status")
@@ -192,12 +193,13 @@ class TPUDevice:
         self.platform = self.devices[0].platform
         self.device_kind = getattr(self.devices[0], "device_kind", self.platform)
         self.mesh = _mesh_from_topology(self._mesh_request, self.devices)
-        from gofr_tpu.tpu.flops import device_peak_flops
+        from gofr_tpu.tpu.flops import device_peak_flops, device_peak_hbm_bw
 
-        # MFU denominator = aggregate peak of the chips actually serving
-        # (mesh size under TPU_MESH, else one chip)
+        # MFU/MBU denominators = aggregate peak of the chips actually
+        # serving (mesh size under TPU_MESH, else one chip)
         n_chips = self.mesh.size if self.mesh is not None else 1
         self.peak_flops = device_peak_flops(str(self.device_kind), self.platform) * n_chips
+        self.peak_hbm_bw = device_peak_hbm_bw(str(self.device_kind), self.platform) * n_chips
 
     def _boot(self) -> None:
         try:
@@ -294,6 +296,7 @@ class TPUDevice:
                 cache_shardings=getattr(self.runner, "_cache_shardings", None),
                 n_params=getattr(self.runner, "n_params", None),
                 peak_flops=self.peak_flops,
+                peak_hbm_bw=self.peak_hbm_bw,
                 model=self.model_name,
             )
         self.batcher = DynamicBatcher(

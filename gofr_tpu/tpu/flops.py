@@ -31,18 +31,75 @@ _PEAKS: tuple[tuple[str, float], ...] = (
     ("v2", 46e12),
 )
 
+# Published per-chip HBM bandwidth (bytes/s) by device_kind substring.
+# (v5e: 819 GB/s; v4: 1228; v5p: 2765; v6e/Trillium: 1640.) Decode is
+# bandwidth-bound (every step streams the whole model), so MBU — fraction
+# of peak HBM bandwidth — is the utilization number that says how close
+# decode is to the hardware roofline; decode MFU is inherently tiny.
+_HBM_BW: tuple[tuple[str, float], ...] = (
+    ("v6 lite", 1640e9),
+    ("v6e", 1640e9),
+    ("v5 lite", 819e9),
+    ("v5litepod", 819e9),
+    ("v5e", 819e9),
+    ("v5p", 2765e9),
+    ("v5", 2765e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+
+
+def _lookup(
+    table: tuple[tuple[str, float], ...],
+    device_kind: str,
+    platform: str,
+    tpu_default: float,
+    other_default: float,
+) -> float:
+    """Shared device-kind table scan for the peak FLOP/s and HBM-bandwidth
+    lookups: ordered substring match, unknown-TPU fallback, non-TPU nominal."""
+    kind = (device_kind or "").lower()
+    if platform == "tpu" or "tpu" in kind:
+        for needle, value in table:
+            if needle in kind:
+                return value
+        return tpu_default
+    return other_default
+
 
 def device_peak_flops(device_kind: str, platform: str) -> float:
     """Per-chip bf16 peak for the device kind; CPU falls back to a nominal
     100 GFLOP/s so MFU math never divides by zero in tests (CPU MFU is not a
-    meaningful number and is labeled by platform in the metrics)."""
-    kind = (device_kind or "").lower()
-    if platform == "tpu" or "tpu" in kind:
-        for needle, peak in _PEAKS:
-            if needle in kind:
-                return peak
-        return 197e12  # unknown TPU: assume v5e-class
-    return 100e9
+    meaningful number and is labeled by platform in the metrics).
+    Unknown TPU kinds assume v5e-class."""
+    return _lookup(_PEAKS, device_kind, platform, 197e12, 100e9)
+
+
+def device_peak_hbm_bw(device_kind: str, platform: str) -> float:
+    """Per-chip HBM bandwidth for the device kind; CPU falls back to a
+    nominal 50 GB/s so MBU math never divides by zero in tests.
+    Unknown TPU kinds assume v5e-class."""
+    return _lookup(_HBM_BW, device_kind, platform, 819e9, 50e9)
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total device bytes of a param/cache pytree — the decode working set
+    a step streams from HBM (int8 {"q","scale"} leaves count their packed
+    size, which is the point of weight-only quantization)."""
+    import jax
+
+    return sum(
+        leaf.nbytes for leaf in jax.tree.leaves(tree) if hasattr(leaf, "nbytes")
+    )
+
+
+def mbu(bytes_streamed: float, seconds: float, peak_bw: float) -> float:
+    """Fraction of peak HBM bandwidth achieved streaming ``bytes_streamed``
+    in ``seconds``."""
+    if seconds <= 0 or peak_bw <= 0:
+        return 0.0
+    return bytes_streamed / seconds / peak_bw
 
 
 def transformer_param_count(cfg: Any) -> int:

@@ -208,6 +208,34 @@ def test_stop_tokens_in_stream(pooled):
     assert got == full[: full.index(stop_tok)]
 
 
+def test_pooled_decode_sets_mbu_gauge(pooled):
+    # decode is bandwidth-bound; the pool maintains an MBU gauge (bytes
+    # streamed per step / time / peak bw) next to the MFU one
+    pooled.generate([1, 2, 3], max_new_tokens=6)
+    text = pooled.metrics.expose()
+    line = next(
+        (ln for ln in text.splitlines()
+         if ln.startswith('gofr_tpu_mbu{model="tiny",op="decode"}')),
+        None,
+    )
+    assert line is not None, text
+    assert float(line.rsplit(" ", 1)[1]) > 0.0
+    assert pooled.decode_pool._bytes_per_step > 0
+
+
+def test_slot_sampling_knobs_reset_on_free(pooled):
+    # a finished sampled request must not leave its temperature on the
+    # slot: stale temps defeat the all-greedy lax.cond fast path in
+    # sample_logits_rows for every later chunk
+    pooled.generate([2, 4, 6], max_new_tokens=4,
+                    sampler=Sampler(temperature=0.9, top_k=7, top_p=0.5))
+    pool = pooled.decode_pool
+    with pool._work:  # settle: delivery runs under this lock
+        assert all(t == 0.0 for t in pool._temps), list(pool._temps)
+        assert all(k == 0 for k in pool._top_ks), list(pool._top_ks)
+        assert all(p == 1.0 for p in pool._top_ps), list(pool._top_ps)
+
+
 def test_pool_close_mid_stream_raises_not_truncates():
     dev, old = _device(DECODE_POOL="on", DECODE_SLOTS="2", DECODE_CHUNK="2")
     try:
