@@ -19,12 +19,15 @@ softmax in VMEM):
 - online softmax: running (m, l, acc) in f32; probabilities cast back to
   the value dtype so the p·V matmul hits the MXU in bf16 with f32
   accumulation.
-- backward: ``jax.custom_vjp`` that recomputes attention **q-block by
-  q-block under jax.checkpoint** and differentiates that — flash speed
-  forward, correct gradients under ``jax.grad``, and backward memory
-  bounded at O(block_q·S) per block instead of materializing the full
-  O(S²) score matrix (a fused Pallas backward kernel can replace this
-  without an API change).
+- backward: **fused Pallas kernels** (FlashAttention-2 style). The forward
+  additionally emits per-row logsumexp; ``_dq_kernel`` recomputes P from it
+  and accumulates dQ over the same bounded KV loop as the forward, and
+  ``_dkv_kernel`` accumulates dK/dV per KV block over the (causally
+  bounded) query blocks, summing GQA groups by revisiting the output block
+  on the innermost grid axis. The O(S²) score matrix never materializes in
+  either direction. A checkpointed q-blockwise XLA recompute
+  (``_blockwise_reference``) remains as the numeric oracle and the
+  ``FUSED_BWD = False`` escape hatch.
 
 Layouts match gofr_tpu.ops.attention: q [B, Sq, Hq, D]; k, v [B, Skv,
 Hkv, D]; Hq % Hkv == 0. On non-TPU backends the kernel runs in pallas
@@ -56,6 +59,7 @@ def _kernel(
     k_ref,  # [1, 1, Skv_pad, D]
     v_ref,  # [1, 1, Skv_pad, D]
     out_ref,  # [1, 1, block_q, D]
+    lse_ref,  # [1, 1, block_q] f32: per-row logsumexp (backward residual)
     *,
     causal: bool,
     scale: float,
@@ -127,6 +131,10 @@ def _kernel(
     # fully-masked rows (padding) have l == 0 → emit zeros, not NaN
     out = acc / jnp.where(l == 0.0, 1.0, l)
     out_ref[0, 0, :, :] = out.astype(out_ref.dtype)
+    # logsumexp residual for the fused backward; +inf on fully-masked rows
+    # makes their recomputed probabilities exp(-1e30 - inf) = 0 there
+    lse = jnp.where(l > 0.0, m + jnp.log(l), jnp.inf)
+    lse_ref[0, 0, :] = lse[:, 0]
 
 
 def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
@@ -152,7 +160,7 @@ def _flash_fwd_impl(
     block_q: int,
     block_kv: int,
     interpret: bool,
-) -> jnp.ndarray:
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     b, sq, hq, d = q.shape
     skv, hkv = k.shape[1], k.shape[2]
     groups = hq // hkv
@@ -190,9 +198,12 @@ def _flash_fwd_impl(
                 lambda bi, h, qi, *_, g=groups: (bi, h // g, 0, 0),
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda bi, h, qi, *_: (bi, h, qi, 0)
-        ),
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bi, h, qi, *_: (bi, h, qi, 0)
+            ),
+            pl.BlockSpec((1, 1, block_q), lambda bi, h, qi, *_: (bi, h, qi)),
+        ],
     )
 
     kernel = functools.partial(
@@ -203,10 +214,13 @@ def _flash_fwd_impl(
         block_kv=block_kv,
         num_kv_blocks=num_kv_blocks,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hq, sq_pad, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq_pad), jnp.float32),
+        ],
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=4 * b * hq * sq * skv * d,
@@ -214,7 +228,307 @@ def _flash_fwd_impl(
             transcendentals=b * hq * sq * skv,
         ),
     )(offsets, kv_lens, qt, kt, vt)
-    return jnp.swapaxes(out[:, :, :sq, :], 1, 2)
+    return jnp.swapaxes(out[:, :, :sq, :], 1, 2), lse[:, :, :sq]
+
+
+def _dq_kernel(
+    offs_ref,  # [B] int32 scalar-prefetch
+    lens_ref,  # [B] int32 scalar-prefetch
+    q_ref,  # [1, 1, block_q, D]
+    k_ref,  # [1, 1, Skv_pad, D]
+    v_ref,  # [1, 1, Skv_pad, D]
+    do_ref,  # [1, 1, block_q, D]
+    lse_ref,  # [1, 1, block_q] f32
+    dvec_ref,  # [1, 1, block_q] f32: D = rowsum(dO ⊙ O)
+    dq_ref,  # [1, 1, block_q, D] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_kv: int,
+    num_kv_blocks: int,
+):
+    """dQ = scale · Σ_j dS_j K_j with dS = P ⊙ (dP − D), P recomputed from
+    the forward's logsumexp — same KV loop bounds as the forward, so the
+    O(S²) score matrix never materializes."""
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    offset = offs_ref[b]
+    kv_len = lens_ref[b]
+
+    qb = q_ref[0, 0, :, :]
+    dob = do_ref[0, 0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :][:, None]  # [block_q, 1]
+    dvec = dvec_ref[0, 0, :][:, None]  # [block_q, 1]
+
+    q_pos = (
+        offset + qi * block_q
+        + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    )
+    k_ids = jax.lax.broadcasted_iota(jnp.int32, (1, block_kv), 1)
+
+    hi = pl.cdiv(kv_len, block_kv)
+    if causal:
+        hi = jnp.minimum(hi, pl.cdiv(offset + (qi + 1) * block_q, block_kv))
+    hi = jnp.minimum(hi, num_kv_blocks)
+
+    def body(j, acc):
+        kb = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
+        vb = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        k_pos = j * block_kv + k_ids
+        mask = k_pos < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [block_q, block_kv]; masked/padded → 0
+        dp = jax.lax.dot_general(
+            dob, vb.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dvec)  # [block_q, block_kv]
+        return acc + jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    acc0 = jnp.zeros((block_q, qb.shape[-1]), jnp.float32)
+    acc = jax.lax.fori_loop(0, hi, body, acc0)
+    dq_ref[0, 0, :, :] = acc * scale
+
+
+def _dkv_kernel(
+    offs_ref,  # [B] int32 scalar-prefetch
+    lens_ref,  # [B] int32 scalar-prefetch
+    q_ref,  # [1, 1, Sq_pad, D] — one query head's full (padded) sequence
+    k_ref,  # [1, 1, block_kv, D]
+    v_ref,  # [1, 1, block_kv, D]
+    do_ref,  # [1, 1, Sq_pad, D]
+    lse_ref,  # [1, 1, Sq_pad] f32
+    dvec_ref,  # [1, 1, Sq_pad] f32
+    dk_ref,  # [1, 1, block_kv, D] f32 — revisited across the g grid axis
+    dv_ref,  # [1, 1, block_kv, D] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_kv: int,
+    num_q_blocks: int,
+):
+    """dK/dV for one KV block, accumulated over the query blocks that can
+    see it (dynamic causal lower bound) and, via grid revisiting, over the
+    ``groups`` query heads sharing this KV head (GQA). The g axis is the
+    innermost grid dimension, so the output block stays resident while the
+    group accumulates."""
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+    g = pl.program_id(3)
+    offset = offs_ref[b]
+    kv_len = lens_ref[b]
+
+    kb = k_ref[0, 0, :, :]
+    vb = v_ref[0, 0, :, :]
+    d = kb.shape[-1]
+
+    k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_kv), 1
+    )  # [1, block_kv]
+    kv_mask = k_pos < kv_len
+
+    # causal: only query blocks whose last row reaches this KV block's
+    # first position contribute (same arithmetic as the forward's hi bound,
+    # seen from the KV side)
+    if causal:
+        lo = jnp.maximum(0, (ki * block_kv - offset) // block_q)
+    else:
+        lo = 0
+
+    def body(qi, carry):
+        dk_acc, dv_acc = carry
+        qb = q_ref[0, 0, pl.ds(qi * block_q, block_q), :]
+        dob = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        dvec = dvec_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_kv]
+        q_pos = (
+            offset + qi * block_q
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+        )
+        mask = kv_mask
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # zero at masked and padded-q positions
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            dob, vb.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dvec)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_acc, dv_acc
+
+    zeros = jnp.zeros((block_kv, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (zeros, zeros))
+
+    @pl.when(g == 0)
+    def _init():
+        dk_ref[0, 0, :, :] = dk * scale
+        dv_ref[0, 0, :, :] = dv
+
+    @pl.when(g > 0)
+    def _accum():
+        dk_ref[0, 0, :, :] += dk * scale
+        dv_ref[0, 0, :, :] += dv
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_q", "block_kv", "interpret")
+)
+def _flash_bwd_impl(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    offsets: jnp.ndarray,
+    kv_lens: jnp.ndarray,
+    out: jnp.ndarray,
+    lse: jnp.ndarray,
+    g: jnp.ndarray,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_kv: int,
+    interpret: bool,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    b, sq, hq, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    groups = hq // hkv
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    dot = jnp.swapaxes(g, 1, 2)
+
+    block_q = min(block_q, max(sq, 16))
+    block_kv = min(block_kv, skv)
+    sq_pad = pl.cdiv(sq, block_q) * block_q
+    skv_pad = pl.cdiv(skv, block_kv) * block_kv
+    qt = _pad_axis(qt, 2, sq_pad)
+    kt = _pad_axis(kt, 2, skv_pad)
+    vt = _pad_axis(vt, 2, skv_pad)
+    dot = _pad_axis(dot, 2, sq_pad)  # zero-padded rows contribute nothing
+    num_q_blocks = sq_pad // block_q
+    num_kv_blocks = skv_pad // block_kv
+
+    # D = rowsum(dO ⊙ O): one cheap fused elementwise+reduce, shared by
+    # both kernels (padded rows: dO = 0 → D = 0)
+    dvec = jnp.sum(
+        dot.astype(jnp.float32)
+        * _pad_axis(jnp.swapaxes(out, 1, 2), 2, sq_pad).astype(jnp.float32),
+        axis=-1,
+    )  # [B, Hq, Sq_pad]
+    lse_pad = _pad_axis(lse, 2, sq_pad)
+
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hq, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, qi, *_: (bi, h, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, skv_pad, d),
+                lambda bi, h, qi, *_, g_=groups: (bi, h // g_, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, skv_pad, d),
+                lambda bi, h, qi, *_, g_=groups: (bi, h // g_, 0, 0),
+            ),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, qi, *_: (bi, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, h, qi, *_: (bi, h, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, h, qi, *_: (bi, h, qi)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, h, qi, *_: (bi, h, qi, 0)
+        ),
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, causal=causal, scale=scale, block_q=block_q,
+            block_kv=block_kv, num_kv_blocks=num_kv_blocks,
+        ),
+        grid_spec=dq_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq_pad, d), jnp.float32),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=5 * b * hq * sq * skv * d,
+            bytes_accessed=(q.size + k.size + v.size + g.size) * q.dtype.itemsize,
+            transcendentals=b * hq * sq * skv,
+        ),
+    )(offsets, kv_lens, qt, kt, vt, dot, lse_pad, dvec)
+
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        # g innermost: consecutive iterations revisit the same dk/dv block
+        grid=(b, hkv, num_kv_blocks, groups),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, sq_pad, d),
+                lambda bi, h, ki, gi, *_, g_=groups: (bi, h * g_ + gi, 0, 0),
+            ),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bi, h, ki, gi, *_: (bi, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bi, h, ki, gi, *_: (bi, h, ki, 0)),
+            pl.BlockSpec(
+                (1, 1, sq_pad, d),
+                lambda bi, h, ki, gi, *_, g_=groups: (bi, h * g_ + gi, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, sq_pad),
+                lambda bi, h, ki, gi, *_, g_=groups: (bi, h * g_ + gi, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, sq_pad),
+                lambda bi, h, ki, gi, *_, g_=groups: (bi, h * g_ + gi, 0),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_kv, d), lambda bi, h, ki, gi, *_: (bi, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda bi, h, ki, gi, *_: (bi, h, ki, 0)),
+        ],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, causal=causal, scale=scale, block_q=block_q,
+            block_kv=block_kv, num_q_blocks=num_q_blocks,
+        ),
+        grid_spec=dkv_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, skv_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, skv_pad, d), jnp.float32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=5 * b * hq * sq * skv * d,
+            bytes_accessed=(q.size + k.size + v.size + g.size) * q.dtype.itemsize,
+            transcendentals=b * hq * sq * skv,
+        ),
+    )(offsets, kv_lens, qt, kt, vt, dot, lse_pad, dvec)
+
+    dq = jnp.swapaxes(dq[:, :, :sq, :], 1, 2).astype(q.dtype)
+    dk = jnp.swapaxes(dk[:, :, :skv, :], 1, 2).astype(k.dtype)
+    dv = jnp.swapaxes(dv[:, :, :skv, :], 1, 2).astype(v.dtype)
+    return dq, dk, dv
 
 
 def _normalize_scalars(
@@ -252,10 +566,10 @@ def _blockwise_reference(q, k, v, offsets, kv_lens, causal, scale,
     """Semantically identical to ``_reference`` but computed q-block by
     q-block under ``jax.checkpoint``: differentiating THIS never holds more
     than one block's [block_q, Skv] score matrix — O(block_q·S) backward
-    memory instead of the O(S²) that a full-sequence recompute
-    materializes (exactly the regime ring attention exists for;
-    round-2 verdict weak #7). dk/dv accumulate through the scan's carry.
-    """
+    memory instead of the O(S²) of a full-sequence recompute. Serves as
+    the numeric oracle for the fused Pallas backward kernels and as the
+    ``FUSED_BWD = False`` fallback. dk/dv accumulate through the scan's
+    carry."""
     if block_q is None:
         block_q = BWD_BLOCK_Q  # module-level lookup: tests can patch it
     b, sq, hq, d = q.shape
@@ -282,31 +596,47 @@ def _blockwise_reference(q, k, v, offsets, kv_lens, causal, scale,
     return out[:, :sq]
 
 
+# Backward implementation switch: True (default) uses the fused Pallas
+# kernels; False selects the checkpointed q-blockwise XLA recompute (the
+# numeric oracle the fused kernels are tested against, and the escape
+# hatch if a backend miscompiles the backward kernels). Read at TRACE
+# time: set it before building jitted train steps — already-compiled
+# functions keep the backward they were traced with until their jit
+# caches are cleared (jax.clear_caches()).
+FUSED_BWD = True
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def _flash(q, k, v, offsets, kv_lens, causal, scale, block_q, block_kv, interpret):
     return _flash_fwd_impl(
         q, k, v, offsets, kv_lens, causal, scale, block_q, block_kv, interpret
-    )
+    )[0]
 
 
 def _flash_fwd(q, k, v, offsets, kv_lens, causal, scale, block_q, block_kv, interpret):
-    out = _flash_fwd_impl(
+    out, lse = _flash_fwd_impl(
         q, k, v, offsets, kv_lens, causal, scale, block_q, block_kv, interpret
     )
-    return out, (q, k, v, offsets, kv_lens)
+    return out, (q, k, v, offsets, kv_lens, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_kv, interpret, residuals, g):
-    q, k, v, offsets, kv_lens = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _blockwise_reference(
-            q_, k_, v_, offsets, kv_lens, causal, scale
-        ),
-        q,
-        k,
-        v,
-    )
-    dq, dk, dv = vjp(g)
+    q, k, v, offsets, kv_lens, out, lse = residuals
+    if FUSED_BWD:
+        dq, dk, dv = _flash_bwd_impl(
+            q, k, v, offsets, kv_lens, out, lse, g,
+            causal, scale, block_q, block_kv, interpret,
+        )
+    else:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _blockwise_reference(
+                q_, k_, v_, offsets, kv_lens, causal, scale
+            ),
+            q,
+            k,
+            v,
+        )
+        dq, dk, dv = vjp(g)
     return (
         dq,
         dk,
@@ -335,7 +665,9 @@ def flash_attention(
 
     ``q_offset``: scalar or [B] absolute position of q row 0 (ragged
     decode). ``kv_lens``: optional [B] count of valid KV positions
-    (padded/unwritten cache tail is masked). Differentiable via recompute.
+    (padded/unwritten cache tail is masked). Differentiable via the fused
+    backward kernels (gradients flow to q, k, v; not to the position
+    scalars).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5

@@ -20,6 +20,34 @@ import jax.numpy as jnp
 _NEG_INF = float(-1e30)
 
 
+def _filter_top_k_top_p(
+    scaled: jnp.ndarray, top_k: jnp.ndarray, top_p: jnp.ndarray
+) -> jnp.ndarray:
+    """Apply top-k then nucleus filtering to temperature-scaled logits.
+    ``scaled`` [B, V]; ``top_k`` [B] int32 (0 = off); ``top_p`` [B, 1] f32.
+
+    ONE full-vocab sort serves both filters (a [B, V] sort is the
+    expensive op here — V is 128K for llama3): top-k thresholds at the
+    k-th largest value, and the nucleus cutoff is computed in the same
+    sorted space (masking below the top-k threshold there is
+    order-preserving, so no second sort of the filtered array). Nucleus
+    uses sequential-warper semantics: drop tokens whose EXCLUSIVE
+    cumulative probability (descending order) has already reached top_p;
+    the argmax token always survives (its exclusive cumsum is 0)."""
+    b, v = scaled.shape
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)  # [B]
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    sorted_k = jnp.where(sorted_desc < kth, _NEG_INF, sorted_desc)
+
+    probs = jax.nn.softmax(sorted_k, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+    cutoff_logit = jnp.min(
+        jnp.where(cum < top_p, sorted_k, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(scaled < jnp.maximum(kth, cutoff_logit), _NEG_INF, scaled)
+
+
 @jax.jit
 def sample_logits(
     logits: jnp.ndarray,
@@ -34,7 +62,7 @@ def sample_logits(
     sampler serves every request — request-supplied knobs must never
     recompile on the serving path."""
     logits = logits.astype(jnp.float32)
-    b, v = logits.shape
+    b = logits.shape[0]
     temperature = jnp.asarray(temperature, jnp.float32)
     top_p = jnp.asarray(top_p, jnp.float32)
     top_k = jnp.asarray(top_k, jnp.int32)
@@ -44,33 +72,15 @@ def sample_logits(
 
     def _sampled() -> jnp.ndarray:
         scaled = logits / jnp.maximum(temperature, 1e-6)
-
-        # ONE full-vocab sort serves both filters (a [B, V] sort is the
-        # expensive op here — V is 128K for llama3): top-k thresholds at
-        # the k-th largest value, and the nucleus cutoff is computed in
-        # the same sorted space (masking below the top-k threshold there
-        # is order-preserving, so no second sort of the filtered array).
-        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-        k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)
-        kth = jnp.take_along_axis(sorted_desc, jnp.full((b, 1), k_idx), axis=-1)
-        sorted_k = jnp.where(sorted_desc < kth, _NEG_INF, sorted_desc)
-
-        # nucleus over the top-k-filtered distribution (sequential warper
-        # semantics): drop tokens whose EXCLUSIVE cumulative probability
-        # (in descending order) has already reached top_p; the argmax
-        # token always survives (its exclusive cumsum is 0)
-        probs = jax.nn.softmax(sorted_k, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
-        cutoff_logit = jnp.min(
-            jnp.where(cum < top_p, sorted_k, jnp.inf), axis=-1, keepdims=True
+        filtered = _filter_top_k_top_p(
+            scaled,
+            jnp.broadcast_to(top_k, (b,)),
+            jnp.broadcast_to(top_p, (b, 1)),
         )
-        scaled = jnp.where(
-            scaled < jnp.maximum(kth, cutoff_logit), _NEG_INF, scaled
-        )
-        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
 
     # cond, not where: the greedy default (every /generate without a
-    # temperature) must not pay the two full-vocab sorts per step
+    # temperature) must not pay the full-vocab sort per step
     return jax.lax.cond(temperature <= 0.0, _greedy, _sampled)
 
 
@@ -87,29 +97,15 @@ def sample_logits_rows(
     requests with different sampling settings in one dispatch, so each row
     carries its own knobs (rows with temperature 0 take their argmax)."""
     logits = logits.astype(jnp.float32)
-    b, v = logits.shape
+    b = logits.shape[0]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     temperature = jnp.asarray(temperature, jnp.float32).reshape(b, 1)
     top_p = jnp.asarray(top_p, jnp.float32).reshape(b, 1)
     top_k = jnp.asarray(top_k, jnp.int32).reshape(b)
 
     def _mixed() -> jnp.ndarray:
-        # same single-sort composition as sample_logits, with [B]-shaped
-        # knobs; see there for the order-preservation argument
         scaled = logits / jnp.maximum(temperature, 1e-6)
-        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-        k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)  # [B]
-        kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
-        sorted_k = jnp.where(sorted_desc < kth, _NEG_INF, sorted_desc)
-
-        probs = jax.nn.softmax(sorted_k, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
-        cutoff_logit = jnp.min(
-            jnp.where(cum < top_p, sorted_k, jnp.inf), axis=-1, keepdims=True
-        )
-        filtered = jnp.where(
-            scaled < jnp.maximum(kth, cutoff_logit), _NEG_INF, scaled
-        )
+        filtered = _filter_top_k_top_p(scaled, top_k, top_p)
         sampled = jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
         return jnp.where(temperature[:, 0] <= 0.0, greedy, sampled)
 
