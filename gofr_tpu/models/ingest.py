@@ -226,7 +226,7 @@ def iter_hf_llama_tensors(
 
 
 def load_llama_params(
-    path: str, cfg: Any, quantize: bool = False
+    path: str, cfg: Any, quantize: Any = False
 ) -> dict:
     """Build the serving param tree (models/transformer.py layout: stacked
     [n_layers, ...] layer weights) from an HF Llama safetensors checkpoint.
@@ -239,15 +239,16 @@ def load_llama_params(
     stacked key (the views themselves are mmap-backed)."""
     import jax.numpy as jnp
 
-    from gofr_tpu.models.quant import quantize_array
+    from gofr_tpu.models.quant import quantizer_for
 
+    quantize_fn = quantizer_for(quantize)
     ckpt = Checkpoint(path)
     try:
         params: dict[str, Any] = {"layers": {}}
 
         def place(arr: np.ndarray, quant_ok: bool) -> Any:
             x = jnp.asarray(np.ascontiguousarray(arr), dtype=cfg.dtype)
-            return quantize_array(x) if (quantize and quant_ok) else x
+            return quantize_fn(x) if (quantize_fn and quant_ok) else x
 
         pending: dict[str, list[np.ndarray]] = {}
         for tree_path, arr in iter_hf_llama_tensors(ckpt, cfg):
@@ -272,10 +273,10 @@ def export_llama_hf(params: dict, cfg: Any) -> dict[str, np.ndarray]:
     """Inverse mapping (our tree -> HF tensor dict), used by tests to
     round-trip and by users exporting trained weights. Quantized trees must
     be dequantized first."""
-    from gofr_tpu.models.quant import is_quantized
+    from gofr_tpu.models.quant import is_quantized, is_quantized_int4
 
     def host(x: Any) -> np.ndarray:
-        if is_quantized(x):
+        if is_quantized(x) or is_quantized_int4(x):
             raise ValueError("dequantize params before export")
         return np.asarray(x)
 

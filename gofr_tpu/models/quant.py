@@ -1,14 +1,23 @@
-"""Int8 weight-only quantization for serving (BASELINE target: Llama-3-8B
-int8 on v5e-4).
+"""Weight-only quantization for serving (BASELINE target: Llama-3-8B
+int8 on v5e-4; ``MODEL_QUANT=int4`` halves HBM weight traffic again).
 
-Per-output-channel symmetric quantization: a weight ``w [..., in, out]``
-becomes ``{"q": int8 [..., in, out], "scale": f32 [..., 1, out]}``. Matmuls
-upcast int8 in registers (XLA fuses the convert into the MXU feed);
-HBM traffic — the serving bottleneck — drops 2x vs bf16. Embeddings and
-norms stay high precision.
+Two schemes, both symmetric:
 
-This module is the single source of truth for the scheme: ``quantize_array``
-/ ``dequantize_array`` / ``mm`` are what the model forwards use
+- **int8, per output channel**: ``w [..., in, out]`` becomes
+  ``{"q": int8 [..., in, out], "scale": f32 [..., 1, out]}``. Matmuls
+  upcast int8 in registers (XLA fuses the convert into the MXU feed);
+  HBM traffic — the serving bottleneck — drops 2x vs bf16.
+- **int4, group-wise** (group = 128 input rows per scale): ``w`` becomes
+  ``{"q4": int4 [..., in, out], "scale": f32 [..., in/128, out]}``.
+  Per-group scales recover most of the accuracy a 4-bit grid loses at
+  per-channel granularity (~0.25 extra bits/weight of scale overhead);
+  decode is weight-streaming-bound, so 4-bit weights raise its
+  throughput ceiling ~2x over int8.
+
+Embeddings and norms stay high precision in both schemes.
+
+This module is the single source of truth: ``quantize_array`` /
+``quantize_array_int4`` / ``mm`` are what the model forwards use
 (gofr_tpu.models.transformer._mm and bert both route through ``mm``).
 """
 
@@ -19,11 +28,14 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-# weight names eligible for int8 (2-D matmul weights used via mm())
+# weight names eligible for quantization (2-D matmul weights used via mm())
 _QUANT_KEYS = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head", "wqkv", "w_in", "w_out"}
 
 _CLIP = 127.0
+_CLIP4 = 7.0
 _SCALE_FLOOR = 1e-8
+
+INT4_GROUP = 128  # input rows per int4 scale group
 
 
 def quantize_array(w: jnp.ndarray) -> dict[str, jnp.ndarray]:
@@ -39,40 +51,113 @@ def dequantize_array(packed: dict[str, jnp.ndarray], dtype: Any = jnp.bfloat16) 
     return (packed["q"].astype(jnp.float32) * packed["scale"]).astype(dtype)
 
 
+def quantize_array_int4(
+    w: jnp.ndarray, group: int = INT4_GROUP
+) -> dict[str, jnp.ndarray]:
+    """Group-wise symmetric int4: ``group`` input rows share one scale per
+    output channel. The group clamps to the reduction dim for small
+    (test-sized) weights; the dim must divide by the effective group
+    (true for every transformer dim this framework ships)."""
+    wf = w.astype(jnp.float32)
+    i, o = wf.shape[-2], wf.shape[-1]
+    group = min(group, i)
+    if i % group:
+        raise ValueError(
+            f"int4 quantization needs the reduction dim ({i}) divisible by "
+            f"the scale group ({group})"
+        )
+    lead = wf.shape[:-2]
+    wg = wf.reshape(*lead, i // group, group, o)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(wg), axis=-2, keepdims=True) / _CLIP4, _SCALE_FLOOR
+    )  # [..., n_groups, 1, out]
+    q4 = (
+        jnp.clip(jnp.round(wg / scale), -_CLIP4, _CLIP4)
+        .astype(jnp.int4)
+        .reshape(*lead, i, o)
+    )
+    return {"q4": q4, "scale": scale[..., 0, :].astype(jnp.float32)}
+
+
+def dequantize_array_int4(
+    packed: dict[str, jnp.ndarray], dtype: Any = jnp.bfloat16
+) -> jnp.ndarray:
+    q4, scale = packed["q4"], packed["scale"]
+    i, o = q4.shape[-2], q4.shape[-1]
+    lead = q4.shape[:-2]
+    n = scale.shape[-2]
+    wg = q4.astype(jnp.float32).reshape(*lead, n, i // n, o)
+    return (wg * scale[..., :, None, :]).reshape(*lead, i, o).astype(dtype)
+
+
 def is_quantized(leaf: Any) -> bool:
     return isinstance(leaf, dict) and set(leaf) == {"q", "scale"}
 
 
-def mm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
-    """Quant-aware matmul: ``w`` is a plain [in, out] array or a packed int8
-    dict. Accumulation in f32 either way (preferred_element_type feeds the
-    MXU correctly on TPU).
+def is_quantized_int4(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and set(leaf) == {"q4", "scale"}
 
-    The int8 operand goes into ``dot_general`` DIRECTLY — an explicit
+
+def mm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """Quant-aware matmul: ``w`` is a plain [in, out] array or a packed
+    int8/int4 dict. Accumulation in f32 either way (preferred_element_type
+    feeds the MXU correctly on TPU).
+
+    The quantized operand goes into ``dot_general`` DIRECTLY — an explicit
     ``astype`` before the matmul makes XLA materialize the dequantized
     bf16 weight in HBM (3x the traffic, measured ~1.9x slower per decode
     matvec on v5e), while the mixed-dtype dot fuses the upconvert into the
-    MXU feed so only int8 bytes ever cross HBM. Numerics are identical:
-    int8 values are exactly representable in bf16/f32."""
+    MXU feed so only the packed bytes ever cross HBM. Numerics are
+    identical: int8/int4 values are exactly representable in bf16/f32.
+
+    int4 runs one dot per scale group (the group axis becomes a batched
+    matmul dim); the per-group scale multiplies the f32 partials before
+    the group sum."""
     if is_quantized(w):
         y = jax.lax.dot_general(
             x, w["q"], (((x.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return (y * w["scale"].reshape(1, -1)).astype(x.dtype)
+    if is_quantized_int4(w):
+        q4, scale = w["q4"], w["scale"]
+        i, o = q4.shape
+        n = scale.shape[-2]
+        xg = x.reshape(*x.shape[:-1], n, i // n)
+        qg = q4.reshape(n, i // n, o)
+        y = jnp.einsum(
+            "...ag,ago->...ao", xg, qg, preferred_element_type=jnp.float32
+        )
+        return jnp.sum(y * scale, axis=-2).astype(x.dtype)
     return x @ w
 
 
-def quantize_params(params: dict) -> dict:
+def quantizer_for(mode: Any) -> Any:
+    """Map a MODEL_QUANT value to the per-array quantizer. Accepts the
+    legacy bool (True = int8), "int8", "int4", and ""/None/False (no
+    quantization -> None). Unknown strings raise at config time."""
+    if mode in ("int8", True):
+        return quantize_array
+    if mode == "int4":
+        return quantize_array_int4
+    if mode in ("", None, False):
+        return None
+    raise ValueError(f"MODEL_QUANT '{mode}' not supported — use int8 or int4")
+
+
+def quantize_params(params: dict, mode: Any = "int8") -> dict:
     """Quantize all eligible weights in a model param tree (stacked layer
     weights quantized per layer-slice by the axis=-2 convention)."""
+    quantize = quantizer_for(mode)
+    if quantize is None:
+        return params
 
     def walk(tree: Any) -> Any:
         if isinstance(tree, dict):
             out = {}
             for key, value in tree.items():
                 if key in _QUANT_KEYS and isinstance(value, jnp.ndarray) and value.ndim >= 2:
-                    out[key] = quantize_array(value)
+                    out[key] = quantize(value)
                 else:
                     out[key] = walk(value)
             return out
@@ -85,6 +170,8 @@ def dequantize_params(params: dict, dtype: Any = jnp.bfloat16) -> dict:
     def walk(tree: Any) -> Any:
         if is_quantized(tree):
             return dequantize_array(tree, dtype)
+        if is_quantized_int4(tree):
+            return dequantize_array_int4(tree, dtype)
         if isinstance(tree, dict):
             return {k: walk(v) for k, v in tree.items()}
         return tree

@@ -76,25 +76,26 @@ def _cached_freqs(head_dim: int, max_seq: int, theta: float):
 
 
 def init_transformer(
-    key: jax.Array, cfg: TransformerConfig, quantize: bool = False
+    key: jax.Array, cfg: TransformerConfig, quantize: Any = False
 ) -> dict:
     """Weight layout mirrors Llama-3 shapes; initialization is scaled
     truncated-normal (serving weights come from checkpoints; init exists for
     tests and training-from-scratch).
 
-    ``quantize=True`` quantizes each matmul weight to int8 IMMEDIATELY
-    after creation, so peak device memory is the int8 model plus ONE bf16
-    weight — init-then-quantize of the full tree would peak at 3x the int8
-    size and OOM an 8B model on a 16GB chip. Values are bit-identical to
-    ``quantize_params(init_transformer(key, cfg))``."""
-    from gofr_tpu.models.quant import quantize_array
+    ``quantize`` ("int8"/"int4"; True = int8) quantizes each matmul weight
+    IMMEDIATELY after creation, so peak device memory is the packed model
+    plus ONE bf16 weight — init-then-quantize of the full tree would peak
+    at 3x the packed size and OOM an 8B model on a 16GB chip. Values are
+    bit-identical to ``quantize_params(init_transformer(key, cfg), mode)``."""
+    from gofr_tpu.models.quant import quantizer_for
 
+    quantize_fn = quantizer_for(quantize)
     n_keys = cfg.n_layers * 7 + 3
     keys = iter(jax.random.split(key, n_keys))
 
     def dense(k: jax.Array, shape: tuple[int, ...], fan_in: int) -> Any:
         w = (jax.random.truncated_normal(k, -3, 3, shape) * (fan_in ** -0.5)).astype(cfg.dtype)
-        return quantize_array(w) if quantize else w
+        return quantize_fn(w) if quantize_fn else w
 
     params: dict[str, Any] = {
         # embeddings stay high precision (the quantization scheme's rule)

@@ -22,8 +22,9 @@ out-projections — XLA inserts the psum on the row-parallel output. ``fsdp``
 shards the other matmul dimension (ZeRO-3); gradients reduce-scatter over
 ``fsdp`` and all-reduce over ``dp`` automatically under jit.
 
-Int8-packed weights ({"q", "scale"}) shard like the underlying weight
-(scale rows are tiny and follow the output axis).
+Quantized weights shard like the underlying weight: int8 {"q", "scale"}
+scales follow the output axis; int4 {"q4", "scale"} scales take the full
+weight spec (their group axis follows the input axis).
 """
 
 from __future__ import annotations
@@ -63,12 +64,23 @@ def param_specs(params: Any, _name: str = "") -> Any:
 
     def walk(tree: Any, name: str) -> Any:
         if isinstance(tree, dict):
-            if set(tree) == {"q", "scale"}:  # int8-packed leaf pair
-                q_spec = _spec_for(name, tree["q"].ndim)
-                # scale is [..., 1, out]; shard only the out axis like q
+            keys = set(tree)
+            if keys in ({"q", "scale"}, {"q4", "scale"}):  # packed leaf pair
+                q_key = "q" if "q" in tree else "q4"
+                q_spec = _spec_for(name, tree[q_key].ndim)
+                if q_key == "q4" and tree["scale"].shape[-2] > 1:
+                    # int4 scale [..., groups, out]: the group axis follows
+                    # the weight's in axis, so it takes the SAME spec (a
+                    # row-parallel weight shards its groups over tp). A
+                    # single-group scale (group clamped to a small dim)
+                    # degenerates to the int8 rule below — a size-1 axis
+                    # cannot split
+                    return {q_key: q_spec, "scale": q_spec}
+                # int8 scale is [..., 1, out]: only the out axis is
+                # shardable (the size-1 axis cannot split)
                 tail = q_spec[-1] if len(q_spec) > 0 else None
                 scale_pad = (None,) * (tree["scale"].ndim - 1)
-                return {"q": q_spec, "scale": P(*scale_pad, tail)}
+                return {q_key: q_spec, "scale": P(*scale_pad, tail)}
             return {k: walk(v, k) for k, v in tree.items()}
         return _spec_for(name, getattr(tree, "ndim", 0))
 

@@ -6,7 +6,9 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
   llama3-70b (transformer names from gofr_tpu.models.llama.CONFIGS)
 - ``MODEL_PATH``: optional checkpoint — an HF safetensors file/dir (routed
   through models/ingest.py) or an orbax dir (absent -> seeded init)
-- ``MODEL_QUANT``: "int8" for weight-only quantized serving
+- ``MODEL_QUANT``: "int8" (per-channel) or "int4" (group-wise scales) for
+  weight-only quantized serving — decode streams the whole weight set per
+  step, so packed weights raise its throughput ceiling 2x / ~4x over bf16
 - ``MODEL_KV_DTYPE``: "f8" stores the KV cache in float8_e4m3fn (2x
   context length or decode slots per HBM byte, small accuracy cost)
 - ``MODEL_BUCKETS``: comma-separated sequence buckets to compile at boot
@@ -82,7 +84,12 @@ class TPUDevice:
         self.model_name = config.get_or_default("MODEL_NAME", "mlp")
         self.max_batch = int(config.get_or_default("BATCH_MAX_SIZE", "8"))
         self.timeout_ms = float(config.get_or_default("BATCH_TIMEOUT_MS", "5"))
-        self.quant = config.get_or_default("MODEL_QUANT", "") == "int8"
+        # "int8" | "int4" | "" — validated eagerly so a MODEL_QUANT typo
+        # fails at startup, not behind a background boot
+        from gofr_tpu.models.quant import quantizer_for
+
+        self.quant = config.get_or_default("MODEL_QUANT", "")
+        quantizer_for(self.quant)
         self.model_path = config.get("MODEL_PATH")
         from gofr_tpu.tokenizer import load_tokenizer
 
@@ -492,7 +499,7 @@ class TPUDevice:
         return (
             f"model={self.model_name} platform={self.platform} "
             f"devices={len(self.devices)} kind={self.device_kind}"
-            + (" quant=int8" if self.quant else "")
+            + (f" quant={self.quant}" if self.quant else "")
             + (f" mesh={dict(self.mesh.shape)}" if self.mesh is not None else "")
             + (
                 f" tokenizer={self.tokenizer.backend}"
@@ -723,7 +730,7 @@ class _BertRunner:
 
         self.n_params = bert_param_count(self.cfg)  # MFU gauge (config 2)
         params = _load_or_init(model_path, lambda: init_bert(jax.random.key(0), self.cfg))
-        self.params = quantize_params(params) if quant else params
+        self.params = quantize_params(params, quant)
         cfg = self.cfg
         self._embed = jax.jit(lambda p, t, m: bert_embed(p, t, m, cfg))
 
@@ -827,11 +834,11 @@ class _TransformerRunner:
             params = _load_or_init(
                 model_path, lambda: init_transformer(jax.random.key(0), self.cfg)
             )
-            self.params = quantize_params(params) if quant else params
+            self.params = quantize_params(params, quant)
         elif quant:
-            # quantize-during-init: peak memory = int8 model + ONE bf16
+            # quantize-during-init: peak memory = packed model + ONE bf16
             # weight (init-then-quantize would peak ~3x and OOM 8B on 16GB)
-            self.params = init_transformer(jax.random.key(0), self.cfg, quantize=True)
+            self.params = init_transformer(jax.random.key(0), self.cfg, quantize=quant)
         else:
             self.params = init_transformer(jax.random.key(0), self.cfg)
         self.mesh = mesh

@@ -85,13 +85,22 @@ def device_peak_hbm_bw(device_kind: str, platform: str) -> float:
 
 def tree_bytes(tree: Any) -> int:
     """Total device bytes of a param/cache pytree — the decode working set
-    a step streams from HBM (int8 {"q","scale"} leaves count their packed
-    size, which is the point of weight-only quantization)."""
+    a step streams from HBM (quantized leaves count their packed size,
+    which is the point of weight-only quantization). int4 leaves count a
+    half byte per element (TPU HBM packs two nibbles per byte; CPU's
+    byte-per-element .nbytes would overstate the stream)."""
     import jax
+    import jax.numpy as jnp
 
-    return sum(
-        leaf.nbytes for leaf in jax.tree.leaves(tree) if hasattr(leaf, "nbytes")
-    )
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if not hasattr(leaf, "nbytes"):
+            continue
+        if getattr(leaf, "dtype", None) in (jnp.int4, jnp.uint4):
+            total += -(-leaf.size // 2)
+        else:
+            total += leaf.nbytes
+    return total
 
 
 def mbu(bytes_streamed: float, seconds: float, peak_bw: float) -> float:

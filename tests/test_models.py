@@ -136,6 +136,73 @@ def test_quantized_forward_close(params):
     np.testing.assert_allclose(np.asarray(quant), np.asarray(deq_logits), rtol=1e-3, atol=1e-3)
 
 
+def test_int4_roundtrip_and_mm():
+    from gofr_tpu.models.quant import (
+        dequantize_array_int4,
+        mm,
+        quantize_array_int4,
+    )
+
+    w = jax.random.normal(jax.random.key(8), (256, 32), jnp.float32)
+    packed = quantize_array_int4(w)
+    assert packed["q4"].dtype == jnp.int4
+    assert packed["scale"].shape == (2, 32)  # 256 / 128 groups
+    back = dequantize_array_int4(packed, jnp.float32)
+    rel = float(jnp.sqrt(jnp.mean((w - back) ** 2)) / jnp.sqrt(jnp.mean(w ** 2)))
+    assert rel < 0.2  # 4-bit grid, group-wise scales
+    # group-wise scales must beat one per-channel scale over the same grid
+    per_channel = w / jnp.maximum(jnp.max(jnp.abs(w), axis=0, keepdims=True) / 7.0, 1e-8)
+    coarse = jnp.round(jnp.clip(per_channel, -7, 7)) * jnp.maximum(
+        jnp.max(jnp.abs(w), axis=0, keepdims=True) / 7.0, 1e-8
+    )
+    rel_coarse = float(
+        jnp.sqrt(jnp.mean((w - coarse) ** 2)) / jnp.sqrt(jnp.mean(w ** 2))
+    )
+    assert rel < rel_coarse
+    # mm against the packed dict == matmul against the dequantized weight
+    x = jax.random.normal(jax.random.key(9), (3, 256), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(mm(x, packed)), np.asarray(x @ back), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_int4_forward_close(params):
+    tokens = jax.random.randint(jax.random.key(10), (1, 6), 0, CFG.vocab_size)
+    base = _fwd(params, tokens)
+    qparams = quantize_params(params, "int4")
+    assert qparams["layers"]["wq"]["q4"].dtype == jnp.int4
+    quant = jax.jit(lambda p, t: transformer_forward(p, t, CFG))(qparams, tokens)
+    base_probs = jax.nn.softmax(base[:, -1])
+    quant_probs = jax.nn.softmax(quant[:, -1])
+    assert float(jnp.abs(base_probs - quant_probs).sum()) < 0.35
+    # dequantize restores plain arrays usable by the same forward
+    deq = dequantize_params(qparams, jnp.float32)
+    deq_logits = jax.jit(lambda p, t: transformer_forward(p, t, CFG))(deq, tokens)
+    np.testing.assert_allclose(
+        np.asarray(quant), np.asarray(deq_logits), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_quantizer_for_rejects_unknown_mode():
+    from gofr_tpu.models.quant import quantizer_for
+
+    with pytest.raises(ValueError, match="int8 or int4"):
+        quantizer_for("fp4")
+    assert quantizer_for("") is None and quantizer_for(None) is None
+
+
+def test_int4_init_matches_quantize_after():
+    from gofr_tpu.models.llama import TINY
+    from gofr_tpu.models.transformer import init_transformer
+
+    a = init_transformer(jax.random.key(3), TINY, quantize="int4")
+    b = quantize_params(init_transformer(jax.random.key(3), TINY), "int4")
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
 def test_ragged_prefill_ignores_padding(params):
     """A prompt padded to a bucket must yield the same logits and decode
     behavior as the unpadded prompt (per-request lengths)."""

@@ -520,6 +520,46 @@ def test_model_max_seq_bounds_cache():
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
 
 
+def test_int4_serving_generates():
+    """MODEL_QUANT=int4 boots and serves; packed int4 leaves in the runner
+    tree; generation runs through prefill + pooled decode."""
+    import os
+
+    import jax.numpy as jnp
+
+    env = {"MODEL_NAME": "tiny", "MODEL_QUANT": "int4", "BATCH_MAX_SIZE": "2",
+           "BATCH_TIMEOUT_MS": "1", "DECODE_CHUNK": "4"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            assert device.runner.params["layers"]["wq"]["q4"].dtype == jnp.int4
+            out = device.generate([1, 2, 3], max_new_tokens=6)
+            assert len(out) == 6
+            assert all(0 <= t < device.runner.cfg.vocab_size for t in out)
+            assert "quant=int4" in device.describe()
+        finally:
+            device.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_bad_model_quant_fails_fast():
+    import os
+
+    env = {"MODEL_NAME": "tiny", "MODEL_QUANT": "fp4"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        with pytest.raises(ValueError, match="int8 or int4"):
+            new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
 def test_flops_helpers():
     from gofr_tpu.tpu.flops import device_peak_flops, mfu, train_mfu
 
@@ -528,6 +568,13 @@ def test_flops_helpers():
     assert device_peak_flops("unknown", "cpu") == 100e9
     assert mfu(100, 10, 0.0, 1e3) == 0.0  # degenerate inputs never divide by 0
     assert train_mfu(100, 10, 1.0, 1e12) == pytest.approx(3 * mfu(100, 10, 1.0, 1e12))
+    # int4 leaves count half a byte per element in the decode stream
+    from gofr_tpu.tpu.flops import tree_bytes
+
+    import jax.numpy as jnp
+
+    tree = {"a": jnp.zeros((4, 4), jnp.int4), "s": jnp.zeros((4,), jnp.float32)}
+    assert tree_bytes(tree) == 16 // 2 + 16
 
 
 def test_seq_bucket_ladder_covers_full_context():

@@ -64,6 +64,48 @@ def test_params_actually_sharded(sharded):
     assert "mesh" in sharded.describe()
 
 
+def test_int4_multigroup_scale_shards_with_weight():
+    # a row-parallel int4 weight with several scale groups: the scale's
+    # group axis must shard over tp exactly like the weight's in axis
+    import jax
+    import jax.numpy as jnp
+
+    from gofr_tpu.models.quant import dequantize_array_int4, quantize_array_int4
+    from gofr_tpu.parallel.mesh import make_mesh, mesh_shape_for
+    from gofr_tpu.parallel.sharding import param_specs, shard_params
+
+    w = jax.random.normal(jax.random.key(0), (256, 64), jnp.float32)
+    tree = {"w_down": quantize_array_int4(w, group=64)}  # 4 groups
+    specs = param_specs(tree)
+    assert specs["w_down"]["scale"] == specs["w_down"]["q4"]
+    mesh = make_mesh(mesh_shape_for(2, tp=2), devices=jax.devices()[:2])
+    placed = shard_params(tree, mesh)
+    assert len(placed["w_down"]["q4"].sharding.device_set) == 2
+    assert len(placed["w_down"]["scale"].sharding.device_set) == 2
+    np.testing.assert_allclose(
+        np.asarray(dequantize_array_int4(placed["w_down"], jnp.float32)),
+        np.asarray(dequantize_array_int4(tree["w_down"], jnp.float32)),
+    )
+
+
+def test_int4_sharded_matches_plain():
+    # int4-packed weights shard (q4 like the weight, scale groups along the
+    # in axis) and serve the same tokens as the unsharded int4 runner
+    plain4 = _device(MODEL_NAME="tiny", MODEL_QUANT="int4", BATCH_MAX_SIZE="4",
+                     BATCH_TIMEOUT_MS="1", TPU_MESH="")
+    sharded4 = _device(MODEL_NAME="tiny", MODEL_QUANT="int4", BATCH_MAX_SIZE="4",
+                       BATCH_TIMEOUT_MS="1", TPU_MESH="tp=2")
+    try:
+        wq = sharded4.runner.params["layers"]["wq"]
+        assert len(wq["q4"].sharding.device_set) == 2
+        want = plain4.generate(PROMPT["tokens"], max_new_tokens=8)
+        got = sharded4.generate(PROMPT["tokens"], max_new_tokens=8)
+        assert got == want
+    finally:
+        plain4.close()
+        sharded4.close()
+
+
 def test_sharded_infer_matches_plain(plain, sharded):
     a = plain.infer(PROMPT)
     b = sharded.infer(PROMPT)
