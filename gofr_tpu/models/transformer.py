@@ -307,6 +307,41 @@ def decode_step(
     return _forward_with_cache(params, token, cache, cfg, None)
 
 
+def verify_chunk(
+    params: dict, tokens: jnp.ndarray, cache: dict, cfg: TransformerConfig
+) -> tuple[jnp.ndarray, dict]:
+    """Target-model verification step for speculative decoding: run
+    ``tokens`` [B, S] (the pending token followed by S-1 draft tokens)
+    through the cached forward and return the GREEDY next token at EVERY
+    position [B, S] plus the advanced cache. Position i's argmax is the
+    target's continuation after consuming tokens[:i+1] — the host accepts
+    the longest draft prefix that matches and takes position n as the
+    bonus token. One dispatch verifies a whole draft chunk."""
+    b, s = tokens.shape
+    starts = cache["lengths"]
+    freqs = jnp.asarray(_cached_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta))
+    positions = starts[:, None] + jnp.arange(s)[None, :]
+    x = params["embed"][tokens]
+    written = starts + s
+
+    def body(carry, inputs):
+        layer_params, k_cache, v_cache = inputs
+        y, (k_cache, v_cache), _ = _block(
+            cfg, layer_params, carry, freqs, positions,
+            kv_cache=(k_cache, v_cache), starts=starts, kv_lens=written,
+        )
+        return y, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["norm_f"], cfg.norm_eps)
+    logits = _mm(x, params["lm_head"])  # [B, S, V]
+    next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    new_cache = {"k": k_new, "v": v_new, "lengths": written}
+    return next_ids, new_cache
+
+
 def decode_chunk(
     params: dict,
     token: jnp.ndarray,

@@ -13,6 +13,11 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
   context length or decode slots per HBM byte, small accuracy cost)
 - ``MODEL_BUCKETS``: comma-separated sequence buckets to compile at boot
   (default: the SEQ_BUCKETS ladder up to max_seq)
+- ``DRAFT_MODEL_NAME`` / ``DRAFT_TOKENS`` / ``DRAFT_MODEL_PATH``:
+  greedy speculative decoding — a small same-vocab draft model proposes
+  DRAFT_TOKENS tokens per cycle and the target verifies them in one
+  forward (output bit-identical to plain greedy; latency mode, so greedy
+  requests bypass the continuous-batching pool)
 - ``TPU_BOOT``: "background" boots the stack off-thread; the server
   accepts immediately and /.well-known/ready reports warmup progress
 - ``BATCH_MAX_SIZE`` / ``BATCH_TIMEOUT_MS``: batcher shape
@@ -132,6 +137,11 @@ class TPUDevice:
         self._tokens_counter = metrics.counter(
             "gofr_tpu_tokens_total", "tokens processed", labels=("model", "op")
         )
+        self._spec_gauge = metrics.gauge(
+            "gofr_tpu_spec_acceptance",
+            "speculative decoding: accepted draft tokens / drafted",
+            labels=("model",),
+        )
 
         self._decode_chunk_cfg = int(config.get_or_default("DECODE_CHUNK", "8"))
         raw_max_seq = config.get("MODEL_MAX_SEQ")
@@ -160,6 +170,18 @@ class TPUDevice:
                 f"MODEL_BUCKETS entries must be positive, got {raw_buckets!r} "
                 "(a zero-width bucket would silently serve empty prefills)"
             )
+        # speculative decoding (DRAFT_MODEL_NAME): a small draft model
+        # proposes DRAFT_TOKENS tokens per cycle, the target verifies them
+        # in ONE forward — greedy output is EXACTLY the target's, at a
+        # fraction of the per-token weight streams when drafts are accepted
+        self._draft_name = config.get_or_default("DRAFT_MODEL_NAME", "").strip()
+        self._draft_tokens = int(config.get_or_default("DRAFT_TOKENS", "4"))
+        self._draft_path = config.get("DRAFT_MODEL_PATH")
+        if self._draft_tokens < 2:
+            # acceptance is capped at k-1 (the draft cache holds at most k
+            # committed positions per cycle), so k=1 could never accept a
+            # draft — strictly slower than plain decode
+            raise ValueError("DRAFT_TOKENS must be >= 2")
         self._pool_enabled = config.get_or_default("DECODE_POOL", "on") != "off"
         self._pool_slots = int(config.get_or_default("DECODE_SLOTS", str(self.max_batch)))
         self._last_reinit = 0.0
@@ -270,7 +292,8 @@ class TPUDevice:
             self.model_name, self.quant, self.model_path, self.max_batch,
             mesh=self.mesh, decode_chunk=self._decode_chunk_cfg,
             max_seq=self._max_seq_cfg, buckets=self._buckets_cfg,
-            kv_dtype=self._kv_dtype,
+            kv_dtype=self._kv_dtype, draft_name=self._draft_name,
+            draft_tokens=self._draft_tokens, draft_path=self._draft_path,
         )
         self.runner.warmup(progress=self._boot_progress)
         # continuous batching: concurrent decodes share one fixed-shape
@@ -391,6 +414,11 @@ class TPUDevice:
                 ),
             )
             self._requests.inc(model=self.model_name, op="generate", status="ok")
+            stats = getattr(self.runner, "spec_stats", None)
+            if stats and stats["drafted"]:
+                self._spec_gauge.set(
+                    stats["accepted"] / stats["drafted"], model=self.model_name
+                )
             return out
         except Exception:
             self._requests.inc(model=self.model_name, op="generate", status="error")
@@ -798,6 +826,9 @@ class _TransformerRunner:
         max_seq: Optional[int] = None,
         buckets: Optional[tuple[int, ...]] = None,
         kv_dtype: Optional[Any] = None,
+        draft_name: str = "",
+        draft_tokens: int = 4,
+        draft_path: Optional[str] = None,
     ):
         self.max_batch = max_batch
         from gofr_tpu.models.llama import CONFIGS
@@ -894,6 +925,18 @@ class _TransformerRunner:
         self.n_params = transformer_param_count(cfg)
         bucket_source = buckets if buckets else self.SEQ_BUCKETS
         self.buckets = [b for b in bucket_source if b <= cfg.max_seq] or [cfg.max_seq]
+        # speculative decoding: draft engine + target-side verify/reset
+        self.spec = (
+            _SpecEngine(cfg, quant, draft_name, draft_tokens, draft_path)
+            if draft_name
+            else None
+        )
+        self.spec_stats = {"cycles": 0, "drafted": 0, "accepted": 0}
+        if self.spec is not None:
+            from gofr_tpu.models.transformer import verify_chunk
+
+            self._verify = jax.jit(lambda p, t, c: verify_chunk(p, t, c, cfg))
+            self._set_cache_len = _cache_with_len
         # shared key for greedy decode (temperature 0 ignores it): skips a
         # per-chunk split op, which costs a dispatch on tunneled links
         self._greedy_key = jax.random.key(0)
@@ -1011,6 +1054,16 @@ class _TransformerRunner:
         if max_new_tokens <= 1:
             return out
 
+        # speculative decoding: greedy requests with a configured draft
+        # take the draft-and-verify path (exactly the target's greedy
+        # output; DRAFT_MODEL_NAME opts the deployment into latency mode,
+        # so these requests bypass the throughput pool)
+        if self.spec is not None and sampler.greedy:
+            return self._spec_generate(
+                state, ids, out, token, max_new_tokens, on_token, stop,
+                stop_tokens,
+            )
+
         # continuous batching: unseeded requests decode in the shared pool
         # (seeded ones need the exact per-request key sequence — solo path)
         if decode_pool is not None and not sampler.seeded:
@@ -1115,6 +1168,106 @@ class _TransformerRunner:
                 stopped = True
         return out
 
+    def _spec_generate(
+        self,
+        state: Any,
+        ids: np.ndarray,
+        out: list[int],
+        token: int,
+        max_new_tokens: int,
+        on_token: Any,
+        stop: Any,
+        stop_tokens: frozenset,
+    ) -> list[int]:
+        """Greedy speculative decode: per cycle, ONE draft chunk proposes
+        k tokens, ONE target forward verifies all of them, ONE [k+2] fetch
+        returns the target's argmaxes plus the on-device accepted count —
+        so an accepted prefix of n tokens costs the target a single
+        weight stream instead of n. Every emitted token is the target's
+        own argmax (the accepted drafts equal it by construction), so
+        output is bit-identical to plain greedy decode whatever the draft
+        proposes. Acceptance is capped at k-1 so the draft cache always
+        contains the committed prefix (its chunk writes k positions)."""
+        spec = self.spec
+        k = spec.k
+        cache = state["cache"]
+        cache_len = state["length"]
+        state = None
+        max_len = int(cache["k"].shape[2])
+        dcache = spec.prefill_prompt(ids, self._bucket_for(int(ids.size)))
+        stats = self.spec_stats
+
+        def emit(tokens_host: list[int]) -> bool:
+            """Append tokens, honoring stop conditions; True = keep going."""
+            for t in tokens_host:
+                if t in stop_tokens:
+                    return False
+                out.append(t)
+                if on_token:
+                    on_token(t)
+                if len(out) >= max_new_tokens:
+                    return False
+                if stop is not None and stop.is_set():
+                    return False
+            return True
+
+        while (
+            len(out) < max_new_tokens
+            and not (stop is not None and stop.is_set())
+            and cache_len + k + 1 <= max_len
+        ):
+            token_dev = jnp.asarray([[token]], jnp.int32)
+            draft_toks, dcache = spec.propose(token_dev, dcache)  # [1, k]
+            verify_in = jnp.concatenate([token_dev, draft_toks], axis=1)
+            next_ids, cache = self._verify(self.params, verify_in, cache)
+            # on-device acceptance count: leading draft tokens equal to the
+            # target's argmax at the same position; packed with the ids so
+            # the cycle costs ONE host fetch
+            matches = (next_ids[:, :k] == draft_toks).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+            packed = np.asarray(jnp.concatenate([next_ids, n_acc[:, None]], axis=1))
+            a = packed[0, : k + 1]
+            # cap at k-1: the draft chunk wrote k positions, so the draft
+            # cache can hold at most k committed tokens (t + k-1 drafts)
+            n_use = min(int(packed[0, k + 1]), k - 1, max_new_tokens - len(out) - 1)
+            n_use = max(n_use, 0)
+            stats["cycles"] += 1
+            stats["drafted"] += k
+            stats["accepted"] += n_use
+            # emitted tokens a[0..n_use]: n_use accepted drafts + the bonus
+            keep_going = emit([int(t) for t in a[: n_use + 1]])
+            cache_len += 1 + n_use  # t plus the accepted drafts are committed
+            if not keep_going:
+                break
+            cache = self._set_cache_len(cache, cache_len)
+            dcache = spec.reset_len(dcache, cache_len)
+            token = int(a[n_use])  # bonus token: emitted, not yet in cache
+        else:
+            # natural exhaustion only (a break above means a stop
+            # condition already fired): if the cache got too full for a
+            # k+1 verify but tokens remain, finish with plain single-step
+            # decodes through the already-compiled chunk
+            if (
+                len(out) < max_new_tokens
+                and not (stop is not None and stop.is_set())
+                and cache_len < max_len
+            ):
+                cache = self._set_cache_len(cache, cache_len)
+                while (
+                    len(out) < max_new_tokens
+                    and not (stop is not None and stop.is_set())
+                    and cache_len < max_len
+                ):
+                    toks, cache = self._decode_chunk(
+                        self.params, jnp.asarray([[token]], jnp.int32), cache,
+                        self._greedy_key, 0.0, 0, 1.0, 1,
+                    )
+                    token = int(np.asarray(toks)[0, 0])
+                    cache_len += 1
+                    if not emit([token]):  # handles stop tokens/events/max
+                        break
+        return out
+
     def warmup(self, progress: Any = None) -> None:
         # one compiled prefill per sequence bucket (batch fixed at
         # max_batch), plus the b=1 decode step — nothing compiles on the
@@ -1150,6 +1303,136 @@ class _TransformerRunner:
             jax.random.key(0), 0.0, 0, 1.0, self.decode_chunk_size,
         )
         toks.block_until_ready()
+        if self.spec is not None:
+            # speculative path: draft prefill per bucket, draft chunk, and
+            # the target verify — nothing compiles on the serving path
+            spec = self.spec
+            for i, bucket in enumerate(self.buckets):
+                if progress:
+                    progress(
+                        f"compiling draft prefill bucket {bucket} "
+                        f"({i + 1}/{len(self.buckets)})"
+                    )
+                dcache = spec.prefill_prompt(np.ones((4,), np.int32), bucket)
+            if progress:
+                progress(f"compiling draft chunk + verify (k={spec.k})")
+            dtoks, dcache = spec.propose(jnp.zeros((1, 1), jnp.int32), dcache)
+            verify_in = jnp.concatenate([jnp.zeros((1, 1), jnp.int32), dtoks], axis=1)
+            vids, vcache = self._verify(self.params, verify_in, one)
+            vids.block_until_ready()
+            spec.reset_len(dcache, 1)
+            # the capacity-tail fallback decodes single steps: warm the
+            # n=1 chunk shape so it never compiles on the serving path
+            t1, vcache = self._decode_chunk(
+                self.params, jnp.zeros((1, 1), jnp.int32), vcache,
+                self._greedy_key, 0.0, 0, 1.0, 1,
+            )
+            t1.block_until_ready()
+            self._set_cache_len(vcache, 1)
+
+
+# shared by the target runner and the draft engine: roll a KV cache's
+# write head back to ``n`` (speculative decoding rejects by length — the
+# garbage KV past n is masked by attention and overwritten by later steps)
+_cache_with_len = jax.jit(
+    lambda c, n: {
+        "k": c["k"], "v": c["v"], "lengths": jnp.zeros_like(c["lengths"]) + n,
+    },
+    donate_argnums=(0,),
+)
+
+
+class _SpecEngine:
+    """Draft side of greedy speculative decoding.
+
+    Holds the draft model's params and its jitted entry points: a bucketed
+    prefill (the draft's cache must contain the same prompt as the
+    target's), a k-step greedy chunk (ONE dispatch proposes k tokens), and
+    a cache-length reset (rolls back the positions a rejected draft
+    wrote). Output correctness never depends on the draft — the target's
+    verify pass re-derives every emitted token — so the draft may be any
+    same-vocab model; its quality only sets the acceptance rate."""
+
+    def __init__(
+        self,
+        target_cfg: Any,
+        quant: Any,
+        draft_name: str,
+        k: int,
+        draft_path: Optional[str] = None,
+    ):
+        from gofr_tpu.models.llama import CONFIGS
+        from gofr_tpu.models.transformer import (
+            decode_chunk,
+            init_cache,
+            init_transformer,
+            prefill,
+        )
+
+        if draft_name not in CONFIGS:
+            raise ValueError(
+                f"DRAFT_MODEL_NAME '{draft_name}' unknown — expected one of "
+                f"{sorted(CONFIGS)}"
+            )
+        cfg = CONFIGS[draft_name]
+        if cfg.vocab_size != target_cfg.vocab_size:
+            raise ValueError(
+                f"draft '{draft_name}' vocab {cfg.vocab_size} != target "
+                f"vocab {target_cfg.vocab_size} — speculative decoding "
+                "verifies draft token ids against the target distribution"
+            )
+        if cfg.max_seq < target_cfg.max_seq:
+            raise ValueError(
+                f"draft '{draft_name}' max_seq {cfg.max_seq} < target "
+                f"serving max_seq {target_cfg.max_seq}"
+            )
+        import dataclasses
+
+        self.cfg = dataclasses.replace(cfg, max_seq=target_cfg.max_seq)
+        self.k = k
+        from gofr_tpu.models.ingest import is_safetensors_path, load_llama_params
+
+        if draft_path and is_safetensors_path(draft_path):
+            self.params = load_llama_params(draft_path, self.cfg, quantize=quant)
+        elif draft_path:
+            from gofr_tpu.models.quant import quantize_params
+            from gofr_tpu.training.checkpoint import restore_params
+
+            self.params = quantize_params(restore_params(draft_path), quant)
+        else:
+            # seeded draft (key differs from the target's so a same-config
+            # draft still exercises real accept/reject paths in tests)
+            self.params = init_transformer(jax.random.key(1), self.cfg, quantize=quant)
+        dcfg = self.cfg
+        self._init_cache = init_cache
+        self._prefill = jax.jit(lambda p, t, c, l: prefill(p, t, c, dcfg, l))
+        self._chunk = jax.jit(
+            lambda p, t, c: decode_chunk(
+                p, t, c, dcfg, k, jax.random.key(0), 0.0, 0, 1.0
+            )
+        )
+    def prefill_prompt(self, ids: np.ndarray, bucket: int) -> dict:
+        """Run the prompt through the draft -> a fresh [1]-row draft cache
+        holding exactly the prompt (mirrors the target-cache invariant).
+        Over-long prompts keep their LAST tokens, exactly like the target's
+        pack_token_rows clip — the two caches must hold the same prefix."""
+        ids = ids[-bucket:]
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : ids.size] = ids
+        cache = self._init_cache(self.cfg, 1, max_seq=self.cfg.max_seq)
+        _, cache = self._prefill(
+            self.params, jnp.asarray(tokens), cache,
+            jnp.asarray([max(int(ids.size), 1)], jnp.int32),
+        )
+        return cache
+
+    def propose(self, token_dev: Any, cache: dict) -> tuple[Any, dict]:
+        """k greedy draft tokens [1, k] from the pending token; writes the
+        proposed prefix into the draft cache (rolled back on rejection)."""
+        return self._chunk(self.params, token_dev, cache)
+
+    def reset_len(self, cache: dict, n: int) -> dict:
+        return _cache_with_len(cache, jnp.asarray(n, jnp.int32))
 
 
 class _PrefillState(dict):
@@ -1205,7 +1488,7 @@ def _load_or_init(model_path: Optional[str], init_fn: Any) -> Any:
 
 def _build_runner(
     name: str,
-    quant: bool,
+    quant: Any,
     model_path: Optional[str],
     max_batch: int = 8,
     mesh: Optional[Any] = None,
@@ -1213,6 +1496,9 @@ def _build_runner(
     max_seq: Optional[int] = None,
     buckets: Optional[tuple[int, ...]] = None,
     kv_dtype: Optional[Any] = None,
+    draft_name: str = "",
+    draft_tokens: int = 4,
+    draft_path: Optional[str] = None,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
@@ -1224,7 +1510,8 @@ def _build_runner(
         return _TransformerRunner(
             name, quant, model_path, max_batch, mesh=mesh,
             decode_chunk=decode_chunk, max_seq=max_seq, buckets=buckets,
-            kv_dtype=kv_dtype,
+            kv_dtype=kv_dtype, draft_name=draft_name,
+            draft_tokens=draft_tokens, draft_path=draft_path,
         )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "

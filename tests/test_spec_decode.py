@@ -1,0 +1,158 @@
+"""Greedy speculative decoding: draft-and-verify must be bit-identical to
+plain greedy decode whatever the draft proposes — the draft only sets the
+acceptance rate, never the output."""
+
+import os
+import threading
+
+import pytest
+
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.logging import Level
+from gofr_tpu.metrics import Registry
+from gofr_tpu.testutil import MockLogger
+from gofr_tpu.tpu.device import new_device
+
+
+def _device(**env):
+    defaults = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2", "BATCH_TIMEOUT_MS": "1"}
+    defaults.update(env)
+    old = {k: os.environ.get(k) for k in defaults}
+    os.environ.update(defaults)
+    try:
+        return new_device(EnvConfig(), MockLogger(Level.INFO), Registry()), old
+    finally:
+        pass
+
+
+def _restore(old):
+    for k, v in old.items():
+        os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+@pytest.fixture(scope="module")
+def plain():
+    dev, old = _device(DECODE_POOL="off", DECODE_CHUNK="4")
+    yield dev
+    dev.close()
+    _restore(old)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    # draft "tiny" for target "tiny" but seeded differently (the engine
+    # inits drafts from key(1)): real accept AND reject traffic
+    dev, old = _device(DRAFT_MODEL_NAME="tiny", DRAFT_TOKENS="4",
+                       DECODE_POOL="off", DECODE_CHUNK="4")
+    yield dev
+    dev.close()
+    _restore(old)
+
+
+def test_spec_exactly_matches_plain_greedy(plain, spec):
+    for prompt, n in (([1, 2, 3], 12), ([7] * 30, 6), ([42], 1), ([5, 6], 17)):
+        assert spec.generate(prompt, max_new_tokens=n) == \
+            plain.generate(prompt, max_new_tokens=n), (prompt, n)
+
+
+def test_spec_engine_actually_ran(spec):
+    spec.generate([9, 8, 7], max_new_tokens=10)
+    stats = spec.runner.spec_stats
+    assert stats["cycles"] > 0 and stats["drafted"] >= stats["accepted"] >= 0
+    # acceptance gauge exposed after generate
+    text = spec.metrics.expose()
+    assert any(
+        line.startswith('gofr_tpu_spec_acceptance{model="tiny"}')
+        for line in text.splitlines()
+    ), text
+
+
+def test_spec_respects_stop_tokens(plain, spec):
+    full = plain.generate([1, 2, 3], max_new_tokens=10)
+    stop_tok = full[5]
+    want = full[: full.index(stop_tok)]
+    assert spec.generate([1, 2, 3], max_new_tokens=10,
+                         stop_tokens=[stop_tok]) == want
+
+
+def test_spec_streams_and_cancels(spec):
+    stop = threading.Event()
+    seen = []
+
+    def on_token(t):
+        seen.append(t)
+        if len(seen) >= 3:
+            stop.set()
+
+    out = spec.generate([1, 2, 3], max_new_tokens=200, on_token=on_token,
+                        stop=stop)
+    assert out == seen
+    assert 3 <= len(out) < 200
+
+
+def test_spec_cache_capacity_tail(plain, spec):
+    # tiny max_seq=128; a near-full prompt forces the plain-step tail path
+    prompt = list(range(1, 120))
+    assert spec.generate(prompt, max_new_tokens=50) == \
+        plain.generate(prompt, max_new_tokens=50)
+
+
+def test_sampled_requests_skip_spec(spec):
+    from gofr_tpu.ops.sampling import Sampler
+
+    before = dict(spec.runner.spec_stats)
+    s = Sampler(temperature=1.0, seed=3)
+    out = spec.generate([1, 2, 3], max_new_tokens=5, sampler=s)
+    assert len(out) == 5
+    assert spec.runner.spec_stats == before  # sampled path never drafts
+
+
+def test_spec_overlong_prompt_clips_like_target():
+    # prompt longer than the largest bucket: both caches keep the LAST
+    # bucket tokens; spec must still match plain exactly (no crash)
+    plain_dev, old1 = _device(DECODE_POOL="off", MODEL_BUCKETS="64")
+    spec_dev, old2 = _device(DRAFT_MODEL_NAME="tiny", DECODE_POOL="off",
+                             MODEL_BUCKETS="64")
+    try:
+        prompt = [(i % 9) + 1 for i in range(100)]
+        assert spec_dev.generate(prompt, max_new_tokens=8) == \
+            plain_dev.generate(prompt, max_new_tokens=8)
+    finally:
+        plain_dev.close()
+        spec_dev.close()
+        _restore(old1)
+        _restore(old2)
+
+
+def test_draft_tokens_must_allow_acceptance():
+    env = {"MODEL_NAME": "tiny", "DRAFT_MODEL_NAME": "tiny", "DRAFT_TOKENS": "1"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        with pytest.raises(ValueError, match=">= 2"):
+            new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+    finally:
+        _restore(old)
+
+
+def test_vocab_mismatch_fails_fast():
+    # "small" has a different vocab than "tiny": must raise, not mis-verify
+    env = {"MODEL_NAME": "tiny", "DRAFT_MODEL_NAME": "small"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        with pytest.raises(ValueError, match="vocab"):
+            new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+    finally:
+        _restore(old)
+
+
+def test_unknown_draft_name_fails_fast():
+    env = {"MODEL_NAME": "tiny", "DRAFT_MODEL_NAME": "nope"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        with pytest.raises(ValueError, match="DRAFT_MODEL_NAME"):
+            new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+    finally:
+        _restore(old)
