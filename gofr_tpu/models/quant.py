@@ -154,9 +154,19 @@ def quantize_params(params: dict, mode: Any = "int8") -> dict:
 
     def walk(tree: Any) -> Any:
         if isinstance(tree, dict):
+            # MoE expert stacks (the dict also holds the router) compute
+            # their FFN via batched einsum over the expert axis, not mm()
+            # — those keys stay dense; the attention weights beside them
+            # quantize normally
+            skip = {"w_gate", "w_up", "w_down"} if "router" in tree else set()
             out = {}
             for key, value in tree.items():
-                if key in _QUANT_KEYS and isinstance(value, jnp.ndarray) and value.ndim >= 2:
+                if (
+                    key in _QUANT_KEYS
+                    and key not in skip
+                    and isinstance(value, jnp.ndarray)
+                    and value.ndim >= 2
+                ):
                     out[key] = quantize(value)
                 else:
                     out[key] = walk(value)

@@ -244,28 +244,22 @@ def init_cache(cfg: TransformerConfig, batch: int, max_seq: int | None = None) -
     }
 
 
-def _forward_with_cache(
-    params: dict,
-    tokens: jnp.ndarray,
-    cache: dict,
-    cfg: TransformerConfig,
-    lengths: Optional[jnp.ndarray],
-) -> tuple[jnp.ndarray, dict]:
-    """Run ``tokens`` [B, S] starting at per-request ``cache['lengths']``.
-    ``lengths`` [B] gives the true (un-padded) token count of this call per
-    request (defaults to S). Returns logits at each request's final real
-    position and the updated cache."""
+def _run_cached(
+    params: dict, tokens: jnp.ndarray, cache: dict, cfg: TransformerConfig
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shared cached-forward body (prefill, decode, and the speculative
+    verify all run THIS): ``tokens`` [B, S] starting at per-request
+    ``cache['lengths']``. Returns the final-norm hidden states [B, S, D],
+    the updated k/v stacks, and ``starts`` [B].
+
+    Keys valid for query j of request b: cache positions <= starts_b + j
+    (causal handles the per-query bound; kv_lens bounds the written region
+    so never-written cache slots are excluded)."""
     b, s = tokens.shape
     starts = cache["lengths"]  # [B]
-    if lengths is None:
-        lengths = jnp.full((b,), s, jnp.int32)
     freqs = jnp.asarray(_cached_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta))
     positions = starts[:, None] + jnp.arange(s)[None, :]  # [B, S]
     x = params["embed"][tokens]
-
-    # keys valid for query j of request b: cache positions <= starts_b + j
-    # (causal handles the per-query bound; kv_lens bounds the written
-    # region so never-written cache slots are excluded)
     written = starts + s  # [B]
 
     def body(carry, inputs):
@@ -279,7 +273,24 @@ def _forward_with_cache(
     x, (k_new, v_new) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
-    x = rms_norm(x, params["norm_f"], cfg.norm_eps)
+    return rms_norm(x, params["norm_f"], cfg.norm_eps), k_new, v_new, starts
+
+
+def _forward_with_cache(
+    params: dict,
+    tokens: jnp.ndarray,
+    cache: dict,
+    cfg: TransformerConfig,
+    lengths: Optional[jnp.ndarray],
+) -> tuple[jnp.ndarray, dict]:
+    """Run ``tokens`` [B, S] starting at per-request ``cache['lengths']``.
+    ``lengths`` [B] gives the true (un-padded) token count of this call per
+    request (defaults to S). Returns logits at each request's final real
+    position and the updated cache."""
+    b, s = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
+    x, k_new, v_new, starts = _run_cached(params, tokens, cache, cfg)
     # gather each request's last REAL position (pad-aware bucketed prefill)
     last_idx = jnp.clip(lengths - 1, 0, s - 1)  # [B]
     x_last = jnp.take_along_axis(x, last_idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
@@ -312,33 +323,23 @@ def verify_chunk(
 ) -> tuple[jnp.ndarray, dict]:
     """Target-model verification step for speculative decoding: run
     ``tokens`` [B, S] (the pending token followed by S-1 draft tokens)
-    through the cached forward and return the GREEDY next token at EVERY
-    position [B, S] plus the advanced cache. Position i's argmax is the
-    target's continuation after consuming tokens[:i+1] — the host accepts
-    the longest draft prefix that matches and takes position n as the
-    bonus token. One dispatch verifies a whole draft chunk."""
-    b, s = tokens.shape
-    starts = cache["lengths"]
-    freqs = jnp.asarray(_cached_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta))
-    positions = starts[:, None] + jnp.arange(s)[None, :]
-    x = params["embed"][tokens]
-    written = starts + s
+    through the SAME cached forward as prefill/decode (``_run_cached``)
+    and return the greedy next token at EVERY position [B, S] plus the
+    advanced cache. Position i's argmax is the target's continuation
+    after consuming tokens[:i+1] — the host accepts the longest draft
+    prefix that matches and takes position n as the bonus token. One
+    dispatch verifies a whole draft chunk.
 
-    def body(carry, inputs):
-        layer_params, k_cache, v_cache = inputs
-        y, (k_cache, v_cache), _ = _block(
-            cfg, layer_params, carry, freqs, positions,
-            kv_cache=(k_cache, v_cache), starts=starts, kv_lens=written,
-        )
-        return y, (k_cache, v_cache)
-
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
-    )
-    x = rms_norm(x, params["norm_f"], cfg.norm_eps)
-    logits = _mm(x, params["lm_head"])  # [B, S, V]
+    Logits are computed in f32 (same cast as ``_forward_with_cache``) so
+    the verify argmax sees the decode path's numerics; note XLA may still
+    schedule the [B,S,·] matmuls differently than the [B,1,·] decode
+    shapes, so near-tie logits can in principle break exact greedy
+    equality on low-precision checkpoints."""
+    s = tokens.shape[1]
+    x, k_new, v_new, starts = _run_cached(params, tokens, cache, cfg)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)  # [B, S, V]
     next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    new_cache = {"k": k_new, "v": v_new, "lengths": written}
+    new_cache = {"k": k_new, "v": v_new, "lengths": starts + s}
     return next_ids, new_cache
 
 

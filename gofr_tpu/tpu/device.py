@@ -11,6 +11,9 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
   step, so packed weights raise its throughput ceiling 2x / ~4x over bf16
 - ``MODEL_KV_DTYPE``: "f8" stores the KV cache in float8_e4m3fn (2x
   context length or decode slots per HBM byte, small accuracy cost)
+- ``MODEL_ATTN_IMPL``: auto (default) | xla | pallas — forces the
+  attention implementation (ops/attention.py); "auto" picks the Pallas
+  flash kernel when shapes are tile-friendly and profitable
 - ``MODEL_BUCKETS``: comma-separated sequence buckets to compile at boot
   (default: the SEQ_BUCKETS ladder up to max_seq)
 - ``DRAFT_MODEL_NAME`` / ``DRAFT_TOKENS`` / ``DRAFT_MODEL_PATH``:
@@ -149,6 +152,13 @@ class TPUDevice:
         # MODEL_KV_DTYPE=f8 stores the KV cache in float8_e4m3fn — half the
         # HBM per cached token, so 2x MODEL_MAX_SEQ (or decode slots) on a
         # capacity-bound chip at a small accuracy cost
+        attn_raw = config.get_or_default("MODEL_ATTN_IMPL", "").strip().lower()
+        if attn_raw not in ("", "auto", "xla", "pallas"):
+            raise ValueError(
+                f"MODEL_ATTN_IMPL '{attn_raw}' not supported — use auto, "
+                "xla, or pallas"
+            )
+        self._attn_impl = attn_raw or None
         kv_raw = config.get_or_default("MODEL_KV_DTYPE", "").strip().lower()
         if kv_raw in ("", "bf16", "bfloat16"):
             self._kv_dtype = None
@@ -177,10 +187,11 @@ class TPUDevice:
         self._draft_name = config.get_or_default("DRAFT_MODEL_NAME", "").strip()
         self._draft_tokens = int(config.get_or_default("DRAFT_TOKENS", "4"))
         self._draft_path = config.get("DRAFT_MODEL_PATH")
-        if self._draft_tokens < 2:
+        if self._draft_name and self._draft_tokens < 2:
             # acceptance is capped at k-1 (the draft cache holds at most k
             # committed positions per cycle), so k=1 could never accept a
-            # draft — strictly slower than plain decode
+            # draft — strictly slower than plain decode. A stale
+            # DRAFT_TOKENS without a draft model is ignored.
             raise ValueError("DRAFT_TOKENS must be >= 2")
         self._pool_enabled = config.get_or_default("DECODE_POOL", "on") != "off"
         self._pool_slots = int(config.get_or_default("DECODE_SLOTS", str(self.max_batch)))
@@ -294,6 +305,7 @@ class TPUDevice:
             max_seq=self._max_seq_cfg, buckets=self._buckets_cfg,
             kv_dtype=self._kv_dtype, draft_name=self._draft_name,
             draft_tokens=self._draft_tokens, draft_path=self._draft_path,
+            attn_impl=self._attn_impl,
         )
         self.runner.warmup(progress=self._boot_progress)
         # continuous batching: concurrent decodes share one fixed-shape
@@ -829,6 +841,7 @@ class _TransformerRunner:
         draft_name: str = "",
         draft_tokens: int = 4,
         draft_path: Optional[str] = None,
+        attn_impl: Optional[str] = None,
     ):
         self.max_batch = max_batch
         from gofr_tpu.models.llama import CONFIGS
@@ -850,6 +863,8 @@ class _TransformerRunner:
             overrides["max_seq"] = max_seq
         if kv_dtype is not None:
             overrides["kv_dtype"] = kv_dtype
+        if attn_impl:
+            overrides["attn_impl"] = attn_impl
         if overrides:
             import dataclasses
 
@@ -1184,10 +1199,14 @@ class _TransformerRunner:
         returns the target's argmaxes plus the on-device accepted count —
         so an accepted prefix of n tokens costs the target a single
         weight stream instead of n. Every emitted token is the target's
-        own argmax (the accepted drafts equal it by construction), so
-        output is bit-identical to plain greedy decode whatever the draft
-        proposes. Acceptance is capped at k-1 so the draft cache always
-        contains the committed prefix (its chunk writes k positions)."""
+        own argmax under the verify computation (accepted drafts equal it
+        by construction), so output never depends on draft quality; with
+        matched numerics this reproduces plain greedy decode exactly
+        (asserted in tests — note the verify matmuls run at [B, k+1]
+        shapes, so near-tie bf16 logits can in principle flip an argmax
+        vs the [B, 1] decode shapes). Acceptance is capped at k-1 so the
+        draft cache always contains the committed prefix (its chunk
+        writes k positions)."""
         spec = self.spec
         k = spec.k
         cache = state["cache"]
@@ -1386,6 +1405,12 @@ class _SpecEngine:
                 f"draft '{draft_name}' max_seq {cfg.max_seq} < target "
                 f"serving max_seq {target_cfg.max_seq}"
             )
+        if k + 2 > target_cfg.max_seq:
+            raise ValueError(
+                f"DRAFT_TOKENS {k} cannot fit a verify (k+1 tokens) in the "
+                f"serving cache (max_seq {target_cfg.max_seq}) — spec "
+                "decoding would silently never engage"
+            )
         import dataclasses
 
         self.cfg = dataclasses.replace(cfg, max_seq=target_cfg.max_seq)
@@ -1499,6 +1524,7 @@ def _build_runner(
     draft_name: str = "",
     draft_tokens: int = 4,
     draft_path: Optional[str] = None,
+    attn_impl: Optional[str] = None,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
@@ -1512,6 +1538,7 @@ def _build_runner(
             decode_chunk=decode_chunk, max_seq=max_seq, buckets=buckets,
             kv_dtype=kv_dtype, draft_name=draft_name,
             draft_tokens=draft_tokens, draft_path=draft_path,
+            attn_impl=attn_impl,
         )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "

@@ -106,3 +106,29 @@ def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1) -> Any:
         optax.clip_by_global_norm(1.0),
         optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay),
     )
+
+
+def warmup_cosine_optimizer(
+    peak_lr: float = 3e-4,
+    total_steps: int = 10_000,
+    warmup_steps: int = 200,
+    final_lr_frac: float = 0.1,
+    weight_decay: float = 0.1,
+) -> Any:
+    """The standard LLM pretraining schedule: linear warmup to ``peak_lr``
+    then cosine decay to ``final_lr_frac``·peak over ``total_steps``, with
+    grad clipping and AdamW — a drop-in for ``default_optimizer`` when the
+    run length is known. Schedules are pure functions of the step count,
+    so checkpoint-resume (the step rides in the opt state) reproduces the
+    exact LR trajectory."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=peak_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=total_steps,
+        end_value=peak_lr * final_lr_frac,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )

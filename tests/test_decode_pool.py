@@ -21,8 +21,9 @@ def _device(**env):
     os.environ.update(defaults)
     try:
         return new_device(EnvConfig(), MockLogger(Level.INFO), Registry()), old
-    finally:
-        pass
+    except BaseException:
+        _restore(old)  # a failed boot must not leak env into later tests
+        raise
 
 
 def _restore(old):

@@ -97,6 +97,23 @@ def test_ep_capacity_overflow_is_finite(params, tokens):
     assert np.isfinite(np.asarray(logits)).all()
 
 
+def test_moe_quantization_skips_experts_quantizes_attention(params, tokens):
+    from gofr_tpu.models.quant import is_quantized, quantize_params
+
+    q = quantize_params(params, "int8")
+    layer = q["layers"]
+    # expert FFN stacks run through batched einsums, never mm(): dense
+    assert not is_quantized(layer["w_gate"])
+    assert not is_quantized(layer["w_up"])
+    assert not is_quantized(layer["w_down"])
+    # attention weights beside them route through mm(): packed
+    assert is_quantized(layer["wq"]) and is_quantized(layer["wo"])
+    assert is_quantized(q["lm_head"])
+    # the quantized tree still runs the full forward
+    logits, aux = jax.jit(lambda p, t: moe_forward(p, t, CFG))(q, tokens)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
 def test_ep_rejects_indivisible_experts(params):
     mesh = make_mesh(mesh_shape_for(8, ep=8), devices=jax.devices()[:8])
     bad = MoEConfig(

@@ -21,8 +21,9 @@ def _device(**env):
     os.environ.update(defaults)
     try:
         return new_device(EnvConfig(), MockLogger(Level.INFO), Registry()), old
-    finally:
-        pass
+    except BaseException:
+        _restore(old)  # a failed boot must not leak env into later tests
+        raise
 
 
 def _restore(old):
@@ -120,8 +121,9 @@ def test_spec_overlong_prompt_clips_like_target():
     finally:
         plain_dev.close()
         spec_dev.close()
-        _restore(old1)
+        # reverse order: old2 snapshotted values old1's _device had set
         _restore(old2)
+        _restore(old1)
 
 
 def test_draft_tokens_must_allow_acceptance():

@@ -546,6 +546,28 @@ def test_int4_serving_generates():
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
 
 
+def test_attn_impl_override():
+    import os
+
+    env = {"MODEL_NAME": "tiny", "MODEL_ATTN_IMPL": "xla", "BATCH_MAX_SIZE": "2",
+           "BATCH_TIMEOUT_MS": "1"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            assert device.runner.cfg.attn_impl == "xla"
+            assert len(device.generate([1, 2, 3], max_new_tokens=4)) == 4
+        finally:
+            device.close()
+        os.environ["MODEL_ATTN_IMPL"] = "nope"
+        with pytest.raises(ValueError, match="MODEL_ATTN_IMPL"):
+            new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
 def test_bad_model_quant_fails_fast():
     import os
 
