@@ -21,6 +21,9 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
   DRAFT_TOKENS tokens per cycle and the target verifies them in one
   forward (output bit-identical to plain greedy; latency mode, so greedy
   requests bypass the continuous-batching pool)
+- ``PREFIX_CACHE``: keep the KV rows of the n most recent distinct
+  prompts — an exact repeat (system prompts, retries) skips prefill
+  entirely on the generate path (hit ratio on /metrics)
 - ``TPU_BOOT``: "background" boots the stack off-thread; the server
   accepts immediately and /.well-known/ready reports warmup progress
 - ``BATCH_MAX_SIZE`` / ``BATCH_TIMEOUT_MS``: batcher shape
@@ -145,6 +148,11 @@ class TPUDevice:
             "speculative decoding: accepted draft tokens / drafted",
             labels=("model",),
         )
+        self._prefix_gauge = metrics.gauge(
+            "gofr_tpu_prefix_hit_ratio",
+            "prefix cache: prompt hits / lookups",
+            labels=("model",),
+        )
 
         self._decode_chunk_cfg = int(config.get_or_default("DECODE_CHUNK", "8"))
         raw_max_seq = config.get("MODEL_MAX_SEQ")
@@ -193,6 +201,12 @@ class TPUDevice:
             # draft — strictly slower than plain decode. A stale
             # DRAFT_TOKENS without a draft model is ignored.
             raise ValueError("DRAFT_TOKENS must be >= 2")
+        # PREFIX_CACHE=n keeps the KV rows of the n most recent distinct
+        # prompts: an exact-match repeat (system prompts, retries) skips
+        # prefill entirely — TTFT collapses to the decode path
+        self._prefix_cache_size = int(config.get_or_default("PREFIX_CACHE", "0"))
+        if self._prefix_cache_size < 0:
+            raise ValueError("PREFIX_CACHE must be >= 0")
         self._pool_enabled = config.get_or_default("DECODE_POOL", "on") != "off"
         self._pool_slots = int(config.get_or_default("DECODE_SLOTS", str(self.max_batch)))
         self._last_reinit = 0.0
@@ -306,6 +320,7 @@ class TPUDevice:
             kv_dtype=self._kv_dtype, draft_name=self._draft_name,
             draft_tokens=self._draft_tokens, draft_path=self._draft_path,
             attn_impl=self._attn_impl,
+            prefix_cache=self._prefix_cache_size,
         )
         self.runner.warmup(progress=self._boot_progress)
         # continuous batching: concurrent decodes share one fixed-shape
@@ -430,6 +445,12 @@ class TPUDevice:
             if stats and stats["drafted"]:
                 self._spec_gauge.set(
                     stats["accepted"] / stats["drafted"], model=self.model_name
+                )
+            pstats = getattr(self.runner, "prefix_stats", None)
+            if pstats and (pstats["hits"] + pstats["misses"]):
+                self._prefix_gauge.set(
+                    pstats["hits"] / (pstats["hits"] + pstats["misses"]),
+                    model=self.model_name,
                 )
             return out
         except Exception:
@@ -842,6 +863,7 @@ class _TransformerRunner:
         draft_tokens: int = 4,
         draft_path: Optional[str] = None,
         attn_impl: Optional[str] = None,
+        prefix_cache: int = 0,
     ):
         self.max_batch = max_batch
         from gofr_tpu.models.llama import CONFIGS
@@ -947,6 +969,18 @@ class _TransformerRunner:
             else None
         )
         self.spec_stats = {"cycles": 0, "drafted": 0, "accepted": 0}
+        # prefix cache: prompt bytes -> (cache_row, length, next_token).
+        # Rows are shared read-only: neither the solo decode chunk nor the
+        # pool's write_slot donates/mutates its row input, so one stored
+        # row can seed any number of later generations.
+        from collections import OrderedDict
+
+        self._prefix_cache: Optional[OrderedDict] = (
+            OrderedDict() if prefix_cache > 0 else None
+        )
+        self._prefix_cache_size = prefix_cache
+        self._prefix_lock = threading.Lock()
+        self.prefix_stats = {"hits": 0, "misses": 0}
         if self.spec is not None:
             from gofr_tpu.models.transformer import verify_chunk
 
@@ -955,6 +989,9 @@ class _TransformerRunner:
         # shared key for greedy decode (temperature 0 ignores it): skips a
         # per-chunk split op, which costs a dispatch on tunneled links
         self._greedy_key = jax.random.key(0)
+        # device-side row copy for prefix-cache entries: stored rows must
+        # survive any later donation of the live row (and vice versa)
+        self._copy_row = jax.jit(lambda c: jax.tree.map(jnp.copy, c))
         # preallocated zero caches per batch size: prefill never mutates its
         # input cache, so one shared zero cache per bsz removes per-batch
         # allocation dispatches (the tunneled device link makes every
@@ -1050,10 +1087,14 @@ class _TransformerRunner:
             sampler = Sampler()  # greedy
         stop_tokens = frozenset(stop_tokens or ())
         ids = self.prepare(tokens)
-        if prefill_batcher is not None:
-            state = prefill_batcher.infer(ids)
-        else:
-            state = self.run_batch([ids])[0]
+        state = self._prefix_lookup(ids) if self._prefix_cache is not None else None
+        if state is None:
+            if prefill_batcher is not None:
+                state = prefill_batcher.infer(ids)
+            else:
+                state = self.run_batch([ids])[0]
+            if self._prefix_cache is not None:
+                self._prefix_store(ids, state)
         out: list[int] = []
         if sampler.greedy:
             token = state["next_token"]  # device-argmaxed; no logits fetch
@@ -1182,6 +1223,40 @@ class _TransformerRunner:
             if len(out) >= max_new_tokens:
                 stopped = True
         return out
+
+    def _prefix_lookup(self, ids: np.ndarray) -> Optional[dict]:
+        """Exact-match prompt lookup -> a private state (copied cache row;
+        shared read-only logits) or None. LRU order updates on hit."""
+        key = ids.tobytes()
+        with self._prefix_lock:
+            entry = self._prefix_cache.get(key)
+            if entry is None:
+                self.prefix_stats["misses"] += 1
+                return None
+            self._prefix_cache.move_to_end(key)
+            self.prefix_stats["hits"] += 1
+        row, length, next_token, logits = entry
+        return {
+            "cache": self._copy_row(row),
+            "length": length,
+            "next_token": next_token,
+            "logits": logits,
+        }
+
+    def _prefix_store(self, ids: np.ndarray, state: Any) -> None:
+        """Store this prompt's prefill result (copied row — the live row
+        continues into decode); evict least-recently-used beyond the
+        configured size."""
+        entry = (
+            self._copy_row(state["cache"]),
+            state["length"],
+            state["next_token"],
+            state["logits"],
+        )
+        with self._prefix_lock:
+            self._prefix_cache[ids.tobytes()] = entry
+            while len(self._prefix_cache) > self._prefix_cache_size:
+                self._prefix_cache.popitem(last=False)
 
     def _spec_generate(
         self,
@@ -1525,6 +1600,7 @@ def _build_runner(
     draft_tokens: int = 4,
     draft_path: Optional[str] = None,
     attn_impl: Optional[str] = None,
+    prefix_cache: int = 0,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
@@ -1538,7 +1614,7 @@ def _build_runner(
             decode_chunk=decode_chunk, max_seq=max_seq, buckets=buckets,
             kv_dtype=kv_dtype, draft_name=draft_name,
             draft_tokens=draft_tokens, draft_path=draft_path,
-            attn_impl=attn_impl,
+            attn_impl=attn_impl, prefix_cache=prefix_cache,
         )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "

@@ -101,7 +101,8 @@ def place_train_state(state: dict, mesh: Mesh) -> dict:
     return {"params": params, "opt_state": opt_state, "step": step}
 
 
-def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1) -> Any:
+def default_optimizer(lr: Any = 3e-4, weight_decay: float = 0.1) -> Any:
+    """Grad clip + AdamW. ``lr`` is a float or an optax schedule."""
     return optax.chain(
         optax.clip_by_global_norm(1.0),
         optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay),
@@ -128,7 +129,4 @@ def warmup_cosine_optimizer(
         decay_steps=total_steps,
         end_value=peak_lr * final_lr_frac,
     )
-    return optax.chain(
-        optax.clip_by_global_norm(1.0),
-        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
-    )
+    return default_optimizer(schedule, weight_decay)

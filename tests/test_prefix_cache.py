@@ -1,0 +1,121 @@
+"""Prefix cache (PREFIX_CACHE=n): exact-prompt repeats skip prefill and
+must produce identical generations; entries are private copies, LRU-bound,
+and safe under the decode pool and sampling."""
+
+import os
+import threading
+
+import pytest
+
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.logging import Level
+from gofr_tpu.metrics import Registry
+from gofr_tpu.ops.sampling import Sampler
+from gofr_tpu.testutil import MockLogger
+from gofr_tpu.tpu.device import new_device
+
+
+def _restore(old):
+    for k, v in old.items():
+        os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def _device(**env):
+    # PREFIX_CACHE defaults OFF here so the 'plain' baseline stays a real
+    # no-cache device even while 'cached' has the env var set
+    defaults = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2",
+                "BATCH_TIMEOUT_MS": "1", "PREFIX_CACHE": "0"}
+    defaults.update(env)
+    old = {k: os.environ.get(k) for k in defaults}
+    os.environ.update(defaults)
+    try:
+        return new_device(EnvConfig(), MockLogger(Level.INFO), Registry()), old
+    except BaseException:
+        _restore(old)
+        raise
+
+
+@pytest.fixture(scope="module")
+def cached():
+    dev, old = _device(PREFIX_CACHE="2", DECODE_CHUNK="4")
+    yield dev
+    dev.close()
+    _restore(old)
+
+
+@pytest.fixture(scope="module")
+def plain():
+    dev, old = _device(DECODE_CHUNK="4")
+    yield dev
+    dev.close()
+    _restore(old)
+
+
+def test_repeat_prompt_hits_and_matches(cached, plain):
+    prompt = [1, 2, 3, 4]
+    want = plain.generate(prompt, max_new_tokens=8)
+    first = cached.generate(prompt, max_new_tokens=8)
+    stats_after_first = dict(cached.runner.prefix_stats)
+    second = cached.generate(prompt, max_new_tokens=8)
+    assert first == want and second == want
+    assert cached.runner.prefix_stats["hits"] == stats_after_first["hits"] + 1
+    # hit-ratio gauge exposed
+    text = cached.metrics.expose()
+    assert any(
+        ln.startswith('gofr_tpu_prefix_hit_ratio{model="tiny"}')
+        for ln in text.splitlines()
+    ), text
+
+
+def test_hit_entry_survives_reuse(cached):
+    # three generations off one stored entry, interleaved with another
+    # prompt: stored rows must not be corrupted by earlier decodes
+    a = cached.generate([9, 8, 7], max_new_tokens=6)
+    cached.generate([5, 5, 5], max_new_tokens=6)
+    b = cached.generate([9, 8, 7], max_new_tokens=6)
+    c = cached.generate([9, 8, 7], max_new_tokens=6)
+    assert a == b == c
+
+
+def test_lru_eviction_bounds_entries(cached):
+    for i in range(5):
+        cached.generate([i + 1, i + 2], max_new_tokens=2)
+    assert len(cached.runner._prefix_cache) <= 2
+
+
+def test_sampled_requests_use_cached_logits(cached):
+    # seeded sampling works off a cache hit (the stored logits row)
+    prompt = [3, 1, 4, 1, 5]
+    a = cached.generate(prompt, max_new_tokens=6,
+                        sampler=Sampler(temperature=1.0, seed=7))
+    b = cached.generate(prompt, max_new_tokens=6,
+                        sampler=Sampler(temperature=1.0, seed=7))
+    assert a == b
+
+
+def test_concurrent_hits_are_safe(cached, plain):
+    prompt = [2, 7, 1, 8]
+    want = plain.generate(prompt, max_new_tokens=6)
+    cached.generate(prompt, max_new_tokens=6)  # seed the entry
+    got = [None] * 4
+
+    def run(i):
+        got[i] = cached.generate(prompt, max_new_tokens=6)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(g == want for g in got)
+
+
+def test_negative_size_rejected():
+    env = {"MODEL_NAME": "tiny", "PREFIX_CACHE": "-1"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        with pytest.raises(ValueError, match="PREFIX_CACHE"):
+            new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+    finally:
+        _restore(old)
