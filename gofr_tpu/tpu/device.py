@@ -15,7 +15,10 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
   attention implementation (ops/attention.py); "auto" picks the Pallas
   flash kernel when shapes are tile-friendly and profitable
 - ``MODEL_BUCKETS``: comma-separated sequence buckets to compile at boot
-  (default: the SEQ_BUCKETS ladder up to max_seq)
+  (default: the SEQ_BUCKETS ladder up to max_seq). Prompts longer than
+  the largest bucket prefill CHUNKED through it on the generate path
+  (full context from one small compiled shape; fast cold boot) — the
+  batched /infer path keeps the recency clip
 - ``DRAFT_MODEL_NAME`` / ``DRAFT_TOKENS`` / ``DRAFT_MODEL_PATH``:
   greedy speculative decoding — a small same-vocab draft model proposes
   DRAFT_TOKENS tokens per cycle and the target verifies them in one
@@ -1089,7 +1092,12 @@ class _TransformerRunner:
         ids = self.prepare(tokens)
         state = self._prefix_lookup(ids) if self._prefix_cache is not None else None
         if state is None:
-            if prefill_batcher is not None:
+            if ids.size > self.buckets[-1] and self._can_chunk_prefill():
+                # longer than the largest compiled bucket: slice through it
+                # instead of truncating (run_batch's batched path keeps the
+                # recency clip — mixed-length chunking doesn't batch)
+                state = self._chunked_prefill(ids)
+            elif prefill_batcher is not None:
                 state = prefill_batcher.infer(ids)
             else:
                 state = self.run_batch([ids])[0]
@@ -1224,6 +1232,43 @@ class _TransformerRunner:
                 stopped = True
         return out
 
+    def _can_chunk_prefill(self) -> bool:
+        """Chunked prefill builds a [1]-row cache; under a mesh that only
+        works when the cache's batch axis is unsharded (tp-only meshes)."""
+        if self.mesh is None:
+            return True
+        return self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1) == 1
+
+    def _chunked_prefill(self, ids: np.ndarray) -> dict:
+        """Prefill a prompt LONGER than the largest compiled bucket by
+        running it through the top bucket in slices, each writing into the
+        same [1]-row cache at its ragged start offset — the exact cached
+        forward decode already uses. One compiled [1, bucket] shape serves
+        any prompt length up to max_seq, so a deployment can restrict
+        MODEL_BUCKETS (fast cold boot) without truncating long prompts.
+        ONE host fetch at the end (the last chunk's argmax)."""
+        bucket = self.buckets[-1]
+        # the shared zero cache: prefill never mutates its input, so every
+        # chunked request can start from the same [1]-row allocation
+        cache = self._zero_cache(1)
+        logits = next_ids = None
+        total = 0
+        for start in range(0, int(ids.size), bucket):
+            chunk = ids[start : start + bucket]
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, : chunk.size] = chunk
+            logits, next_ids, cache = self._prefill(
+                self.params, jnp.asarray(tokens), cache,
+                jnp.asarray([chunk.size], jnp.int32),
+            )
+            total += int(chunk.size)
+        return {
+            "cache": cache,
+            "length": total,
+            "next_token": int(np.asarray(next_ids)[0]),
+            "logits": logits[0],
+        }
+
     def _prefix_lookup(self, ids: np.ndarray) -> Optional[dict]:
         """Exact-match prompt lookup -> a private state (copied cache row;
         shared read-only logits) or None. LRU order updates on hit."""
@@ -1288,7 +1333,12 @@ class _TransformerRunner:
         cache_len = state["length"]
         state = None
         max_len = int(cache["k"].shape[2])
-        dcache = spec.prefill_prompt(ids, self._bucket_for(int(ids.size)))
+        chunked = ids.size > self.buckets[-1] and self._can_chunk_prefill()
+        dcache = spec.prefill_prompt(
+            ids,
+            self.buckets[-1] if chunked else self._bucket_for(int(ids.size)),
+            chunked,
+        )
         stats = self.spec_stats
 
         def emit(tokens_host: list[int]) -> bool:
@@ -1384,9 +1434,21 @@ class _TransformerRunner:
                 lengths = jax.device_put(lengths, self._row_sharding)
             logits, next_ids, cache = self._prefill(self.params, tokens, cache, lengths)
             next_ids.block_until_ready()
+        if self.buckets[-1] < self.cfg.max_seq and self._can_chunk_prefill():
+            # prompts beyond the top bucket take the chunked-prefill path:
+            # warm its [1, bucket] shape so it never compiles mid-request
+            if progress:
+                progress(f"compiling chunked prefill ([1, {self.buckets[-1]}])")
+            state = self._chunked_prefill(
+                np.ones((self.buckets[-1] + 1,), np.int32)
+            )
+            del state
         if progress:
             progress("compiling decode step")
         one = _slice_cache(cache, 0)
+        if self._prefix_cache is not None:
+            # prefix-cache row copies must not compile on the serving path
+            self._copy_row(one)["lengths"].block_until_ready()
         step, _ = self._decode(self.params, jnp.zeros((1, 1), jnp.int32), one)
         step.block_until_ready()
         # warm the full decode chunk (remainder sizes compile on demand)
@@ -1407,7 +1469,7 @@ class _TransformerRunner:
                         f"compiling draft prefill bucket {bucket} "
                         f"({i + 1}/{len(self.buckets)})"
                     )
-                dcache = spec.prefill_prompt(np.ones((4,), np.int32), bucket)
+                dcache = spec.prefill_prompt(np.ones((4,), np.int32), bucket, False)
             if progress:
                 progress(f"compiling draft chunk + verify (k={spec.k})")
             dtoks, dcache = spec.propose(jnp.zeros((1, 1), jnp.int32), dcache)
@@ -1511,19 +1573,24 @@ class _SpecEngine:
                 p, t, c, dcfg, k, jax.random.key(0), 0.0, 0, 1.0
             )
         )
-    def prefill_prompt(self, ids: np.ndarray, bucket: int) -> dict:
+    def prefill_prompt(self, ids: np.ndarray, bucket: int, chunked: bool) -> dict:
         """Run the prompt through the draft -> a fresh [1]-row draft cache
         holding exactly the prompt (mirrors the target-cache invariant).
-        Over-long prompts keep their LAST tokens, exactly like the target's
-        pack_token_rows clip — the two caches must hold the same prefix."""
-        ids = ids[-bucket:]
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, : ids.size] = ids
+        ``chunked`` mirrors the target's path for over-long prompts: slice
+        through the bucket; otherwise clip to the LAST bucket tokens the
+        way the target's pack_token_rows does — the two caches must hold
+        the same prefix either way."""
+        if not chunked:
+            ids = ids[-bucket:]
         cache = self._init_cache(self.cfg, 1, max_seq=self.cfg.max_seq)
-        _, cache = self._prefill(
-            self.params, jnp.asarray(tokens), cache,
-            jnp.asarray([max(int(ids.size), 1)], jnp.int32),
-        )
+        for start in range(0, max(int(ids.size), 1), bucket):
+            chunk = ids[start : start + bucket]
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, : chunk.size] = chunk
+            _, cache = self._prefill(
+                self.params, jnp.asarray(tokens), cache,
+                jnp.asarray([max(int(chunk.size), 1)], jnp.int32),
+            )
         return cache
 
     def propose(self, token_dev: Any, cache: dict) -> tuple[Any, dict]:

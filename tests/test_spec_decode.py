@@ -108,9 +108,9 @@ def test_sampled_requests_skip_spec(spec):
     assert spec.runner.spec_stats == before  # sampled path never drafts
 
 
-def test_spec_overlong_prompt_clips_like_target():
-    # prompt longer than the largest bucket: both caches keep the LAST
-    # bucket tokens; spec must still match plain exactly (no crash)
+def test_spec_overlong_prompt_chunks_like_target():
+    # prompt longer than the largest bucket: both target and draft prefill
+    # CHUNKED through the top bucket; spec must still match plain exactly
     plain_dev, old1 = _device(DECODE_POOL="off", MODEL_BUCKETS="64")
     spec_dev, old2 = _device(DRAFT_MODEL_NAME="tiny", DECODE_POOL="off",
                              MODEL_BUCKETS="64")

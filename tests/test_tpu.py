@@ -546,6 +546,43 @@ def test_int4_serving_generates():
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
 
 
+def test_chunked_prefill_matches_full_bucket():
+    """A prompt longer than the largest compiled bucket prefills through
+    bucket-sized chunks into one cache row — generation must equal a
+    device whose ladder covers the prompt in a single shot (no
+    truncation), and the batched /infer path keeps the recency clip."""
+    import os
+
+    base = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2", "BATCH_TIMEOUT_MS": "1"}
+    old = {k: os.environ.get(k) for k in {**base, "MODEL_BUCKETS": None}}
+    prompt = [(i % 11) + 1 for i in range(100)]
+    try:
+        os.environ.update(base)
+        os.environ["MODEL_BUCKETS"] = "128"
+        full = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            want = full.generate(prompt, max_new_tokens=8)
+        finally:
+            full.close()
+        os.environ["MODEL_BUCKETS"] = "32"
+        small = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            assert small.runner.buckets == [32]
+            got = small.generate(prompt, max_new_tokens=8)
+            # same tokens from 4 chunked prefills as from one 128-bucket
+            assert got == want, (got, want)
+            # /infer (batched path) still clips to the top bucket
+            clipped = small.infer({"tokens": prompt})
+            assert clipped["next_token"] == small.infer(
+                {"tokens": prompt[-32:]}
+            )["next_token"]
+        finally:
+            small.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
 def test_attn_impl_override():
     import os
 
