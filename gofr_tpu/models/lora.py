@@ -1,0 +1,285 @@
+"""LoRA adapters: low-rank deltas on the matmul weights.
+
+TPU-first design: a LoRA-wrapped weight is just another *packed leaf*
+flowing through the same quant-aware ``mm`` the models already use
+(gofr_tpu.models.quant.mm) — ``{"w": base, "lora_a": [..., in, r],
+"lora_b": [..., r, out], "lora_scale": alpha/r}`` where ``base`` may
+itself be an int8/int4 packed dict (QLoRA-style: quantized frozen base,
+bf16 adapters). The forward is ``mm(x, base) + (x @ A) @ B * scale``; the
+low-rank path adds two skinny matmuls that XLA fuses alongside the main
+one, and stacked ``[n_layers, ...]`` weights carry stacked adapters
+through the same ``lax.scan``.
+
+Training: ``lora_mask`` drives ``optax.masked`` so the optimizer holds
+moments ONLY for adapter leaves — the base stays frozen and costs no
+optimizer memory. ``merge_lora`` folds the deltas back into plain
+weights for serving.
+
+A-init is scaled-normal, B-init zeros: a fresh adapter is an exact
+identity, so wrapping never changes outputs until training moves B.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.models.quant import (
+    _QUANT_KEYS,
+    dequantize_array,
+    dequantize_array_int4,
+    is_quantized,
+    is_quantized_int4,
+)
+
+# weight names eligible for adapters (the attention + MLP matmuls; the
+# reference LoRA recipe targets attention projections — pass ``keys`` to
+# restrict)
+_LORA_KEYS = frozenset(_QUANT_KEYS)
+
+
+def is_lora(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and set(leaf) == {
+        "w", "lora_a", "lora_b", "lora_scale",
+    }
+
+
+def lora_mm(x: jnp.ndarray, w: dict, base_mm: Any) -> jnp.ndarray:
+    """``mm`` for a LoRA leaf: the base matmul (through ``base_mm`` so a
+    quantized base keeps its fused path) plus the low-rank delta."""
+    y = base_mm(x, w["w"])
+    delta = (x @ w["lora_a"]) @ w["lora_b"]
+    return y + (delta * w["lora_scale"]).astype(y.dtype)
+
+
+def add_lora(
+    params: dict,
+    key: jax.Array,
+    rank: int = 8,
+    alpha: float = 16.0,
+    keys: Optional[Iterable[str]] = None,
+) -> dict:
+    """Wrap eligible weights with fresh (identity) adapters. Stacked
+    ``[L, in, out]`` weights get stacked ``[L, in, r]``/``[L, r, out]``
+    adapters. The wrapped tree serves and trains through the existing
+    model forwards unchanged."""
+    eligible = frozenset(keys) if keys is not None else _LORA_KEYS
+    leaves: list[tuple[str, Any]] = []
+
+    def collect(tree: Any) -> None:
+        if isinstance(tree, dict) and not _is_packed(tree):
+            for k, v in tree.items():
+                if k in eligible and _weight_shape(v) is not None:
+                    leaves.append((k, v))
+                else:
+                    collect(v)
+
+    collect(params)
+    subkeys = iter(jax.random.split(key, max(len(leaves), 1)))
+
+    def wrap(tree: Any) -> Any:
+        if isinstance(tree, dict) and not _is_packed(tree):
+            out = {}
+            for k, v in tree.items():
+                shape = _weight_shape(v)
+                if k in eligible and shape is not None:
+                    lead, i, o = shape
+                    a = (
+                        jax.random.normal(next(subkeys), (*lead, i, rank))
+                        * (i ** -0.5)
+                    ).astype(jnp.bfloat16)
+                    b = jnp.zeros((*lead, rank, o), jnp.bfloat16)
+                    out[k] = {
+                        "w": v,
+                        "lora_a": a,
+                        "lora_b": b,
+                        # [*lead, 1, 1] so stacked layer weights scan their
+                        # scale alongside the adapters (scan slices every
+                        # leaf's leading axis)
+                        "lora_scale": jnp.full(
+                            (*lead, 1, 1), alpha / rank, jnp.float32
+                        ),
+                    }
+                else:
+                    out[k] = wrap(v)
+            return out
+        return tree
+
+    return wrap(params)
+
+
+def _is_packed(tree: dict) -> bool:
+    return is_quantized(tree) or is_quantized_int4(tree) or is_lora(tree)
+
+
+def _weight_shape(v: Any) -> Optional[tuple[tuple[int, ...], int, int]]:
+    """(leading dims, in, out) for a wrappable weight: a plain >=2-D array
+    or a quantized packed dict (QLoRA base)."""
+    if isinstance(v, dict):
+        if is_quantized(v) or is_quantized_int4(v):
+            q = v.get("q", v.get("q4"))
+            return q.shape[:-2], q.shape[-2], q.shape[-1]
+        return None
+    if hasattr(v, "ndim") and v.ndim >= 2:
+        return v.shape[:-2], v.shape[-2], v.shape[-1]
+    return None
+
+
+def lora_mask(params: dict) -> Any:
+    """True exactly at adapter leaves (``lora_a``/``lora_b``) — the mask
+    for ``optax.masked``: the optimizer sees only adapter parameters."""
+
+    def walk(tree: Any) -> Any:
+        if is_lora(tree):
+            return {
+                "w": jax.tree.map(lambda _: False, tree["w"]),
+                "lora_a": True,
+                "lora_b": True,
+                "lora_scale": False,
+            }
+        if isinstance(tree, dict):
+            return {k: walk(v) for k, v in tree.items()}
+        return False
+
+    return walk(params)
+
+
+def lora_optimizer(inner: Any, params: dict) -> Any:
+    """Freeze everything but the adapters: ``inner`` updates adapter
+    leaves, every other parameter gets a zero update and no optimizer
+    state (the memory point of LoRA fine-tuning)."""
+    import optax
+
+    mask = lora_mask(params)
+    inverse = jax.tree.map(lambda m: not m, mask)
+    return optax.chain(
+        optax.masked(inner, mask),
+        optax.masked(optax.set_to_zero(), inverse),
+    )
+
+
+def split_lora(params: dict) -> tuple[Any, Any]:
+    """Split a wrapped tree into (adapters, rest): ``adapters`` holds ONLY
+    the ``lora_a``/``lora_b`` leaves — the differentiable subtree — and
+    ``rest`` everything else. Training differentiates w.r.t. ``adapters``
+    alone, which is what makes QLoRA work (an int8/int4 base is not a
+    valid grad input) and skips computing base grads entirely."""
+
+    def walk(tree: Any) -> tuple[Any, Any]:
+        if is_lora(tree):
+            return (
+                {"lora_a": tree["lora_a"], "lora_b": tree["lora_b"]},
+                {"w": tree["w"], "lora_scale": tree["lora_scale"]},
+            )
+        if isinstance(tree, dict) and not _is_packed(tree):
+            adapters: dict = {}
+            rest: dict = {}
+            for k, v in tree.items():
+                a, r = walk(v)
+                if a is not None:
+                    adapters[k] = a
+                rest[k] = r
+            return (adapters or None), rest
+        return None, tree
+
+    return walk(params)
+
+
+def combine_lora(adapters: Any, rest: Any) -> dict:
+    """Inverse of ``split_lora``: rebuild the wrapped tree (called inside
+    the jitted loss, so it costs nothing at runtime)."""
+    if isinstance(rest, dict) and set(rest) == {"w", "lora_scale"}:
+        return {**rest, **adapters}
+    if isinstance(rest, dict):
+        return {
+            k: combine_lora(adapters.get(k) if adapters else None, v)
+            for k, v in rest.items()
+        }
+    return rest
+
+
+def init_lora_train_state(params: dict, optimizer: Any) -> dict:
+    """Training state for adapter-only fine-tuning: the optimizer holds
+    moments for the adapter subtree only (the memory point of LoRA)."""
+    adapters, rest = split_lora(params)
+    return {
+        "adapters": adapters,
+        "rest": rest,
+        "opt_state": optimizer.init(adapters),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def make_lora_train_step(cfg: Any, optimizer: Any, loss_fn: Any = None) -> Any:
+    """Jitted adapter-only train step (QLoRA-ready: the frozen base may be
+    int8/int4 packed — it is never a grad input). ``loss_fn`` defaults to
+    the next-token loss; signature (params, tokens, cfg)."""
+    import optax
+
+    if loss_fn is None:
+        from gofr_tpu.training.trainer import cross_entropy_loss
+
+        loss_fn = cross_entropy_loss
+
+    def _step(carry: dict, rest: Any, tokens: jnp.ndarray) -> tuple[dict, dict]:
+        def f(adapters: Any) -> jnp.ndarray:
+            return loss_fn(combine_lora(adapters, rest), tokens, cfg)
+
+        loss, grads = jax.value_and_grad(f)(carry["adapters"])
+        updates, opt_state = optimizer.update(
+            grads, carry["opt_state"], carry["adapters"]
+        )
+        adapters = optax.apply_updates(carry["adapters"], updates)
+        new_carry = {
+            "adapters": adapters, "opt_state": opt_state,
+            "step": carry["step"] + 1,
+        }
+        return new_carry, {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "step": new_carry["step"],
+        }
+
+    # donate ONLY the adapter carry: the frozen base ("rest") is shared
+    # with the caller's wrapped tree and must survive every step
+    jitted = jax.jit(_step, donate_argnums=(0,))
+
+    def train_step(state: dict, tokens: Any) -> tuple[dict, dict]:
+        carry = {
+            "adapters": state["adapters"],
+            "opt_state": state["opt_state"],
+            "step": state["step"],
+        }
+        new_carry, metrics = jitted(carry, state["rest"], tokens)
+        return {**new_carry, "rest": state["rest"]}, metrics
+
+    return train_step
+
+
+def merge_lora(params: dict, dtype: Any = None) -> dict:
+    """Fold adapters into plain weights (serving export): ``w + A@B·s``.
+    Quantized bases dequantize first — the merged tree is full-precision
+    (re-quantize with ``quantize_params`` if desired)."""
+
+    def merge_leaf(leaf: dict) -> jnp.ndarray:
+        w = leaf["w"]
+        if is_quantized(w):
+            w = dequantize_array(w)
+        elif is_quantized_int4(w):
+            w = dequantize_array_int4(w)
+        out_dtype = dtype or w.dtype
+        delta = (
+            leaf["lora_a"].astype(jnp.float32) @ leaf["lora_b"].astype(jnp.float32)
+        ) * leaf["lora_scale"]
+        return (w.astype(jnp.float32) + delta).astype(out_dtype)
+
+    def walk(tree: Any) -> Any:
+        if is_lora(tree):
+            return merge_leaf(tree)
+        if isinstance(tree, dict):
+            return {k: walk(v) for k, v in tree.items()}
+        return tree
+
+    return walk(params)

@@ -113,6 +113,10 @@ def mm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     int4 runs one dot per scale group (the group axis becomes a batched
     matmul dim); the per-group scale multiplies the f32 partials before
     the group sum."""
+    if isinstance(w, dict) and "lora_a" in w:
+        from gofr_tpu.models.lora import lora_mm
+
+        return lora_mm(x, w, mm)
     if is_quantized(w):
         y = jax.lax.dot_general(
             x, w["q"], (((x.ndim - 1,), (0,)), ((), ())),

@@ -65,6 +65,21 @@ def param_specs(params: Any, _name: str = "") -> Any:
     def walk(tree: Any, name: str) -> Any:
         if isinstance(tree, dict):
             keys = set(tree)
+            if keys == {"w", "lora_a", "lora_b", "lora_scale"}:
+                # LoRA leaf: base shards by its own rule under the same
+                # name; A shards its in axis, B its out axis, like the
+                # weight (the rank axis replicates)
+                w_spec = walk(tree["w"], name)
+                q_spec = _spec_for(name, tree["lora_a"].ndim)
+                pad = (None,) * (tree["lora_a"].ndim - 2)
+                a_in = q_spec[-2] if len(q_spec) >= 2 else None
+                b_out = q_spec[-1] if len(q_spec) >= 1 else None
+                return {
+                    "w": w_spec,
+                    "lora_a": P(*pad, a_in, None),
+                    "lora_b": P(*pad, None, b_out),
+                    "lora_scale": P(),
+                }
             if keys in ({"q", "scale"}, {"q4", "scale"}):  # packed leaf pair
                 q_key = "q" if "q" in tree else "q4"
                 q_spec = _spec_for(name, tree[q_key].ndim)

@@ -1253,15 +1253,11 @@ class _TransformerRunner:
         cache = self._zero_cache(1)
         logits = next_ids = None
         total = 0
-        for start in range(0, int(ids.size), bucket):
-            chunk = ids[start : start + bucket]
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, : chunk.size] = chunk
+        for tokens, lengths, size in _prompt_chunks(ids, bucket):
             logits, next_ids, cache = self._prefill(
-                self.params, jnp.asarray(tokens), cache,
-                jnp.asarray([chunk.size], jnp.int32),
+                self.params, tokens, cache, lengths
             )
-            total += int(chunk.size)
+            total += size
         return {
             "cache": cache,
             "length": total,
@@ -1487,6 +1483,22 @@ class _TransformerRunner:
             self._set_cache_len(vcache, 1)
 
 
+def _prompt_chunks(ids: np.ndarray, bucket: int):
+    """Slice a prompt into [1, bucket] zero-padded token rows with true
+    lengths — the ONE chunking used by both the target's chunked prefill
+    and the draft engine's, so their caches provably hold the same prefix
+    (speculative decoding verifies against exactly this alignment)."""
+    for start in range(0, max(int(ids.size), 1), bucket):
+        chunk = ids[start : start + bucket]
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : chunk.size] = chunk
+        yield (
+            jnp.asarray(tokens),
+            jnp.asarray([max(int(chunk.size), 1)], jnp.int32),
+            int(chunk.size),
+        )
+
+
 # shared by the target runner and the draft engine: roll a KV cache's
 # write head back to ``n`` (speculative decoding rejects by length — the
 # garbage KV past n is masked by attention and overwritten by later steps)
@@ -1583,14 +1595,8 @@ class _SpecEngine:
         if not chunked:
             ids = ids[-bucket:]
         cache = self._init_cache(self.cfg, 1, max_seq=self.cfg.max_seq)
-        for start in range(0, max(int(ids.size), 1), bucket):
-            chunk = ids[start : start + bucket]
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, : chunk.size] = chunk
-            _, cache = self._prefill(
-                self.params, jnp.asarray(tokens), cache,
-                jnp.asarray([max(int(chunk.size), 1)], jnp.int32),
-            )
+        for tokens, lengths, _ in _prompt_chunks(ids, bucket):
+            _, cache = self._prefill(self.params, tokens, cache, lengths)
         return cache
 
     def propose(self, token_dev: Any, cache: dict) -> tuple[Any, dict]:
