@@ -353,20 +353,21 @@ def decode_chunk(
     temperature: jnp.ndarray | float = 0.0,
     top_k: jnp.ndarray | int = 0,
     top_p: jnp.ndarray | float = 1.0,
+    min_p: jnp.ndarray | float = 0.0,
 ) -> tuple[jnp.ndarray, dict]:
     """``n_steps`` autoregressive steps in ONE dispatch: decode + on-device
     sampling under ``lax.scan``, so a whole chunk of tokens costs a single
     host↔device round trip (the round trip, not the matmuls, dominates
     decode on remote-attached devices). ``token`` [B, 1] is the last known
     token; returns sampled tokens [B, n_steps] + the advanced cache.
-    temperature/top_k/top_p are dynamic (0 temperature = greedy)."""
+    temperature/top_k/top_p/min_p are dynamic (0 temperature = greedy)."""
     from gofr_tpu.ops.sampling import sample_logits
 
     def body(carry, _):
         tok, c, k = carry
         logits, c = decode_step(params, tok, c, cfg)
         k, sub = jax.random.split(k)
-        nxt = sample_logits(logits, sub, temperature, top_k, top_p)  # [B]
+        nxt = sample_logits(logits, sub, temperature, top_k, top_p, min_p)  # [B]
         return (nxt[:, None], c, k), nxt
 
     (_, cache, _), toks = jax.lax.scan(
@@ -385,6 +386,7 @@ def decode_chunk_pool(
     temperature: jnp.ndarray,
     top_k: jnp.ndarray,
     top_p: jnp.ndarray,
+    min_p: jnp.ndarray | float = 0.0,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jax.Array, dict]:
     """``decode_chunk_rows`` plus the on-device RNG advance and the
     feed-forward token slice, so one pooled chunk is exactly ONE dispatch:
@@ -395,7 +397,8 @@ def decode_chunk_pool(
     advanced key, cache)."""
     key, sub = jax.random.split(key)
     toks, cache = decode_chunk_rows(
-        params, token, cache, cfg, n_steps, sub, temperature, top_k, top_p
+        params, token, cache, cfg, n_steps, sub, temperature, top_k, top_p,
+        min_p,
     )
     return toks, toks[:, -1:], key, cache
 
@@ -410,17 +413,19 @@ def decode_chunk_rows(
     temperature: jnp.ndarray,
     top_k: jnp.ndarray,
     top_p: jnp.ndarray,
+    min_p: jnp.ndarray | float = 0.0,
 ) -> tuple[jnp.ndarray, dict]:
     """``decode_chunk`` with PER-ROW sampling params ([B] each) — the
     continuous-batching decode pool runs many requests' decode in one
-    fixed-shape dispatch, each slot with its own temperature/top-k/top-p."""
+    fixed-shape dispatch, each slot with its own temperature/top-k/
+    top-p/min-p."""
     from gofr_tpu.ops.sampling import sample_logits_rows
 
     def body(carry, _):
         tok, c, k = carry
         logits, c = decode_step(params, tok, c, cfg)
         k, sub = jax.random.split(k)
-        nxt = sample_logits_rows(logits, sub, temperature, top_k, top_p)
+        nxt = sample_logits_rows(logits, sub, temperature, top_k, top_p, min_p)
         return (nxt[:, None], c, k), nxt
 
     (_, cache, _), toks = jax.lax.scan(

@@ -7,7 +7,9 @@ by ``jax.random`` so a request seed makes generation reproducible.
 Semantics (the standard composition): logits are temperature-scaled, then
 top-k filtered, then nucleus-filtered (smallest prefix of the sorted
 distribution whose mass reaches ``top_p``; always at least one token),
-then sampled categorically. ``temperature=0`` short-circuits to argmax.
+then min-p filtered (drop tokens whose probability is below ``min_p``
+times the top token's), then sampled categorically. ``temperature=0``
+short-circuits to argmax.
 """
 
 from __future__ import annotations
@@ -21,20 +23,30 @@ _NEG_INF = float(-1e30)
 
 
 def _filter_top_k_top_p(
-    scaled: jnp.ndarray, top_k: jnp.ndarray, top_p: jnp.ndarray
+    scaled: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    min_p: jnp.ndarray | float = 0.0,
 ) -> jnp.ndarray:
-    """Apply top-k then nucleus filtering to temperature-scaled logits.
-    ``scaled`` [B, V]; ``top_k`` [B] int32 (0 = off); ``top_p`` [B, 1] f32.
+    """Apply top-k, nucleus (top-p), and min-p filtering to
+    temperature-scaled logits. ``scaled`` [B, V]; ``top_k`` [B] int32
+    (0 = off); ``top_p`` [B, 1] f32 (1 = off); ``min_p`` [B, 1] f32
+    (0 = off; drop tokens whose probability is below min_p times the top
+    token's — scale-aware tail truncation).
 
-    ONE full-vocab sort serves both filters (a [B, V] sort is the
+    ONE full-vocab sort serves all three filters (a [B, V] sort is the
     expensive op here — V is 128K for llama3): top-k thresholds at the
-    k-th largest value, and the nucleus cutoff is computed in the same
-    sorted space (masking below the top-k threshold there is
+    k-th largest value, and the nucleus and min-p cutoffs are computed in
+    the same sorted space (masking below the top-k threshold there is
     order-preserving, so no second sort of the filtered array). Nucleus
     uses sequential-warper semantics: drop tokens whose EXCLUSIVE
     cumulative probability (descending order) has already reached top_p;
-    the argmax token always survives (its exclusive cumsum is 0)."""
+    the argmax token always survives (its exclusive cumsum is 0, and its
+    probability trivially clears its own min-p bar)."""
     b, v = scaled.shape
+    min_p = jnp.asarray(min_p, jnp.float32)
+    if min_p.ndim == 0:
+        min_p = jnp.full((b, 1), min_p)
     sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
     k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)  # [B]
     kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
@@ -45,7 +57,14 @@ def _filter_top_k_top_p(
     cutoff_logit = jnp.min(
         jnp.where(cum < top_p, sorted_k, jnp.inf), axis=-1, keepdims=True
     )
-    return jnp.where(scaled < jnp.maximum(kth, cutoff_logit), _NEG_INF, scaled)
+    # min-p: keep tokens with prob >= min_p * top prob (probs[:, :1] is
+    # the max — descending order)
+    keep_mp = probs >= min_p * probs[:, :1]
+    cutoff_mp = jnp.min(
+        jnp.where(keep_mp, sorted_k, jnp.inf), axis=-1, keepdims=True
+    )
+    cutoff = jnp.maximum(kth, jnp.maximum(cutoff_logit, cutoff_mp))
+    return jnp.where(scaled < cutoff, _NEG_INF, scaled)
 
 
 @jax.jit
@@ -55,17 +74,19 @@ def sample_logits(
     temperature: float | jnp.ndarray = 1.0,
     top_k: int | jnp.ndarray = 0,
     top_p: float | jnp.ndarray = 1.0,
+    min_p: float | jnp.ndarray = 0.0,
 ) -> jnp.ndarray:
     """[B, V] float logits -> [B] int32 sampled token ids.
 
-    temperature, top_k, and top_p are ALL dynamic operands: one compiled
-    sampler serves every request — request-supplied knobs must never
-    recompile on the serving path."""
+    temperature, top_k, top_p, and min_p are ALL dynamic operands: one
+    compiled sampler serves every request — request-supplied knobs must
+    never recompile on the serving path."""
     logits = logits.astype(jnp.float32)
     b = logits.shape[0]
     temperature = jnp.asarray(temperature, jnp.float32)
     top_p = jnp.asarray(top_p, jnp.float32)
     top_k = jnp.asarray(top_k, jnp.int32)
+    min_p = jnp.asarray(min_p, jnp.float32)
 
     def _greedy() -> jnp.ndarray:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -76,6 +97,7 @@ def sample_logits(
             scaled,
             jnp.broadcast_to(top_k, (b,)),
             jnp.broadcast_to(top_p, (b, 1)),
+            jnp.broadcast_to(min_p, (b, 1)),
         )
         return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
 
@@ -91,21 +113,24 @@ def sample_logits_rows(
     temperature: jnp.ndarray,
     top_k: jnp.ndarray,
     top_p: jnp.ndarray,
+    min_p: jnp.ndarray | float = 0.0,
 ) -> jnp.ndarray:
-    """Per-ROW sampling params: logits [B, V], temperature/top_k/top_p each
-    [B] -> [B] int32 ids. The continuous-batching decode pool mixes
-    requests with different sampling settings in one dispatch, so each row
-    carries its own knobs (rows with temperature 0 take their argmax)."""
+    """Per-ROW sampling params: logits [B, V], temperature/top_k/top_p/
+    min_p each [B] -> [B] int32 ids. The continuous-batching decode pool
+    mixes requests with different sampling settings in one dispatch, so
+    each row carries its own knobs (rows with temperature 0 take their
+    argmax)."""
     logits = logits.astype(jnp.float32)
     b = logits.shape[0]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     temperature = jnp.asarray(temperature, jnp.float32).reshape(b, 1)
     top_p = jnp.asarray(top_p, jnp.float32).reshape(b, 1)
     top_k = jnp.asarray(top_k, jnp.int32).reshape(b)
+    min_p = jnp.broadcast_to(jnp.asarray(min_p, jnp.float32), (b,)).reshape(b, 1)
 
     def _mixed() -> jnp.ndarray:
         scaled = logits / jnp.maximum(temperature, 1e-6)
-        filtered = _filter_top_k_top_p(scaled, top_k, top_p)
+        filtered = _filter_top_k_top_p(scaled, top_k, top_p, min_p)
         sampled = jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
         return jnp.where(temperature[:, 0] <= 0.0, greedy, sampled)
 
@@ -140,6 +165,7 @@ class Sampler:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        min_p: float = 0.0,
         seed: Optional[int] = None,
     ):
         if temperature < 0:
@@ -148,9 +174,12 @@ class Sampler:
             raise ValueError("top_k must be >= 0")
         if not 0.0 < top_p <= 1.0:
             raise ValueError("top_p must be in (0, 1]")
+        if not 0.0 <= min_p < 1.0:
+            raise ValueError("min_p must be in [0, 1)")
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
+        self.min_p = float(min_p)
         self.seeded = seed is not None
         if seed is None:
             # unseeded requests must be genuinely random, not key(0)
@@ -162,12 +191,13 @@ class Sampler:
     @classmethod
     def from_body(cls, body: dict) -> "Sampler":
         """Build from a request body's sampling keys (temperature, top_k,
-        top_p, seed) — the shared parse for HTTP/gRPC handlers. Raises
-        ValueError/TypeError on malformed values (map to a 400)."""
+        top_p, min_p, seed) — the shared parse for HTTP/gRPC handlers.
+        Raises ValueError/TypeError on malformed values (map to a 400)."""
         return cls(
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             top_p=float(body.get("top_p", 1.0)),
+            min_p=float(body.get("min_p", 0.0)),
             seed=body.get("seed"),
         )
 
@@ -191,6 +221,6 @@ class Sampler:
         return int(
             sample_logits(
                 logits, sub, temperature=self.temperature,
-                top_k=self.top_k, top_p=self.top_p,
+                top_k=self.top_k, top_p=self.top_p, min_p=self.min_p,
             )[0]
         )

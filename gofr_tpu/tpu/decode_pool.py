@@ -126,8 +126,8 @@ class DecodePool:
         # live buffer and must be updated in place, not copied per chunk.
         # The key also donates (it threads through every chunk).
         self._decode = jax.jit(
-            lambda p, t, c, key, temp, tk, tp: decode_chunk_pool(
-                p, t, c, cfg, chunk, key, temp, tk, tp
+            lambda p, t, c, key, temp, tk, tp, mp: decode_chunk_pool(
+                p, t, c, cfg, chunk, key, temp, tk, tp, mp
             ),
             donate_argnums=(2, 3),
         )
@@ -150,10 +150,12 @@ class DecodePool:
         self._temps = np.zeros(n_slots, np.float32)
         self._top_ks = np.zeros(n_slots, np.int32)
         self._top_ps = np.ones(n_slots, np.float32)
+        self._min_ps = np.zeros(n_slots, np.float32)
         # device-resident copies, refreshed only when a submit changes them
         # (three host->device uploads per CHUNK otherwise — pure link waste)
         self._sampling_dirty = True
         self._temps_dev = self._top_ks_dev = self._top_ps_dev = None
+        self._min_ps_dev = None
         # device-resident, advanced INSIDE each chunk dispatch (no per-chunk
         # host-side split op)
         self._key = jax.random.key(np.random.SeedSequence().entropy % (1 << 63))
@@ -198,6 +200,7 @@ class DecodePool:
             self.params, self._last_tokens, self.cache,
             self._key, jnp.asarray(self._temps),
             jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
+            jnp.asarray(self._min_ps),
         )
         toks.block_until_ready()
         self.cache = self._place(init_cache(cfg, n_slots))  # reset the warmup writes
@@ -237,10 +240,12 @@ class DecodePool:
                 self._temps[slot.index] != sampler.temperature
                 or self._top_ks[slot.index] != sampler.top_k
                 or self._top_ps[slot.index] != sampler.top_p
+                or self._min_ps[slot.index] != sampler.min_p
             ):
                 self._temps[slot.index] = sampler.temperature
                 self._top_ks[slot.index] = sampler.top_k
                 self._top_ps[slot.index] = sampler.top_p
+                self._min_ps[slot.index] = sampler.min_p
                 self._sampling_dirty = True
             # cache/token writes happen under the lock: jax sequences them
             # after any in-flight chunk (their inputs are its outputs), so
@@ -298,6 +303,7 @@ class DecodePool:
                         self._temps_dev = jnp.asarray(self._temps)
                         self._top_ks_dev = jnp.asarray(self._top_ks)
                         self._top_ps_dev = jnp.asarray(self._top_ps)
+                        self._min_ps_dev = jnp.asarray(self._min_ps)
                         self._sampling_dirty = False
                     dispatch_start = _perf_counter()
                     # ONE dispatch: RNG advance and the feed-forward token
@@ -305,6 +311,7 @@ class DecodePool:
                     toks_dev, self._last_tokens, self._key, self.cache = self._decode(
                         self.params, self._last_tokens, self.cache, self._key,
                         self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                        self._min_ps_dev,
                     )
                     in_flight.append((records, toks_dev, dispatch_start))
             # fetch the OLDEST chunk outside the lock: the device is
@@ -390,10 +397,12 @@ class DecodePool:
                         self._temps[index] != 0.0
                         or self._top_ks[index] != 0
                         or self._top_ps[index] != 1.0
+                        or self._min_ps[index] != 0.0
                     ):
                         self._temps[index] = 0.0
                         self._top_ks[index] = 0
                         self._top_ps[index] = 1.0
+                        self._min_ps[index] = 0.0
                         self._sampling_dirty = True
         if self._depth_gauge:
             self._depth_gauge.set(len(self._active))

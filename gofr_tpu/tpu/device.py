@@ -955,10 +955,10 @@ class _TransformerRunner:
         from gofr_tpu.models.transformer import decode_chunk
 
         self._decode_chunk = jax.jit(
-            lambda p, t, c, key, temp, tk, tp, n: decode_chunk(
-                p, t, c, cfg, n, key, temp, tk, tp
+            lambda p, t, c, key, temp, tk, tp, mp, n: decode_chunk(
+                p, t, c, cfg, n, key, temp, tk, tp, mp
             ),
-            static_argnums=(7,),
+            static_argnums=(8,),
         )
         from gofr_tpu.tpu.flops import transformer_param_count
 
@@ -1188,6 +1188,7 @@ class _TransformerRunner:
         state = None  # release the full-batch prefill buffers
         max_len = int(cache["k"].shape[2])
         temp, tk, tp = sampler.temperature, sampler.top_k, sampler.top_p
+        mp = sampler.min_p
         pending: "deque" = deque()  # (toks_dev, n_steps)
         token_dev = jnp.asarray([[token]], jnp.int32)
         steps_in_flight = 0
@@ -1206,7 +1207,7 @@ class _TransformerRunner:
                 n = min(self.decode_chunk_size, max_len - cache_len - steps_in_flight)
                 key = self._greedy_key if sampler.greedy else sampler.take_key()
                 toks_dev, cache = self._decode_chunk(
-                    self.params, token_dev, cache, key, temp, tk, tp, n,
+                    self.params, token_dev, cache, key, temp, tk, tp, mp, n,
                 )
                 token_dev = toks_dev[:, -1:]
                 pending.append((toks_dev, n))
@@ -1400,7 +1401,7 @@ class _TransformerRunner:
                 ):
                     toks, cache = self._decode_chunk(
                         self.params, jnp.asarray([[token]], jnp.int32), cache,
-                        self._greedy_key, 0.0, 0, 1.0, 1,
+                        self._greedy_key, 0.0, 0, 1.0, 0.0, 1,
                     )
                     token = int(np.asarray(toks)[0, 0])
                     cache_len += 1
@@ -1452,7 +1453,7 @@ class _TransformerRunner:
             progress(f"compiling decode chunk ({self.decode_chunk_size} steps)")
         toks, _ = self._decode_chunk(
             self.params, jnp.zeros((1, 1), jnp.int32), one,
-            jax.random.key(0), 0.0, 0, 1.0, self.decode_chunk_size,
+            jax.random.key(0), 0.0, 0, 1.0, 0.0, self.decode_chunk_size,
         )
         toks.block_until_ready()
         if self.spec is not None:
@@ -1477,7 +1478,7 @@ class _TransformerRunner:
             # n=1 chunk shape so it never compiles on the serving path
             t1, vcache = self._decode_chunk(
                 self.params, jnp.zeros((1, 1), jnp.int32), vcache,
-                self._greedy_key, 0.0, 0, 1.0, 1,
+                self._greedy_key, 0.0, 0, 1.0, 0.0, 1,
             )
             t1.block_until_ready()
             self._set_cache_len(vcache, 1)

@@ -136,3 +136,54 @@ def test_dynamic_top_k_no_recompile():
     picks = {int(sample_logits(logits, jax.random.key(i), temperature=5.0, top_k=1)[0])
              for i in range(20)}
     assert picks == {4}
+
+
+def test_min_p_filters_scale_aware():
+    # probs ~ [0.5, 0.3, 0.15, 0.05]; min_p=0.5 keeps tokens with
+    # p >= 0.25 -> only ids 0 and 1 can ever sample
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    picks = {
+        int(sample_logits(logits, jax.random.key(i), temperature=1.0,
+                          min_p=0.5)[0])
+        for i in range(60)
+    }
+    assert picks <= {0, 1} and 0 in picks
+    # min_p ~1 degenerates to argmax whatever the temperature
+    picks = {
+        int(sample_logits(logits, jax.random.key(i), temperature=8.0,
+                          min_p=0.99)[0])
+        for i in range(20)
+    }
+    assert picks == {0}
+    # min_p=0 is off: the tail stays reachable at high temperature
+    picks = {
+        int(sample_logits(logits, jax.random.key(i), temperature=8.0)[0])
+        for i in range(80)
+    }
+    assert len(picks) >= 3
+
+
+def test_min_p_rows_and_sampler_body():
+    from gofr_tpu.ops.sampling import sample_logits_rows
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05],
+                                  [0.5, 0.3, 0.15, 0.05]]))
+    # row 0: min_p strict; row 1: off — one dispatch, different behavior
+    got = {0: set(), 1: set()}
+    for i in range(60):
+        ids = sample_logits_rows(
+            logits, jax.random.key(i),
+            jnp.asarray([1.0, 8.0]), jnp.asarray([0, 0]),
+            jnp.asarray([1.0, 1.0]), jnp.asarray([0.5, 0.0]),
+        )
+        got[0].add(int(ids[0]))
+        got[1].add(int(ids[1]))
+    assert got[0] <= {0, 1}
+    assert len(got[1]) >= 3
+    # request-body parse + validation
+    s = Sampler.from_body({"temperature": 1.0, "min_p": 0.3})
+    assert s.min_p == 0.3
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="min_p"):
+        Sampler(min_p=1.5)
