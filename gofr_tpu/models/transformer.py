@@ -354,26 +354,50 @@ def decode_chunk(
     top_k: jnp.ndarray | int = 0,
     top_p: jnp.ndarray | float = 1.0,
     min_p: jnp.ndarray | float = 0.0,
-) -> tuple[jnp.ndarray, dict]:
+    presence: Optional[jnp.ndarray] = None,
+    repetition_penalty: jnp.ndarray | float = 1.0,
+) -> tuple[jnp.ndarray, dict] | tuple[jnp.ndarray, dict, jnp.ndarray]:
     """``n_steps`` autoregressive steps in ONE dispatch: decode + on-device
     sampling under ``lax.scan``, so a whole chunk of tokens costs a single
     host↔device round trip (the round trip, not the matmuls, dominates
     decode on remote-attached devices). ``token`` [B, 1] is the last known
     token; returns sampled tokens [B, n_steps] + the advanced cache.
-    temperature/top_k/top_p/min_p are dynamic (0 temperature = greedy)."""
-    from gofr_tpu.ops.sampling import sample_logits
+    temperature/top_k/top_p/min_p are dynamic (0 temperature = greedy).
+
+    ``presence`` [B, V] bool (context-token mask) turns on the CTRL
+    repetition penalty: logits are penalized before the greedy/sampled
+    split and freshly sampled tokens join the mask inside the scan; the
+    updated mask is returned as a third output."""
+    from gofr_tpu.ops.sampling import (
+        apply_repetition_penalty,
+        sample_logits,
+        update_presence,
+    )
 
     def body(carry, _):
-        tok, c, k = carry
+        if presence is None:
+            tok, c, k = carry
+        else:
+            tok, c, k, pres = carry
         logits, c = decode_step(params, tok, c, cfg)
         k, sub = jax.random.split(k)
-        nxt = sample_logits(logits, sub, temperature, top_k, top_p, min_p)  # [B]
-        return (nxt[:, None], c, k), nxt
+        if presence is None:
+            nxt = sample_logits(logits, sub, temperature, top_k, top_p, min_p)
+            return (nxt[:, None], c, k), nxt
+        logits = apply_repetition_penalty(logits, pres, repetition_penalty)
+        nxt = sample_logits(logits, sub, temperature, top_k, top_p, min_p)
+        pres = update_presence(pres, nxt)
+        return (nxt[:, None], c, k, pres), nxt
 
-    (_, cache, _), toks = jax.lax.scan(
-        body, (token, cache, key), None, length=n_steps
+    if presence is None:
+        (_, cache, _), toks = jax.lax.scan(
+            body, (token, cache, key), None, length=n_steps
+        )
+        return jnp.transpose(toks), cache  # [B, n_steps]
+    (_, cache, _, presence), toks = jax.lax.scan(
+        body, (token, cache, key, presence), None, length=n_steps
     )
-    return jnp.transpose(toks), cache  # [B, n_steps]
+    return jnp.transpose(toks), cache, presence
 
 
 def decode_chunk_pool(
@@ -418,7 +442,8 @@ def decode_chunk_rows(
     """``decode_chunk`` with PER-ROW sampling params ([B] each) — the
     continuous-batching decode pool runs many requests' decode in one
     fixed-shape dispatch, each slot with its own temperature/top-k/
-    top-p/min-p."""
+    top-p/min-p. (Repetition-penalized requests decode solo through
+    ``decode_chunk``'s presence path — the pool stays presence-free.)"""
     from gofr_tpu.ops.sampling import sample_logits_rows
 
     def body(carry, _):

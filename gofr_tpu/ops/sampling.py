@@ -22,6 +22,39 @@ import jax.numpy as jnp
 _NEG_INF = float(-1e30)
 
 
+def apply_repetition_penalty(
+    logits: jnp.ndarray, presence: jnp.ndarray, penalty: jnp.ndarray | float
+) -> jnp.ndarray:
+    """CTRL-style repetition penalty: tokens already in the context
+    (``presence`` [B, V] bool — prompt plus generated) have positive
+    logits divided by ``penalty`` and negative logits multiplied by it.
+    ``penalty`` is scalar or [B] (1 = off); applies BEFORE the greedy/
+    sampled split so greedy decode is penalized too (the HF semantics)."""
+    logits = logits.astype(jnp.float32)
+    penalty = jnp.asarray(penalty, jnp.float32)
+    if penalty.ndim == 1:
+        penalty = penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(presence, penalized, logits)
+
+
+def presence_from_tokens(ids: Any, vocab_size: int) -> jnp.ndarray:
+    """[1, V] bool presence row for a prompt (host-side build, one upload
+    per penalized request)."""
+    import numpy as np
+
+    row = np.zeros((1, vocab_size), bool)
+    row[0, np.asarray(ids, np.int32)] = True
+    return jnp.asarray(row)
+
+
+def update_presence(presence: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Mark freshly sampled ``tokens`` [B] in ``presence`` [B, V] (inside
+    the decode scan — one scatter per step)."""
+    b = presence.shape[0]
+    return presence.at[jnp.arange(b), tokens].set(True)
+
+
 def _filter_top_k_top_p(
     scaled: jnp.ndarray,
     top_k: jnp.ndarray,
@@ -166,6 +199,7 @@ class Sampler:
         top_k: int = 0,
         top_p: float = 1.0,
         min_p: float = 0.0,
+        repetition_penalty: float = 1.0,
         seed: Optional[int] = None,
     ):
         if temperature < 0:
@@ -176,10 +210,13 @@ class Sampler:
             raise ValueError("top_p must be in (0, 1]")
         if not 0.0 <= min_p < 1.0:
             raise ValueError("min_p must be in [0, 1)")
+        if repetition_penalty <= 0.0:
+            raise ValueError("repetition_penalty must be > 0")
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.min_p = float(min_p)
+        self.repetition_penalty = float(repetition_penalty)
         self.seeded = seed is not None
         if seed is None:
             # unseeded requests must be genuinely random, not key(0)
@@ -191,13 +228,15 @@ class Sampler:
     @classmethod
     def from_body(cls, body: dict) -> "Sampler":
         """Build from a request body's sampling keys (temperature, top_k,
-        top_p, min_p, seed) — the shared parse for HTTP/gRPC handlers.
+        top_p, min_p, repetition_penalty, seed) — the shared parse for
+        HTTP/gRPC handlers.
         Raises ValueError/TypeError on malformed values (map to a 400)."""
         return cls(
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             top_p=float(body.get("top_p", 1.0)),
             min_p=float(body.get("min_p", 0.0)),
+            repetition_penalty=float(body.get("repetition_penalty", 1.0)),
             seed=body.get("seed"),
         )
 

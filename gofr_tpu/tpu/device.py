@@ -960,6 +960,18 @@ class _TransformerRunner:
             ),
             static_argnums=(8,),
         )
+        # repetition-penalty variant: threads a [1, V] presence mask of
+        # context tokens through the chunk (penalized requests run solo —
+        # the pool stays presence-free). Compiles on the FIRST penalized
+        # request rather than at boot: a per-request opt-in knob must not
+        # slow every cold start by a full decode-scan compile (same
+        # policy as remainder chunk sizes).
+        self._decode_chunk_pen = jax.jit(
+            lambda p, t, c, key, temp, tk, tp, mp, pres, pen, n: decode_chunk(
+                p, t, c, cfg, n, key, temp, tk, tp, mp, pres, pen
+            ),
+            static_argnums=(10,),
+        )
         from gofr_tpu.tpu.flops import transformer_param_count
 
         self.n_params = transformer_param_count(cfg)
@@ -1104,7 +1116,24 @@ class _TransformerRunner:
             if self._prefix_cache is not None:
                 self._prefix_store(ids, state)
         out: list[int] = []
-        if sampler.greedy:
+        presence = None
+        if sampler.repetition_penalty != 1.0:
+            # context presence penalizes the FIRST token too (greedy
+            # argmax included), so the device-argmaxed id is not usable
+            from gofr_tpu.ops.sampling import (
+                apply_repetition_penalty,
+                presence_from_tokens,
+                update_presence,
+            )
+
+            presence = presence_from_tokens(ids, self.cfg.vocab_size)
+            logits_pen = apply_repetition_penalty(
+                jnp.asarray(state["logits"])[None, :], presence,
+                sampler.repetition_penalty,
+            )
+            token = sampler.pick(logits_pen)
+            presence = update_presence(presence, jnp.asarray([token]))
+        elif sampler.greedy:
             token = state["next_token"]  # device-argmaxed; no logits fetch
         else:
             token = sampler.pick(state["logits"])
@@ -1122,7 +1151,7 @@ class _TransformerRunner:
         # take the draft-and-verify path (exactly the target's greedy
         # output; DRAFT_MODEL_NAME opts the deployment into latency mode,
         # so these requests bypass the throughput pool)
-        if self.spec is not None and sampler.greedy:
+        if self.spec is not None and sampler.greedy and presence is None:
             return self._spec_generate(
                 state, ids, out, token, max_new_tokens, on_token, stop,
                 stop_tokens,
@@ -1130,7 +1159,7 @@ class _TransformerRunner:
 
         # continuous batching: unseeded requests decode in the shared pool
         # (seeded ones need the exact per-request key sequence — solo path)
-        if decode_pool is not None and not sampler.seeded:
+        if decode_pool is not None and not sampler.seeded and presence is None:
             import queue as queue_mod
 
             from gofr_tpu.tpu.decode_pool import DONE, PoolFailure
@@ -1189,6 +1218,7 @@ class _TransformerRunner:
         max_len = int(cache["k"].shape[2])
         temp, tk, tp = sampler.temperature, sampler.top_k, sampler.top_p
         mp = sampler.min_p
+        pen = sampler.repetition_penalty
         pending: "deque" = deque()  # (toks_dev, n_steps)
         token_dev = jnp.asarray([[token]], jnp.int32)
         steps_in_flight = 0
@@ -1206,9 +1236,16 @@ class _TransformerRunner:
                 # surplus sampled tokens are simply discarded
                 n = min(self.decode_chunk_size, max_len - cache_len - steps_in_flight)
                 key = self._greedy_key if sampler.greedy else sampler.take_key()
-                toks_dev, cache = self._decode_chunk(
-                    self.params, token_dev, cache, key, temp, tk, tp, mp, n,
-                )
+                if presence is None:
+                    toks_dev, cache = self._decode_chunk(
+                        self.params, token_dev, cache, key, temp, tk, tp,
+                        mp, n,
+                    )
+                else:
+                    toks_dev, cache, presence = self._decode_chunk_pen(
+                        self.params, token_dev, cache, key, temp, tk, tp,
+                        mp, presence, pen, n,
+                    )
                 token_dev = toks_dev[:, -1:]
                 pending.append((toks_dev, n))
                 steps_in_flight += n
