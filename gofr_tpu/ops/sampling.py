@@ -28,12 +28,11 @@ def apply_repetition_penalty(
     """CTRL-style repetition penalty: tokens already in the context
     (``presence`` [B, V] bool — prompt plus generated) have positive
     logits divided by ``penalty`` and negative logits multiplied by it.
-    ``penalty`` is scalar or [B] (1 = off); applies BEFORE the greedy/
-    sampled split so greedy decode is penalized too (the HF semantics)."""
+    ``penalty`` is a scalar (1 = off; penalized requests decode solo, so
+    there is no per-row form); applies BEFORE the greedy/sampled split so
+    greedy decode is penalized too (the HF semantics)."""
     logits = logits.astype(jnp.float32)
     penalty = jnp.asarray(penalty, jnp.float32)
-    if penalty.ndim == 1:
-        penalty = penalty[:, None]
     penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
     return jnp.where(presence, penalized, logits)
 

@@ -72,3 +72,32 @@ def test_dict_result_prints_json(cmd_app):
     cmd_app.sub_command("info", lambda ctx: {"version": 1})
     out = stdout_output_for(lambda: run_cmd(cmd_app, ["info"]))
     assert '"version": 1' in out
+
+
+def test_lora_finetune_example(monkeypatch, tmp_path):
+    """The lora-finetune example CLI trains adapters and writes a merged
+    checkpoint that the serving path can load (MODEL_PATH round trip)."""
+    import os
+    import runpy
+    import sys
+
+    out = str(tmp_path / "lora_ckpt")
+    monkeypatch.setenv("LOG_LEVEL", "FATAL")
+    monkeypatch.setattr(
+        sys, "argv",
+        ["main.py", "finetune", "--model=tiny", "--steps=4", "--rank=2",
+         f"--out={out}"],
+    )
+    text = stdout_output_for(
+        lambda: runpy.run_path(
+            os.path.join(os.path.dirname(__file__), "..", "examples",
+                         "lora-finetune", "main.py"),
+            run_name="__main__",
+        )
+    )
+    assert "merged checkpoint" in text
+
+    from gofr_tpu.training.checkpoint import restore_params
+
+    params = restore_params(out)
+    assert hasattr(params["layers"]["wq"], "ndim")  # merged: plain weights
