@@ -133,6 +133,13 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
         jax.config.update("jax_compilation_cache_dir", "/tmp/gofr_jax_cache")
     except Exception:
         pass
+    # BENCH_PLATFORM=cpu pins the backend IN-PROCESS (the ambient
+    # sitecustomize re-registers the TPU plugin over JAX_PLATFORMS, the
+    # same override tests/conftest.py applies) — CI smoke of this harness
+    # must not touch a possibly-wedged device tunnel
+    platform = os.environ.get("BENCH_PLATFORM", "")
+    if platform:
+        jax.config.update("jax_platforms", platform)
 
     import gofr_tpu
 

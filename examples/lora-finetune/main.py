@@ -2,12 +2,14 @@
 transport (the reference's CLI-app mode, /root/reference/pkg/gofr/cmd.go,
 applied to the TPU build's training story).
 
-    python main.py finetune --model=tiny --data=/path/tokens.bin \
-        --steps=50 --rank=8 --out=/tmp/lora_out
+    python main.py finetune --model=tiny --base=/ckpts/pretrained \
+        --data=/path/tokens.bin --steps=50 --rank=8 --out=/tmp/lora_out
 
-Trains adapters over a frozen (optionally MODEL_QUANT-quantized, i.e.
-QLoRA) base, logs loss through the framework logger, and writes the
-MERGED weights as an orbax checkpoint that serving loads via MODEL_PATH.
+Trains adapters over a frozen base — ``--base`` restores a pretrained
+orbax checkpoint (seeded init without it, for smoke runs), ``--quant``
+packs it int8/int4 first (QLoRA) — logs loss through the framework
+logger, and writes the MERGED weights as an orbax checkpoint that
+serving loads via MODEL_PATH.
 """
 
 import os
@@ -38,20 +40,29 @@ def finetune(ctx):
 
     model = ctx.param("model") or "tiny"
     steps = int(ctx.param("steps") or 20)
+    if steps < 1:
+        raise ValueError("--steps must be >= 1")
     rank = int(ctx.param("rank") or 8)
     out = ctx.param("out") or "/tmp/gofr_lora_out"
     data = ctx.param("data")
+    base = ctx.param("base")  # pretrained checkpoint to fine-tune
     quant = ctx.param("quant") or ""  # "int8"/"int4" -> QLoRA
 
     cfg = CONFIGS[model]
-    params = init_transformer(jax.random.key(0), cfg)
+    if base:
+        from gofr_tpu.training.checkpoint import restore_params
+
+        params = restore_params(base)
+    else:
+        params = init_transformer(jax.random.key(0), cfg)
     if quant:
         params = quantize_params(params, quant)
     wrapped = add_lora(params, jax.random.key(1), rank=rank)
 
     if data:
-        ds = TokenDataset(np.memmap(data, dtype=np.uint16, mode="r"),
-                          seq_len=64, batch_size=4)
+        # the path form reads the .meta.json sidecar, so uint32 corpora
+        # (llama3-class vocabs) are never misread as uint16
+        ds = TokenDataset(data, seq_len=64, batch_size=4)
         batches = ds.batches(0)
     else:  # demo corpus: a repeating ramp the adapters can memorize
         tokens = np.arange(4000) % min(cfg.vocab_size, 199)
