@@ -356,7 +356,8 @@ def decode_chunk(
     min_p: jnp.ndarray | float = 0.0,
     presence: Optional[jnp.ndarray] = None,
     repetition_penalty: jnp.ndarray | float = 1.0,
-) -> tuple[jnp.ndarray, dict] | tuple[jnp.ndarray, dict, jnp.ndarray]:
+    with_logprobs: bool = False,
+) -> tuple:
     """``n_steps`` autoregressive steps in ONE dispatch: decode + on-device
     sampling under ``lax.scan``, so a whole chunk of tokens costs a single
     host↔device round trip (the round trip, not the matmuls, dominates
@@ -367,7 +368,11 @@ def decode_chunk(
     ``presence`` [B, V] bool (context-token mask) turns on the CTRL
     repetition penalty: logits are penalized before the greedy/sampled
     split and freshly sampled tokens join the mask inside the scan; the
-    updated mask is returned as a third output."""
+    updated mask is returned as an extra output.
+
+    ``with_logprobs`` (static) also returns the chosen tokens' RAW model
+    log-probabilities [B, n_steps] f32 — log-softmax of the unpenalized
+    logits, the standard serving-API logprob — as the last output."""
     from gofr_tpu.ops.sampling import (
         apply_repetition_penalty,
         sample_logits,
@@ -381,23 +386,33 @@ def decode_chunk(
             tok, c, k, pres = carry
         logits, c = decode_step(params, tok, c, cfg)
         k, sub = jax.random.split(k)
-        if presence is None:
-            nxt = sample_logits(logits, sub, temperature, top_k, top_p, min_p)
-            return (nxt[:, None], c, k), nxt
-        logits = apply_repetition_penalty(logits, pres, repetition_penalty)
-        nxt = sample_logits(logits, sub, temperature, top_k, top_p, min_p)
-        pres = update_presence(pres, nxt)
-        return (nxt[:, None], c, k, pres), nxt
-
-    if presence is None:
-        (_, cache, _), toks = jax.lax.scan(
-            body, (token, cache, key), None, length=n_steps
+        sample_in = (
+            logits if presence is None
+            else apply_repetition_penalty(logits, pres, repetition_penalty)
         )
-        return jnp.transpose(toks), cache  # [B, n_steps]
-    (_, cache, _, presence), toks = jax.lax.scan(
-        body, (token, cache, key, presence), None, length=n_steps
-    )
-    return jnp.transpose(toks), cache, presence
+        nxt = sample_logits(sample_in, sub, temperature, top_k, top_p, min_p)
+        outs = nxt
+        if with_logprobs:
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+                nxt[:, None], axis=-1,
+            )[:, 0]
+            outs = (nxt, lp)
+        if presence is None:
+            return (nxt[:, None], c, k), outs
+        pres = update_presence(pres, nxt)
+        return (nxt[:, None], c, k, pres), outs
+
+    carry0 = (token, cache, key) if presence is None else (token, cache, key, presence)
+    carry, outs = jax.lax.scan(body, carry0, None, length=n_steps)
+    cache = carry[1]
+    toks, lps = outs if with_logprobs else (outs, None)
+    result: tuple = (jnp.transpose(toks), cache)
+    if presence is not None:
+        result = result + (carry[3],)
+    if with_logprobs:
+        result = result + (jnp.transpose(lps),)
+    return result
 
 
 def decode_chunk_pool(

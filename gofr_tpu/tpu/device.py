@@ -420,7 +420,8 @@ class TPUDevice:
         stop: Optional[Any] = None,
         sampler: Optional[Any] = None,
         stop_tokens: Optional[Any] = None,
-    ) -> list[int]:
+        logprobs: bool = False,
+    ) -> "list[int] | tuple[list[int], list[float]]":
         """Autoregressive generation (transformer models): prefill goes
         through the dynamic batcher (TTFT path); decode steps run per
         request. ``on_token`` streams each new token id (SSE endpoints);
@@ -429,7 +430,9 @@ class TPUDevice:
         ``tokens`` may be a str when a tokenizer is configured; ``sampler``
         (ops.sampling.Sampler) sets temperature/top-k/top-p — default
         greedy. ``stop_tokens`` (iterable of ids) end generation; the stop
-        token itself is not emitted."""
+        token itself is not emitted. ``logprobs=True`` returns
+        (tokens, logprobs) — the chosen tokens' RAW model log-softmax
+        values; these requests decode solo (like seeded ones)."""
         self.wait_ready(600.0)
         if isinstance(tokens, str):
             tokens = self._detokenize(tokens)["tokens"]
@@ -439,7 +442,8 @@ class TPUDevice:
                 tokens, max_new_tokens, on_token=on_token, stop=stop,
                 sampler=sampler, stop_tokens=stop_tokens,
                 decode_pool=self.decode_pool,
-                prefill_batcher=self.batcher, ttft_cb=lambda: self._ttft.observe(
+                prefill_batcher=self.batcher, logprobs=logprobs,
+                ttft_cb=lambda: self._ttft.observe(
                     time.perf_counter() - start, model=self.model_name, op="generate"
                 ),
             )
@@ -954,24 +958,36 @@ class _TransformerRunner:
         self._decode = jax.jit(lambda p, t, c: decode_step(p, t, c, cfg))
         from gofr_tpu.models.transformer import decode_chunk
 
-        self._decode_chunk = jax.jit(
-            lambda p, t, c, key, temp, tk, tp, mp, n: decode_chunk(
-                p, t, c, cfg, n, key, temp, tk, tp, mp
-            ),
-            static_argnums=(8,),
-        )
-        # repetition-penalty variant: threads a [1, V] presence mask of
-        # context tokens through the chunk (penalized requests run solo —
-        # the pool stays presence-free). Compiles on the FIRST penalized
-        # request rather than at boot: a per-request opt-in knob must not
-        # slow every cold start by a full decode-scan compile (same
-        # policy as remainder chunk sizes).
-        self._decode_chunk_pen = jax.jit(
-            lambda p, t, c, key, temp, tk, tp, mp, pres, pen, n: decode_chunk(
-                p, t, c, cfg, n, key, temp, tk, tp, mp, pres, pen
-            ),
-            static_argnums=(10,),
-        )
+        # ONE parameterized family of decode-chunk executables keyed by
+        # (penalized, logprobs). Penalized chunks thread a [1, V] presence
+        # mask (such requests run solo — the pool stays presence-free);
+        # logprob chunks also return the chosen tokens' raw log-softmax.
+        # Only the plain (False, False) variant is warmed at boot; the
+        # opt-in variants compile on first use (same policy as remainder
+        # chunk sizes) — but every variant is built HERE from one helper,
+        # so a decode_chunk signature change cannot silently miss one.
+        def _make_chunk_fn(pen: bool, lp: bool) -> Any:
+            if pen:
+                return jax.jit(
+                    lambda p, t, c, key, temp, tk, tp, mp, pres, rp, n:
+                    decode_chunk(
+                        p, t, c, cfg, n, key, temp, tk, tp, mp, pres, rp,
+                        with_logprobs=lp,
+                    ),
+                    static_argnums=(10,),
+                )
+            return jax.jit(
+                lambda p, t, c, key, temp, tk, tp, mp, n: decode_chunk(
+                    p, t, c, cfg, n, key, temp, tk, tp, mp, with_logprobs=lp
+                ),
+                static_argnums=(8,),
+            )
+
+        self._chunk_fns = {
+            (pen, lp): _make_chunk_fn(pen, lp)
+            for pen in (False, True) for lp in (False, True)
+        }
+        self._decode_chunk = self._chunk_fns[(False, False)]
         from gofr_tpu.tpu.flops import transformer_param_count
 
         self.n_params = transformer_param_count(cfg)
@@ -1095,7 +1111,8 @@ class _TransformerRunner:
         decode_pool: Any = None,
         prefill_batcher: Any = None,
         ttft_cb: Any = None,
-    ) -> list[int]:
+        logprobs: bool = False,
+    ) -> "list[int] | tuple[list[int], list[float]]":
         if sampler is None:
             from gofr_tpu.ops.sampling import Sampler
 
@@ -1116,6 +1133,7 @@ class _TransformerRunner:
             if self._prefix_cache is not None:
                 self._prefix_store(ids, state)
         out: list[int] = []
+        lps: list[float] = []
         presence = None
         if sampler.repetition_penalty != 1.0:
             # context presence penalizes the FIRST token too (greedy
@@ -1140,18 +1158,26 @@ class _TransformerRunner:
         if ttft_cb:
             ttft_cb()
         if token in stop_tokens:
-            return out  # stop tokens end generation and are not emitted
+            return (out, lps) if logprobs else out
         out.append(token)
+        if logprobs:
+            # RAW model logprob of the first token (one [V] row is on
+            # device already; logprobs requests tolerate this fetch)
+            row = jnp.asarray(state["logits"]).astype(jnp.float32)
+            lps.append(float(jax.nn.log_softmax(row)[token]))
         if on_token:
             on_token(token)
         if max_new_tokens <= 1:
-            return out
+            return (out, lps) if logprobs else out
 
         # speculative decoding: greedy requests with a configured draft
         # take the draft-and-verify path (exactly the target's greedy
         # output; DRAFT_MODEL_NAME opts the deployment into latency mode,
         # so these requests bypass the throughput pool)
-        if self.spec is not None and sampler.greedy and presence is None:
+        if (
+            self.spec is not None and sampler.greedy and presence is None
+            and not logprobs
+        ):
             return self._spec_generate(
                 state, ids, out, token, max_new_tokens, on_token, stop,
                 stop_tokens,
@@ -1159,7 +1185,10 @@ class _TransformerRunner:
 
         # continuous batching: unseeded requests decode in the shared pool
         # (seeded ones need the exact per-request key sequence — solo path)
-        if decode_pool is not None and not sampler.seeded and presence is None:
+        if (
+            decode_pool is not None and not sampler.seeded
+            and presence is None and not logprobs
+        ):
             import queue as queue_mod
 
             from gofr_tpu.tpu.decode_pool import DONE, PoolFailure
@@ -1236,31 +1265,39 @@ class _TransformerRunner:
                 # surplus sampled tokens are simply discarded
                 n = min(self.decode_chunk_size, max_len - cache_len - steps_in_flight)
                 key = self._greedy_key if sampler.greedy else sampler.take_key()
+                fn = self._chunk_fns[(presence is not None, logprobs)]
                 if presence is None:
-                    toks_dev, cache = self._decode_chunk(
-                        self.params, token_dev, cache, key, temp, tk, tp,
-                        mp, n,
-                    )
+                    result = fn(self.params, token_dev, cache, key, temp,
+                                tk, tp, mp, n)
                 else:
-                    toks_dev, cache, presence = self._decode_chunk_pen(
-                        self.params, token_dev, cache, key, temp, tk, tp,
-                        mp, presence, pen, n,
-                    )
+                    result = fn(self.params, token_dev, cache, key, temp,
+                                tk, tp, mp, presence, pen, n)
+                toks_dev, cache = result[0], result[1]
+                rest = list(result[2:])
+                if presence is not None:
+                    presence = rest.pop(0)
+                lps_dev = rest.pop(0) if logprobs else None
                 token_dev = toks_dev[:, -1:]
-                pending.append((toks_dev, n))
+                pending.append((toks_dev, lps_dev, n))
                 steps_in_flight += n
             if not pending:
                 break
-            toks_dev, n = pending.popleft()
+            toks_dev, lps_dev, n = pending.popleft()
             chunk = [int(t) for t in np.asarray(toks_dev)[0]]
+            chunk_lps = (
+                [float(x) for x in np.asarray(lps_dev)[0]]
+                if lps_dev is not None else None
+            )
             steps_in_flight -= n
             cache_len += n
             take = min(n, max_new_tokens - len(out))
-            for t in chunk[:take]:
+            for j, t in enumerate(chunk[:take]):
                 if t in stop_tokens:
                     stopped = True
                     break
                 out.append(t)
+                if chunk_lps is not None:
+                    lps.append(chunk_lps[j])
                 if on_token:
                     on_token(t)
                 if stop is not None and stop.is_set():
@@ -1268,7 +1305,7 @@ class _TransformerRunner:
                     break
             if len(out) >= max_new_tokens:
                 stopped = True
-        return out
+        return (out, lps) if logprobs else out
 
     def _can_chunk_prefill(self) -> bool:
         """Chunked prefill builds a [1]-row cache; under a mesh that only

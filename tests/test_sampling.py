@@ -255,3 +255,65 @@ def test_repetition_penalty_body_parse_and_validation():
 
     with _pytest.raises(ValueError, match="repetition_penalty"):
         Sampler(repetition_penalty=0.0)
+
+
+def test_logprobs_match_teacher_forcing():
+    """generate(logprobs=True): returned values must equal the log-softmax
+    the full no-cache forward assigns to each emitted token at its
+    position — the decode path's logprobs are real model logprobs."""
+    import os
+
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.logging import Level
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.models.llama import TINY
+    from gofr_tpu.models.transformer import transformer_forward
+    from gofr_tpu.testutil import MockLogger
+    from gofr_tpu.tpu.device import new_device
+
+    env = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2", "BATCH_TIMEOUT_MS": "1",
+           "DECODE_CHUNK": "4"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        dev = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            prompt = [1, 2, 3]
+            toks, lps = dev.generate(prompt, max_new_tokens=7, logprobs=True)
+            assert toks == dev.generate(prompt, max_new_tokens=7)
+            assert len(lps) == len(toks) == 7
+            assert all(lp <= 0.0 for lp in lps)
+            # teacher-forcing recompute over [prompt + toks]
+            full = jnp.asarray([prompt + toks], jnp.int32)
+            logits = transformer_forward(dev.runner.params, full, TINY)
+            ref = jax.nn.log_softmax(logits[0].astype(jnp.float32), axis=-1)
+            for i, (t, lp) in enumerate(zip(toks, lps)):
+                pos = len(prompt) - 1 + i  # logits at pos predict token i
+                np.testing.assert_allclose(
+                    lp, float(ref[pos, t]), rtol=1e-4, atol=1e-4
+                )
+            # seeded sampled + logprobs reproduces
+            a = dev.generate(prompt, max_new_tokens=5, logprobs=True,
+                             sampler=Sampler(temperature=1.0, seed=2))
+            b = dev.generate(prompt, max_new_tokens=5, logprobs=True,
+                             sampler=Sampler(temperature=1.0, seed=2))
+            assert a == b
+            # penalty + logprobs compose; logprobs stay RAW model values
+            pt, pl = dev.generate(prompt, max_new_tokens=5, logprobs=True,
+                                  sampler=Sampler(repetition_penalty=1e6))
+            assert pt == dev.generate(prompt, max_new_tokens=5,
+                                      sampler=Sampler(repetition_penalty=1e6))
+            full = jnp.asarray([prompt + pt], jnp.int32)
+            ref = jax.nn.log_softmax(
+                transformer_forward(dev.runner.params, full, TINY)[0]
+                .astype(jnp.float32), axis=-1,
+            )
+            for i, (t, lp) in enumerate(zip(pt, pl)):
+                np.testing.assert_allclose(
+                    lp, float(ref[len(prompt) - 1 + i, t]), rtol=1e-4, atol=1e-4
+                )
+        finally:
+            dev.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
