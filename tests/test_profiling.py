@@ -143,7 +143,7 @@ def test_admin_token_gates_profiler(free_port, monkeypatch, tmp_path):
     base = f"http://127.0.0.1:{application.http_port}"
     try:
         try:
-            urllib.request.urlopen(base + "/admin/profiler", timeout=5)
+            urllib.request.urlopen(base + "/admin/profiler", timeout=30)
             raise AssertionError("expected 401")
         except urllib.error.HTTPError as e:
             assert e.code == 401
@@ -151,7 +151,7 @@ def test_admin_token_gates_profiler(free_port, monkeypatch, tmp_path):
             base + "/admin/profiler",
             headers={"Authorization": "Bearer s3cret"},
         )
-        with urllib.request.urlopen(req, timeout=5) as r:
+        with urllib.request.urlopen(req, timeout=30) as r:
             assert json.loads(r.read())["data"] == {"state": "idle"}
         # wrong token also rejected
         req = urllib.request.Request(
@@ -159,7 +159,7 @@ def test_admin_token_gates_profiler(free_port, monkeypatch, tmp_path):
             headers={"Authorization": "Bearer wrong"},
         )
         try:
-            urllib.request.urlopen(req, timeout=5)
+            urllib.request.urlopen(req, timeout=30)
             raise AssertionError("expected 401")
         except urllib.error.HTTPError as e:
             assert e.code == 401

@@ -1,0 +1,91 @@
+"""Serving-config sweep over bench.py: runs the flagship benchmark under a
+list of named configurations and prints one JSON line per run plus a
+ranked summary. The driver-facing contract stays bench.py's single line;
+this tool answers "which knobs move the number" on real hardware.
+
+    python tools/bench_sweep.py                 # default sweep
+    python tools/bench_sweep.py slots32 int4    # named subset
+
+Each run is a fresh process (fresh device runtime), sharing the XLA
+compile cache, so later runs boot fast.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# name -> env overrides on top of bench.py's flagship defaults
+SWEEP: dict[str, dict[str, str]] = {
+    "base8": {"DECODE_SLOTS": "8", "BENCH_DECODE_STREAMS": "8"},
+    "slots16": {"DECODE_SLOTS": "16"},
+    "slots32": {"DECODE_SLOTS": "32"},
+    "slots32-f8kv": {"DECODE_SLOTS": "32", "MODEL_KV_DTYPE": "f8"},
+    "slots64-f8kv": {"DECODE_SLOTS": "64", "MODEL_KV_DTYPE": "f8"},
+    "int4": {"MODEL_QUANT": "int4", "DECODE_SLOTS": "32"},
+    "int4-f8kv": {
+        "MODEL_QUANT": "int4", "DECODE_SLOTS": "64", "MODEL_KV_DTYPE": "f8",
+    },
+    "attn-pallas": {"MODEL_ATTN_IMPL": "pallas", "DECODE_SLOTS": "32"},
+    "chunk16": {"DECODE_CHUNK": "16", "DECODE_SLOTS": "32"},
+}
+
+
+def main() -> int:
+    names = sys.argv[1:] or list(SWEEP)
+    unknown = [n for n in names if n not in SWEEP]
+    if unknown:
+        print(
+            f"unknown config(s) {unknown}; available: {', '.join(SWEEP)}",
+            file=sys.stderr,
+        )
+        return 2
+    results = []
+    failures = 0
+    for name in names:
+        env = {**os.environ, **SWEEP[name]}
+        print(f"=== {name}: {SWEEP[name]}", file=sys.stderr, flush=True)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py")],
+                env=env, capture_output=True, text=True, timeout=1800,
+            )
+        except subprocess.TimeoutExpired:
+            # one hung config must not discard the completed results
+            parsed = {"config": name, "errors": ["timeout after 1800s"]}
+            results.append(parsed)
+            failures += 1
+            print(json.dumps(parsed), flush=True)
+            continue
+        line = (proc.stdout.strip().splitlines() or ["{}"])[-1]
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            parsed = {"errors": [f"unparseable: {line[:200]}"]}
+        parsed["config"] = name
+        results.append(parsed)
+        print(json.dumps(parsed), flush=True)
+        if proc.returncode != 0:
+            failures += 1
+            tail = "\n".join(proc.stderr.strip().splitlines()[-5:])
+            print(f"--- {name} rc={proc.returncode}\n{tail}", file=sys.stderr)
+    ranked = sorted(
+        (r for r in results if r.get("decode_tok_per_sec")),
+        key=lambda r: -r["decode_tok_per_sec"],
+    )
+    print("\n=== decode tok/s ranking", file=sys.stderr)
+    for r in ranked:
+        print(
+            f"  {r['config']:>14}: {r['decode_tok_per_sec']:8.1f} tok/s  "
+            f"p50 {r.get('value')}ms  mbu {r.get('mbu_decode')}",
+            file=sys.stderr,
+        )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
