@@ -143,14 +143,6 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
 
     import gofr_tpu
 
-    log(f"booting app (model={model} quant={os.environ.get('MODEL_QUANT')}"
-        f" max_seq={os.environ.get('MODEL_MAX_SEQ')}"
-        f" buckets={os.environ.get('MODEL_BUCKETS')})")
-    boot_start = time.perf_counter()
-    app = gofr_tpu.new()
-    if app.container.tpu is None:
-        raise RuntimeError("TPU datasource failed to wire (see stderr above)")
-
     async def infer(ctx):
         payload = ctx.bind()
         state = await ctx.tpu.infer_async(payload["tokens"])
@@ -165,14 +157,74 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
         )
         return {"tokens": toks, "n": len(toks)}
 
-    app.post("/infer", infer)
-    app.post("/generate", generate)
-    app.start()
-    base = f"http://127.0.0.1:{app.http_port}"
+    # -- phase: boot, halving decode slots on memory-class failures ---------
+    # the slot count scales decode throughput but its HBM fit depends on
+    # model/chip; a mis-sized default must degrade the number, not kill
+    # the whole artifact. All retries share ONE boot deadline (the driver
+    # window was sized for a single attempt), each retry releases the
+    # failed attempt's device memory and binds a fresh port, and the
+    # halved count stays a multiple of the mesh's dp*fsdp so the pool
+    # never silently disables.
+    import gc
 
+    boot_start = time.perf_counter()
+    boot_deadline = time.monotonic() + boot_timeout
+    # mirror the device's own default (BATCH_MAX_SIZE) so the degradation
+    # path also covers deployments that never set DECODE_SLOTS
+    if not os.environ.get("DECODE_SLOTS"):
+        os.environ["DECODE_SLOTS"] = os.environ["BATCH_MAX_SIZE"]
+    rows = _mesh_rows(os.environ.get("TPU_MESH", ""))
+    port = int(os.environ["HTTP_PORT"])
+    while True:
+        log(f"booting app (model={model} quant={os.environ.get('MODEL_QUANT')}"
+            f" max_seq={os.environ.get('MODEL_MAX_SEQ')}"
+            f" buckets={os.environ.get('MODEL_BUCKETS')}"
+            f" slots={os.environ.get('DECODE_SLOTS')})")
+        app = gofr_tpu.new()
+        if app.container.tpu is None:
+            raise RuntimeError("TPU datasource failed to wire (see stderr above)")
+        app.post("/infer", infer)
+        app.post("/generate", generate)
+        app.start()
+        base = f"http://127.0.0.1:{app.http_port}"
+        try:
+            _await_ready(base, max(boot_deadline - time.monotonic(), 1.0))
+            break
+        except BaseException as exc:
+            try:
+                app.shutdown()  # every failure path tears the server down
+            except Exception:
+                pass
+            slots = int(os.environ.get("DECODE_SLOTS", "0") or 0)
+            next_slots = (slots // 2 // rows) * rows if rows > 1 else slots // 2
+            if (
+                isinstance(exc, RuntimeError)
+                and _is_memory_error(str(exc))
+                and next_slots >= 1
+                and time.monotonic() < boot_deadline
+            ):
+                errors.append(
+                    f"boot OOM at DECODE_SLOTS={slots}: retrying at {next_slots}"
+                )
+                log(errors[-1])
+                os.environ["DECODE_SLOTS"] = str(next_slots)
+                # release the failed attempt's device memory BEFORE booting
+                # another full model beside it (the boot error traceback
+                # pins the old runner until collected)
+                app = None
+                gc.collect()
+                # a wedged server thread may still hold the old socket
+                port += 1
+                os.environ["HTTP_PORT"] = str(port)
+                continue
+            raise
+
+    result["decode_slots"] = int(os.environ.get("DECODE_SLOTS", "0") or 0) or None
+    if result["decode_slots"] and not os.environ.get("BENCH_DECODE_STREAMS"):
+        # an OOM retry shrank the pool: keep the decode phase exactly
+        # pool-sized so the measurement stays honest
+        decode_streams = min(decode_streams, result["decode_slots"])
     try:
-        # -- phase: wait for readiness, narrating boot progress -------------
-        _await_ready(base, boot_timeout)
         boot_s = time.perf_counter() - boot_start
         result["boot_seconds"] = round(boot_s, 1)
         result["n_params"] = getattr(app.container.tpu.runner, "n_params", None)
@@ -351,6 +403,30 @@ def _warmup(fire, errors: list[str], attempts: int = 5) -> None:
             time.sleep(2.0)
     if ok == 0:
         raise RuntimeError("warmup never succeeded — aborting measurement")
+
+
+def _mesh_rows(topology: str) -> int:
+    """dp*fsdp of a TPU_MESH request (1 when unset/unparseable): the
+    decode pool requires its slot count divisible by this, so OOM-retry
+    halving must round to a multiple or the pool silently disables."""
+    rows = 1
+    for part in topology.split(","):
+        key, _, val = part.strip().partition("=")
+        if key in ("dp", "fsdp"):
+            try:
+                rows *= max(int(val), 1)
+            except ValueError:
+                pass
+    return rows
+
+
+def _is_memory_error(detail: str) -> bool:
+    """Device-memory boot failures (worth retrying with a smaller pool) vs
+    config/runtime errors (not). Matches the failure strings XLA/PJRT
+    attach to allocation failures."""
+    needles = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory", "OOM",
+               "Failed to allocate", "memory limit")
+    return any(n in detail for n in needles)
 
 
 def _describe_http_error(exc: Exception) -> str:
