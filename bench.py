@@ -406,18 +406,17 @@ def _warmup(fire, errors: list[str], attempts: int = 5) -> None:
 
 
 def _mesh_rows(topology: str) -> int:
-    """dp*fsdp of a TPU_MESH request (1 when unset/unparseable): the
-    decode pool requires its slot count divisible by this, so OOM-retry
-    halving must round to a multiple or the pool silently disables."""
-    rows = 1
-    for part in topology.split(","):
-        key, _, val = part.strip().partition("=")
-        if key in ("dp", "fsdp"):
-            try:
-                rows *= max(int(val), 1)
-            except ValueError:
-                pass
-    return rows
+    """dp*fsdp of a TPU_MESH request (1 when unset/invalid): the decode
+    pool requires its slot count divisible by this, so OOM-retry halving
+    must round to a multiple or the pool silently disables. Parses with
+    the device's own parser — one definition of the mesh grammar."""
+    from gofr_tpu.tpu.device import _parse_mesh_request
+
+    try:
+        kwargs = _parse_mesh_request(topology) or {}
+    except ValueError:
+        return 1  # a malformed mesh fails the boot itself with the real error
+    return max(kwargs.get("dp", 1), 1) * max(kwargs.get("fsdp", 1), 1)
 
 
 def _is_memory_error(detail: str) -> bool:

@@ -8,6 +8,7 @@ simple swap captures everything the real logger writes.
 
 from __future__ import annotations
 
+import contextlib
 import io
 import sys
 import time
@@ -58,3 +59,33 @@ class MockLogger(Logger):
 
     def contains(self, text: str) -> bool:
         return text in self.output
+
+
+@contextlib.contextmanager
+def serving_device(**env: str):
+    """Build a TPUDevice under temporary env overrides; close it and
+    restore the environment on exit — INCLUDING when construction itself
+    raises, so a failed boot never leaks env mutations or worker threads
+    into later tests. Nesting two devices restores in reverse order
+    automatically (the with-statement ordering), which hand-rolled
+    snapshot pairs repeatedly got wrong."""
+    import os
+
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.tpu.device import new_device
+
+    defaults = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2",
+                "BATCH_TIMEOUT_MS": "1"}
+    defaults.update(env)
+    old = {k: os.environ.get(k) for k in defaults}
+    os.environ.update(defaults)
+    dev = None
+    try:
+        dev = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        yield dev
+    finally:
+        if dev is not None:
+            dev.close()
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
