@@ -258,6 +258,60 @@ def make_lora_train_step(cfg: Any, optimizer: Any, loss_fn: Any = None) -> Any:
     return train_step
 
 
+def export_adapter(state: dict) -> dict:
+    """Self-contained adapter artifact from a LoRA train state: the
+    adapter subtree plus its per-leaf scales (scales live in ``rest``, so
+    the adapters alone would lose the alpha/rank ratio). Orbax-saveable;
+    ``apply_adapter`` re-attaches it to any same-shape base."""
+
+    def scales(tree: Any) -> Any:
+        if isinstance(tree, dict) and set(tree) == {"w", "lora_scale"}:
+            return tree["lora_scale"]
+        if isinstance(tree, dict):
+            out = {k: scales(v) for k, v in tree.items()}
+            return {k: v for k, v in out.items() if v is not None} or None
+        return None
+
+    return {"adapters": state["adapters"], "scales": scales(state["rest"])}
+
+
+def apply_adapter(base: dict, artifact: dict) -> dict:
+    """Attach a saved adapter artifact to a base param tree -> a wrapped
+    tree (the multi-LoRA serving path: every wrapped tree SHARES the base
+    arrays, so n adapters cost n × adapter bytes, not n × model bytes).
+    The base may be quantized; shapes must match the training base."""
+    adapters, scales = artifact["adapters"], artifact["scales"]
+
+    def walk(b: Any, a: Any, s: Any) -> Any:
+        if isinstance(a, dict) and set(a) == {"lora_a", "lora_b"}:
+            lead, i, o = _weight_shape(b)
+            rank = a["lora_a"].shape[-1]
+            # full-shape check including stacked leading (layer) dims: a
+            # wrong-depth adapter must fail HERE with a clear error, not
+            # inside a jitted scan later
+            want_a = (*lead, i, rank)
+            want_b = (*lead, rank, o)
+            if (
+                tuple(a["lora_a"].shape) != want_a
+                or tuple(a["lora_b"].shape) != want_b
+            ):
+                raise ValueError(
+                    f"adapter shapes {tuple(a['lora_a'].shape)} x "
+                    f"{tuple(a['lora_b'].shape)} do not fit base weight "
+                    f"{(*lead, i, o)} (expected {want_a} x {want_b})"
+                )
+            return {"w": b, "lora_a": a["lora_a"], "lora_b": a["lora_b"],
+                    "lora_scale": s}
+        if isinstance(a, dict):
+            return {
+                k: walk(b[k], a[k], s[k]) if a.get(k) is not None else b[k]
+                for k in b
+            }
+        return b
+
+    return walk(base, adapters, scales)
+
+
 def merge_lora(params: dict, dtype: Any = None) -> dict:
     """Fold adapters into plain weights (serving export): ``w + A@B·s``.
     Quantized bases dequantize first — the merged tree is full-precision

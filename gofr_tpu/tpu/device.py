@@ -24,6 +24,9 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
   DRAFT_TOKENS tokens per cycle and the target verifies them in one
   forward (output bit-identical to plain greedy; latency mode, so greedy
   requests bypass the continuous-batching pool)
+- ``LORA_ADAPTERS``: "name=path,..." named LoRA adapter artifacts
+  (models/lora.py::export_adapter, orbax-saved) served over the shared
+  base; requests select one via generate(adapter=...) and decode solo
 - ``PREFIX_CACHE``: keep the KV rows of the n most recent distinct
   prompts — an exact repeat (system prompts, retries) skips prefill
   entirely on the generate path (hit ratio on /metrics)
@@ -204,6 +207,20 @@ class TPUDevice:
             # draft — strictly slower than plain decode. A stale
             # DRAFT_TOKENS without a draft model is ignored.
             raise ValueError("DRAFT_TOKENS must be >= 2")
+        # LORA_ADAPTERS="name=path,name2=path2": named adapter sets
+        # (orbax artifacts from models/lora.py::export_adapter) served
+        # over ONE shared base — requests pick one with {"adapter": name}
+        raw_adapters = config.get_or_default("LORA_ADAPTERS", "").strip()
+        self._lora_adapters: dict[str, str] = {}
+        if raw_adapters:
+            for part in raw_adapters.split(","):
+                name, sep, path = part.strip().partition("=")
+                if not sep or not name or not path:
+                    raise ValueError(
+                        f"LORA_ADAPTERS entry '{part.strip()}' is malformed "
+                        "— expected name=path[,name2=path2...]"
+                    )
+                self._lora_adapters[name] = path
         # PREFIX_CACHE=n keeps the KV rows of the n most recent distinct
         # prompts: an exact-match repeat (system prompts, retries) skips
         # prefill entirely — TTFT collapses to the decode path
@@ -324,6 +341,7 @@ class TPUDevice:
             draft_tokens=self._draft_tokens, draft_path=self._draft_path,
             attn_impl=self._attn_impl,
             prefix_cache=self._prefix_cache_size,
+            lora_adapters=self._lora_adapters,
         )
         self.runner.warmup(progress=self._boot_progress)
         # continuous batching: concurrent decodes share one fixed-shape
@@ -421,6 +439,7 @@ class TPUDevice:
         sampler: Optional[Any] = None,
         stop_tokens: Optional[Any] = None,
         logprobs: bool = False,
+        adapter: Optional[str] = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         """Autoregressive generation (transformer models): prefill goes
         through the dynamic batcher (TTFT path); decode steps run per
@@ -443,6 +462,7 @@ class TPUDevice:
                 sampler=sampler, stop_tokens=stop_tokens,
                 decode_pool=self.decode_pool,
                 prefill_batcher=self.batcher, logprobs=logprobs,
+                adapter=adapter,
                 ttft_cb=lambda: self._ttft.observe(
                     time.perf_counter() - start, model=self.model_name, op="generate"
                 ),
@@ -468,6 +488,7 @@ class TPUDevice:
         self, tokens: list[int], max_new_tokens: int = 32,
         sampler: Optional[Any] = None,
         stop_tokens: Optional[Any] = None,
+        adapter: Optional[str] = None,
     ) -> Any:
         """Iterator of decoded token ids, yielded as they decode — the shared
         bridge for SSE and gRPC streaming transports. Closing the iterator
@@ -485,7 +506,7 @@ class TPUDevice:
             try:
                 self.generate(
                     tokens, max_new_tokens, on_token=out.put, stop=stop,
-                    sampler=sampler, stop_tokens=stop_tokens,
+                    sampler=sampler, stop_tokens=stop_tokens, adapter=adapter,
                 )
             except BaseException as exc:
                 failure.append(exc)
@@ -871,6 +892,7 @@ class _TransformerRunner:
         draft_path: Optional[str] = None,
         attn_impl: Optional[str] = None,
         prefix_cache: int = 0,
+        lora_adapters: Optional[dict] = None,
     ):
         self.max_batch = max_batch
         from gofr_tpu.models.llama import CONFIGS
@@ -993,6 +1015,25 @@ class _TransformerRunner:
         self.n_params = transformer_param_count(cfg)
         bucket_source = buckets if buckets else self.SEQ_BUCKETS
         self.buckets = [b for b in bucket_source if b <= cfg.max_seq] or [cfg.max_seq]
+        # multi-LoRA serving: named adapter sets over the SHARED base
+        # arrays (n adapters cost n x adapter bytes, not n x model bytes);
+        # requests pick one per call and decode solo
+        self.adapters: dict[str, Any] = {}
+        if lora_adapters:
+            if mesh is not None and (
+                mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1) > 1
+            ):
+                raise ValueError(
+                    "LORA_ADAPTERS serve single-row (solo) requests — use a "
+                    "tp-only TPU_MESH or no mesh"
+                )
+            from gofr_tpu.models.lora import apply_adapter
+            from gofr_tpu.training.checkpoint import restore_params
+
+            for a_name, a_path in lora_adapters.items():
+                self.adapters[a_name] = apply_adapter(
+                    self.params, restore_params(a_path)
+                )
         # speculative decoding: draft engine + target-side verify/reset
         self.spec = (
             _SpecEngine(cfg, quant, draft_name, draft_tokens, draft_path)
@@ -1112,6 +1153,7 @@ class _TransformerRunner:
         prefill_batcher: Any = None,
         ttft_cb: Any = None,
         logprobs: bool = False,
+        adapter: Optional[str] = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         if sampler is None:
             from gofr_tpu.ops.sampling import Sampler
@@ -1119,19 +1161,38 @@ class _TransformerRunner:
             sampler = Sampler()  # greedy
         stop_tokens = frozenset(stop_tokens or ())
         ids = self.prepare(tokens)
-        state = self._prefix_lookup(ids) if self._prefix_cache is not None else None
-        if state is None:
-            if ids.size > self.buckets[-1] and self._can_chunk_prefill():
-                # longer than the largest compiled bucket: slice through it
-                # instead of truncating (run_batch's batched path keeps the
-                # recency clip — mixed-length chunking doesn't batch)
-                state = self._chunked_prefill(ids)
-            elif prefill_batcher is not None:
-                state = prefill_batcher.infer(ids)
-            else:
-                state = self.run_batch([ids])[0]
-            if self._prefix_cache is not None:
-                self._prefix_store(ids, state)
+        prm = self.params
+        if adapter is not None:
+            if adapter not in self.adapters:
+                from gofr_tpu.errors import InvalidParamError
+
+                raise InvalidParamError(
+                    f"adapter '{adapter}' (loaded: {sorted(self.adapters)})"
+                )
+            prm = self.adapters[adapter]
+            # adapter weights differ from the batch's: prefill solo (one
+            # [1, bucket] row, bucket sized to the prompt) and skip the
+            # shared prefix cache/pool/spec
+            state = self._chunked_prefill(
+                ids, prm, bucket=self._bucket_for(int(ids.size))
+            )
+        else:
+            state = (
+                self._prefix_lookup(ids)
+                if self._prefix_cache is not None else None
+            )
+            if state is None:
+                if ids.size > self.buckets[-1] and self._can_chunk_prefill():
+                    # longer than the largest compiled bucket: slice
+                    # through it instead of truncating (run_batch's
+                    # batched path keeps the recency clip)
+                    state = self._chunked_prefill(ids)
+                elif prefill_batcher is not None:
+                    state = prefill_batcher.infer(ids)
+                else:
+                    state = self.run_batch([ids])[0]
+                if self._prefix_cache is not None:
+                    self._prefix_store(ids, state)
         out: list[int] = []
         lps: list[float] = []
         presence = None
@@ -1176,7 +1237,7 @@ class _TransformerRunner:
         # so these requests bypass the throughput pool)
         if (
             self.spec is not None and sampler.greedy and presence is None
-            and not logprobs
+            and not logprobs and adapter is None
         ):
             return self._spec_generate(
                 state, ids, out, token, max_new_tokens, on_token, stop,
@@ -1187,7 +1248,7 @@ class _TransformerRunner:
         # (seeded ones need the exact per-request key sequence — solo path)
         if (
             decode_pool is not None and not sampler.seeded
-            and presence is None and not logprobs
+            and presence is None and not logprobs and adapter is None
         ):
             import queue as queue_mod
 
@@ -1267,10 +1328,10 @@ class _TransformerRunner:
                 key = self._greedy_key if sampler.greedy else sampler.take_key()
                 fn = self._chunk_fns[(presence is not None, logprobs)]
                 if presence is None:
-                    result = fn(self.params, token_dev, cache, key, temp,
+                    result = fn(prm, token_dev, cache, key, temp,
                                 tk, tp, mp, n)
                 else:
-                    result = fn(self.params, token_dev, cache, key, temp,
+                    result = fn(prm, token_dev, cache, key, temp,
                                 tk, tp, mp, presence, pen, n)
                 toks_dev, cache = result[0], result[1]
                 rest = list(result[2:])
@@ -1314,24 +1375,27 @@ class _TransformerRunner:
             return True
         return self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1) == 1
 
-    def _chunked_prefill(self, ids: np.ndarray) -> dict:
+    def _chunked_prefill(
+        self, ids: np.ndarray, params: Any = None, bucket: Optional[int] = None
+    ) -> dict:
         """Prefill a prompt LONGER than the largest compiled bucket by
         running it through the top bucket in slices, each writing into the
         same [1]-row cache at its ragged start offset — the exact cached
         forward decode already uses. One compiled [1, bucket] shape serves
         any prompt length up to max_seq, so a deployment can restrict
         MODEL_BUCKETS (fast cold boot) without truncating long prompts.
-        ONE host fetch at the end (the last chunk's argmax)."""
-        bucket = self.buckets[-1]
+        ONE host fetch at the end (the last chunk's argmax). ``bucket``
+        overrides the chunk width (adapter requests size it to the
+        prompt so short prompts never pay top-bucket FLOPs)."""
+        bucket = bucket or self.buckets[-1]
         # the shared zero cache: prefill never mutates its input, so every
         # chunked request can start from the same [1]-row allocation
         cache = self._zero_cache(1)
         logits = next_ids = None
         total = 0
+        prm = self.params if params is None else params
         for tokens, lengths, size in _prompt_chunks(ids, bucket):
-            logits, next_ids, cache = self._prefill(
-                self.params, tokens, cache, lengths
-            )
+            logits, next_ids, cache = self._prefill(prm, tokens, cache, lengths)
             total += size
         return {
             "cache": cache,
@@ -1520,6 +1584,27 @@ class _TransformerRunner:
         if self._prefix_cache is not None:
             # prefix-cache row copies must not compile on the serving path
             self._copy_row(one)["lengths"].block_until_ready()
+        if self.adapters:
+            # LoRA-wrapped trees have a different pytree structure, so the
+            # adapter prefill/decode executables are separate compiles —
+            # ONE each, shared by every adapter (same structure)
+            any_tree = next(iter(self.adapters.values()))
+            for i, b_ in enumerate(self.buckets):
+                if progress:
+                    progress(
+                        f"compiling adapter prefill bucket {b_} "
+                        f"({i + 1}/{len(self.buckets)})"
+                    )
+                st = self._chunked_prefill(
+                    np.ones((4,), np.int32), any_tree, bucket=b_
+                )
+            if progress:
+                progress("compiling adapter decode chunk")
+            a_toks = self._decode_chunk(
+                any_tree, jnp.zeros((1, 1), jnp.int32), st["cache"],
+                self._greedy_key, 0.0, 0, 1.0, 0.0, self.decode_chunk_size,
+            )[0]
+            a_toks.block_until_ready()
         step, _ = self._decode(self.params, jnp.zeros((1, 1), jnp.int32), one)
         step.block_until_ready()
         # warm the full decode chunk (remainder sizes compile on demand)
@@ -1749,9 +1834,14 @@ def _build_runner(
     draft_path: Optional[str] = None,
     attn_impl: Optional[str] = None,
     prefix_cache: int = 0,
+    lora_adapters: Optional[dict] = None,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
+    if lora_adapters and name not in CONFIGS:
+        raise ValueError(
+            f"LORA_ADAPTERS requires a transformer MODEL_NAME (got '{name}')"
+        )
     if name in ("mlp", "tiny-mlp"):
         return _MLPRunner(quant, model_path, max_batch)
     if name.startswith("bert"):
@@ -1763,6 +1853,7 @@ def _build_runner(
             kv_dtype=kv_dtype, draft_name=draft_name,
             draft_tokens=draft_tokens, draft_path=draft_path,
             attn_impl=attn_impl, prefix_cache=prefix_cache,
+            lora_adapters=lora_adapters,
         )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "
