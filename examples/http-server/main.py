@@ -75,6 +75,12 @@ def generate_handler(ctx):
     max_new = int(body.get("max_new_tokens") or 16)
     sampler = _sampler_from(body)
     stop_tokens = _stop_tokens_from(body)
+    adapter = body.get("adapter")  # multi-LoRA: named adapter selection
+    if adapter is not None and not isinstance(adapter, str):
+        raise HTTPError(400, '"adapter" must be a string')
+    want_logprobs = bool(body.get("logprobs"))
+    if want_logprobs and ctx.param("stream") == "true":
+        raise HTTPError(400, '"logprobs" is not available on the SSE stream')
     tok = ctx.tpu.tokenizer
     if ctx.param("stream") == "true":
         from gofr_tpu.http.response import Stream
@@ -85,7 +91,8 @@ def generate_handler(ctx):
             dec = tok.stream_decoder() if tok is not None else None
             try:
                 for token in ctx.tpu.generate_stream(
-                    tokens, max_new, sampler=sampler, stop_tokens=stop_tokens
+                    tokens, max_new, sampler=sampler, stop_tokens=stop_tokens,
+                    adapter=adapter,
                 ):
                     event = {"token": token}
                     if dec is not None:
@@ -99,8 +106,15 @@ def generate_handler(ctx):
                 yield {"error": str(exc)}
 
         return Stream(events())
-    out = ctx.tpu.generate(tokens, max_new, sampler=sampler, stop_tokens=stop_tokens)
+    out = ctx.tpu.generate(
+        tokens, max_new, sampler=sampler, stop_tokens=stop_tokens,
+        adapter=adapter, logprobs=want_logprobs,
+    )
+    if want_logprobs:
+        out, logprobs = out
     result = {"tokens": out}
+    if want_logprobs:
+        result["logprobs"] = logprobs
     if tok is not None:
         result["text"] = tok.decode(out)
     return result
