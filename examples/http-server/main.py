@@ -79,22 +79,28 @@ def generate_handler(ctx):
     if adapter is not None and not isinstance(adapter, str):
         raise HTTPError(400, '"adapter" must be a string')
     want_logprobs = bool(body.get("logprobs"))
-    if want_logprobs and ctx.param("stream") == "true":
-        raise HTTPError(400, '"logprobs" is not available on the SSE stream')
     tok = ctx.tpu.tokenizer
     if ctx.param("stream") == "true":
         from gofr_tpu.http.response import Stream
+
+        # called OUTSIDE events(): parameter validation (e.g. an unknown
+        # adapter) must 400 before the SSE response commits its 200
+        stream_iter = ctx.tpu.generate_stream(
+            tokens, max_new, sampler=sampler, stop_tokens=stop_tokens,
+            adapter=adapter, logprobs=want_logprobs,
+        )
 
         def events():
             # incremental decode: multi-byte UTF-8 split across tokens
             # buffers until the character completes
             dec = tok.stream_decoder() if tok is not None else None
             try:
-                for token in ctx.tpu.generate_stream(
-                    tokens, max_new, sampler=sampler, stop_tokens=stop_tokens,
-                    adapter=adapter,
-                ):
+                for item in stream_iter:
+                    # with logprobs, items are (token, logprob) pairs
+                    token, lp = item if want_logprobs else (item, None)
                     event = {"token": token}
+                    if lp is not None:
+                        event["logprob"] = lp
                     if dec is not None:
                         event["text"] = dec.feed(token)
                     yield event

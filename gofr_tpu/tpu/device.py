@@ -489,11 +489,32 @@ class TPUDevice:
         sampler: Optional[Any] = None,
         stop_tokens: Optional[Any] = None,
         adapter: Optional[str] = None,
+        logprobs: bool = False,
     ) -> Any:
         """Iterator of decoded token ids, yielded as they decode — the shared
-        bridge for SSE and gRPC streaming transports. Closing the iterator
-        (client disconnect) cancels the background decode instead of letting
-        it run to completion unread."""
+        bridge for SSE and gRPC streaming transports. With ``logprobs=True``
+        each item is a (token, raw_logprob) pair instead of a bare id.
+        Closing the iterator (client disconnect) cancels the background
+        decode instead of letting it run to completion unread."""
+        if adapter is not None:
+            # validate EAGERLY (this wrapper is not a generator, so the
+            # check runs before the transport commits a 200): an unknown
+            # adapter must 400 exactly like the non-streaming path
+            self.wait_ready(600.0)
+            if adapter not in getattr(self.runner, "adapters", {}):
+                from gofr_tpu.errors import InvalidParamError
+
+                raise InvalidParamError(
+                    f"adapter '{adapter}' (loaded: "
+                    f"{sorted(getattr(self.runner, 'adapters', {}))})"
+                )
+        return self._stream_iter(
+            tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs
+        )
+
+    def _stream_iter(
+        self, tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs
+    ) -> Any:
         import queue as queue_mod
         import threading
 
@@ -507,6 +528,7 @@ class TPUDevice:
                 self.generate(
                     tokens, max_new_tokens, on_token=out.put, stop=stop,
                     sampler=sampler, stop_tokens=stop_tokens, adapter=adapter,
+                    logprobs=logprobs,
                 )
             except BaseException as exc:
                 failure.append(exc)
@@ -1227,7 +1249,8 @@ class _TransformerRunner:
             row = jnp.asarray(state["logits"]).astype(jnp.float32)
             lps.append(float(jax.nn.log_softmax(row)[token]))
         if on_token:
-            on_token(token)
+            # with logprobs, streaming consumers receive (token, logprob)
+            on_token((token, lps[-1]) if logprobs else token)
         if max_new_tokens <= 1:
             return (out, lps) if logprobs else out
 
@@ -1360,7 +1383,7 @@ class _TransformerRunner:
                 if chunk_lps is not None:
                     lps.append(chunk_lps[j])
                 if on_token:
-                    on_token(t)
+                    on_token((t, chunk_lps[j]) if logprobs else t)
                 if stop is not None and stop.is_set():
                     stopped = True  # on_token may set stop mid-burst
                     break

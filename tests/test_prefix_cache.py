@@ -2,53 +2,26 @@
 must produce identical generations; entries are private copies, LRU-bound,
 and safe under the decode pool and sampling."""
 
-import os
 import threading
 
 import pytest
 
-from gofr_tpu.config import EnvConfig
-from gofr_tpu.logging import Level
-from gofr_tpu.metrics import Registry
 from gofr_tpu.ops.sampling import Sampler
-from gofr_tpu.testutil import MockLogger
-from gofr_tpu.tpu.device import new_device
-
-
-def _restore(old):
-    for k, v in old.items():
-        os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
-
-
-def _device(**env):
-    # PREFIX_CACHE defaults OFF here so the 'plain' baseline stays a real
-    # no-cache device even while 'cached' has the env var set
-    defaults = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2",
-                "BATCH_TIMEOUT_MS": "1", "PREFIX_CACHE": "0"}
-    defaults.update(env)
-    old = {k: os.environ.get(k) for k in defaults}
-    os.environ.update(defaults)
-    try:
-        return new_device(EnvConfig(), MockLogger(Level.INFO), Registry()), old
-    except BaseException:
-        _restore(old)
-        raise
+from gofr_tpu.testutil import serving_device
 
 
 @pytest.fixture(scope="module")
 def cached():
-    dev, old = _device(PREFIX_CACHE="2", DECODE_CHUNK="4")
-    yield dev
-    dev.close()
-    _restore(old)
+    with serving_device(PREFIX_CACHE="2", DECODE_CHUNK="4") as dev:
+        yield dev
 
 
 @pytest.fixture(scope="module")
 def plain():
-    dev, old = _device(DECODE_CHUNK="4")
-    yield dev
-    dev.close()
-    _restore(old)
+    # PREFIX_CACHE pinned OFF so this baseline stays a real no-cache
+    # device even while 'cached' has the env var set
+    with serving_device(PREFIX_CACHE="0", DECODE_CHUNK="4") as dev:
+        yield dev
 
 
 def test_repeat_prompt_hits_and_matches(cached, plain):
@@ -111,11 +84,6 @@ def test_concurrent_hits_are_safe(cached, plain):
 
 
 def test_negative_size_rejected():
-    env = {"MODEL_NAME": "tiny", "PREFIX_CACHE": "-1"}
-    old = {k: os.environ.get(k) for k in env}
-    os.environ.update(env)
-    try:
-        with pytest.raises(ValueError, match="PREFIX_CACHE"):
-            new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
-    finally:
-        _restore(old)
+    with pytest.raises(ValueError, match="PREFIX_CACHE"):
+        with serving_device(PREFIX_CACHE="-1"):
+            pass

@@ -317,3 +317,17 @@ def test_logprobs_match_teacher_forcing():
     finally:
         for k, v in old.items():
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_stream_logprobs_match_blocking():
+    from gofr_tpu.testutil import serving_device
+
+    with serving_device(DECODE_CHUNK="4") as dev:
+        toks, lps = dev.generate([1, 2, 3], max_new_tokens=6, logprobs=True)
+        streamed = list(dev.generate_stream([1, 2, 3], max_new_tokens=6,
+                                            logprobs=True))
+        assert [t for t, _ in streamed] == toks
+        assert [round(lp, 5) for _, lp in streamed] == [round(x, 5) for x in lps]
+        # without the flag the stream still yields bare ints
+        plain = list(dev.generate_stream([1, 2, 3], max_new_tokens=3))
+        assert all(isinstance(t, int) for t in plain)
