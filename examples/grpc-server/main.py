@@ -83,12 +83,20 @@ def generate_stream(ctx):
         stop_tokens = stop_tokens_from_body(body)
     except ValueError as exc:
         raise HTTPError(400, str(exc))
+    adapter = body.get("adapter")  # multi-LoRA: named adapter selection
+    if adapter is not None and not isinstance(adapter, str):
+        raise HTTPError(400, '"adapter" must be a string')
+    want_logprobs = bool(body.get("logprobs"))
     tok = ctx.tpu.tokenizer
     dec = tok.stream_decoder() if tok is not None else None
-    for token in ctx.tpu.generate_stream(
-        tokens, max_new, sampler=sampler, stop_tokens=stop_tokens
+    for item in ctx.tpu.generate_stream(
+        tokens, max_new, sampler=sampler, stop_tokens=stop_tokens,
+        adapter=adapter, logprobs=want_logprobs,
     ):
+        token, lp = item if want_logprobs else (item, None)
         event = {"token": token}
+        if lp is not None:
+            event["logprob"] = lp
         if dec is not None:
             event["text"] = dec.feed(token)
         yield event
