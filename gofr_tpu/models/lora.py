@@ -32,6 +32,7 @@ from gofr_tpu.models.quant import (
     dequantize_array_int4,
     is_quantized,
     is_quantized_int4,
+    moe_skip_keys,
 )
 
 # weight names eligible for adapters (the attention + MLP matmuls; the
@@ -70,8 +71,9 @@ def add_lora(
 
     def collect(tree: Any) -> None:
         if isinstance(tree, dict) and not _is_packed(tree):
+            skip = moe_skip_keys(tree)
             for k, v in tree.items():
-                if k in eligible and _weight_shape(v) is not None:
+                if k in eligible and k not in skip and _weight_shape(v) is not None:
                     leaves.append((k, v))
                 else:
                     collect(v)
@@ -81,10 +83,11 @@ def add_lora(
 
     def wrap(tree: Any) -> Any:
         if isinstance(tree, dict) and not _is_packed(tree):
+            skip = moe_skip_keys(tree)
             out = {}
             for k, v in tree.items():
                 shape = _weight_shape(v)
-                if k in eligible and shape is not None:
+                if k in eligible and k not in skip and shape is not None:
                     lead, i, o = shape
                     a = (
                         jax.random.normal(next(subkeys), (*lead, i, rank))

@@ -31,6 +31,17 @@ import jax.numpy as jnp
 # weight names eligible for quantization (2-D matmul weights used via mm())
 _QUANT_KEYS = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head", "wqkv", "w_in", "w_out"}
 
+
+def moe_skip_keys(tree: dict) -> frozenset:
+    """Keys a param-tree walker must leave dense inside a MoE block: expert
+    stacks (the dict also holds the router) compute their FFN via batched
+    einsum over the expert axis, not mm(), so a packed/LoRA dict there
+    would be untraceable. Shared by quantize_params and lora.add_lora so
+    the skip set cannot drift between walkers."""
+    return (
+        frozenset(("w_gate", "w_up", "w_down")) if "router" in tree else frozenset()
+    )
+
 _CLIP = 127.0
 _CLIP4 = 7.0
 _SCALE_FLOOR = 1e-8
@@ -158,11 +169,7 @@ def quantize_params(params: dict, mode: Any = "int8") -> dict:
 
     def walk(tree: Any) -> Any:
         if isinstance(tree, dict):
-            # MoE expert stacks (the dict also holds the router) compute
-            # their FFN via batched einsum over the expert axis, not mm()
-            # — those keys stay dense; the attention weights beside them
-            # quantize normally
-            skip = {"w_gate", "w_up", "w_down"} if "router" in tree else set()
+            skip = moe_skip_keys(tree)
             out = {}
             for key, value in tree.items():
                 if (

@@ -365,7 +365,11 @@ def _dkv_kernel(
         if causal:
             mask = jnp.logical_and(mask, k_pos <= q_pos)
         s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse)  # zero at masked and padded-q positions
+        # zero at masked positions (s = -inf). Zero-PADDED query rows have
+        # lse = 0 and s = 0, so p = exp(0) = 1 there — those rows still
+        # contribute nothing, but only because dO = 0 and D (dvec) = 0
+        # make dv/ds vanish; preserve that invariant when editing.
+        p = jnp.exp(s - lse)
         dv_acc = dv_acc + jax.lax.dot_general(
             p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,

@@ -46,7 +46,15 @@ import numpy as np
 
 DONE = object()  # end-of-stream marker on a slot's token queue
 
-PIPELINE_DEPTH = 2  # chunks in flight: fetch of N overlaps compute of N+1
+# Chunks in flight (DECODE_PIPELINE config): the host fetch of chunk N's
+# tokens overlaps execution of the younger in-flight chunks. Round-3 pool
+# debug data on the tunneled v5e showed fetch-wait ~133ms of a ~137ms
+# chunk at depth 2 — i.e. ONE younger chunk does not cover the link round
+# trip, the device idles most of each chunk. Depth d covers a round trip
+# up to (d-1) x chunk-compute long; 3 is the default because the tunnel
+# RTT is roughly one chunk compute, and the cost of extra depth is only
+# wasted lockstep steps for slots freed mid-pipeline.
+PIPELINE_DEPTH = 3
 
 # GOFR_POOL_DEBUG=1: per-chunk dispatch/fetch/deliver timings on stderr —
 # the first tool to reach for when pooled tok/s diverges from the raw
@@ -104,9 +112,13 @@ class DecodePool:
         peak_flops: Any = None,
         peak_hbm_bw: Any = None,
         model: str = "",
+        pipeline_depth: int = PIPELINE_DEPTH,
     ):
         from gofr_tpu.models.transformer import decode_chunk_pool
 
+        if pipeline_depth < 1:
+            raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        self.pipeline_depth = pipeline_depth
         self.cfg = cfg
         self.params = params
         self.n_slots = n_slots
@@ -295,7 +307,7 @@ class DecodePool:
                     return
                 # dispatch until the pipeline is full: chunk N+1's inputs
                 # are chunk N's output futures, so this never blocks
-                while self._active and len(in_flight) < PIPELINE_DEPTH:
+                while self._active and len(in_flight) < self.pipeline_depth:
                     records = [
                         (slot.index, slot.request) for slot in self._active.values()
                     ]
@@ -331,7 +343,7 @@ class DecodePool:
             span = fetch_done - dispatch_start
             dispatch_elapsed = max(
                 fetch_done - max(dispatch_start, last_fetch_done),
-                span / PIPELINE_DEPTH,
+                span / self.pipeline_depth,
             )
             last_fetch_done = fetch_done
             with self._work:

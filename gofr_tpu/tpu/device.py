@@ -229,6 +229,15 @@ class TPUDevice:
             raise ValueError("PREFIX_CACHE must be >= 0")
         self._pool_enabled = config.get_or_default("DECODE_POOL", "on") != "off"
         self._pool_slots = int(config.get_or_default("DECODE_SLOTS", str(self.max_batch)))
+        from gofr_tpu.tpu.decode_pool import PIPELINE_DEPTH
+
+        # chunks kept in flight by the pool worker — the knob that hides
+        # the host<->device round trip (see decode_pool.PIPELINE_DEPTH)
+        self._pool_depth = int(
+            config.get_or_default("DECODE_PIPELINE", str(PIPELINE_DEPTH))
+        )
+        if self._pool_depth < 1:
+            raise ValueError("DECODE_PIPELINE must be >= 1")
         self._last_reinit = 0.0
         self._reinit_lock = threading.Lock()
         # boot status: surfaced by /.well-known/ready and health details so
@@ -376,6 +385,7 @@ class TPUDevice:
                 peak_flops=self.peak_flops,
                 peak_hbm_bw=self.peak_hbm_bw,
                 model=self.model_name,
+                pipeline_depth=self._pool_depth,
             )
         self.batcher = DynamicBatcher(
             self._run_batch,
@@ -470,9 +480,9 @@ class TPUDevice:
             self._requests.inc(model=self.model_name, op="generate", status="ok")
             stats = getattr(self.runner, "spec_stats", None)
             if stats and stats["drafted"]:
-                self._spec_gauge.set(
-                    stats["accepted"] / stats["drafted"], model=self.model_name
-                )
+                with self.runner._spec_lock:
+                    ratio = stats["accepted"] / stats["drafted"]
+                self._spec_gauge.set(ratio, model=self.model_name)
             pstats = getattr(self.runner, "prefix_stats", None)
             if pstats and (pstats["hits"] + pstats["misses"]):
                 self._prefix_gauge.set(
@@ -1063,6 +1073,10 @@ class _TransformerRunner:
             else None
         )
         self.spec_stats = {"cycles": 0, "drafted": 0, "accepted": 0}
+        # guards spec_stats like _prefix_lock guards prefix_stats:
+        # concurrent speculative requests increment from their own handler
+        # threads, and unlocked += would lose updates (metrics-only skew)
+        self._spec_lock = threading.Lock()
         # prefix cache: prompt bytes -> (cache_row, length, next_token).
         # Rows are shared read-only: neither the solo decode chunk nor the
         # pool's write_slot donates/mutates its row input, so one stored
@@ -1529,13 +1543,18 @@ class _TransformerRunner:
             n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
             packed = np.asarray(jnp.concatenate([next_ids, n_acc[:, None]], axis=1))
             a = packed[0, : k + 1]
+            # the UNCLAMPED on-device match count feeds the acceptance
+            # gauge (the budget clamp below would bias it low on short
+            # generations — it reflects emission room, not draft quality)
+            n_match = int(packed[0, k + 1])
             # cap at k-1: the draft chunk wrote k positions, so the draft
             # cache can hold at most k committed tokens (t + k-1 drafts)
-            n_use = min(int(packed[0, k + 1]), k - 1, max_new_tokens - len(out) - 1)
+            n_use = min(n_match, k - 1, max_new_tokens - len(out) - 1)
             n_use = max(n_use, 0)
-            stats["cycles"] += 1
-            stats["drafted"] += k
-            stats["accepted"] += n_use
+            with self._spec_lock:
+                stats["cycles"] += 1
+                stats["drafted"] += k
+                stats["accepted"] += n_match
             # emitted tokens a[0..n_use]: n_use accepted drafts + the bonus
             keep_going = emit([int(t) for t in a[: n_use + 1]])
             cache_len += 1 + n_use  # t plus the accepted drafts are committed

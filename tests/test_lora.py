@@ -163,3 +163,30 @@ def test_lora_shards_over_tp(params, tokens):
     a = _fwd(wrapped, tokens)
     b = _fwd(placed, tokens)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_add_lora_leaves_moe_expert_stacks_dense():
+    """MoE expert stacks (batched-einsum weights beside a router) must not
+    be wrapped — the einsum cannot trace a LoRA dict. Same skip rule as
+    quantize_params (quant.moe_skip_keys)."""
+    from gofr_tpu.models.lora import is_lora
+    from gofr_tpu.models.moe import MoEConfig, init_moe, moe_forward
+
+    cfg = MoEConfig(
+        vocab_size=89, dim=16, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=32, max_seq=64, n_experts=4, top_k=2,
+        capacity_factor=2.0, dtype=jnp.float32, attn_impl="xla",
+    )
+    params = init_moe(jax.random.key(0), cfg)
+    wrapped = add_lora(params, jax.random.key(1), rank=2)
+    layers = wrapped["layers"]
+    for key in ("w_gate", "w_up", "w_down"):
+        assert not is_lora(layers[key]), f"{key} must stay a dense stack"
+    assert is_lora(layers["wq"]), "attention weights beside the router wrap"
+    tokens = jax.random.randint(jax.random.key(2), (2, 8), 0, cfg.vocab_size)
+    base_logits, _ = moe_forward(params, tokens, cfg)
+    lora_logits, _ = moe_forward(wrapped, tokens, cfg)
+    # fresh adapters are identity: the wrapped MoE must trace AND match
+    np.testing.assert_allclose(
+        np.asarray(base_logits), np.asarray(lora_logits), rtol=1e-5, atol=1e-5
+    )

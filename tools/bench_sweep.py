@@ -19,19 +19,28 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# name -> env overrides on top of bench.py's flagship defaults
+# name -> env overrides on top of bench.py's flagship defaults (which are
+# DECODE_SLOTS=8, DECODE_CHUNK=8, DECODE_PIPELINE=3 — the round-3 sweep
+# ranked 8 slots above 16/32 on the tunneled link). The depth/chunk grid
+# answers the round-3 finding that fetch-wait ate ~133ms of a ~137ms chunk
+# at depth 2: deeper pipeline and/or longer chunks both amortise the link
+# round trip, with different latency costs (chunk length delays delivery,
+# depth only wastes lockstep steps on freed slots).
 SWEEP: dict[str, dict[str, str]] = {
-    "base8": {"DECODE_SLOTS": "8", "BENCH_DECODE_STREAMS": "8"},
+    "base8": {"DECODE_SLOTS": "8"},
+    "depth2": {"DECODE_SLOTS": "8", "DECODE_PIPELINE": "2"},
+    "depth4": {"DECODE_SLOTS": "8", "DECODE_PIPELINE": "4"},
+    "chunk16": {"DECODE_SLOTS": "8", "DECODE_CHUNK": "16"},
+    "chunk32": {"DECODE_SLOTS": "8", "DECODE_CHUNK": "32"},
+    "chunk16-depth4": {
+        "DECODE_SLOTS": "8", "DECODE_CHUNK": "16", "DECODE_PIPELINE": "4",
+    },
     "slots16": {"DECODE_SLOTS": "16"},
+    "slots16-chunk16": {"DECODE_SLOTS": "16", "DECODE_CHUNK": "16"},
     "slots32": {"DECODE_SLOTS": "32"},
     "slots32-f8kv": {"DECODE_SLOTS": "32", "MODEL_KV_DTYPE": "f8"},
-    "slots64-f8kv": {"DECODE_SLOTS": "64", "MODEL_KV_DTYPE": "f8"},
-    "int4": {"MODEL_QUANT": "int4", "DECODE_SLOTS": "32"},
-    "int4-f8kv": {
-        "MODEL_QUANT": "int4", "DECODE_SLOTS": "64", "MODEL_KV_DTYPE": "f8",
-    },
-    "attn-pallas": {"MODEL_ATTN_IMPL": "pallas", "DECODE_SLOTS": "32"},
-    "chunk16": {"DECODE_CHUNK": "16", "DECODE_SLOTS": "32"},
+    "int4": {"MODEL_QUANT": "int4"},
+    "attn-pallas": {"MODEL_ATTN_IMPL": "pallas"},
 }
 
 
