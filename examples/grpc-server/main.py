@@ -1,17 +1,31 @@
 """Reference gRPC serving binary.
 
 Parity: /root/reference/examples/grpc-server/main.go:8-14 + grpc/server.go —
-a Hello service behind the framework's gRPC server. Uses the JSON service
-mode (no protoc codegen needed); generated-stub services register the same
-way via ``app.register_service``.
+a Hello service behind the framework's gRPC server, registered BOTH ways:
+the protoc generated-stub path (``app.register_service`` with the
+checked-in pb/hello_pb2* stubs, mirroring the reference's committed
+.pb.go), and the reflection-free JSON service mode (TPU-native addition,
+no codegen needed).
 """
 
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "pb"))
+
+import hello_pb2
+import hello_pb2_grpc
 
 import gofr_tpu
+
+
+class HelloServicer(hello_pb2_grpc.HelloServicer):
+    """Parity: /root/reference/examples/grpc-server/grpc/server.go:8-22."""
+
+    def SayHello(self, request, context):
+        name = request.name or "World"
+        return hello_pb2.HelloResponse(message=f"Hello {name}!")
 
 
 def say_hello(ctx):
@@ -108,6 +122,10 @@ def generate_stream(ctx):
 
 def main():
     app = gofr_tpu.new(configs_dir=os.path.join(os.path.dirname(__file__), "configs"))
+    # generated-stub registration (parity: examples/grpc-server/main.go:11)
+    app.register_service(
+        hello_pb2_grpc.add_HelloServicer_to_server, HelloServicer()
+    )
     app.register_json_service(
         "HelloService",
         {"SayHello": say_hello},

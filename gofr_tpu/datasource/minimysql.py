@@ -2,16 +2,23 @@
 
 Parity rationale: the reference unit-tests its MySQL layer against
 go-sqlmock (SURVEY.md §4) without a real server. This fake goes one step
-further: it speaks the REAL wire protocol (handshake v10,
-mysql_native_password verification, COM_QUERY text resultsets, COM_PING)
-over a localhost socket, executing statements against an in-memory sqlite —
-so datasource/mysql.py's client is tested through its actual socket path,
+further: it speaks the REAL wire protocol (handshake v10, auth plugin
+verification, COM_QUERY text resultsets, COM_PING) over a localhost
+socket, executing statements against an in-memory sqlite — so
+datasource/mysql.py's client is tested through its actual socket path,
 framing, auth and resultset decoding included.
+
+Auth mirrors a default-configured MySQL 8 (the reference CI image,
+mysql:8.2.0): ``caching_sha2_password`` advertised by default, with the
+fast-auth scramble verified; ``full_auth=True`` demands the non-TLS RSA
+public-key exchange instead (what a real server does on a cache miss);
+``auth_plugin="mysql_native_password"`` reproduces legacy servers; and
+``switch_to=`` sends an AuthSwitchRequest so the client's plugin-name
+check is exercised.
 """
 
 from __future__ import annotations
 
-import hashlib
 import os
 import socket
 import sqlite3
@@ -25,6 +32,9 @@ from gofr_tpu.datasource.mysql import (
     COM_QUIT,
     encode_lenenc_int,
     encode_lenenc_str,
+    native_password_token,
+    sha2_password_token,
+    xor_rotating,
 )
 
 _TYPE_LONGLONG, _TYPE_DOUBLE, _TYPE_VARSTR, _TYPE_BLOB = 0x08, 0x05, 0xFD, 0xFC
@@ -75,8 +85,14 @@ class MiniMySQL:
     wire-protocol MySQL on ``srv.port`` backed by a shared in-memory
     sqlite."""
 
-    def __init__(self, user: str = "root", password: str = "", port: int = 0):
+    def __init__(self, user: str = "root", password: str = "", port: int = 0,
+                 auth_plugin: str = "caching_sha2_password",
+                 full_auth: bool = False, switch_to: str = ""):
         self.user, self.password = user, password
+        self.auth_plugin = auth_plugin
+        self.full_auth = full_auth
+        self.switch_to = switch_to
+        self._rsa_key = None  # generated on first full-auth exchange
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", port))
@@ -175,17 +191,34 @@ class MiniMySQL:
                 + bytes([21])  # auth data len (8 + 12 + NUL)
                 + b"\x00" * 10
                 + scramble[8:] + b"\x00"
-                + b"mysql_native_password\x00"
+                + self.auth_plugin.encode() + b"\x00"
             )
-            seq = self._send(conn, 0, greeting)
+            self._send(conn, 0, greeting)
             pkt = self._read_packet(conn)
             if pkt is None:
                 return
             seq, payload = pkt[0] + 1, pkt[1]
-            if not self._check_auth(payload, scramble):
+            user, token = self._parse_handshake_response(payload)
+            plugin = self.auth_plugin
+            if self.switch_to:
+                # real servers switch when the account's plugin differs
+                # from the advertised default — exercises the client's
+                # check of the plugin NAME in AuthSwitchRequest
+                plugin = self.switch_to
+                scramble = os.urandom(20)
+                seq = self._send(
+                    conn, seq,
+                    b"\xfe" + plugin.encode() + b"\x00" + scramble + b"\x00",
+                )
+                pkt = self._read_packet(conn)
+                if pkt is None:
+                    return
+                seq, token = pkt[0] + 1, pkt[1]
+            ok, seq = self._verify_auth(conn, seq, user, token, plugin, scramble)
+            if not ok:
                 self._send(conn, seq, self._err(1045, f"Access denied for user '{self.user}'"))
                 return
-            seq = self._send(conn, seq, self._ok())
+            self._send(conn, seq, self._ok())
             self._command_loop(conn, db)
         except OSError:
             pass
@@ -196,7 +229,8 @@ class MiniMySQL:
             except OSError:
                 pass
 
-    def _check_auth(self, payload: bytes, scramble: bytes) -> bool:
+    @staticmethod
+    def _parse_handshake_response(payload: bytes) -> tuple[str, bytes]:
         # HandshakeResponse41: caps(4) maxpacket(4) charset(1) filler(23)
         pos = 4 + 4 + 1 + 23
         end = payload.index(b"\x00", pos)
@@ -204,16 +238,61 @@ class MiniMySQL:
         pos = end + 1
         token_len = payload[pos]
         token = payload[pos + 1 : pos + 1 + token_len]
+        return user, token
+
+    def _verify_auth(
+        self, conn: socket.socket, seq: int, user: str, token: bytes,
+        plugin: str, scramble: bytes,
+    ) -> tuple[bool, int]:
+        """Verify ``token`` under ``plugin``; drives the caching_sha2
+        AuthMoreData sub-protocol (0x03 fast-auth hit, or the full RSA
+        exchange when ``full_auth``). Returns (ok, next_seq)."""
         if user != self.user:
-            return False
+            return False, seq
         if not self.password:
-            return token == b""
-        h1 = hashlib.sha1(self.password.encode()).digest()
-        h2 = hashlib.sha1(h1).digest()
-        expected = bytes(
-            a ^ b for a, b in zip(h1, hashlib.sha1(scramble + h2).digest())
+            return token == b"", seq
+        if plugin == "mysql_native_password":
+            return token == native_password_token(self.password, scramble), seq
+        if plugin != "caching_sha2_password":
+            return False, seq
+        if not self.full_auth:
+            if token != sha2_password_token(self.password, scramble):
+                return False, seq
+            # cache hit: fast_auth_success, then the caller's OK
+            return True, self._send(conn, seq, b"\x01\x03")
+        # cache miss: demand the non-TLS RSA public-key exchange (ignores
+        # the scramble token, exactly like a real server on a cold cache)
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+        if self._rsa_key is None:
+            self._rsa_key = rsa.generate_private_key(
+                public_exponent=65537, key_size=2048
+            )
+        seq = self._send(conn, seq, b"\x01\x04")  # perform_full_authentication
+        pkt = self._read_packet(conn)
+        if pkt is None or pkt[1] != b"\x02":  # client asks for the RSA key
+            return False, seq if pkt is None else pkt[0] + 1
+        pem = self._rsa_key.public_key().public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
         )
-        return token == expected
+        seq = self._send(conn, pkt[0] + 1, b"\x01" + pem)
+        pkt = self._read_packet(conn)
+        if pkt is None:
+            return False, seq
+        seq = pkt[0] + 1
+        try:
+            plain = self._rsa_key.decrypt(
+                pkt[1],
+                padding.OAEP(
+                    mgf=padding.MGF1(hashes.SHA1()),
+                    algorithm=hashes.SHA1(), label=None,
+                ),
+            )
+        except Exception:
+            return False, seq
+        return xor_rotating(plain, scramble) == self.password.encode() + b"\x00", seq
 
     # -- commands ------------------------------------------------------------
     def _command_loop(self, conn: socket.socket, db: sqlite3.Connection) -> None:

@@ -3,7 +3,9 @@
 Parity: /root/reference/pkg/gofr/datasource/sql/sql.go:19-37 — the reference
 is a MySQL framework (``NewMYSQL`` builds the DSN and pings). This
 environment ships no MySQL driver, so the client speaks the documented
-protocol directly: handshake v10, ``mysql_native_password`` auth,
+protocol directly: handshake v10, ``caching_sha2_password`` (the MySQL 8
+default, incl. the non-TLS RSA full-auth exchange) and
+``mysql_native_password`` auth with AuthSwitch between them,
 ``COM_QUERY`` with text resultsets, ``COM_PING`` health. The surface
 mirrors datasource/sql.py's DB (logged query/execute/tx/select) so
 ``DB_DIALECT=mysql`` swaps in transparently behind the container.
@@ -12,10 +14,12 @@ Scope: classic EOF framing (CLIENT_DEPRECATE_EOF not negotiated), text
 protocol only — parameters interpolate client-side with proper escaping
 (the same approach as go-sql-driver's interpolateParams fast path). One
 socket guarded by a mutex; MySQL connections are sequential by protocol.
+No TLS: full auth on caching_sha2 always takes the RSA public-key path
+(what go-sql-driver does with allowCleartextPasswords off on plain TCP).
 
 Tested against datasource/minimysql.py, an in-process fake speaking the
 same wire format (the reference tests MySQL with sqlmock the same way,
-SURVEY.md §4).
+SURVEY.md §4) — including a fake demanding caching_sha2 full auth.
 """
 
 from __future__ import annotations
@@ -63,6 +67,36 @@ def native_password_token(password: str, scramble: bytes) -> bytes:
     h2 = hashlib.sha1(h1).digest()
     h3 = hashlib.sha1(scramble + h2).digest()
     return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+def sha2_password_token(password: str, scramble: bytes) -> bytes:
+    """caching_sha2_password fast-auth scramble:
+    SHA256(pass) XOR SHA256(SHA256(SHA256(pass)) + scramble)."""
+    if not password:
+        return b""
+    h1 = hashlib.sha256(password.encode()).digest()
+    h2 = hashlib.sha256(hashlib.sha256(h1).digest() + scramble).digest()
+    return bytes(a ^ b for a, b in zip(h1, h2))
+
+
+def xor_rotating(data: bytes, key: bytes) -> bytes:
+    """XOR ``data`` with ``key`` repeated — the pre-RSA whitening MySQL
+    applies to the password in the caching_sha2 full-auth exchange."""
+    return bytes(b ^ key[i % len(key)] for i, b in enumerate(data))
+
+
+def rsa_encrypt_password(password: str, scramble: bytes, pem: bytes) -> bytes:
+    """Non-TLS full auth: RSA-OAEP(SHA1)-encrypt the nonce-whitened
+    NUL-terminated password with the server's public key."""
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding as _pad
+
+    key = serialization.load_pem_public_key(pem)
+    plain = xor_rotating(password.encode() + b"\x00", scramble)
+    return key.encrypt(
+        plain,
+        _pad.OAEP(mgf=_pad.MGF1(hashes.SHA1()), algorithm=hashes.SHA1(), label=None),
+    )
 
 
 def _lenenc_int(data: bytes, pos: int) -> tuple[int, int]:
@@ -216,6 +250,17 @@ class _Conn:
         self.sock.sendall(header + payload)
 
     # -- handshake -----------------------------------------------------------
+    @staticmethod
+    def _auth_token(plugin: str, password: str, scramble: bytes) -> bytes:
+        """Scramble token for the plugin the SERVER named — never assume
+        (a default-configured MySQL 8 advertises caching_sha2_password;
+        older servers and explicit accounts use mysql_native_password)."""
+        if plugin == "mysql_native_password":
+            return native_password_token(password, scramble)
+        if plugin == "caching_sha2_password":
+            return sha2_password_token(password, scramble)
+        raise MySQLError(2059, f"authentication plugin '{plugin}' not supported")
+
     def _handshake(self, user: str, password: str, database: str) -> None:
         greeting = self.read_packet()
         if greeting and greeting[0] == 0xFF:
@@ -233,8 +278,17 @@ class _Conn:
         auth_len = greeting[pos] if pos < len(greeting) else 0
         pos += 1 + 10  # + reserved
         if auth_len > 8 and pos < len(greeting):
-            extra = greeting[pos : pos + max(12, auth_len - 9)]
-            scramble += extra[:12]
+            # part 2 occupies max(13, auth_len-8) bytes, of which the first
+            # 12 extend the nonce (the 13th is a NUL)
+            part2_len = max(13, auth_len - 8)
+            scramble += greeting[pos : pos + 12]
+            pos += part2_len
+        plugin = "mysql_native_password"
+        if pos < len(greeting):
+            nul = greeting.find(b"\x00", pos)
+            name = greeting[pos : nul if nul >= 0 else len(greeting)]
+            if name:
+                plugin = name.decode("utf-8", "replace")
 
         caps = (
             CLIENT_LONG_PASSWORD | CLIENT_PROTOCOL_41 | CLIENT_TRANSACTIONS
@@ -242,28 +296,58 @@ class _Conn:
         )
         if database:
             caps |= CLIENT_CONNECT_WITH_DB
-        token = native_password_token(password, scramble)
+        token = self._auth_token(plugin, password, scramble)
         payload = (
             struct.pack("<IIB23x", caps, 1 << 24, 45)  # caps, max packet, utf8mb4
             + user.encode() + b"\x00"
             + bytes([len(token)]) + token
             + ((database.encode() + b"\x00") if database else b"")
-            + b"mysql_native_password\x00"
+            + plugin.encode() + b"\x00"
         )
         self.write_packet(payload)
-        reply = self.read_packet()
-        if reply and reply[0] == 0xFE:  # AuthSwitchRequest -> resend token
-            end = reply.index(b"\x00", 1)
-            # exactly ONE trailing NUL terminates the scramble — rstrip
-            # would also eat random scramble bytes that happen to be 0x00
-            new_scramble = reply[end + 1 :]
-            if new_scramble.endswith(b"\x00"):
-                new_scramble = new_scramble[:-1]
-            self.write_packet(native_password_token(password, new_scramble))
+        self._auth_loop(password, scramble, plugin)
+
+    def _auth_loop(self, password: str, scramble: bytes, plugin: str) -> None:
+        """Drive auth to OK: AuthSwitchRequest (re-scramble under the
+        plugin the server NAMES), caching_sha2 AuthMoreData (0x03 fast-auth
+        hit; 0x04 full auth via the RSA public-key exchange)."""
+        while True:
             reply = self.read_packet()
-        if reply and reply[0] == 0xFF:
-            raise self._err(reply)
-        if not reply or reply[0] != 0x00:
+            if not reply:
+                raise MySQLError(2013, "connection closed during auth")
+            if reply[0] == 0x00:
+                return
+            if reply[0] == 0xFF:
+                raise self._err(reply)
+            if reply[0] == 0xFE:  # AuthSwitchRequest
+                end = reply.index(b"\x00", 1)
+                plugin = reply[1:end].decode("utf-8", "replace")
+                scramble = reply[end + 1 :]
+                # exactly ONE trailing NUL terminates the scramble — rstrip
+                # would also eat random scramble bytes that happen to be 0x00
+                if scramble.endswith(b"\x00"):
+                    scramble = scramble[:-1]
+                self.write_packet(self._auth_token(plugin, password, scramble))
+                continue
+            if reply[0] == 0x01 and plugin == "caching_sha2_password":
+                status = reply[1:2]
+                if status == b"\x03":  # fast_auth_success; OK follows
+                    continue
+                if status == b"\x04":  # perform_full_authentication
+                    # no TLS on this socket: ask for the server RSA key and
+                    # send the nonce-whitened password encrypted under it
+                    self.write_packet(b"\x02")
+                    key_pkt = self.read_packet()
+                    if not key_pkt or key_pkt[0] != 0x01:
+                        raise MySQLError(
+                            2012,
+                            f"expected RSA key, got 0x{key_pkt[:1].hex()}",
+                        )
+                    self.write_packet(
+                        rsa_encrypt_password(password, scramble, key_pkt[1:])
+                    )
+                    continue
+                raise MySQLError(2012, f"unexpected auth state 0x{status.hex()}")
             raise MySQLError(2012, f"unexpected auth reply 0x{reply[:1].hex()}")
 
     @staticmethod

@@ -9,6 +9,10 @@ import pytest
 from gofr_tpu.ops.attention import _xla_attention, attention
 from gofr_tpu.ops.flash import flash_attention
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 
 def _rand(key, shape):
     return jax.random.normal(jax.random.key(key), shape, jnp.float32)

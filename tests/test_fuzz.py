@@ -18,6 +18,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from gofr_tpu.testutil import serving_device
+import pytest
+
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
 
 
 def test_fuzz_pooled_equals_solo():

@@ -190,3 +190,84 @@ def test_duplicate_unary_and_stream_method_rejected(free_port):
             json_services={"S": {"Gen": lambda ctx: 1}},
             json_stream_services={"S": {"Gen": lambda ctx: iter(())}},
         )
+
+
+# -- generated-stub path (parity: examples/grpc-server committed .pb.go) -----
+
+def _load_hello_stubs():
+    """Import the example's vendored protoc-generated modules (checked-in
+    codegen, like the reference's hello{,_grpc}.pb.go)."""
+    import os
+    import sys
+
+    pb_dir = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "grpc-server", "pb"
+    )
+    sys.path.insert(0, pb_dir)
+    try:
+        import hello_pb2
+        import hello_pb2_grpc
+    finally:
+        sys.path.remove(pb_dir)
+    return hello_pb2, hello_pb2_grpc
+
+
+def test_generated_stub_service(free_port):
+    """app.register_service wiring: a protoc-generated servicer served and
+    called through the generated client stub — real proto bytes on the
+    wire, not JSON."""
+    hello_pb2, hello_pb2_grpc = _load_hello_stubs()
+
+    class Servicer(hello_pb2_grpc.HelloServicer):
+        def SayHello(self, request, context):
+            return hello_pb2.HelloResponse(
+                message=f"Hello {request.name or 'World'}!"
+            )
+
+    port = free_port()
+    container = Container(EnvConfig(), wire=False)
+    container.logger = MockLogger()
+    srv = GRPCServer(
+        port, container,
+        registrations=[(hello_pb2_grpc.add_HelloServicer_to_server, Servicer())],
+    )
+    srv.start()
+    try:
+        with grpc.insecure_channel(f"localhost:{port}") as channel:
+            stub = hello_pb2_grpc.HelloStub(channel)
+            reply = stub.SayHello(
+                hello_pb2.HelloRequest(name="ada"), timeout=5
+            )
+            assert reply.message == "Hello ada!"
+            reply = stub.SayHello(hello_pb2.HelloRequest(), timeout=5)
+            assert reply.message == "Hello World!"
+    finally:
+        srv.stop()
+
+
+def test_generated_stub_rpc_is_logged(free_port):
+    """The interceptor chain (recovery -> RPCLog) wraps generated-stub
+    services exactly as JSON ones (parity: grpc/log.go:27-50)."""
+    hello_pb2, hello_pb2_grpc = _load_hello_stubs()
+
+    class Servicer(hello_pb2_grpc.HelloServicer):
+        def SayHello(self, request, context):
+            return hello_pb2.HelloResponse(message="hi")
+
+    port = free_port()
+    container = Container(EnvConfig(), wire=False)
+    container.logger = MockLogger()
+    srv = GRPCServer(
+        port, container,
+        registrations=[(hello_pb2_grpc.add_HelloServicer_to_server, Servicer())],
+    )
+    srv.start()
+    try:
+        with grpc.insecure_channel(f"localhost:{port}") as channel:
+            hello_pb2_grpc.HelloStub(channel).SayHello(
+                hello_pb2.HelloRequest(name="x"), timeout=5
+            )
+    finally:
+        srv.stop()
+    assert container.logger.contains("/hello.Hello/SayHello")
+    assert container.logger.contains('"status": "OK"')

@@ -23,6 +23,10 @@ from gofr_tpu.models.ingest import (
 from gofr_tpu.models.llama import TINY
 from gofr_tpu.models.transformer import init_transformer, transformer_forward
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 TOKENS = jnp.asarray([[5, 3, 8, 1, 9, 2]], jnp.int32)
 
 

@@ -17,6 +17,10 @@ from gofr_tpu.models.lora import (
 from gofr_tpu.models.quant import quantize_params
 from gofr_tpu.models.transformer import init_transformer, transformer_forward
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 CFG = TINY
 
 

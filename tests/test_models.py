@@ -20,12 +20,17 @@ from gofr_tpu.models import (
     prefill,
     transformer_forward,
 )
+
 from gofr_tpu.models.llama import CONFIGS, TINY
 from gofr_tpu.models.quant import (
     dequantize_params,
     quantization_error,
     quantize_params,
 )
+
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
 
 CFG = TINY
 

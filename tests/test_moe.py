@@ -15,6 +15,10 @@ from gofr_tpu.parallel.expert import (
 )
 from gofr_tpu.parallel.mesh import make_mesh, mesh_shape_for
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 # capacity_factor = n_experts/top_k => capacity = T (no token can ever drop)
 CFG = MoEConfig(
     vocab_size=89, dim=16, n_layers=2, n_heads=4, n_kv_heads=2,

@@ -24,6 +24,10 @@ from gofr_tpu.models.transformer import init_transformer
 from gofr_tpu.testutil import serving_device
 from gofr_tpu.training.checkpoint import save_params
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def adapter_paths(tmp_path_factory):

@@ -223,3 +223,68 @@ def test_container_degrades_on_bad_mysql(monkeypatch):
     c = Container(EnvConfig())
     assert c.db is None  # logged, not fatal (container.go:80-85 parity)
     c.close()
+
+
+# -- caching_sha2_password (MySQL 8 default; VERDICT r03 item 4) -------------
+
+def test_sha2_fast_auth_is_the_default():
+    """The fixture server advertises caching_sha2_password (stock MySQL 8),
+    so the happy path above already runs the sha2 scramble; this pins it."""
+    with MiniMySQL(user="u", password="pw") as srv:
+        assert srv.auth_plugin == "caching_sha2_password"
+        db = MySQLDB("127.0.0.1", srv.port, "u", "pw", "")
+        assert db.health_check().status == "UP"
+        db.close()
+
+
+def test_sha2_full_auth_rsa_exchange():
+    """Cache-miss path: server demands perform_full_authentication; the
+    client fetches the RSA key and sends the nonce-whitened password
+    encrypted — over plain TCP, as go-sql-driver does without TLS."""
+    with MiniMySQL(user="u", password="hunter2", full_auth=True) as srv:
+        db = MySQLDB("127.0.0.1", srv.port, "u", "hunter2", "")
+        assert db.select_value("select 41 + 1") == 42
+        db.close()
+
+
+def test_sha2_full_auth_wrong_password_denied():
+    with MiniMySQL(user="u", password="right", full_auth=True) as srv:
+        with pytest.raises(MySQLError) as exc:
+            MySQLDB("127.0.0.1", srv.port, "u", "wrong", "")
+        assert exc.value.code == 1045
+
+
+def test_auth_switch_to_native_password():
+    """Server advertises caching_sha2 but switches the account to
+    mysql_native_password — the client must check the plugin NAME in the
+    AuthSwitchRequest, not resend the old plugin's token."""
+    with MiniMySQL(user="u", password="pw",
+                   switch_to="mysql_native_password") as srv:
+        db = MySQLDB("127.0.0.1", srv.port, "u", "pw", "")
+        assert db.select_value("select 7") == 7
+        db.close()
+
+
+def test_auth_switch_to_sha2():
+    with MiniMySQL(user="u", password="pw",
+                   auth_plugin="mysql_native_password",
+                   switch_to="caching_sha2_password") as srv:
+        db = MySQLDB("127.0.0.1", srv.port, "u", "pw", "")
+        assert db.select_value("select 7") == 7
+        db.close()
+
+
+def test_unknown_plugin_rejected_with_clear_error():
+    with MiniMySQL(user="u", password="pw",
+                   auth_plugin="sha256_password") as srv:
+        with pytest.raises(MySQLError) as exc:
+            MySQLDB("127.0.0.1", srv.port, "u", "pw", "")
+        assert exc.value.code == 2059
+        assert "sha256_password" in str(exc.value)
+
+
+def test_sha2_empty_password():
+    with MiniMySQL(user="u", password="") as srv:
+        db = MySQLDB("127.0.0.1", srv.port, "u", "", "")
+        assert db.select_value("select 1") == 1
+        db.close()

@@ -9,6 +9,10 @@ from gofr_tpu.ops.attention import attention
 from gofr_tpu.ops.norms import layer_norm, rms_norm
 from gofr_tpu.ops.rope import apply_rope, rope_frequencies
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 
 def test_rms_norm_matches_manual():
     x = jax.random.normal(jax.random.key(0), (2, 5, 8))

@@ -20,6 +20,10 @@ from gofr_tpu.parallel.pipeline import (
 )
 from gofr_tpu.training.trainer import cross_entropy_loss
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 CFG = TransformerConfig(
     vocab_size=97, dim=16, n_layers=4, n_heads=4, n_kv_heads=2,
     hidden_dim=32, max_seq=64, dtype=jnp.float32, attn_impl="xla",

@@ -9,6 +9,10 @@ import pytest
 from gofr_tpu.ops.sampling import Sampler
 from gofr_tpu.testutil import serving_device
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def cached():

@@ -10,6 +10,10 @@ import pytest
 import gofr_tpu
 from gofr_tpu.profiling import Profiler
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 
 def test_profiler_lifecycle(tmp_path):
     import jax

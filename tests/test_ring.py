@@ -12,6 +12,10 @@ from gofr_tpu.parallel.mesh import make_mesh, mesh_shape_for
 from gofr_tpu.parallel.ring import make_ring_forward, make_ring_loss, ring_attention
 from gofr_tpu.training.trainer import cross_entropy_loss
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def sp_mesh():

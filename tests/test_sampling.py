@@ -7,6 +7,10 @@ import pytest
 
 from gofr_tpu.ops.sampling import Sampler, sample_logits
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 
 def _logits(vals):
     return jnp.asarray([vals], jnp.float32)

@@ -13,6 +13,10 @@ from gofr_tpu.metrics import Registry
 from gofr_tpu.testutil import MockLogger
 from gofr_tpu.tpu.device import new_device
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 
 def _device(**env):
     defaults = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2", "BATCH_TIMEOUT_MS": "1"}

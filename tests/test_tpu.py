@@ -16,6 +16,10 @@ from gofr_tpu.testutil import MockLogger
 from gofr_tpu.tpu.batcher import DynamicBatcher, next_pow2, pad_rows
 from gofr_tpu.tpu.device import new_device
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 
 # -- batcher -----------------------------------------------------------------
 

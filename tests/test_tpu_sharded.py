@@ -14,6 +14,10 @@ from gofr_tpu.metrics import Registry
 from gofr_tpu.testutil import MockLogger
 from gofr_tpu.tpu.device import _mesh_from_topology, new_device
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 PROMPT = {"tokens": [3, 1, 4, 1, 5, 9, 2, 6]}
 
 

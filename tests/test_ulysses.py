@@ -19,6 +19,10 @@ from gofr_tpu.parallel.ulysses import (
     ulysses_attention,
 )
 
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def sp_mesh():

@@ -1,0 +1,149 @@
+"""Prefill MFU profiler: where does the non-MXU time go?
+
+VERDICT r03 item 3: flagship int8 prefill measured MFU 0.194 at bucket 64 /
+batch 8 — one fifth of the v5e roofline — and no profile of the serving hot
+path had ever been taken. This tool answers the question two ways:
+
+1. **Shape grid**: times the runner's REAL prefill executable (the same
+   ``_prefill`` the serving path dispatches) across bucket x batch shapes,
+   reporting ms and MFU per shape. Prefill MFU rises with tokens-per-
+   dispatch until the MXU saturates; the grid shows where.
+2. **Ablations**: re-times the grid under variants that isolate a cost —
+   ``bf16`` (no int8 dequant on the weight path), ``pallas`` / ``xla``
+   attention — so the gap to roofline decomposes into named causes
+   instead of guesses.
+
+Optionally captures a jax.profiler trace (``--trace DIR``) of one hot
+dispatch for TensorBoard's trace viewer (gofr_tpu/profiling.py wraps the
+same API for live servers).
+
+    python tools/profile_prefill.py                      # flagship grid
+    python tools/profile_prefill.py --model small --platform cpu  # smoke
+    python tools/profile_prefill.py --ablate             # + bf16/attn runs
+
+Each config prints one JSON line; stderr carries a ranked summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _time_prefill(runner, bucket: int, batch: int, reps: int = 5) -> dict:
+    """Median wall seconds of the runner's real prefill dispatch at
+    [batch, bucket] (first call may compile: excluded via a warmup rep)."""
+    import jax
+    import jax.numpy as jnp
+
+    tokens = jnp.ones((batch, bucket), jnp.int32)
+    lengths = jnp.full((batch,), bucket, jnp.int32)
+    if getattr(runner, "_token_sharding", None) is not None:
+        tokens = jax.device_put(tokens, runner._token_sharding)
+        lengths = jax.device_put(lengths, runner._row_sharding)
+    cache = runner._zero_cache(batch)
+    runner._prefill(runner.params, tokens, cache, lengths)[1].block_until_ready()
+    times = []
+    for _ in range(reps):
+        start = time.perf_counter()
+        _, next_ids, _ = runner._prefill(runner.params, tokens, cache, lengths)
+        next_ids.block_until_ready()
+        times.append(time.perf_counter() - start)
+    times.sort()
+    return {"seconds": times[len(times) // 2], "best": times[0]}
+
+
+def run_grid(model: str, quant: str, buckets, batches, attn: str | None,
+             max_seq: int, trace_dir: str | None) -> list[dict]:
+    import jax
+
+    from gofr_tpu.tpu.device import _build_runner
+    from gofr_tpu.tpu.flops import device_peak_flops, mfu
+
+    dev = jax.devices()[0]
+    peak = device_peak_flops(getattr(dev, "device_kind", dev.platform), dev.platform)
+    label = f"{model}/{quant or 'bf16'}/{attn or 'auto'}"
+    print(f"=== building {label} (buckets={buckets})", file=sys.stderr, flush=True)
+    runner = _build_runner(
+        model, quant, None, max(batches),
+        buckets=tuple(sorted(set(buckets))), max_seq=max_seq, attn_impl=attn,
+    )
+    out = []
+    for bucket in buckets:
+        for batch in batches:
+            t = _time_prefill(runner, bucket, batch)
+            tokens = bucket * batch
+            rec = {
+                "config": label, "bucket": bucket, "batch": batch,
+                "ms": round(t["seconds"] * 1e3, 2),
+                "best_ms": round(t["best"] * 1e3, 2),
+                "tokens": tokens,
+                "mfu": round(mfu(runner.n_params, tokens, t["seconds"], peak), 4),
+                "tok_per_sec": round(tokens / t["seconds"], 1),
+            }
+            out.append(rec)
+            print(json.dumps(rec), flush=True)
+    if trace_dir:
+        bucket, batch = buckets[-1], batches[-1]
+        print(f"=== tracing one [{batch}, {bucket}] dispatch -> {trace_dir}",
+              file=sys.stderr)
+        jax.profiler.start_trace(trace_dir)
+        _time_prefill(runner, bucket, batch, reps=2)
+        jax.profiler.stop_trace()
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=os.environ.get("BENCH_MODEL", "llama3-8b"))
+    ap.add_argument("--quant", default="int8")
+    ap.add_argument("--buckets", default="64,128,256,512")
+    ap.add_argument("--batches", default="1,4,8,16")
+    ap.add_argument("--max-seq", type=int, default=512)
+    ap.add_argument("--ablate", action="store_true",
+                    help="also run bf16 and explicit xla/pallas attention grids")
+    ap.add_argument("--trace", default="", help="capture a profiler trace here")
+    ap.add_argument("--platform", default="", help="pin jax platform (cpu smoke)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gofr_jax_cache")
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/gofr_jax_cache")
+    except Exception:
+        pass
+
+    buckets = [int(b) for b in args.buckets.split(",")]
+    batches = [int(b) for b in args.batches.split(",")]
+    results = run_grid(args.model, args.quant, buckets, batches, None,
+                       args.max_seq, args.trace or None)
+    if args.ablate:
+        # dequant cost: same shapes, bf16 weights
+        results += run_grid(args.model, "", buckets[-1:], batches[-1:],
+                            None, args.max_seq, None)
+        # attention impl: pallas flash vs xla at the largest shape
+        for attn in ("xla", "pallas"):
+            results += run_grid(args.model, args.quant, buckets[-1:],
+                                batches[-1:], attn, args.max_seq, None)
+    ranked = sorted(results, key=lambda r: -r["mfu"])
+    print("\n=== MFU ranking", file=sys.stderr)
+    for r in ranked[:12]:
+        print(
+            f"  {r['config']:>24} b{r['bucket']:<4}x{r['batch']:<3}: "
+            f"mfu {r['mfu']:.3f}  {r['ms']:8.2f} ms  {r['tok_per_sec']:10.0f} tok/s",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
