@@ -99,6 +99,62 @@ def run_grid(model: str, quant: str, buckets, batches, attn: str | None,
     return out
 
 
+def summarize_trace(trace_dir: str, top: int = 15) -> list[dict]:
+    """Aggregate device-plane op time from a captured .xplane.pb — the
+    'where does the non-MXU time go' answer, printable without TensorBoard.
+    Uses the ambient tensorflow's xplane proto (parse-only; no TF runtime)."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: E501 — env-provided
+
+    paths = []
+    for root, _, names in os.walk(trace_dir):
+        paths.extend(os.path.join(root, n) for n in names if n.endswith(".xplane.pb"))
+    if not paths:
+        print(f"no .xplane.pb under {trace_dir}", file=sys.stderr)
+        return []
+    spaces = []
+    for path in paths:
+        space = xplane_pb2.XSpace()
+        with open(path, "rb") as fh:
+            space.ParseFromString(fh.read())
+        spaces.append(space)
+    # device planes carry the XLA op timeline; host planes carry
+    # python/runtime noise. On a CPU smoke there is no device plane —
+    # fall back to /host:CPU so the tool is testable without a chip.
+    def is_device(name: str) -> bool:
+        return "TPU" in name or "/device:" in name
+    have_device = any(is_device(p.name) for s in spaces for p in s.planes)
+    totals: dict[str, float] = {}
+    plane_names = []
+    for space in spaces:
+        for plane in space.planes:
+            if have_device and not is_device(plane.name):
+                continue
+            if not have_device and plane.name != "/host:CPU":
+                continue
+            plane_names.append(plane.name)
+            meta = plane.event_metadata
+            # TPU device planes nest timelines ('XLA Modules' events span
+            # their constituent 'XLA Ops' events) — summing every line
+            # would double-count, halving each op's reported share. Keep
+            # only the op-level line when one exists; host planes (the CPU
+            # smoke fallback) have parallel thread lines, not nested ones.
+            lines = [ln for ln in plane.lines if ln.name == "XLA Ops"] or plane.lines
+            for line in lines:
+                for ev in line.events:
+                    name = meta[ev.metadata_id].name if ev.metadata_id in meta else "?"
+                    totals[name] = totals.get(name, 0.0) + ev.duration_ps / 1e9
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1])
+    total_ms = sum(totals.values())
+    print(f"\n=== device op time ({', '.join(sorted(set(plane_names))) or 'no device plane'}; "
+          f"total {total_ms:.1f} ms)", file=sys.stderr)
+    out = []
+    for name, ms in ranked[:top]:
+        pct = 100.0 * ms / total_ms if total_ms else 0.0
+        print(f"  {pct:5.1f}%  {ms:9.2f} ms  {name[:90]}", file=sys.stderr)
+        out.append({"op": name, "ms": round(ms, 2), "pct": round(pct, 1)})
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=os.environ.get("BENCH_MODEL", "llama3-8b"))
@@ -110,7 +166,14 @@ def main() -> int:
                     help="also run bf16 and explicit xla/pallas attention grids")
     ap.add_argument("--trace", default="", help="capture a profiler trace here")
     ap.add_argument("--platform", default="", help="pin jax platform (cpu smoke)")
+    ap.add_argument("--summarize", default="",
+                    help="just summarize an existing trace dir and exit")
     args = ap.parse_args()
+
+    if args.summarize:
+        # exit 1 on an empty/missing trace so automation can't mistake a
+        # typo'd dir for a successful summary
+        return 0 if summarize_trace(args.summarize) else 1
 
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gofr_jax_cache")
     import jax
@@ -126,6 +189,8 @@ def main() -> int:
     batches = [int(b) for b in args.batches.split(",")]
     results = run_grid(args.model, args.quant, buckets, batches, None,
                        args.max_seq, args.trace or None)
+    if args.trace:
+        summarize_trace(args.trace)
     if args.ablate:
         # dequant cost: same shapes, bf16 weights
         results += run_grid(args.model, "", buckets[-1:], batches[-1:],

@@ -1,0 +1,103 @@
+"""Wait for the device tunnel to recover, then run the round's hardware
+agenda unattended: the decode sweep (VERDICT r04 item 2), the prefill
+profile grid + trace (item 3), and one flagship bench with the 64,512
+bucket ladder (item 7). Everything logs under /tmp/r04_hw/.
+
+    python tools/tunnel_watch.py        # blocks; safe to background
+
+The probe runs in a killable subprocess (a wedged tunnel hangs
+jax.devices() forever in-process). Each stage runs even if the previous
+failed — partial hardware data beats none — and a stage that itself hangs
+is killed at its timeout so the watcher always reaches the later stages.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = "/tmp/r04_hw"
+
+
+def log(msg: str) -> None:
+    print(f"[watch {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe(timeout: float = 60.0) -> bool:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_COMPILATION_CACHE_DIR": "/tmp/gofr_jax_cache"},
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_stage(name: str, cmd: list[str], timeout: float,
+              env: dict | None = None) -> None:
+    log(f"stage {name}: {' '.join(cmd)}")
+    with open(os.path.join(OUT, f"{name}.log"), "w") as fh:
+        try:
+            proc = subprocess.run(
+                cmd, stdout=fh, stderr=subprocess.STDOUT, timeout=timeout,
+                cwd=REPO, env=env,
+            )
+            log(f"stage {name}: rc={proc.returncode}")
+        except subprocess.TimeoutExpired:
+            log(f"stage {name}: TIMEOUT after {timeout:.0f}s")
+
+
+def main() -> int:
+    os.makedirs(OUT, exist_ok=True)
+    poll = float(os.environ.get("WATCH_POLL_SECONDS", "120"))
+    deadline = time.monotonic() + float(os.environ.get("WATCH_MAX_SECONDS", "28800"))
+    n = 0
+    while time.monotonic() < deadline:
+        n += 1
+        if probe():
+            log(f"tunnel ALIVE after {n} probes — starting hardware agenda")
+            break
+        log(f"probe {n}: tunnel wedged; sleeping {poll:.0f}s")
+        time.sleep(poll)
+    else:
+        log("gave up: tunnel never recovered inside the watch window")
+        with open(os.path.join(OUT, "verdict.json"), "w") as fh:
+            json.dump({"tunnel": "wedged-all-round", "probes": n}, fh)
+        return 1
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gofr_jax_cache")
+
+    # 1. decode sweep around the measured winner (bench JSON lines land in
+    #    the stage log; ranking at the end)
+    run_stage(
+        "sweep",
+        [sys.executable, "tools/bench_sweep.py",
+         "base8", "depth2", "depth4", "chunk16", "chunk32", "chunk16-depth4",
+         "slots16-chunk16"],
+        timeout=3.5 * 3600,
+    )
+    # 2. prefill MFU grid + ablations + device trace
+    run_stage(
+        "profile",
+        [sys.executable, "tools/profile_prefill.py", "--ablate",
+         "--trace", os.path.join(OUT, "prefill_trace")],
+        timeout=1.5 * 3600,
+    )
+    # 3. flagship bench with the bucket ladder (per-bucket compile seconds
+    #    land in boot_stages)
+    run_stage(
+        "ladder", [sys.executable, "bench.py"], timeout=1800,
+        env={**os.environ, "MODEL_BUCKETS": "64,512", "BENCH_PROMPT_LEN": "48"},
+    )
+    log("hardware agenda complete — results under " + OUT)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
