@@ -325,6 +325,16 @@ class DecodePool:
                         self._temps_dev, self._top_ks_dev, self._top_ps_dev,
                         self._min_ps_dev,
                     )
+                    # start the D2H copy NOW: the transfer begins the moment
+                    # the chunk's compute finishes, so the blocking fetch
+                    # below waits on an already-in-flight copy and the
+                    # per-chunk link round trips OVERLAP across the pipeline
+                    # instead of serializing (on a tunneled link the
+                    # serialized fetch — not compute — was the cap).
+                    try:
+                        toks_dev.copy_to_host_async()
+                    except (AttributeError, RuntimeError):
+                        pass  # older jax / fully-addressable-only arrays
                     in_flight.append((records, toks_dev, dispatch_start))
             # fetch the OLDEST chunk outside the lock: the device is
             # meanwhile executing the younger in-flight chunk(s), and new

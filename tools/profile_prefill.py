@@ -190,7 +190,13 @@ def main() -> int:
     results = run_grid(args.model, args.quant, buckets, batches, None,
                        args.max_seq, args.trace or None)
     if args.trace:
-        summarize_trace(args.trace)
+        # best-effort: a missing tensorflow must not kill the ablation
+        # grids below (the trace itself is still on disk for TensorBoard;
+        # the explicit --summarize path fails loudly instead)
+        try:
+            summarize_trace(args.trace)
+        except ImportError as exc:
+            print(f"trace summary skipped: {exc}", file=sys.stderr)
     if args.ablate:
         # dequant cost: same shapes, bf16 weights
         results += run_grid(args.model, "", buckets[-1:], batches[-1:],

@@ -27,43 +27,72 @@ def log(msg: str) -> None:
     print(f"[watch {time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
-def probe(timeout: float = 60.0) -> bool:
+def probe(timeout: float = 60.0) -> str:
+    """'alive' | 'wedged' (probe hung: the tunnel failure mode) |
+    'broken' (fast non-zero exit: NOT a tunnel problem — a broken jax
+    install must abort the watch, not burn the window as a fake wedge)."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
             capture_output=True, text=True, timeout=timeout,
             env={**os.environ, "JAX_COMPILATION_CACHE_DIR": "/tmp/gofr_jax_cache"},
         )
-        return proc.returncode == 0
     except subprocess.TimeoutExpired:
-        return False
+        return "wedged"
+    if proc.returncode == 0:
+        return "alive"
+    log("probe failed FAST (environment, not tunnel): "
+        + "\n".join(proc.stderr.strip().splitlines()[-3:]))
+    return "broken"
 
 
 def run_stage(name: str, cmd: list[str], timeout: float,
               env: dict | None = None) -> None:
+    """Run one stage in its OWN process group: a timeout must kill the
+    whole tree (a sweep's in-flight bench.py grandchild would otherwise
+    survive the kill, keep the exclusive device runtime, and starve every
+    later stage)."""
+    import signal
+
     log(f"stage {name}: {' '.join(cmd)}")
     with open(os.path.join(OUT, f"{name}.log"), "w") as fh:
+        proc = subprocess.Popen(
+            cmd, stdout=fh, stderr=subprocess.STDOUT, cwd=REPO, env=env,
+            start_new_session=True,
+        )
         try:
-            proc = subprocess.run(
-                cmd, stdout=fh, stderr=subprocess.STDOUT, timeout=timeout,
-                cwd=REPO, env=env,
-            )
-            log(f"stage {name}: rc={proc.returncode}")
+            rc = proc.wait(timeout=timeout)
+            log(f"stage {name}: rc={rc}")
         except subprocess.TimeoutExpired:
-            log(f"stage {name}: TIMEOUT after {timeout:.0f}s")
+            log(f"stage {name}: TIMEOUT after {timeout:.0f}s — killing group")
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait(timeout=30)
 
 
 def main() -> int:
     os.makedirs(OUT, exist_ok=True)
     poll = float(os.environ.get("WATCH_POLL_SECONDS", "120"))
     deadline = time.monotonic() + float(os.environ.get("WATCH_MAX_SECONDS", "28800"))
-    n = 0
+    n = broken = 0
     while time.monotonic() < deadline:
         n += 1
-        if probe():
+        state = probe()
+        if state == "alive":
             log(f"tunnel ALIVE after {n} probes — starting hardware agenda")
             break
-        log(f"probe {n}: tunnel wedged; sleeping {poll:.0f}s")
+        if state == "broken":
+            broken += 1
+            if broken >= 3:  # consistent fast failure = config, not link
+                log("aborting: probe fails instantly — fix the environment")
+                with open(os.path.join(OUT, "verdict.json"), "w") as fh:
+                    json.dump({"tunnel": "environment-broken", "probes": n}, fh)
+                return 2
+        else:
+            broken = 0
+        log(f"probe {n}: tunnel {state}; sleeping {poll:.0f}s")
         time.sleep(poll)
     else:
         log("gave up: tunnel never recovered inside the watch window")
@@ -80,7 +109,10 @@ def main() -> int:
         [sys.executable, "tools/bench_sweep.py",
          "base8", "depth2", "depth4", "chunk16", "chunk32", "chunk16-depth4",
          "slots16-chunk16"],
-        timeout=3.5 * 3600,
+        # 7 configs x up to 1800s each inside bench_sweep — the stage
+        # budget must exceed the worst case or the group kill fires with
+        # configs still queued
+        timeout=4.0 * 3600,
     )
     # 2. prefill MFU grid + ablations + device trace
     run_stage(
