@@ -195,8 +195,8 @@ def main() -> int:
         # the explicit --summarize path fails loudly instead)
         try:
             summarize_trace(args.trace)
-        except ImportError as exc:
-            print(f"trace summary skipped: {exc}", file=sys.stderr)
+        except Exception as exc:  # missing tf, truncated .xplane.pb, ...
+            print(f"trace summary skipped: {exc!r}", file=sys.stderr)
     if args.ablate:
         # dequant cost: same shapes, bf16 weights
         results += run_grid(args.model, "", buckets[-1:], batches[-1:],
