@@ -70,8 +70,9 @@ class DynamicBatcher:
         # link has high round-trip latency (tunneled PJRT: ~65ms/sync)
         from concurrent.futures import ThreadPoolExecutor
 
+        self.pipeline_depth = max(1, pipeline_depth)
         self._dispatch_pool = ThreadPoolExecutor(
-            max_workers=max(1, pipeline_depth), thread_name_prefix=f"gofr-dispatch-{name}"
+            max_workers=self.pipeline_depth, thread_name_prefix=f"gofr-dispatch-{name}"
         )
         self._queue: "queue.Queue[Optional[_Item]]" = queue.Queue(maxsize=max_queue)
         self._closed = False

@@ -240,6 +240,10 @@ class TPUDevice:
             raise ValueError("DECODE_PIPELINE must be >= 1")
         self._last_reinit = 0.0
         self._reinit_lock = threading.Lock()
+        # prefill MFU steady-state window (see _run_batch): completions
+        # arrive from the batcher's dispatch-pool threads
+        self._last_batch_done = 0.0
+        self._mfu_window_lock = threading.Lock()
         # boot status: surfaced by /.well-known/ready and health details so
         # a slow cold boot (8B-class warmup compiles) is observable, never
         # indistinguishable from a hang
@@ -604,9 +608,27 @@ class TPUDevice:
             # real (un-padded) prompt tokens; payloads are prepared id rows
             tokens = sum(int(getattr(p, "size", 0)) for p in payloads)
             if tokens:
+                # steady-state denominator, same shape as the decode
+                # pool's: the batcher pipelines dispatches, so under load
+                # this batch's host round trip overlapped the previous
+                # batch's — the interval between COMPLETIONS is the true
+                # per-batch cost, floored at elapsed/depth (the batcher's
+                # REAL pipeline depth) so an idle-then-burst pair cannot
+                # spike the gauge past reality. Single isolated batches
+                # keep their full (RTT-inclusive) elapsed.
+                depth = getattr(
+                    getattr(self, "batcher", None), "pipeline_depth", 2
+                )
+                done = time.perf_counter()
+                with self._mfu_window_lock:
+                    steady = max(
+                        done - max(done - elapsed, self._last_batch_done),
+                        elapsed / depth,
+                    )
+                    self._last_batch_done = done
                 self._tokens_counter.inc(tokens, model=self.model_name, op="prefill")
                 self._mfu_gauge.set(
-                    mfu(n_params, tokens, elapsed, self.peak_flops),
+                    mfu(n_params, tokens, steady, self.peak_flops),
                     model=self.model_name, op="prefill",
                 )
         return results

@@ -37,8 +37,17 @@ sys.path.insert(0, REPO)
 
 
 def _time_prefill(runner, bucket: int, batch: int, reps: int = 5) -> dict:
-    """Median wall seconds of the runner's real prefill dispatch at
-    [batch, bucket] (first call may compile: excluded via a warmup rep)."""
+    """Times the runner's real prefill at [batch, bucket] two ways.
+
+    ``seconds``: median wall time of one synchronized dispatch — what a
+    single request experiences, INCLUDING the host<->device round trip
+    (on the tunneled bench link that RTT is ~65-130 ms, and it is why the
+    serving gauge's host-timed prefill MFU reads low).
+
+    ``pipelined``: per-dispatch time of ``reps`` back-to-back dispatches
+    synchronized once at the end — jax's async dispatch queues them so
+    the link latency amortizes away; this is the DEVICE throughput
+    number, the one comparable to the MXU roofline."""
     import jax
     import jax.numpy as jnp
 
@@ -56,7 +65,13 @@ def _time_prefill(runner, bucket: int, batch: int, reps: int = 5) -> dict:
         next_ids.block_until_ready()
         times.append(time.perf_counter() - start)
     times.sort()
-    return {"seconds": times[len(times) // 2], "best": times[0]}
+    start = time.perf_counter()
+    for _ in range(reps):
+        _, next_ids, _ = runner._prefill(runner.params, tokens, cache, lengths)
+    next_ids.block_until_ready()
+    pipelined = (time.perf_counter() - start) / reps
+    return {"seconds": times[len(times) // 2], "best": times[0],
+            "pipelined": pipelined}
 
 
 def run_grid(model: str, quant: str, buckets, batches, attn: str | None,
@@ -83,8 +98,12 @@ def run_grid(model: str, quant: str, buckets, batches, attn: str | None,
                 "config": label, "bucket": bucket, "batch": batch,
                 "ms": round(t["seconds"] * 1e3, 2),
                 "best_ms": round(t["best"] * 1e3, 2),
+                "pipelined_ms": round(t["pipelined"] * 1e3, 2),
                 "tokens": tokens,
                 "mfu": round(mfu(runner.n_params, tokens, t["seconds"], peak), 4),
+                "mfu_device": round(
+                    mfu(runner.n_params, tokens, t["pipelined"], peak), 4
+                ),
                 "tok_per_sec": round(tokens / t["seconds"], 1),
             }
             out.append(rec)
@@ -205,12 +224,14 @@ def main() -> int:
         for attn in ("xla", "pallas"):
             results += run_grid(args.model, args.quant, buckets[-1:],
                                 batches[-1:], attn, args.max_seq, None)
-    ranked = sorted(results, key=lambda r: -r["mfu"])
-    print("\n=== MFU ranking", file=sys.stderr)
+    ranked = sorted(results, key=lambda r: -r["mfu_device"])
+    print("\n=== MFU ranking (mfu_device = link-amortized; mfu = one synced"
+          " dispatch incl. RTT)", file=sys.stderr)
     for r in ranked[:12]:
         print(
             f"  {r['config']:>24} b{r['bucket']:<4}x{r['batch']:<3}: "
-            f"mfu {r['mfu']:.3f}  {r['ms']:8.2f} ms  {r['tok_per_sec']:10.0f} tok/s",
+            f"mfu_device {r['mfu_device']:.3f}  mfu {r['mfu']:.3f}  "
+            f"{r['pipelined_ms']:8.2f} ms/dispatch",
             file=sys.stderr,
         )
     return 0

@@ -112,16 +112,22 @@ def main() -> int:
     # this point would wedge the round's ONE driver artifact. Stages are
     # skipped (not truncated) once past the deadline; a skipped stage's
     # absence in /tmp/r04_hw is the signal it never fit.
-    abs_deadline = float(os.environ.get("WATCH_ABS_DEADLINE", "0")) or (
-        time.time() + 6 * 3600
-    )
+    try:
+        abs_deadline = float(os.environ.get("WATCH_ABS_DEADLINE", "0"))
+    except ValueError:
+        log("WATCH_ABS_DEADLINE is not epoch seconds — using now+6h")
+        abs_deadline = 0.0
+    abs_deadline = abs_deadline or (time.time() + 6 * 3600)
 
     def remaining() -> float:
         return abs_deadline - time.time()
 
     # 1. decode sweep around the measured winner (bench JSON lines land in
     #    the stage log; ranking at the end)
-    if remaining() > 1800:
+    # gate at one full worst-case config (1800s) + margin: launching a
+    # sweep that cannot finish even its first config burns deadline the
+    # profile/ladder stages could have used
+    if remaining() > 2700:
         run_stage(
             "sweep",
             [sys.executable, "tools/bench_sweep.py",
@@ -141,10 +147,12 @@ def main() -> int:
         )
     # 3. flagship bench with the bucket ladder (per-bucket compile seconds
     #    land in boot_stages)
-    if remaining() > 600:
+    if remaining() > 720:
         run_stage(
             "ladder", [sys.executable, "bench.py"],
-            timeout=min(1800, remaining()),
+            # keep a kill+reap margin inside the deadline: the chip must
+            # be free when the driver's own bench wants it
+            timeout=min(1800, remaining() - 120),
             env={**os.environ, "MODEL_BUCKETS": "64,512",
                  "BENCH_PROMPT_LEN": "48"},
         )
