@@ -619,8 +619,12 @@ class TPUDevice:
                 depth = getattr(
                     getattr(self, "batcher", None), "pipeline_depth", 2
                 )
-                done = time.perf_counter()
                 with self._mfu_window_lock:
+                    # sampled INSIDE the lock: two dispatch threads
+                    # completing together must not move the window
+                    # backwards (a stale-earlier timestamp inflates the
+                    # next interval back to the isolated reading)
+                    done = time.perf_counter()
                     steady = max(
                         done - max(done - elapsed, self._last_batch_done),
                         elapsed / depth,

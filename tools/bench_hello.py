@@ -1,0 +1,87 @@
+"""BASELINE.md config 0: hello-world handler, no model — the pure
+transport number (router + middleware chain + envelope, no device).
+
+Prints one JSON line: req/s and p50/p99 latency through real sockets.
+This is the framework-overhead floor under every other benchmark: a
+`/infer` request can never be faster than `/hello`.
+
+    python tools/bench_hello.py             # 8 clients x 2000 requests
+    BENCH_CLIENTS=32 python tools/bench_hello.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    clients = int(os.environ.get("BENCH_CLIENTS", "8"))
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "2000"))
+    os.environ.setdefault("LOG_LEVEL", "ERROR")
+    os.environ.setdefault("HTTP_PORT", "18821")
+    os.environ.setdefault("APP_NAME", "bench-hello")
+
+    import gofr_tpu
+
+    app = gofr_tpu.new()
+    app.get("/hello", lambda ctx: "Hello World!")
+    app.start()
+    base = f"http://127.0.0.1:{app.http_port}"
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(base + "/hello", timeout=2)
+                break
+            except Exception:
+                time.sleep(0.2)
+
+        latencies: list[float] = []
+        lock = threading.Lock()
+        per_client = max(1, n_requests // clients)
+
+        def worker() -> None:
+            local = []
+            for _ in range(per_client):
+                start = time.perf_counter()
+                with urllib.request.urlopen(base + "/hello", timeout=10) as r:
+                    body = json.loads(r.read())
+                assert body == {"data": "Hello World!"}, body
+                local.append(time.perf_counter() - start)
+            with lock:
+                latencies.extend(local)
+
+        threads = [threading.Thread(target=worker) for _ in range(clients)]
+        wall_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - wall_start
+        latencies.sort()
+        print(json.dumps({
+            "metric": "hello_req_per_sec",
+            "value": round(len(latencies) / wall, 1),
+            "unit": "req/s",
+            "clients": clients,
+            "requests": len(latencies),
+            "p50_ms": round(latencies[len(latencies) // 2] * 1e3, 3),
+            "p99_ms": round(
+                latencies[min(len(latencies) - 1, int(len(latencies) * 0.99))] * 1e3,
+                3,
+            ),
+        }), flush=True)
+        return 0
+    finally:
+        app.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
