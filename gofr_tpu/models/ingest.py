@@ -246,15 +246,20 @@ def load_llama_params(
     try:
         params: dict[str, Any] = {"layers": {}}
 
-        def place(arr: np.ndarray, quant_ok: bool) -> Any:
+        def place(arr: np.ndarray, quant_ok: bool, key: str = "") -> Any:
+            from gofr_tpu.models.quant import quantizer_for_key
+
             x = jnp.asarray(np.ascontiguousarray(arr), dtype=cfg.dtype)
-            return quantize_fn(x) if (quantize_fn and quant_ok) else x
+            if not (quantize_fn and quant_ok):
+                return x
+            # key-aware: encodes the w8a8 lm_head carve-out centrally
+            return quantizer_for_key(quantize, key)(x)
 
         pending: dict[str, list[np.ndarray]] = {}
         for tree_path, arr in iter_hf_llama_tensors(ckpt, cfg):
             if tree_path[0] != "layers":
                 quant_ok = tree_path[0] == "lm_head"  # embeds/norms stay hi-prec
-                params[tree_path[0]] = place(arr, quant_ok)
+                params[tree_path[0]] = place(arr, quant_ok, tree_path[0])
                 continue
             _, key, _i = tree_path  # yielded in layer order 0..n-1
             pending.setdefault(key, []).append(arr)
@@ -262,7 +267,7 @@ def load_llama_params(
             stacked = np.stack(pending.pop(key))
             # quantize_array on [L, in, out] reduces axis=-2: bit-identical
             # to quantizing each layer slice separately
-            params["layers"][key] = place(stacked, key in _QUANT_LAYER_KEYS)
+            params["layers"][key] = place(stacked, key in _QUANT_LAYER_KEYS, key)
             del stacked
         return params
     finally:
@@ -273,10 +278,14 @@ def export_llama_hf(params: dict, cfg: Any) -> dict[str, np.ndarray]:
     """Inverse mapping (our tree -> HF tensor dict), used by tests to
     round-trip and by users exporting trained weights. Quantized trees must
     be dequantized first."""
-    from gofr_tpu.models.quant import is_quantized, is_quantized_int4
+    from gofr_tpu.models.quant import (
+        is_quantized,
+        is_quantized_int4,
+        is_quantized_w8a8,
+    )
 
     def host(x: Any) -> np.ndarray:
-        if is_quantized(x) or is_quantized_int4(x):
+        if is_quantized(x) or is_quantized_int4(x) or is_quantized_w8a8(x):
             raise ValueError("dequantize params before export")
         return np.asarray(x)
 

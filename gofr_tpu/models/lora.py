@@ -30,8 +30,10 @@ from gofr_tpu.models.quant import (
     _QUANT_KEYS,
     dequantize_array,
     dequantize_array_int4,
+    dequantize_array_w8a8,
     is_quantized,
     is_quantized_int4,
+    is_quantized_w8a8,
     moe_skip_keys,
 )
 
@@ -67,6 +69,21 @@ def add_lora(
     adapters. The wrapped tree serves and trains through the existing
     model forwards unchanged."""
     eligible = frozenset(keys) if keys is not None else _LORA_KEYS
+
+    def reject_w8a8(tree: Any) -> None:
+        if isinstance(tree, dict):
+            if is_quantized_w8a8(tree):
+                raise ValueError(
+                    "add_lora over a w8a8 base is unsupported: the "
+                    "activation round-to-int8 has zero gradient, so "
+                    "adapters below the first w8a8 matmul would train on "
+                    "silent zeros. Train (QLoRA) over an int8/int4 base "
+                    "and re-quantize w8a8 for deployment."
+                )
+            for v in tree.values():
+                reject_w8a8(v)
+
+    reject_w8a8(params)
     leaves: list[tuple[str, Any]] = []
 
     def collect(tree: Any) -> None:
@@ -114,15 +131,18 @@ def add_lora(
 
 
 def _is_packed(tree: dict) -> bool:
-    return is_quantized(tree) or is_quantized_int4(tree) or is_lora(tree)
+    return (
+        is_quantized(tree) or is_quantized_int4(tree)
+        or is_quantized_w8a8(tree) or is_lora(tree)
+    )
 
 
 def _weight_shape(v: Any) -> Optional[tuple[tuple[int, ...], int, int]]:
     """(leading dims, in, out) for a wrappable weight: a plain >=2-D array
     or a quantized packed dict (QLoRA base)."""
     if isinstance(v, dict):
-        if is_quantized(v) or is_quantized_int4(v):
-            q = v.get("q", v.get("q4"))
+        if is_quantized(v) or is_quantized_int4(v) or is_quantized_w8a8(v):
+            q = v.get("q", v.get("q4", v.get("q8")))
             return q.shape[:-2], q.shape[-2], q.shape[-1]
         return None
     if hasattr(v, "ndim") and v.ndim >= 2:
@@ -326,6 +346,8 @@ def merge_lora(params: dict, dtype: Any = None) -> dict:
             w = dequantize_array(w)
         elif is_quantized_int4(w):
             w = dequantize_array_int4(w)
+        elif is_quantized_w8a8(w):
+            w = dequantize_array_w8a8(w)
         out_dtype = dtype or w.dtype
         delta = (
             leaf["lora_a"].astype(jnp.float32) @ leaf["lora_b"].astype(jnp.float32)

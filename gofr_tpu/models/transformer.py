@@ -87,14 +87,18 @@ def init_transformer(
     plus ONE bf16 weight — init-then-quantize of the full tree would peak
     at 3x the packed size and OOM an 8B model on a 16GB chip. Values are
     bit-identical to ``quantize_params(init_transformer(key, cfg), mode)``."""
-    from gofr_tpu.models.quant import quantizer_for
+    from gofr_tpu.models.quant import quantizer_for, quantizer_for_key
 
-    quantize_fn = quantizer_for(quantize)
+    quantizer_for(quantize)  # validate the mode eagerly
     n_keys = cfg.n_layers * 7 + 3
     keys = iter(jax.random.split(key, n_keys))
 
-    def dense(k: jax.Array, shape: tuple[int, ...], fan_in: int) -> Any:
+    def dense(k: jax.Array, shape: tuple[int, ...], fan_in: int,
+              name: str = "") -> Any:
         w = (jax.random.truncated_normal(k, -3, 3, shape) * (fan_in ** -0.5)).astype(cfg.dtype)
+        # key-aware quantizer: the w8a8 lm_head carve-out lives in
+        # quant.quantizer_for_key, not here
+        quantize_fn = quantizer_for_key(quantize, name)
         return quantize_fn(w) if quantize_fn else w
 
     params: dict[str, Any] = {
@@ -104,7 +108,9 @@ def init_transformer(
             * (cfg.dim ** -0.5)
         ).astype(cfg.dtype),
         "norm_f": jnp.ones((cfg.dim,), cfg.dtype),
-        "lm_head": dense(next(keys), (cfg.dim, cfg.vocab_size), cfg.dim),
+        "lm_head": dense(
+            next(keys), (cfg.dim, cfg.vocab_size), cfg.dim, name="lm_head"
+        ),
     }
     kv_dim = cfg.n_kv_heads * cfg.head_dim
 

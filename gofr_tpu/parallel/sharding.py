@@ -23,7 +23,8 @@ shards the other matmul dimension (ZeRO-3); gradients reduce-scatter over
 ``fsdp`` and all-reduce over ``dp`` automatically under jit.
 
 Quantized weights shard like the underlying weight: int8 {"q", "scale"}
-scales follow the output axis; int4 {"q4", "scale"} scales take the full
+(and w8a8 {"q8", "scale"}) scales follow the output axis; int4
+{"q4", "scale"} scales take the full
 weight spec (their group axis follows the input axis).
 """
 
@@ -80,8 +81,9 @@ def param_specs(params: Any, _name: str = "") -> Any:
                     "lora_b": P(*pad, None, b_out),
                     "lora_scale": P(),
                 }
-            if keys in ({"q", "scale"}, {"q4", "scale"}):  # packed leaf pair
-                q_key = "q" if "q" in tree else "q4"
+            if keys in ({"q", "scale"}, {"q4", "scale"}, {"q8", "scale"}):
+                # packed leaf pair; w8a8 ({"q8"}) shards exactly like int8
+                q_key = next(k for k in ("q", "q4", "q8") if k in tree)
                 q_spec = _spec_for(name, tree[q_key].ndim)
                 if q_key == "q4" and tree["scale"].shape[-2] > 1:
                     # int4 scale [..., groups, out]: the group axis follows

@@ -194,3 +194,13 @@ def test_add_lora_leaves_moe_expert_stacks_dense():
     np.testing.assert_allclose(
         np.asarray(base_logits), np.asarray(lora_logits), rtol=1e-5, atol=1e-5
     )
+
+
+def test_add_lora_rejects_w8a8_base():
+    """w8a8 is a serving mode: the activation round has zero gradient, so
+    QLoRA over it must fail loudly, not train on silent zeros."""
+    from gofr_tpu.models.quant import quantize_params
+
+    base = quantize_params(init_transformer(jax.random.key(4), TINY), "w8a8")
+    with pytest.raises(ValueError, match="w8a8"):
+        add_lora(base, jax.random.key(5))

@@ -191,7 +191,7 @@ def test_int4_forward_close(params):
 def test_quantizer_for_rejects_unknown_mode():
     from gofr_tpu.models.quant import quantizer_for
 
-    with pytest.raises(ValueError, match="int8 or int4"):
+    with pytest.raises(ValueError, match="int8, int4, or w8a8"):
         quantizer_for("fp4")
     assert quantizer_for("") is None and quantizer_for(None) is None
 
@@ -281,6 +281,99 @@ def test_quantized_init_matches_quantize_after():
 
     a = init_transformer(jax.random.key(3), TINY, quantize=True)
     b = quantize_params(init_transformer(jax.random.key(3), TINY))
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- W8A8 (int8 weights AND activations: the MXU int8 serving mode) ----------
+
+def test_w8a8_mm_matches_manual_oracle():
+    """mm on a {"q8","scale"} pack == explicit per-token quant + int8 dot
+    + two-scale rescale, computed by hand."""
+    from gofr_tpu.models.quant import mm, quantize_array_w8a8
+
+    w = jax.random.normal(jax.random.key(20), (64, 48), jnp.float32)
+    x = jax.random.normal(jax.random.key(21), (5, 64), jnp.float32)
+    packed = quantize_array_w8a8(w)
+    assert packed["q8"].dtype == jnp.int8
+
+    sx = jnp.maximum(jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0, 1e-8)
+    qx = jnp.clip(jnp.round(x / sx), -127, 127).astype(jnp.int32)
+    oracle = (
+        (qx @ packed["q8"].astype(jnp.int32)).astype(jnp.float32)
+        * sx * packed["scale"].reshape(1, -1)
+    )
+    np.testing.assert_allclose(
+        np.asarray(mm(x, packed)), np.asarray(oracle), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_w8a8_quantize_params_keeps_lm_head_weight_only(params):
+    from gofr_tpu.models.quant import is_quantized, is_quantized_w8a8
+
+    qparams = quantize_params(params, "w8a8")
+    assert is_quantized_w8a8(qparams["layers"]["wq"])
+    # logits matmul stays weight-only: activation noise must not flip argmax
+    assert is_quantized(qparams["lm_head"])
+
+
+def test_w8a8_forward_close(params):
+    tokens = jax.random.randint(jax.random.key(22), (1, 6), 0, CFG.vocab_size)
+    base = _fwd(params, tokens)
+    qparams = quantize_params(params, "w8a8")
+    quant = jax.jit(lambda p, t: transformer_forward(p, t, CFG))(qparams, tokens)
+    base_probs = jax.nn.softmax(base[:, -1])
+    quant_probs = jax.nn.softmax(quant[:, -1])
+    # per-token activation quant adds noise on top of weight-only int8:
+    # distributions stay close, bound looser than the 0.15 weight-only one
+    assert float(jnp.abs(base_probs - quant_probs).sum()) < 0.25
+    # dequantize restores plain arrays usable by the same forward
+    deq = dequantize_params(qparams, jnp.float32)
+    deq_logits = jax.jit(lambda p, t: transformer_forward(p, t, CFG))(deq, tokens)
+    assert np.isfinite(np.asarray(deq_logits)).all()
+
+
+def test_w8a8_moe_experts_stay_dense():
+    from gofr_tpu.models.moe import MoEConfig, init_moe, moe_forward
+    from gofr_tpu.models.quant import is_quantized_w8a8
+
+    cfg = MoEConfig(
+        vocab_size=89, dim=16, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=32, max_seq=64, n_experts=4, top_k=2,
+        capacity_factor=2.0, dtype=jnp.float32, attn_impl="xla",
+    )
+    qparams = quantize_params(init_moe(jax.random.key(23), cfg), "w8a8")
+    layers = qparams["layers"]
+    for key in ("w_gate", "w_up", "w_down"):
+        assert not is_quantized_w8a8(layers[key])
+    assert is_quantized_w8a8(layers["wq"])
+    tokens = jax.random.randint(jax.random.key(24), (2, 8), 0, cfg.vocab_size)
+    logits, _ = moe_forward(qparams, tokens, cfg)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_w8a8_param_specs_shard_like_int8():
+    from gofr_tpu.parallel.sharding import param_specs
+
+    qparams = quantize_params(init_transformer(jax.random.key(25), TINY), "w8a8")
+    specs = param_specs(qparams)
+    wq = specs["layers"]["wq"]
+    assert set(wq) == {"q8", "scale"}
+    # the q8 spec matches what the int8 pack of the same tree gets
+    int8_specs = param_specs(
+        quantize_params(init_transformer(jax.random.key(25), TINY), "int8")
+    )
+    assert wq["q8"] == int8_specs["layers"]["wq"]["q"]
+
+
+def test_w8a8_init_matches_quantize_after():
+    from gofr_tpu.models.llama import TINY
+    from gofr_tpu.models.transformer import init_transformer
+
+    a = init_transformer(jax.random.key(3), TINY, quantize="w8a8")
+    b = quantize_params(init_transformer(jax.random.key(3), TINY), "w8a8")
     la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
     assert len(la) == len(lb)
     for x, y in zip(la, lb):

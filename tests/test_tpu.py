@@ -712,3 +712,30 @@ def test_bert_serving_reports_mfu(monkeypatch):
         assert 'gofr_tpu_mfu{model="bert-tiny",op="prefill"}' in text
     finally:
         device.close()
+
+
+def test_w8a8_serving_generates():
+    """MODEL_QUANT=w8a8 boots and serves: q8 packs in the runner tree
+    (lm_head weight-only), generation through prefill + pooled decode."""
+    import os
+
+    import jax.numpy as jnp
+
+    env = {"MODEL_NAME": "tiny", "MODEL_QUANT": "w8a8", "BATCH_MAX_SIZE": "2",
+           "BATCH_TIMEOUT_MS": "1", "DECODE_CHUNK": "4"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            assert device.runner.params["layers"]["wq"]["q8"].dtype == jnp.int8
+            assert set(device.runner.params["lm_head"]) == {"q", "scale"}
+            out = device.generate([1, 2, 3], max_new_tokens=6)
+            assert len(out) == 6
+            assert all(0 <= t < device.runner.cfg.vocab_size for t in out)
+            assert "quant=w8a8" in device.describe()
+        finally:
+            device.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)

@@ -44,19 +44,24 @@ def main() -> int:
                 time.sleep(0.2)
 
         latencies: list[float] = []
+        failures: list[str] = []
         lock = threading.Lock()
         per_client = max(1, n_requests // clients)
 
         def worker() -> None:
-            local = []
+            local, bad = [], []
             for _ in range(per_client):
-                start = time.perf_counter()
-                with urllib.request.urlopen(base + "/hello", timeout=10) as r:
-                    body = json.loads(r.read())
-                assert body == {"data": "Hello World!"}, body
-                local.append(time.perf_counter() - start)
+                try:
+                    start = time.perf_counter()
+                    with urllib.request.urlopen(base + "/hello", timeout=10) as r:
+                        body = json.loads(r.read())
+                    assert body == {"data": "Hello World!"}, body
+                    local.append(time.perf_counter() - start)
+                except Exception as exc:
+                    bad.append(f"{type(exc).__name__}: {exc}")
             with lock:
                 latencies.extend(local)
+                failures.extend(bad)
 
         threads = [threading.Thread(target=worker) for _ in range(clients)]
         wall_start = time.perf_counter()
@@ -65,6 +70,14 @@ def main() -> int:
         for t in threads:
             t.join()
         wall = time.perf_counter() - wall_start
+        if failures or not latencies:
+            # a partial sample divides survivors by the full wall time —
+            # a silently wrong number; fail loudly instead
+            print(json.dumps({
+                "metric": "hello_req_per_sec", "value": None,
+                "failures": len(failures), "errors": failures[:5],
+            }), flush=True)
+            return 1
         latencies.sort()
         print(json.dumps({
             "metric": "hello_req_per_sec",

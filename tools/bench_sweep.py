@@ -40,6 +40,7 @@ SWEEP: dict[str, dict[str, str]] = {
     "slots32": {"DECODE_SLOTS": "32"},
     "slots32-f8kv": {"DECODE_SLOTS": "32", "MODEL_KV_DTYPE": "f8"},
     "int4": {"MODEL_QUANT": "int4"},
+    "w8a8": {"MODEL_QUANT": "w8a8"},
     "attn-pallas": {"MODEL_ATTN_IMPL": "pallas"},
 }
 
