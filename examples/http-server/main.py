@@ -173,6 +173,11 @@ def main():
     app.get("/trace", trace_handler)
     app.post("/infer", infer_handler)
     app.post("/generate", generate_handler)
+    # OpenAI-compatible surface (/v1/completions, /v1/models): clients
+    # speaking the de-facto completions protocol hit the same datasource
+    from gofr_tpu.openai_compat import register_openai_routes
+
+    register_openai_routes(app)
     app.run()
 
 

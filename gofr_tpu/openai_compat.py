@@ -1,0 +1,220 @@
+"""OpenAI-compatible completions surface over the TPU datasource.
+
+Not a reference-parity component (GoFr has no LLM API) — a TPU-native
+addition so clients speaking the de-facto completions protocol (SDKs,
+load-testing harnesses, gateway routers) can hit this framework without a
+translation shim. ``register_openai_routes(app)`` adds:
+
+- ``POST /v1/completions`` — prompt in, text out; ``"stream": true``
+  switches to SSE chunks terminated by ``data: [DONE]``.
+- ``GET /v1/models`` — the single served model, from MODEL_NAME.
+
+Scope: the completions shape (prompt string or token list, max_tokens,
+temperature/top_p/seed, stop, logprobs, usage accounting). ``stop``
+accepts strings that encode to exactly ONE token (multi-token stop
+sequences would need rolling decoded-text matching on the hot path) or
+the ``stop_token_ids`` extension; anything else is a clear 400, never a
+silent ignore.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any
+
+from gofr_tpu.errors import HTTPError
+
+
+def register_openai_routes(app: Any) -> None:
+    app.post("/v1/completions", completions)
+    app.get("/v1/models", list_models)
+
+
+def list_models(ctx: Any) -> Any:
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    from gofr_tpu.http.response import Raw
+
+    # OpenAI clients expect the list object at top level, not inside the
+    # framework envelope
+    return Raw({
+        "object": "list",
+        "data": [{
+            "id": ctx.tpu.model_name,
+            "object": "model",
+            "owned_by": "gofr_tpu",
+        }],
+    })
+
+
+def _prompt_tokens(ctx: Any, prompt: Any) -> list[int]:
+    if isinstance(prompt, str):
+        tok = ctx.tpu.tokenizer
+        if tok is None:
+            raise HTTPError(
+                400,
+                "string prompt needs a tokenizer (set TOKENIZER_PATH); "
+                "token-id lists work without one",
+            )
+        ids = tok.encode(prompt)
+        if not ids:
+            raise HTTPError(400, "prompt encoded to zero tokens")
+        return ids
+    if (
+        isinstance(prompt, list) and prompt
+        and all(isinstance(t, int) for t in prompt)
+    ):
+        return prompt
+    raise HTTPError(
+        400, '"prompt" must be a non-empty string or list of token ids'
+    )
+
+
+def _stop_token_ids(ctx: Any, body: dict) -> frozenset:
+    ids = set()
+    raw_ids = body.get("stop_token_ids")
+    if raw_ids is not None:
+        if not isinstance(raw_ids, list) or not all(
+            isinstance(t, int) for t in raw_ids
+        ):
+            raise HTTPError(400, '"stop_token_ids" must be a list of ints')
+        ids.update(raw_ids)
+    stop = body.get("stop")
+    if stop is None:
+        return frozenset(ids)
+    if isinstance(stop, str):
+        stop = [stop]
+    if not isinstance(stop, list) or not all(isinstance(s, str) for s in stop):
+        raise HTTPError(400, '"stop" must be a string or list of strings')
+    tok = ctx.tpu.tokenizer
+    if tok is None:
+        raise HTTPError(400, '"stop" strings need a tokenizer; use "stop_token_ids"')
+    for s in stop:
+        encoded = tok.encode(s)
+        if len(encoded) != 1:
+            raise HTTPError(
+                400,
+                f'stop sequence {s!r} spans {len(encoded)} tokens — only '
+                'single-token stops are supported (or pass "stop_token_ids")',
+            )
+        ids.add(encoded[0])
+    return frozenset(ids)
+
+
+def _sampler(body: dict) -> Any:
+    from gofr_tpu.ops.sampling import Sampler
+
+    try:
+        # pass the WHOLE body through the shared parse so every natively
+        # supported knob (top_k, min_p, repetition_penalty, seed) works
+        # here too — only the defaults differ: OpenAI semantics default
+        # to temperature 1.0 (the native /generate defaults to greedy)
+        return Sampler.from_body({"temperature": 1.0, "top_p": 1.0, **body})
+    except (TypeError, ValueError) as exc:
+        raise HTTPError(400, f"invalid sampling params: {exc}")
+
+
+def completions(ctx: Any) -> Any:
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    body = ctx.bind() if ctx.request.body else {}
+    if not isinstance(body, dict):
+        raise HTTPError(400, "request body must be a JSON object")
+    prompt_ids = _prompt_tokens(ctx, body.get("prompt", [1, 2, 3]))
+    max_tokens = body.get("max_tokens", 16)
+    if not isinstance(max_tokens, int) or max_tokens < 1:
+        raise HTTPError(400, '"max_tokens" must be a positive integer')
+    sampler = _sampler(body)
+    stop_ids = _stop_token_ids(ctx, body)
+    want_logprobs = body.get("logprobs") not in (None, False, 0)
+    adapter = body.get("adapter")  # multi-LoRA extension
+    if adapter is not None and not isinstance(adapter, str):
+        raise HTTPError(400, '"adapter" must be a string')
+    model = ctx.tpu.model_name
+    created = int(time.time())
+    cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
+    tok = ctx.tpu.tokenizer
+
+    if body.get("stream"):
+        import json as _json
+
+        from gofr_tpu.http.response import Stream
+
+        # constructed OUTSIDE events(): parameter errors (unknown adapter,
+        # bad sampler) must 400 before the SSE 200 commits
+        stream_iter = ctx.tpu.generate_stream(
+            prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+            adapter=adapter, logprobs=want_logprobs,
+        )
+
+        def chunk(text: str, lp: Any = None, finish: Any = None,
+                  token: Any = None) -> str:
+            choice: dict[str, Any] = {
+                "text": text, "index": 0, "finish_reason": finish,
+            }
+            if token is not None:
+                # no tokenizer: bare str(token) text would concatenate
+                # ambiguously ("12"+"3" == "1"+"23") — ids ride a tokens
+                # extension instead, matching the non-stream path
+                choice["tokens"] = [token]
+            if want_logprobs:
+                choice["logprobs"] = (
+                    {"token_logprobs": [lp]} if lp is not None else None
+                )
+            return _json.dumps({
+                "id": cmpl_id, "object": "text_completion",
+                "created": created, "model": model, "choices": [choice],
+            })
+
+        def events():
+            n = 0
+            dec = tok.stream_decoder() if tok is not None else None
+            try:
+                for item in stream_iter:
+                    token, lp = item if want_logprobs else (item, None)
+                    n += 1
+                    if dec is not None:
+                        yield chunk(dec.feed(token), lp)
+                    else:
+                        yield chunk("", lp, token=token)
+                tail = dec.flush() if dec is not None else ""
+                finish = "length" if n >= max_tokens else "stop"
+                yield chunk(tail, None, finish)
+                yield "[DONE]"
+            except Exception as exc:
+                yield _json.dumps({"error": {"message": str(exc)}})
+
+        return Stream(events())
+
+    out = ctx.tpu.generate(
+        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+        adapter=adapter, logprobs=want_logprobs,
+    )
+    logprobs = None
+    if want_logprobs:
+        out, logprobs = out
+    choice: dict[str, Any] = {
+        "text": tok.decode(out) if tok is not None else "",
+        "index": 0,
+        "finish_reason": "length" if len(out) >= max_tokens else "stop",
+        "logprobs": {"token_logprobs": logprobs} if logprobs is not None else None,
+    }
+    if tok is None:
+        choice["tokens"] = out  # no tokenizer: ids are the payload
+    from gofr_tpu.http.response import Raw
+
+    # OpenAI clients expect the completion object at the top level, not
+    # inside this framework's {"data": ...} envelope
+    return Raw({
+        "id": cmpl_id,
+        "object": "text_completion",
+        "created": created,
+        "model": model,
+        "choices": [choice],
+        "usage": {
+            "prompt_tokens": len(prompt_ids),
+            "completion_tokens": len(out),
+            "total_tokens": len(prompt_ids) + len(out),
+        },
+    })

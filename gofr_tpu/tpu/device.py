@@ -283,9 +283,12 @@ class TPUDevice:
         from gofr_tpu.tpu.flops import device_peak_flops, device_peak_hbm_bw
 
         # MFU/MBU denominators = aggregate peak of the chips actually
-        # serving (mesh size under TPU_MESH, else one chip)
+        # serving (mesh size under TPU_MESH, else one chip); quant-aware
+        # (w8a8 runs the MXU int8 path — flops.py owns the factor)
         n_chips = self.mesh.size if self.mesh is not None else 1
-        self.peak_flops = device_peak_flops(str(self.device_kind), self.platform) * n_chips
+        self.peak_flops = device_peak_flops(
+            str(self.device_kind), self.platform, quant=self.quant
+        ) * n_chips
         self.peak_hbm_bw = device_peak_hbm_bw(str(self.device_kind), self.platform) * n_chips
 
     def _boot(self) -> None:

@@ -68,12 +68,20 @@ def _lookup(
     return other_default
 
 
-def device_peak_flops(device_kind: str, platform: str) -> float:
-    """Per-chip bf16 peak for the device kind; CPU falls back to a nominal
+def device_peak_flops(device_kind: str, platform: str, quant: str = "") -> float:
+    """Per-chip peak for the device kind; CPU falls back to a nominal
     100 GFLOP/s so MFU math never divides by zero in tests (CPU MFU is not a
     meaningful number and is labeled by platform in the metrics).
-    Unknown TPU kinds assume v5e-class."""
-    return _lookup(_PEAKS, device_kind, platform, 197e12, 100e9)
+    Unknown TPU kinds assume v5e-class.
+
+    ``quant="w8a8"`` returns the int8 peak: every shipped TPU generation's
+    MXU runs int8 at 2x its bf16 rate, and an MFU gauge fed the bf16 peak
+    would read 2x too high under w8a8. THE single home of that factor —
+    the serving gauge and the profiler must agree."""
+    peak = _lookup(_PEAKS, device_kind, platform, 197e12, 100e9)
+    if quant == "w8a8" and (platform == "tpu" or "tpu" in (device_kind or "").lower()):
+        peak *= 2.0
+    return peak
 
 
 def device_peak_hbm_bw(device_kind: str, platform: str) -> float:

@@ -1,0 +1,141 @@
+"""OpenAI-compatible completions surface (gofr_tpu/openai_compat.py):
+request/response shape, SSE streaming with [DONE], stop handling, usage
+accounting, and validation errors — through the real HTTP transport."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+# XLA-compile-dominated module: deselect with -m 'not slow' for the
+# fast developer loop (CI runs everything; CONTRIBUTING.md)
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def base(tmp_path_factory):
+    import os
+    import socket
+
+    import gofr_tpu
+    from gofr_tpu.openai_compat import register_openai_routes
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {"HTTP_PORT": str(port), "LOG_LEVEL": "FATAL", "MODEL_NAME": "tiny",
+           "BATCH_MAX_SIZE": "2", "BATCH_TIMEOUT_MS": "1", "DECODE_CHUNK": "4"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    cwd = os.getcwd()
+    os.chdir(tmp_path_factory.mktemp("openai"))
+    try:
+        app = gofr_tpu.new()
+    finally:
+        os.chdir(cwd)
+        for k, v in saved.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+    register_openai_routes(app)
+    app.start()
+    yield f"http://127.0.0.1:{app.http_port}"
+    app.shutdown()
+
+
+def _post(base_url, payload, path="/v1/completions"):
+    req = urllib.request.Request(
+        base_url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_completions_response_shape_and_usage(base):
+    status, body = _post(base, {"prompt": [3, 1, 4, 1, 5], "max_tokens": 6,
+                                "temperature": 0})
+    assert status == 200
+    # OpenAI object at top level — NOT the framework envelope
+    assert "data" not in body or body.get("object") == "text_completion"
+    assert body["object"] == "text_completion"
+    assert body["id"].startswith("cmpl-")
+    choice = body["choices"][0]
+    assert choice["finish_reason"] == "length"
+    assert body["usage"] == {
+        "prompt_tokens": 5, "completion_tokens": 6, "total_tokens": 11,
+    }
+    # no tokenizer configured for 'tiny': ids carried alongside empty text
+    assert len(choice["tokens"]) == 6
+
+
+def test_completions_greedy_matches_native_generate(base):
+    status, body = _post(base, {"prompt": [2, 7, 2], "max_tokens": 5,
+                                "temperature": 0})
+    ids = body["choices"][0]["tokens"]
+    status, body2 = _post(base, {"prompt": [2, 7, 2], "max_tokens": 5,
+                                 "temperature": 0})
+    assert body2["choices"][0]["tokens"] == ids  # deterministic greedy
+
+
+def test_completions_stop_token_ids(base):
+    # generate once to learn the greedy continuation, then stop on its
+    # first token: the completion must end immediately with reason "stop"
+    _, free = _post(base, {"prompt": [5, 5, 5], "max_tokens": 4,
+                           "temperature": 0})
+    first = free["choices"][0]["tokens"][0]
+    _, stopped = _post(base, {"prompt": [5, 5, 5], "max_tokens": 4,
+                              "temperature": 0, "stop_token_ids": [first]})
+    assert stopped["choices"][0]["tokens"] == []
+    assert stopped["choices"][0]["finish_reason"] == "stop"
+    assert stopped["usage"]["completion_tokens"] == 0
+
+
+def test_completions_logprobs(base):
+    _, body = _post(base, {"prompt": [1, 2, 3], "max_tokens": 4,
+                           "temperature": 0, "logprobs": 1})
+    lps = body["choices"][0]["logprobs"]["token_logprobs"]
+    assert len(lps) == 4
+    assert all(lp <= 0.0 for lp in lps)
+
+
+def test_completions_stream_sse_with_done(base):
+    req = urllib.request.Request(
+        base + "/v1/completions",
+        data=json.dumps({"prompt": [4, 4], "max_tokens": 3,
+                         "temperature": 0, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.status == 200
+        raw = resp.read().decode()
+    events = [ln[len("data: "):] for ln in raw.splitlines()
+              if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    parsed = [json.loads(e) for e in events[:-1]]
+    assert all(p["object"] == "text_completion" for p in parsed)
+    assert parsed[-1]["choices"][0]["finish_reason"] == "length"
+    assert all(p["choices"][0]["finish_reason"] is None for p in parsed[:-1])
+
+
+def test_models_endpoint(base):
+    with urllib.request.urlopen(base + "/v1/models", timeout=30) as resp:
+        body = json.loads(resp.read())
+    assert body["object"] == "list"
+    assert body["data"][0]["id"] == "tiny"
+
+
+@pytest.mark.parametrize("payload,needle", [
+    ({"prompt": "text prompt", "max_tokens": 2}, "tokenizer"),
+    ({"prompt": [], "max_tokens": 2}, "prompt"),
+    ({"prompt": [1, 2], "max_tokens": 0}, "max_tokens"),
+    ({"prompt": [1, 2], "max_tokens": 2, "stop": "word"}, "tokenizer"),
+    ({"prompt": [1, 2], "max_tokens": 2, "stop_token_ids": ["x"]}, "stop_token_ids"),
+    ({"prompt": [1, 2], "max_tokens": 2, "temperature": -1}, "sampling"),
+])
+def test_completions_validation_errors(base, payload, needle):
+    try:
+        _post(base, payload)
+        raise AssertionError(f"expected 400 for {payload}")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert needle in e.read(400).decode()

@@ -616,7 +616,7 @@ def test_bad_model_quant_fails_fast():
     old = {k: os.environ.get(k) for k in env}
     os.environ.update(env)
     try:
-        with pytest.raises(ValueError, match="int8 or int4"):
+        with pytest.raises(ValueError, match="int8, int4, or w8a8"):
             new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
     finally:
         for k, v in old.items():

@@ -82,7 +82,11 @@ def run_grid(model: str, quant: str, buckets, batches, attn: str | None,
     from gofr_tpu.tpu.flops import device_peak_flops, mfu
 
     dev = jax.devices()[0]
-    peak = device_peak_flops(getattr(dev, "device_kind", dev.platform), dev.platform)
+    # quant-aware: w8a8 measures against the MXU int8 peak (flops.py owns
+    # the factor — the serving gauge uses the same call)
+    peak = device_peak_flops(
+        getattr(dev, "device_kind", dev.platform), dev.platform, quant=quant
+    )
     label = f"{model}/{quant or 'bf16'}/{attn or 'auto'}"
     print(f"=== building {label} (buckets={buckets})", file=sys.stderr, flush=True)
     runner = _build_runner(
@@ -219,6 +223,9 @@ def main() -> int:
     if args.ablate:
         # dequant cost: same shapes, bf16 weights
         results += run_grid(args.model, "", buckets[-1:], batches[-1:],
+                            None, args.max_seq, None)
+        # MXU int8 path: W8A8 at the largest shape (MFU vs the int8 peak)
+        results += run_grid(args.model, "w8a8", buckets[-1:], batches[-1:],
                             None, args.max_seq, None)
         # attention impl: pallas flash vs xla at the largest shape
         for attn in ("xla", "pallas"):
