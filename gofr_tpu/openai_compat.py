@@ -7,6 +7,9 @@ translation shim. ``register_openai_routes(app)`` adds:
 
 - ``POST /v1/completions`` — prompt in, text out; ``"stream": true``
   switches to SSE chunks terminated by ``data: [DONE]``.
+- ``POST /v1/chat/completions`` — messages in, assistant message out
+  (requires a tokenizer; the prompt is rendered through CHAT_TEMPLATE,
+  default ``[{role}]: {content}\\n`` per message + ``[assistant]: ``).
 - ``GET /v1/models`` — the single served model, from MODEL_NAME.
 
 Scope: the completions shape (prompt string or token list, max_tokens,
@@ -28,7 +31,33 @@ from gofr_tpu.errors import HTTPError
 
 def register_openai_routes(app: Any) -> None:
     app.post("/v1/completions", completions)
+    app.post("/v1/chat/completions", chat_completions)
     app.get("/v1/models", list_models)
+
+
+DEFAULT_CHAT_TEMPLATE = "[{role}]: {content}\n"
+
+
+def render_chat_prompt(ctx: Any, messages: Any) -> str:
+    """Messages -> prompt text via CHAT_TEMPLATE ({role}/{content}
+    placeholders, applied per message) + the assistant turn opener. Model
+    checkpoints with their own chat markup set CHAT_TEMPLATE to match."""
+    if not isinstance(messages, list) or not messages:
+        raise HTTPError(400, '"messages" must be a non-empty list')
+    template = ctx.config.get_or_default("CHAT_TEMPLATE", DEFAULT_CHAT_TEMPLATE)
+    parts = []
+    for m in messages:
+        if (
+            not isinstance(m, dict)
+            or not isinstance(m.get("role"), str)
+            or not isinstance(m.get("content"), str)
+        ):
+            raise HTTPError(
+                400,
+                'each message must be {"role": str, "content": str}',
+            )
+        parts.append(template.format(role=m["role"], content=m["content"]))
+    return "".join(parts) + template.format(role="assistant", content="").rstrip("\n")
 
 
 def list_models(ctx: Any) -> Any:
@@ -115,14 +144,16 @@ def _sampler(body: dict) -> Any:
         raise HTTPError(400, f"invalid sampling params: {exc}")
 
 
-def completions(ctx: Any) -> Any:
+def _parse_request(ctx: Any, default_max: int) -> tuple:
+    """Shared request parse for both endpoints: (body, max_tokens,
+    sampler, stop_ids, want_logprobs, adapter). One home, so a knob added
+    to completions cannot silently miss chat (they drifted once)."""
     if ctx.tpu is None:
         raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
     body = ctx.bind() if ctx.request.body else {}
     if not isinstance(body, dict):
         raise HTTPError(400, "request body must be a JSON object")
-    prompt_ids = _prompt_tokens(ctx, body.get("prompt", [1, 2, 3]))
-    max_tokens = body.get("max_tokens", 16)
+    max_tokens = body.get("max_tokens", default_max)
     if not isinstance(max_tokens, int) or max_tokens < 1:
         raise HTTPError(400, '"max_tokens" must be a positive integer')
     sampler = _sampler(body)
@@ -131,6 +162,18 @@ def completions(ctx: Any) -> Any:
     adapter = body.get("adapter")  # multi-LoRA extension
     if adapter is not None and not isinstance(adapter, str):
         raise HTTPError(400, '"adapter" must be a string')
+    return body, max_tokens, sampler, stop_ids, want_logprobs, adapter
+
+
+def completions(ctx: Any) -> Any:
+    body, max_tokens, sampler, stop_ids, want_logprobs, adapter = (
+        _parse_request(ctx, default_max=16)
+    )
+    if "prompt" not in body:
+        # a missing prompt is almost always a caller bug (misspelled key):
+        # generating from a magic default would 200 on garbage
+        raise HTTPError(400, 'missing "prompt"')
+    prompt_ids = _prompt_tokens(ctx, body["prompt"])
     model = ctx.tpu.model_name
     created = int(time.time())
     cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
@@ -212,6 +255,101 @@ def completions(ctx: Any) -> Any:
         "created": created,
         "model": model,
         "choices": [choice],
+        "usage": {
+            "prompt_tokens": len(prompt_ids),
+            "completion_tokens": len(out),
+            "total_tokens": len(prompt_ids) + len(out),
+        },
+    })
+
+
+def chat_completions(ctx: Any) -> Any:
+    """Messages -> assistant message. Same generation core as
+    ``completions``; only the prompt construction (chat template) and the
+    response shapes (chat.completion / chat.completion.chunk with deltas)
+    differ."""
+    body, max_tokens, sampler, stop_ids, want_logprobs, adapter = (
+        _parse_request(ctx, default_max=64)
+    )
+    tok = ctx.tpu.tokenizer
+    if tok is None:
+        raise HTTPError(
+            400, "chat completions need a tokenizer (set TOKENIZER_PATH)"
+        )
+    prompt_text = render_chat_prompt(ctx, body.get("messages"))
+    prompt_ids = tok.encode(prompt_text)
+    if not prompt_ids:
+        raise HTTPError(400, "messages encoded to zero tokens")
+    model = ctx.tpu.model_name
+    created = int(time.time())
+    chat_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+
+    if body.get("stream"):
+        import json as _json
+
+        from gofr_tpu.http.response import Stream
+
+        stream_iter = ctx.tpu.generate_stream(
+            prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+            adapter=adapter, logprobs=want_logprobs,
+        )
+
+        def chunk(delta: dict, finish: Any = None, lp: Any = None) -> str:
+            choice: dict[str, Any] = {
+                "index": 0, "delta": delta, "finish_reason": finish,
+            }
+            if want_logprobs:
+                choice["logprobs"] = (
+                    {"token_logprobs": [lp]} if lp is not None else None
+                )
+            return _json.dumps({
+                "id": chat_id, "object": "chat.completion.chunk",
+                "created": created, "model": model, "choices": [choice],
+            })
+
+        def events():
+            n = 0
+            dec = tok.stream_decoder()
+            yield chunk({"role": "assistant"})  # role arrives first
+            try:
+                for item in stream_iter:
+                    token, lp = item if want_logprobs else (item, None)
+                    n += 1
+                    text = dec.feed(token)
+                    if text or lp is not None:
+                        yield chunk({"content": text}, lp=lp)
+                tail = dec.flush()
+                if tail:
+                    yield chunk({"content": tail})
+                yield chunk({}, "length" if n >= max_tokens else "stop")
+                yield "[DONE]"
+            except Exception as exc:
+                yield _json.dumps({"error": {"message": str(exc)}})
+
+        return Stream(events())
+
+    out = ctx.tpu.generate(
+        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+        adapter=adapter, logprobs=want_logprobs,
+    )
+    logprobs = None
+    if want_logprobs:
+        out, logprobs = out
+    from gofr_tpu.http.response import Raw
+
+    return Raw({
+        "id": chat_id,
+        "object": "chat.completion",
+        "created": created,
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": tok.decode(out)},
+            "finish_reason": "length" if len(out) >= max_tokens else "stop",
+            "logprobs": (
+                {"token_logprobs": logprobs} if logprobs is not None else None
+            ),
+        }],
         "usage": {
             "prompt_tokens": len(prompt_ids),
             "completion_tokens": len(out),

@@ -13,8 +13,10 @@ import pytest
 pytestmark = pytest.mark.slow
 
 
-@pytest.fixture(scope="module")
-def base(tmp_path_factory):
+def _make_app(tmp_path_factory, label, extra_env=None):
+    """Boot an app with the OpenAI routes under temporary env; shared by
+    the tokenizer-less and byte-tokenizer fixtures so the bootstrap dance
+    (port pick, env save/restore, chdir) exists once."""
     import os
     import socket
 
@@ -26,10 +28,11 @@ def base(tmp_path_factory):
         port = s.getsockname()[1]
     env = {"HTTP_PORT": str(port), "LOG_LEVEL": "FATAL", "MODEL_NAME": "tiny",
            "BATCH_MAX_SIZE": "2", "BATCH_TIMEOUT_MS": "1", "DECODE_CHUNK": "4"}
+    env.update(extra_env or {})
     saved = {k: os.environ.get(k) for k in env}
     os.environ.update(env)
     cwd = os.getcwd()
-    os.chdir(tmp_path_factory.mktemp("openai"))
+    os.chdir(tmp_path_factory.mktemp(label))
     try:
         app = gofr_tpu.new()
     finally:
@@ -38,6 +41,12 @@ def base(tmp_path_factory):
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
     register_openai_routes(app)
     app.start()
+    return app
+
+
+@pytest.fixture(scope="module")
+def base(tmp_path_factory):
+    app = _make_app(tmp_path_factory, "openai")
     yield f"http://127.0.0.1:{app.http_port}"
     app.shutdown()
 
@@ -139,3 +148,98 @@ def test_completions_validation_errors(base, payload, needle):
     except urllib.error.HTTPError as e:
         assert e.code == 400
         assert needle in e.read(400).decode()
+
+
+# -- chat completions (needs a tokenizer: byte-level over tiny's 256 vocab) --
+
+@pytest.fixture(scope="module")
+def chat_base(tmp_path_factory):
+    app = _make_app(tmp_path_factory, "openai-chat", {"TOKENIZER": "byte"})
+    yield f"http://127.0.0.1:{app.http_port}"
+    app.shutdown()
+
+
+def test_chat_completion_shape(chat_base):
+    status, body = _post(chat_base, {
+        "messages": [{"role": "system", "content": "be brief"},
+                     {"role": "user", "content": "hi"}],
+        "max_tokens": 6, "temperature": 0,
+    }, path="/v1/chat/completions")
+    assert status == 200
+    assert body["object"] == "chat.completion"
+    assert body["id"].startswith("chatcmpl-")
+    msg = body["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+    assert isinstance(msg["content"], str)
+    assert body["choices"][0]["finish_reason"] == "length"
+    # prompt = rendered template bytes: usage must count them exactly
+    rendered = "[system]: be brief\n[user]: hi\n[assistant]: "
+    assert body["usage"]["prompt_tokens"] == len(rendered.encode())
+    assert body["usage"]["completion_tokens"] == 6
+
+
+def test_chat_completion_stream_deltas(chat_base):
+    req = urllib.request.Request(
+        chat_base + "/v1/chat/completions",
+        data=json.dumps({"messages": [{"role": "user", "content": "go"}],
+                         "max_tokens": 4, "temperature": 0,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        raw = resp.read().decode()
+    events = [ln[len("data: "):] for ln in raw.splitlines()
+              if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    parsed = [json.loads(e) for e in events[:-1]]
+    assert all(p["object"] == "chat.completion.chunk" for p in parsed)
+    assert parsed[0]["choices"][0]["delta"] == {"role": "assistant"}
+    assert parsed[-1]["choices"][0]["finish_reason"] == "length"
+    content = "".join(
+        p["choices"][0]["delta"].get("content", "") for p in parsed
+    )
+    # streamed deltas must reassemble to exactly the non-stream content
+    # (raw bytes may be invalid UTF-8 from an untrained model — both
+    # paths share the replacement-char policy)
+    _, blocking = _post(chat_base, {
+        "messages": [{"role": "user", "content": "go"}],
+        "max_tokens": 4, "temperature": 0,
+    }, path="/v1/chat/completions")
+    assert content == blocking["choices"][0]["message"]["content"]
+
+
+def test_chat_without_tokenizer_400(base):
+    try:
+        _post(base, {"messages": [{"role": "user", "content": "x"}]},
+              path="/v1/chat/completions")
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "tokenizer" in e.read(300).decode()
+
+
+def test_chat_bad_messages_400(chat_base):
+    for bad in ([], [{"role": "user"}], "hi", [{"role": 1, "content": "x"}]):
+        try:
+            _post(chat_base, {"messages": bad}, path="/v1/chat/completions")
+            raise AssertionError(f"expected 400 for {bad!r}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
+def test_completions_missing_prompt_400(base):
+    try:
+        _post(base, {"max_tokens": 3})
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "prompt" in e.read(200).decode()
+
+
+def test_chat_logprobs(chat_base):
+    _, body = _post(chat_base, {
+        "messages": [{"role": "user", "content": "x"}],
+        "max_tokens": 3, "temperature": 0, "logprobs": True,
+    }, path="/v1/chat/completions")
+    lps = body["choices"][0]["logprobs"]["token_logprobs"]
+    assert len(lps) == 3 and all(lp <= 0.0 for lp in lps)

@@ -221,12 +221,12 @@ def main() -> int:
         except Exception as exc:  # missing tf, truncated .xplane.pb, ...
             print(f"trace summary skipped: {exc!r}", file=sys.stderr)
     if args.ablate:
-        # dequant cost: same shapes, bf16 weights
-        results += run_grid(args.model, "", buckets[-1:], batches[-1:],
-                            None, args.max_seq, None)
-        # MXU int8 path: W8A8 at the largest shape (MFU vs the int8 peak)
-        results += run_grid(args.model, "w8a8", buckets[-1:], batches[-1:],
-                            None, args.max_seq, None)
+        # quant ablations at the largest shape (skip whichever mode the
+        # main grid already ran — each is minutes of XLA compile)
+        for mode in ("", "w8a8"):
+            if args.quant != mode:
+                results += run_grid(args.model, mode, buckets[-1:],
+                                    batches[-1:], None, args.max_seq, None)
         # attention impl: pallas flash vs xla at the largest shape
         for attn in ("xla", "pallas"):
             results += run_grid(args.model, args.quant, buckets[-1:],
