@@ -9,7 +9,11 @@ translation shim. ``register_openai_routes(app)`` adds:
   switches to SSE chunks terminated by ``data: [DONE]``.
 - ``POST /v1/chat/completions`` — messages in, assistant message out
   (requires a tokenizer; the prompt is rendered through CHAT_TEMPLATE,
-  default ``[{role}]: {content}\\n`` per message + ``[assistant]: ``).
+  default ``[{role}]: {content}\\n`` per message, and the assistant-turn
+  opener is everything the template puts BEFORE {content} — override
+  with CHAT_TEMPLATE_OPENER for formats that need more).
+- ``POST /v1/embeddings`` — encoder models (MODEL_NAME=bert-*); multi-
+  item inputs pack into one batcher dispatch.
 - ``GET /v1/models`` — the single served model, from MODEL_NAME.
 
 Scope: the completions shape (prompt string or token list, max_tokens,
@@ -32,10 +36,129 @@ from gofr_tpu.errors import HTTPError
 def register_openai_routes(app: Any) -> None:
     app.post("/v1/completions", completions)
     app.post("/v1/chat/completions", chat_completions)
+    app.post("/v1/embeddings", embeddings)
     app.get("/v1/models", list_models)
 
 
+async def embeddings(ctx: Any) -> Any:
+    """OpenAI embeddings shape over an encoder model (MODEL_NAME=bert-*).
+    ``input`` is a string, list of strings, token-id list, or list of
+    id lists; items run through the dynamic batcher CONCURRENTLY, so a
+    multi-item request packs into one device dispatch."""
+    import asyncio
+
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    if not ctx.tpu.model_name.startswith("bert"):
+        # checked BEFORE any inference: a decoder deployment must 400 for
+        # free, not run (and cache) a full prefill per item first
+        raise HTTPError(
+            400,
+            "embeddings need an encoder model (MODEL_NAME=bert-tiny or "
+            f"bert-base); '{ctx.tpu.model_name}' is a decoder",
+        )
+    body = ctx.bind() if ctx.request.body else {}
+    if not isinstance(body, dict):
+        raise HTTPError(400, "request body must be a JSON object")
+    raw = body.get("input")
+    if isinstance(raw, str) or (
+        isinstance(raw, list) and raw and all(isinstance(t, int) for t in raw)
+    ):
+        items = [raw]
+    elif isinstance(raw, list) and raw:
+        items = raw
+    else:
+        raise HTTPError(
+            400,
+            '"input" must be a string, list of strings, or token-id list(s)',
+        )
+    tok = ctx.tpu.tokenizer
+
+    def tokenize_items() -> tuple[int, list]:
+        """CPU-bound BPE over possibly many strings — runs in the
+        executor below, never on the event loop (the async handler
+        contract: the loop is for enqueueing, not computing)."""
+        n = 0
+        payloads = []
+        for item in items:
+            if isinstance(item, str):
+                if tok is None:
+                    raise HTTPError(
+                        400,
+                        "string input needs a tokenizer (set TOKENIZER_PATH)",
+                    )
+                ids = tok.encode(item)
+            elif isinstance(item, list) and item and all(
+                isinstance(t, int) for t in item
+            ):
+                ids = item
+            else:
+                raise HTTPError(400, f"invalid input item: {item!r:.80}")
+            if not ids:
+                raise HTTPError(400, "input item encoded to zero tokens")
+            n += len(ids)
+            payloads.append({"tokens": ids})
+        return n, payloads
+
+    loop = asyncio.get_running_loop()
+    n_tokens, payloads = await loop.run_in_executor(None, tokenize_items)
+    results = await asyncio.gather(
+        *(ctx.tpu.infer_async(p) for p in payloads)
+    )
+
+    def to_rows() -> list:
+        import numpy as np
+
+        return [
+            {
+                "object": "embedding",
+                "index": i,
+                "embedding": np.asarray(out).reshape(-1).tolist(),
+            }
+            for i, out in enumerate(results)
+        ]
+
+    data = await loop.run_in_executor(None, to_rows)
+    from gofr_tpu.http.response import Raw
+
+    return Raw({
+        "object": "list",
+        "model": ctx.tpu.model_name,
+        "data": data,
+        "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+    })
+
+
 DEFAULT_CHAT_TEMPLATE = "[{role}]: {content}\n"
+
+_SENTINEL = "\x00GOFR_CONTENT\x00"
+
+
+def _chat_template(ctx: Any) -> tuple[str, str]:
+    """(template, assistant opener), both validated — a broken operator
+    template must be a clear error, not a per-request 500 from str.format
+    or silently dropped message content. The opener is everything the
+    template renders BEFORE the content slot for role=assistant (correct
+    for markup-wrapped formats like ChatML, where stripping trailing
+    newlines would emit a CLOSED empty assistant turn); override with
+    CHAT_TEMPLATE_OPENER when a format needs something else."""
+    template = ctx.config.get_or_default("CHAT_TEMPLATE", DEFAULT_CHAT_TEMPLATE)
+    try:
+        probe = template.format(role="assistant", content=_SENTINEL)
+    except (KeyError, IndexError, ValueError) as exc:
+        raise HTTPError(
+            500,
+            f"CHAT_TEMPLATE is invalid ({exc!r}) — it must use only "
+            "{role} and {content} placeholders",
+        )
+    if _SENTINEL not in probe:
+        raise HTTPError(
+            500, "CHAT_TEMPLATE must contain a {content} placeholder"
+        )
+    opener = ctx.config.get_or_default(
+        "CHAT_TEMPLATE_OPENER", probe.split(_SENTINEL)[0]
+    )
+    return template, opener
 
 
 def render_chat_prompt(ctx: Any, messages: Any) -> str:
@@ -44,7 +167,7 @@ def render_chat_prompt(ctx: Any, messages: Any) -> str:
     checkpoints with their own chat markup set CHAT_TEMPLATE to match."""
     if not isinstance(messages, list) or not messages:
         raise HTTPError(400, '"messages" must be a non-empty list')
-    template = ctx.config.get_or_default("CHAT_TEMPLATE", DEFAULT_CHAT_TEMPLATE)
+    template, opener = _chat_template(ctx)
     parts = []
     for m in messages:
         if (
@@ -57,7 +180,7 @@ def render_chat_prompt(ctx: Any, messages: Any) -> str:
                 'each message must be {"role": str, "content": str}',
             )
         parts.append(template.format(role=m["role"], content=m["content"]))
-    return "".join(parts) + template.format(role="assistant", content="").rstrip("\n")
+    return "".join(parts) + opener
 
 
 def list_models(ctx: Any) -> Any:

@@ -243,3 +243,82 @@ def test_chat_logprobs(chat_base):
     }, path="/v1/chat/completions")
     lps = body["choices"][0]["logprobs"]["token_logprobs"]
     assert len(lps) == 3 and all(lp <= 0.0 for lp in lps)
+
+
+# -- embeddings (encoder models: BASELINE config 2's OpenAI face) ------------
+
+@pytest.fixture(scope="module")
+def embed_base(tmp_path_factory):
+    app = _make_app(tmp_path_factory, "openai-embed",
+                    {"MODEL_NAME": "bert-tiny"})
+    yield f"http://127.0.0.1:{app.http_port}"
+    app.shutdown()
+
+
+def test_embeddings_single_and_batch(embed_base):
+    status, body = _post(embed_base, {"input": [1, 2, 3]},
+                         path="/v1/embeddings")
+    assert status == 200
+    assert body["object"] == "list"
+    assert body["data"][0]["object"] == "embedding"
+    dim = len(body["data"][0]["embedding"])
+    assert dim == 128  # bert-tiny hidden size
+    assert body["usage"] == {"prompt_tokens": 3, "total_tokens": 3}
+    # multi-item: one embedding per input, indexed
+    _, multi = _post(embed_base, {"input": [[1, 2, 3], [4, 5]]},
+                     path="/v1/embeddings")
+    assert [d["index"] for d in multi["data"]] == [0, 1]
+    assert multi["usage"]["prompt_tokens"] == 5
+    # same ids => same vector
+    assert multi["data"][0]["embedding"] == body["data"][0]["embedding"]
+
+
+def test_embeddings_decoder_model_400(base):
+    try:
+        _post(base, {"input": [1, 2, 3]}, path="/v1/embeddings")
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "encoder" in e.read(300).decode()
+
+
+def test_embeddings_bad_input_400(embed_base):
+    for bad in (None, [], "", [[]], [1.5]):
+        try:
+            _post(embed_base, {"input": bad}, path="/v1/embeddings")
+            raise AssertionError(f"expected 400 for {bad!r}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
+def test_chat_template_opener_derivation(chat_base):
+    """ChatML-style markup AFTER {content}: the opener must stop at the
+    content slot, not emit a closed empty assistant turn."""
+    import os
+
+    os.environ["CHAT_TEMPLATE"] = "<|s|>{role}\n{content}<|e|>\n"
+    try:
+        _, body = _post(chat_base, {
+            "messages": [{"role": "user", "content": "q"}],
+            "max_tokens": 2, "temperature": 0,
+        }, path="/v1/chat/completions")
+        rendered = "<|s|>user\nq<|e|>\n<|s|>assistant\n"
+        assert body["usage"]["prompt_tokens"] == len(rendered.encode())
+    finally:
+        os.environ.pop("CHAT_TEMPLATE", None)
+
+
+def test_chat_template_invalid_is_clear_error(chat_base):
+    import os
+
+    for bad in ("{role}: {contnet}\n", "{role} {content} {", "{role} only\n"):
+        os.environ["CHAT_TEMPLATE"] = bad
+        try:
+            _post(chat_base, {"messages": [{"role": "user", "content": "x"}]},
+                  path="/v1/chat/completions")
+            raise AssertionError(f"expected error for template {bad!r}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+            assert "CHAT_TEMPLATE" in e.read(300).decode()
+        finally:
+            os.environ.pop("CHAT_TEMPLATE", None)
