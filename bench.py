@@ -202,9 +202,14 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
     async def infer(ctx):
         payload = ctx.bind()
         state = await ctx.tpu.infer_async(payload["tokens"])
-        # next_token was argmaxed on device; reading state["logits"] here
-        # would add a [V]-row device fetch per request
-        return {"next_token": state["next_token"]}
+        if isinstance(state, dict):
+            # next_token was argmaxed on device; reading state["logits"]
+            # here would add a [V]-row device fetch per request
+            return {"next_token": state["next_token"]}
+        # MLP/BERT runners return a numpy vector (BASELINE configs 1-2):
+        # its length is enough proof of life — returning the values would
+        # time JSON serialization, not the model
+        return {"dim": int(state.size)}
 
     def generate(ctx):
         payload = ctx.bind()

@@ -73,6 +73,12 @@ async def embeddings(ctx: Any) -> Any:
             '"input" must be a string, list of strings, or token-id list(s)',
         )
     tok = ctx.tpu.tokenizer
+    # the encoder pads/slices to one fixed bucket: over-long input must
+    # 400 (OpenAI behavior), never silently embed a truncated prefix
+    # while usage reports the full count. wait_ready: the bucket lives on
+    # the runner, which a background boot builds late.
+    ctx.tpu.wait_ready(60.0)
+    bucket = getattr(ctx.tpu.runner, "bucket", None)
 
     def tokenize_items() -> tuple[int, list]:
         """CPU-bound BPE over possibly many strings — runs in the
@@ -96,6 +102,12 @@ async def embeddings(ctx: Any) -> Any:
                 raise HTTPError(400, f"invalid input item: {item!r:.80}")
             if not ids:
                 raise HTTPError(400, "input item encoded to zero tokens")
+            if bucket is not None and len(ids) > bucket:
+                raise HTTPError(
+                    400,
+                    f"input item is {len(ids)} tokens; this encoder "
+                    f"accepts at most {bucket}",
+                )
             n += len(ids)
             payloads.append({"tokens": ids})
         return n, payloads

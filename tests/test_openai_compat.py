@@ -322,3 +322,17 @@ def test_chat_template_invalid_is_clear_error(chat_base):
             assert "CHAT_TEMPLATE" in e.read(300).decode()
         finally:
             os.environ.pop("CHAT_TEMPLATE", None)
+
+
+def test_embeddings_overlong_input_400(embed_base):
+    """Over-long input must 400 (OpenAI behavior) — the encoder would
+    silently embed a truncated prefix while usage reported the full
+    count."""
+    try:
+        _post(embed_base, {"input": list(range(1, 200))},
+              path="/v1/embeddings")
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        body = e.read(300).decode()
+        assert "128" in body and "199" in body
