@@ -120,3 +120,20 @@ def test_adapter_shares_base_arrays(adapter_paths):
         # the wrapped tree's base leaves ARE the served base arrays
         assert wrapped["layers"]["wq"]["w"] is dev.runner.params["layers"]["wq"]
         assert wrapped["embed"] is dev.runner.params["embed"]
+
+
+def test_adapters_serve_over_w8a8_base(adapter_paths):
+    """Multi-LoRA SERVING over a w8a8 base works (forward-only: the
+    zero-gradient activation round only matters for training, which
+    add_lora rejects). The adapter must still change behavior."""
+    _, paths = adapter_paths
+    name, (path, _) = next(iter(paths.items()))
+    with serving_device(
+        LORA_ADAPTERS=f"{name}={path}", MODEL_QUANT="w8a8", DECODE_CHUNK="4"
+    ) as dev:
+        assert set(dev.runner.params["layers"]["wq"]) == {"q8", "scale"}
+        prompt = [1, 2, 3]
+        base_out = dev.generate(prompt, max_new_tokens=8)
+        adapted = dev.generate(prompt, max_new_tokens=8, adapter=name)
+        assert len(adapted) == 8
+        assert adapted != base_out  # the adapter is live over the q8 base
