@@ -288,6 +288,23 @@ def _parse_request(ctx: Any, default_max: int) -> tuple:
     body = ctx.bind() if ctx.request.body else {}
     if not isinstance(body, dict):
         raise HTTPError(400, "request body must be a JSON object")
+    # protocol knobs this server does not implement must be a clear 400
+    # when they would change output — never a silent ignore (no-op values
+    # like n=1 or zero penalties pass). repetition_penalty (CTRL-style)
+    # is the supported native alternative to the OpenAI penalties.
+    for key, noop in (
+        ("n", 1), ("best_of", 1), ("echo", False), ("suffix", None),
+        ("presence_penalty", 0), ("frequency_penalty", 0),
+    ):
+        value = body.get(key, noop)
+        if value != noop and value is not None:
+            hint = (
+                " (use repetition_penalty instead)"
+                if key.endswith("_penalty") else ""
+            )
+            raise HTTPError(
+                400, f'"{key}" is not supported by this server{hint}'
+            )
     max_tokens = body.get("max_tokens", default_max)
     if not isinstance(max_tokens, int) or max_tokens < 1:
         raise HTTPError(400, '"max_tokens" must be a positive integer')

@@ -336,3 +336,22 @@ def test_embeddings_overlong_input_400(embed_base):
         assert e.code == 400
         body = e.read(300).decode()
         assert "128" in body and "199" in body
+
+
+def test_unsupported_openai_knobs_400_not_silent(base):
+    """n>1 / best_of / echo / suffix / penalties would change output if
+    honored — refusing loudly beats silently returning something else.
+    No-op values (n=1, zero penalties) pass."""
+    ok = {"prompt": [1, 2], "max_tokens": 2, "n": 1,
+          "presence_penalty": 0, "frequency_penalty": 0}
+    status, _ = _post(base, ok)
+    assert status == 200
+    for key, value in (("n", 2), ("best_of", 3), ("echo", True),
+                       ("suffix", "tail"), ("presence_penalty", 0.5),
+                       ("frequency_penalty", -1)):
+        try:
+            _post(base, {"prompt": [1, 2], "max_tokens": 2, key: value})
+            raise AssertionError(f"expected 400 for {key}={value}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert key in e.read(300).decode()
