@@ -59,7 +59,12 @@ def _kernel(
     k_ref,  # [1, 1, Skv_pad, D]
     v_ref,  # [1, 1, Skv_pad, D]
     out_ref,  # [1, 1, block_q, D]
-    lse_ref,  # [1, 1, block_q] f32: per-row logsumexp (backward residual)
+    lse_ref,  # [1, 1, block_q, 1] f32: per-row logsumexp (backward
+    # residual). The trailing singleton is a TPU tiling requirement: the
+    # block's last two dims must be (divisible by 8, divisible by 128) or
+    # equal the array dims — a [1, 1, block_q] block puts a size-1 head
+    # axis second-to-last, which real-TPU lowering rejects (interpret
+    # mode does not check; the r04 hardware sweep caught it)
     *,
     causal: bool,
     scale: float,
@@ -134,7 +139,7 @@ def _kernel(
     # logsumexp residual for the fused backward; +inf on fully-masked rows
     # makes their recomputed probabilities exp(-1e30 - inf) = 0 there
     lse = jnp.where(l > 0.0, m + jnp.log(l), jnp.inf)
-    lse_ref[0, 0, :] = lse[:, 0]
+    lse_ref[0, 0, :, :] = lse
 
 
 def _pad_axis(x: jnp.ndarray, axis: int, to: int) -> jnp.ndarray:
@@ -202,7 +207,9 @@ def _flash_fwd_impl(
             pl.BlockSpec(
                 (1, 1, block_q, d), lambda bi, h, qi, *_: (bi, h, qi, 0)
             ),
-            pl.BlockSpec((1, 1, block_q), lambda bi, h, qi, *_: (bi, h, qi)),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda bi, h, qi, *_: (bi, h, qi, 0)
+            ),
         ],
     )
 
@@ -219,7 +226,7 @@ def _flash_fwd_impl(
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, sq_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b, hq, sq_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sq_pad, 1), jnp.float32),
         ],
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
@@ -228,7 +235,7 @@ def _flash_fwd_impl(
             transcendentals=b * hq * sq * skv,
         ),
     )(offsets, kv_lens, qt, kt, vt)
-    return jnp.swapaxes(out[:, :, :sq, :], 1, 2), lse[:, :, :sq]
+    return jnp.swapaxes(out[:, :, :sq, :], 1, 2), lse[:, :, :sq, 0]
 
 
 def _dq_kernel(
@@ -238,8 +245,8 @@ def _dq_kernel(
     k_ref,  # [1, 1, Skv_pad, D]
     v_ref,  # [1, 1, Skv_pad, D]
     do_ref,  # [1, 1, block_q, D]
-    lse_ref,  # [1, 1, block_q] f32
-    dvec_ref,  # [1, 1, block_q] f32: D = rowsum(dO ⊙ O)
+    lse_ref,  # [1, 1, block_q, 1] f32 (trailing 1: TPU tiling, see _kernel)
+    dvec_ref,  # [1, 1, block_q, 1] f32: D = rowsum(dO ⊙ O)
     dq_ref,  # [1, 1, block_q, D] f32
     *,
     causal: bool,
@@ -258,8 +265,8 @@ def _dq_kernel(
 
     qb = q_ref[0, 0, :, :]
     dob = do_ref[0, 0, :, :].astype(jnp.float32)
-    lse = lse_ref[0, 0, :][:, None]  # [block_q, 1]
-    dvec = dvec_ref[0, 0, :][:, None]  # [block_q, 1]
+    lse = lse_ref[0, 0, :, :]  # [block_q, 1]
+    dvec = dvec_ref[0, 0, :, :]  # [block_q, 1]
 
     q_pos = (
         offset + qi * block_q
@@ -307,8 +314,8 @@ def _dkv_kernel(
     k_ref,  # [1, 1, block_kv, D]
     v_ref,  # [1, 1, block_kv, D]
     do_ref,  # [1, 1, Sq_pad, D]
-    lse_ref,  # [1, 1, Sq_pad] f32
-    dvec_ref,  # [1, 1, Sq_pad] f32
+    lse_ref,  # [1, 1, Sq_pad, 1] f32 (trailing 1: TPU tiling, see _kernel)
+    dvec_ref,  # [1, 1, Sq_pad, 1] f32
     dk_ref,  # [1, 1, block_kv, D] f32 — revisited across the g grid axis
     dv_ref,  # [1, 1, block_kv, D] f32
     *,
@@ -350,8 +357,8 @@ def _dkv_kernel(
         dk_acc, dv_acc = carry
         qb = q_ref[0, 0, pl.ds(qi * block_q, block_q), :]
         dob = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
-        dvec = dvec_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), :]
+        dvec = dvec_ref[0, 0, pl.ds(qi * block_q, block_q), :]
 
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
@@ -443,8 +450,8 @@ def _flash_bwd_impl(
         dot.astype(jnp.float32)
         * _pad_axis(jnp.swapaxes(out, 1, 2), 2, sq_pad).astype(jnp.float32),
         axis=-1,
-    )  # [B, Hq, Sq_pad]
-    lse_pad = _pad_axis(lse, 2, sq_pad)
+    )[..., None]  # [B, Hq, Sq_pad, 1] — trailing 1: TPU tiling (see _kernel)
+    lse_pad = _pad_axis(lse, 2, sq_pad)[..., None]
 
     dq_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -460,8 +467,8 @@ def _flash_bwd_impl(
                 lambda bi, h, qi, *_, g_=groups: (bi, h // g_, 0, 0),
             ),
             pl.BlockSpec((1, 1, block_q, d), lambda bi, h, qi, *_: (bi, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, h, qi, *_: (bi, h, qi)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, h, qi, *_: (bi, h, qi)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, h, qi, *_: (bi, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, h, qi, *_: (bi, h, qi, 0)),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, block_q, d), lambda bi, h, qi, *_: (bi, h, qi, 0)
@@ -498,12 +505,12 @@ def _flash_bwd_impl(
                 lambda bi, h, ki, gi, *_, g_=groups: (bi, h * g_ + gi, 0, 0),
             ),
             pl.BlockSpec(
-                (1, 1, sq_pad),
-                lambda bi, h, ki, gi, *_, g_=groups: (bi, h * g_ + gi, 0),
+                (1, 1, sq_pad, 1),
+                lambda bi, h, ki, gi, *_, g_=groups: (bi, h * g_ + gi, 0, 0),
             ),
             pl.BlockSpec(
-                (1, 1, sq_pad),
-                lambda bi, h, ki, gi, *_, g_=groups: (bi, h * g_ + gi, 0),
+                (1, 1, sq_pad, 1),
+                lambda bi, h, ki, gi, *_, g_=groups: (bi, h * g_ + gi, 0, 0),
             ),
         ],
         out_specs=[
