@@ -362,6 +362,10 @@ def decode_chunk(
     min_p: jnp.ndarray | float = 0.0,
     presence: Optional[jnp.ndarray] = None,
     repetition_penalty: jnp.ndarray | float = 1.0,
+    counts: Optional[jnp.ndarray] = None,
+    presence_penalty: jnp.ndarray | float = 0.0,
+    frequency_penalty: jnp.ndarray | float = 0.0,
+    bias: jnp.ndarray | float = 0.0,
     with_logprobs: bool = False,
 ) -> tuple:
     """``n_steps`` autoregressive steps in ONE dispatch: decode + on-device
@@ -371,30 +375,42 @@ def decode_chunk(
     token; returns sampled tokens [B, n_steps] + the advanced cache.
     temperature/top_k/top_p/min_p are dynamic (0 temperature = greedy).
 
-    ``presence`` [B, V] bool (context-token mask) turns on the CTRL
-    repetition penalty: logits are penalized before the greedy/sampled
-    split and freshly sampled tokens join the mask inside the scan; the
-    updated mask is returned as an extra output.
+    ``presence`` [B, V] bool (context-token mask) turns on the penalized
+    path: logits go through ``apply_penalties`` (CTRL repetition penalty
+    over the context mask, plus the additive OpenAI presence/frequency
+    penalties over the GENERATED-token ``counts`` [B, V] f32, plus the
+    constant ``bias`` [B, V] f32 logit_bias row) before the greedy/sampled
+    split, and freshly sampled tokens join presence and counts inside the
+    scan; the updated mask and counts come back as extra outputs. All
+    penalty knobs are dynamic operands — every combination shares one
+    executable.
 
     ``with_logprobs`` (static) also returns the chosen tokens' RAW model
     log-probabilities [B, n_steps] f32 — log-softmax of the unpenalized
     logits, the standard serving-API logprob — as the last output."""
     from gofr_tpu.ops.sampling import (
-        apply_repetition_penalty,
+        apply_penalties,
         sample_logits,
+        update_counts,
         update_presence,
     )
+
+    if presence is not None and counts is None:
+        counts = jnp.zeros(presence.shape, jnp.float32)
 
     def body(carry, _):
         if presence is None:
             tok, c, k = carry
         else:
-            tok, c, k, pres = carry
+            tok, c, k, pres, cnt = carry
         logits, c = decode_step(params, tok, c, cfg)
         k, sub = jax.random.split(k)
         sample_in = (
             logits if presence is None
-            else apply_repetition_penalty(logits, pres, repetition_penalty)
+            else apply_penalties(
+                logits, pres, repetition_penalty, cnt,
+                presence_penalty, frequency_penalty, bias,
+            )
         )
         nxt = sample_logits(sample_in, sub, temperature, top_k, top_p, min_p)
         outs = nxt
@@ -407,15 +423,19 @@ def decode_chunk(
         if presence is None:
             return (nxt[:, None], c, k), outs
         pres = update_presence(pres, nxt)
-        return (nxt[:, None], c, k, pres), outs
+        cnt = update_counts(cnt, nxt)
+        return (nxt[:, None], c, k, pres, cnt), outs
 
-    carry0 = (token, cache, key) if presence is None else (token, cache, key, presence)
+    carry0 = (
+        (token, cache, key) if presence is None
+        else (token, cache, key, presence, counts)
+    )
     carry, outs = jax.lax.scan(body, carry0, None, length=n_steps)
     cache = carry[1]
     toks, lps = outs if with_logprobs else (outs, None)
     result: tuple = (jnp.transpose(toks), cache)
     if presence is not None:
-        result = result + (carry[3],)
+        result = result + (carry[3], carry[4])
     if with_logprobs:
         result = result + (jnp.transpose(lps),)
     return result

@@ -273,8 +273,14 @@ def _sampler(body: dict) -> Any:
         # pass the WHOLE body through the shared parse so every natively
         # supported knob (top_k, min_p, repetition_penalty, seed) works
         # here too — only the defaults differ: OpenAI semantics default
-        # to temperature 1.0 (the native /generate defaults to greedy)
-        return Sampler.from_body({"temperature": 1.0, "top_p": 1.0, **body})
+        # to temperature 1.0 (the native /generate defaults to greedy).
+        # Explicit nulls are stripped BEFORE the merge so "temperature":
+        # null falls back to the OpenAI default here, not from_body's
+        # greedy default (the OpenAI fields are nullable).
+        return Sampler.from_body({
+            "temperature": 1.0, "top_p": 1.0,
+            **{k: v for k, v in body.items() if v is not None},
+        })
     except (TypeError, ValueError) as exc:
         raise HTTPError(400, f"invalid sampling params: {exc}")
 
@@ -290,22 +296,20 @@ def _parse_request(ctx: Any, default_max: int) -> tuple:
         raise HTTPError(400, "request body must be a JSON object")
     # protocol knobs this server does not implement must be a clear 400
     # when they would change output — never a silent ignore (no-op values
-    # like n=1 or zero penalties pass). repetition_penalty (CTRL-style)
-    # is the supported native alternative to the OpenAI penalties.
+    # like n=1 pass). presence/frequency penalties run on-device via the
+    # penalized decode chunk (Sampler.from_body parses them below).
     for key, noop in (
         ("n", 1), ("best_of", 1), ("echo", False), ("suffix", None),
-        ("presence_penalty", 0), ("frequency_penalty", 0),
     ):
         value = body.get(key, noop)
         if value != noop and value is not None:
-            hint = (
-                " (use repetition_penalty instead)"
-                if key.endswith("_penalty") else ""
-            )
             raise HTTPError(
-                400, f'"{key}" is not supported by this server{hint}'
+                400, f'"{key}" is not supported by this server'
             )
-    max_tokens = body.get("max_tokens", default_max)
+    # nullable like the sampling knobs: explicit JSON null = the default
+    max_tokens = body.get("max_tokens")
+    if max_tokens is None:
+        max_tokens = default_max
     if not isinstance(max_tokens, int) or max_tokens < 1:
         raise HTTPError(400, '"max_tokens" must be a positive integer')
     sampler = _sampler(body)

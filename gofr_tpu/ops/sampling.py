@@ -37,6 +37,69 @@ def apply_repetition_penalty(
     return jnp.where(presence, penalized, logits)
 
 
+def apply_penalties(
+    logits: jnp.ndarray,
+    presence: jnp.ndarray,
+    repetition_penalty: jnp.ndarray | float,
+    counts: jnp.ndarray,
+    presence_penalty: jnp.ndarray | float = 0.0,
+    frequency_penalty: jnp.ndarray | float = 0.0,
+    bias: jnp.ndarray | float = 0.0,
+) -> jnp.ndarray:
+    """All sampling penalties in one place. ``presence`` [B, V] bool
+    covers the whole context (prompt + generated) and drives the CTRL
+    repetition penalty; ``counts`` [B, V] f32 counts GENERATED tokens only
+    and drives the additive OpenAI penalties: ``presence_penalty`` is
+    subtracted once for any token already generated, ``frequency_penalty``
+    once per occurrence. ``bias`` [B, V] f32 is the OpenAI ``logit_bias``
+    row (added last — ±100 bans/forces a token regardless of the other
+    penalties). All knob operands are dynamic — one compiled penalized
+    executable serves every combination."""
+    logits = apply_repetition_penalty(logits, presence, repetition_penalty)
+    counts = counts.astype(jnp.float32)
+    presence_penalty = jnp.asarray(presence_penalty, jnp.float32)
+    frequency_penalty = jnp.asarray(frequency_penalty, jnp.float32)
+    return (
+        logits
+        - presence_penalty * (counts > 0).astype(jnp.float32)
+        - frequency_penalty * counts
+        + bias
+    )
+
+
+def check_bias_ids(logit_bias: dict, vocab_size: int) -> None:
+    """Raise ValueError if any ``logit_bias`` token id falls outside the
+    vocab (map to a 400 — a silently dropped ban is worse than a
+    refusal). The ONE home for this rule: the row builder below and the
+    streaming path's eager pre-commit check both call it, so the
+    streaming and non-streaming 400s cannot drift."""
+    for tok in logit_bias:
+        if not 0 <= tok < vocab_size:
+            raise ValueError(
+                f'"logit_bias" token id {tok} outside vocab [0, {vocab_size})'
+            )
+
+
+def bias_row_from_map(logit_bias: dict, vocab_size: int) -> jnp.ndarray:
+    """[1, V] f32 additive-bias row from a validated ``{token_id: bias}``
+    map (host-side build, one upload per biased request). Raises
+    ValueError on out-of-vocab ids via ``check_bias_ids``."""
+    import numpy as np
+
+    check_bias_ids(logit_bias, vocab_size)
+    row = np.zeros((1, vocab_size), np.float32)
+    for tok, bias in logit_bias.items():
+        row[0, tok] = bias
+    return jnp.asarray(row)
+
+
+def update_counts(counts: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Count freshly sampled ``tokens`` [B] into ``counts`` [B, V] f32
+    (inside the decode scan — one scatter-add per step)."""
+    b = counts.shape[0]
+    return counts.at[jnp.arange(b), tokens].add(1.0)
+
+
 def presence_from_tokens(ids: Any, vocab_size: int) -> jnp.ndarray:
     """[1, V] bool presence row for a prompt (host-side build, one upload
     per penalized request)."""
@@ -199,6 +262,9 @@ class Sampler:
         top_p: float = 1.0,
         min_p: float = 0.0,
         repetition_penalty: float = 1.0,
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
+        logit_bias: Optional[dict] = None,
         seed: Optional[int] = None,
     ):
         if temperature < 0:
@@ -211,11 +277,37 @@ class Sampler:
             raise ValueError("min_p must be in [0, 1)")
         if repetition_penalty <= 0.0:
             raise ValueError("repetition_penalty must be > 0")
+        # the OpenAI documented range for both additive penalties
+        if not -2.0 <= presence_penalty <= 2.0:
+            raise ValueError("presence_penalty must be in [-2, 2]")
+        if not -2.0 <= frequency_penalty <= 2.0:
+            raise ValueError("frequency_penalty must be in [-2, 2]")
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.min_p = float(min_p)
         self.repetition_penalty = float(repetition_penalty)
+        self.presence_penalty = float(presence_penalty)
+        self.frequency_penalty = float(frequency_penalty)
+        self.logit_bias: Optional[dict] = None
+        if logit_bias:
+            if not isinstance(logit_bias, dict):
+                raise ValueError('"logit_bias" must be a map of token id to bias')
+            parsed: dict = {}
+            for k, v in logit_bias.items():
+                try:
+                    tok = int(k)  # OpenAI clients send string keys (JSON)
+                    val = float(v)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        '"logit_bias" must map token ids to numbers'
+                    ) from None
+                if not -100.0 <= val <= 100.0:
+                    raise ValueError(
+                        '"logit_bias" values must be in [-100, 100]'
+                    )
+                parsed[tok] = val
+            self.logit_bias = parsed
         self.seeded = seed is not None
         if seed is None:
             # unseeded requests must be genuinely random, not key(0)
@@ -227,21 +319,43 @@ class Sampler:
     @classmethod
     def from_body(cls, body: dict) -> "Sampler":
         """Build from a request body's sampling keys (temperature, top_k,
-        top_p, min_p, repetition_penalty, seed) — the shared parse for
-        HTTP/gRPC handlers.
+        top_p, min_p, repetition_penalty, presence_penalty,
+        frequency_penalty, seed) — the shared parse for HTTP/gRPC
+        handlers. An explicit JSON null means "use the default" (the
+        OpenAI fields are nullable), never a 400.
         Raises ValueError/TypeError on malformed values (map to a 400)."""
+
+        def get(key: str, default):
+            value = body.get(key)
+            return default if value is None else value
+
         return cls(
-            temperature=float(body.get("temperature", 0.0)),
-            top_k=int(body.get("top_k", 0)),
-            top_p=float(body.get("top_p", 1.0)),
-            min_p=float(body.get("min_p", 0.0)),
-            repetition_penalty=float(body.get("repetition_penalty", 1.0)),
+            temperature=float(get("temperature", 0.0)),
+            top_k=int(get("top_k", 0)),
+            top_p=float(get("top_p", 1.0)),
+            min_p=float(get("min_p", 0.0)),
+            repetition_penalty=float(get("repetition_penalty", 1.0)),
+            presence_penalty=float(get("presence_penalty", 0.0)),
+            frequency_penalty=float(get("frequency_penalty", 0.0)),
+            logit_bias=get("logit_bias", None),
             seed=body.get("seed"),
         )
 
     @property
     def greedy(self) -> bool:
         return self.temperature == 0.0
+
+    @property
+    def penalized(self) -> bool:
+        """True when any penalty or logit bias is active: such requests
+        decode solo through the presence/counts/bias chunk variant (the
+        pool stays penalty-free)."""
+        return (
+            self.repetition_penalty != 1.0
+            or self.presence_penalty != 0.0
+            or self.frequency_penalty != 0.0
+            or bool(self.logit_bias)
+        )
 
     def take_key(self) -> jax.Array:
         """Split off a fresh subkey (device-side sampling in decode_chunk)."""

@@ -525,6 +525,19 @@ class TPUDevice:
                     f"adapter '{adapter}' (loaded: "
                     f"{sorted(getattr(self.runner, 'adapters', {}))})"
                 )
+        if sampler is not None and getattr(sampler, "logit_bias", None):
+            # same eager rule for logit_bias: an out-of-vocab id must 400
+            # before the stream commits, not surface as an error frame
+            # after a 200
+            self.wait_ready(600.0)
+            from gofr_tpu.ops.sampling import check_bias_ids
+
+            try:
+                check_bias_ids(sampler.logit_bias, self.runner.cfg.vocab_size)
+            except ValueError as exc:
+                from gofr_tpu.errors import InvalidParamError
+
+                raise InvalidParamError(str(exc)) from None
         return self._stream_iter(
             tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs
         )
@@ -1052,12 +1065,13 @@ class _TransformerRunner:
         def _make_chunk_fn(pen: bool, lp: bool) -> Any:
             if pen:
                 return jax.jit(
-                    lambda p, t, c, key, temp, tk, tp, mp, pres, rp, n:
+                    lambda p, t, c, key, temp, tk, tp, mp, pres, rp, cnt,
+                    pp, fp, bias, n:
                     decode_chunk(
                         p, t, c, cfg, n, key, temp, tk, tp, mp, pres, rp,
-                        with_logprobs=lp,
+                        cnt, pp, fp, bias, with_logprobs=lp,
                     ),
-                    static_argnums=(10,),
+                    static_argnums=(14,),
                 )
             return jax.jit(
                 lambda p, t, c, key, temp, tk, tp, mp, n: decode_chunk(
@@ -1260,23 +1274,45 @@ class _TransformerRunner:
                     self._prefix_store(ids, state)
         out: list[int] = []
         lps: list[float] = []
-        presence = None
-        if sampler.repetition_penalty != 1.0:
+        presence = counts = bias_row = None
+        if sampler.penalized:
             # context presence penalizes the FIRST token too (greedy
-            # argmax included), so the device-argmaxed id is not usable
+            # argmax included), so the device-argmaxed id is not usable;
+            # the additive presence/frequency penalties count GENERATED
+            # tokens only, so their counts row starts at zero here —
+            # logit_bias, by contrast, applies to every step including
+            # this first one
             from gofr_tpu.ops.sampling import (
-                apply_repetition_penalty,
+                apply_penalties,
+                bias_row_from_map,
                 presence_from_tokens,
+                update_counts,
                 update_presence,
             )
 
             presence = presence_from_tokens(ids, self.cfg.vocab_size)
-            logits_pen = apply_repetition_penalty(
+            counts = jnp.zeros(presence.shape, jnp.float32)
+            if sampler.logit_bias:
+                try:
+                    bias_row = bias_row_from_map(
+                        sampler.logit_bias, self.cfg.vocab_size
+                    )
+                except ValueError as exc:
+                    from gofr_tpu.errors import InvalidParamError
+
+                    raise InvalidParamError(str(exc)) from None
+            else:
+                bias_row = jnp.zeros(presence.shape, jnp.float32)
+            logits_pen = apply_penalties(
                 jnp.asarray(state["logits"])[None, :], presence,
-                sampler.repetition_penalty,
+                sampler.repetition_penalty, counts,
+                sampler.presence_penalty, sampler.frequency_penalty,
+                bias_row,
             )
             token = sampler.pick(logits_pen)
-            presence = update_presence(presence, jnp.asarray([token]))
+            first = jnp.asarray([token])
+            presence = update_presence(presence, first)
+            counts = update_counts(counts, first)
         elif sampler.greedy:
             token = state["next_token"]  # device-argmaxed; no logits fetch
         else:
@@ -1375,6 +1411,7 @@ class _TransformerRunner:
         temp, tk, tp = sampler.temperature, sampler.top_k, sampler.top_p
         mp = sampler.min_p
         pen = sampler.repetition_penalty
+        ppen, fpen = sampler.presence_penalty, sampler.frequency_penalty
         pending: "deque" = deque()  # (toks_dev, n_steps)
         token_dev = jnp.asarray([[token]], jnp.int32)
         steps_in_flight = 0
@@ -1398,11 +1435,13 @@ class _TransformerRunner:
                                 tk, tp, mp, n)
                 else:
                     result = fn(prm, token_dev, cache, key, temp,
-                                tk, tp, mp, presence, pen, n)
+                                tk, tp, mp, presence, pen, counts,
+                                ppen, fpen, bias_row, n)
                 toks_dev, cache = result[0], result[1]
                 rest = list(result[2:])
                 if presence is not None:
                     presence = rest.pop(0)
+                    counts = rest.pop(0)
                 lps_dev = rest.pop(0) if logprobs else None
                 token_dev = toks_dev[:, -1:]
                 pending.append((toks_dev, lps_dev, n))

@@ -133,11 +133,16 @@ def test_adapters_serve_over_w8a8_base(adapter_paths):
     ) as dev:
         assert set(dev.runner.params["layers"]["wq"]) == {"q8", "scale"}
         prompt = [1, 2, 3]
-        base_out = dev.generate(prompt, max_new_tokens=8)
-        adapted = dev.generate(prompt, max_new_tokens=8, adapter=name)
-        assert len(adapted) == 8
-        # determinism proves the adapter path executes; strict
-        # adapted != base_out could flake (a few training steps need not
-        # flip any greedy argmax — the sibling float test hedges the
-        # same way)
-        assert adapted == dev.generate(prompt, max_new_tokens=8, adapter=name)
+        base_t, base_lp = dev.generate(prompt, max_new_tokens=8, logprobs=True)
+        ad_t, ad_lp = dev.generate(
+            prompt, max_new_tokens=8, adapter=name, logprobs=True
+        )
+        assert len(ad_t) == 8
+        # the adapter must actually reach the forward: token ids need not
+        # flip (a few training steps may not move any greedy argmax), but
+        # the chosen tokens' logprobs shift whenever the LoRA delta is
+        # consumed — a silently-ignored adapter reproduces BOTH exactly
+        assert (ad_t, ad_lp) != (base_t, base_lp)
+        assert (ad_t, ad_lp) == dev.generate(
+            prompt, max_new_tokens=8, adapter=name, logprobs=True
+        )

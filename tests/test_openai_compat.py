@@ -339,19 +339,85 @@ def test_embeddings_overlong_input_400(embed_base):
 
 
 def test_unsupported_openai_knobs_400_not_silent(base):
-    """n>1 / best_of / echo / suffix / penalties would change output if
-    honored — refusing loudly beats silently returning something else.
-    No-op values (n=1, zero penalties) pass."""
-    ok = {"prompt": [1, 2], "max_tokens": 2, "n": 1,
-          "presence_penalty": 0, "frequency_penalty": 0}
+    """n>1 / best_of / echo / suffix would change output if honored —
+    refusing loudly beats silently returning something else. No-op
+    values (n=1) pass."""
+    ok = {"prompt": [1, 2], "max_tokens": 2, "n": 1}
     status, _ = _post(base, ok)
     assert status == 200
     for key, value in (("n", 2), ("best_of", 3), ("echo", True),
-                       ("suffix", "tail"), ("presence_penalty", 0.5),
-                       ("frequency_penalty", -1)):
+                       ("suffix", "tail")):
         try:
             _post(base, {"prompt": [1, 2], "max_tokens": 2, key: value})
             raise AssertionError(f"expected 400 for {key}={value}")
         except urllib.error.HTTPError as e:
             assert e.code == 400
             assert key in e.read(300).decode()
+
+
+def test_openai_penalties_honored(base):
+    """presence/frequency penalties run on-device: an extreme presence
+    penalty forbids re-emitting any generated token, and out-of-range
+    values 400 per the documented [-2, 2] bound."""
+    plain = _post(base, {"prompt": [1, 2, 3], "max_tokens": 8,
+                         "temperature": 0})[1]
+    pen = _post(base, {"prompt": [1, 2, 3], "max_tokens": 8,
+                       "temperature": 0, "presence_penalty": 2.0,
+                       "frequency_penalty": 2.0})[1]
+    plain_ids = plain["choices"][0]["tokens"]
+    pen_ids = pen["choices"][0]["tokens"]
+    assert len(plain_ids) == len(pen_ids) == 8
+    # greedy tiny repeats; max-strength additive penalties steer away
+    assert len(set(plain_ids)) < len(plain_ids)
+    assert pen_ids != plain_ids
+    # penalties cover GENERATED tokens only: first emission matches
+    assert pen_ids[0] == plain_ids[0]
+    try:
+        _post(base, {"prompt": [1, 2], "max_tokens": 2,
+                     "presence_penalty": 3.5})
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "presence_penalty" in e.read(300).decode()
+    # explicit JSON null = "use the default" (nullable per the OpenAI
+    # spec) — must 200, not 400
+    status, _ = _post(base, {"prompt": [1, 2], "max_tokens": 2,
+                             "temperature": None, "top_p": None,
+                             "presence_penalty": None,
+                             "frequency_penalty": None,
+                             "logit_bias": None})
+    assert status == 200
+
+
+def test_logit_bias_honored(base):
+    """logit_bias (string keys, the JSON form OpenAI clients send) bans
+    and forces tokens on-device; out-of-range values 400."""
+    plain = _post(base, {"prompt": [1, 2, 3], "max_tokens": 6,
+                         "temperature": 0})[1]["choices"][0]["tokens"]
+    banned = _post(base, {"prompt": [1, 2, 3], "max_tokens": 6,
+                          "temperature": 0,
+                          "logit_bias": {str(plain[0]): -100}})[1]
+    assert plain[0] not in banned["choices"][0]["tokens"]
+    forced = _post(base, {"prompt": [1, 2, 3], "max_tokens": 4,
+                          "temperature": 0, "logit_bias": {"42": 100}})[1]
+    assert forced["choices"][0]["tokens"] == [42] * 4
+    try:
+        _post(base, {"prompt": [1, 2], "max_tokens": 2,
+                     "logit_bias": {"1": 200}})
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "logit_bias" in e.read(300).decode()
+    # STREAMING with an out-of-vocab id must 400 BEFORE the stream
+    # commits — never a 200 followed by an error frame
+    try:
+        _post(base, {"prompt": [1, 2], "max_tokens": 2, "stream": True,
+                     "logit_bias": {"999999999": -1}})
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "vocab" in e.read(300).decode()
+    # null max_tokens = the default (nullable per the OpenAI spec)
+    status, body = _post(base, {"prompt": [1, 2], "max_tokens": None,
+                                "temperature": 0})
+    assert status == 200 and body["usage"]["completion_tokens"] >= 1

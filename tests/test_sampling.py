@@ -261,6 +261,129 @@ def test_repetition_penalty_body_parse_and_validation():
         Sampler(repetition_penalty=0.0)
 
 
+def test_apply_penalties_semantics():
+    """presence_penalty subtracts once per generated token, frequency
+    scales with its count, and the CTRL repetition penalty composes over
+    the context mask — all in one fused application."""
+    from gofr_tpu.ops.sampling import apply_penalties, update_counts
+
+    logits = jnp.asarray([[2.0, -2.0, 1.0, 3.0]])
+    presence = jnp.asarray([[True, True, False, False]])
+    counts = jnp.asarray([[1.0, 0.0, 3.0, 0.0]])
+    out = np.asarray(apply_penalties(logits, presence, 2.0, counts, 0.5, 0.25))
+    # token0: 2/2 (rep) - 0.5 (presence) - 0.25*1 (freq) = 0.25
+    # token1: -2*2 (rep), counts 0 -> -4
+    # token2: no context presence; 1 - 0.5 - 0.25*3 = -0.25
+    # token3: untouched
+    np.testing.assert_allclose(out, [[0.25, -4.0, -0.25, 3.0]])
+    # zero penalties with zero counts is exactly the repetition-only path
+    out0 = np.asarray(
+        apply_penalties(logits, presence, 2.0, jnp.zeros_like(counts))
+    )
+    from gofr_tpu.ops.sampling import apply_repetition_penalty
+
+    np.testing.assert_allclose(
+        out0, np.asarray(apply_repetition_penalty(logits, presence, 2.0))
+    )
+    # update_counts accumulates per occurrence
+    c = update_counts(counts, jnp.asarray([2]))
+    np.testing.assert_allclose(np.asarray(c), [[1.0, 0.0, 4.0, 0.0]])
+    # logit_bias rides the same application, added AFTER the penalties
+    from gofr_tpu.ops.sampling import bias_row_from_map
+
+    bias = bias_row_from_map({1: 5.0, 3: -100.0}, 4)
+    out_b = np.asarray(
+        apply_penalties(logits, presence, 2.0, counts, 0.5, 0.25, bias)
+    )
+    np.testing.assert_allclose(out_b, [[0.25, 1.0, -0.25, -97.0]])
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="vocab"):
+        bias_row_from_map({7: 1.0}, 4)
+
+
+def test_logit_bias_end_to_end():
+    from gofr_tpu.testutil import serving_device
+
+    with serving_device(DECODE_CHUNK="4") as dev:
+        plain = dev.generate([1, 2, 3], max_new_tokens=8)
+        # ban the first greedy pick: generation must route around it
+        banned = dev.generate(
+            [1, 2, 3], max_new_tokens=8,
+            sampler=Sampler(logit_bias={plain[0]: -100.0}),
+        )
+        assert banned[0] != plain[0]
+        assert plain[0] not in banned
+        # +100 forces a token at EVERY step (bias applies to the first
+        # generated token too, unlike the generated-only penalties)
+        forced = dev.generate(
+            [1, 2, 3], max_new_tokens=6,
+            sampler=Sampler(logit_bias={42: 100.0}),
+        )
+        assert forced == [42] * 6
+        # out-of-vocab ids are a parameter error, not a silent drop
+        from gofr_tpu.errors import InvalidParamError
+
+        with pytest.raises(InvalidParamError, match="vocab"):
+            dev.generate(
+                [1, 2], max_new_tokens=2,
+                sampler=Sampler(logit_bias={10 ** 9: -1.0}),
+            )
+    # parse/validation: string keys (JSON), range check, type check
+    s = Sampler.from_body({"logit_bias": {"5": -100, "9": 2.5}})
+    assert s.logit_bias == {5: -100.0, 9: 2.5} and s.penalized
+    with pytest.raises(ValueError, match="logit_bias"):
+        Sampler(logit_bias={"5": 101.0})
+    with pytest.raises(ValueError, match="logit_bias"):
+        Sampler(logit_bias={"x": 1.0})
+    with pytest.raises(ValueError, match="logit_bias"):
+        Sampler(logit_bias=[5])
+    assert not Sampler(logit_bias={}).penalized
+
+
+def test_presence_frequency_penalty_end_to_end():
+    from gofr_tpu.testutil import serving_device
+
+    with serving_device(DECODE_CHUNK="4") as dev:
+        plain = dev.generate([1, 2, 3], max_new_tokens=10)
+        assert len(set(plain)) < len(plain), "tiny greedy should repeat"
+        # max-strength additive penalties on a tiny model (logits O(1))
+        # steer greedy away from the repeating sequence
+        pen = dev.generate(
+            [1, 2, 3], max_new_tokens=10,
+            sampler=Sampler(presence_penalty=2.0, frequency_penalty=2.0),
+        )
+        assert pen != plain
+        # penalties are over GENERATED tokens only: a fresh request's
+        # first token is unpenalized, so it matches plain greedy
+        assert pen[0] == plain[0]
+        # zero-valued penalties stay on the plain path (pool-eligible)
+        assert dev.generate(
+            [1, 2, 3], max_new_tokens=10,
+            sampler=Sampler(presence_penalty=0.0),
+        ) == plain
+        # seeded + penalties reproduce exactly
+        a = dev.generate([1, 2, 3], max_new_tokens=8,
+                         sampler=Sampler(temperature=1.0, seed=5,
+                                         presence_penalty=1.0,
+                                         frequency_penalty=0.5))
+        b = dev.generate([1, 2, 3], max_new_tokens=8,
+                         sampler=Sampler(temperature=1.0, seed=5,
+                                         presence_penalty=1.0,
+                                         frequency_penalty=0.5))
+        assert a == b
+        # range validation per the OpenAI spec
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="presence_penalty"):
+            Sampler(presence_penalty=2.5)
+        with _pytest.raises(ValueError, match="frequency_penalty"):
+            Sampler(frequency_penalty=-2.5)
+        s = Sampler.from_body({"presence_penalty": 0.5,
+                               "frequency_penalty": 0.25})
+        assert s.presence_penalty == 0.5 and s.penalized
+
+
 def test_logprobs_match_teacher_forcing():
     """generate(logprobs=True): returned values must equal the log-softmax
     the full no-cache forward assigns to each emitted token at its
