@@ -295,17 +295,12 @@ def _parse_request(ctx: Any, default_max: int) -> tuple:
     if not isinstance(body, dict):
         raise HTTPError(400, "request body must be a JSON object")
     # protocol knobs this server does not implement must be a clear 400
-    # when they would change output — never a silent ignore (no-op values
-    # like n=1 pass). presence/frequency penalties run on-device via the
-    # penalized decode chunk (Sampler.from_body parses them below).
-    for key, noop in (
-        ("n", 1), ("best_of", 1), ("echo", False), ("suffix", None),
-    ):
-        value = body.get(key, noop)
-        if value != noop and value is not None:
-            raise HTTPError(
-                400, f'"{key}" is not supported by this server'
-            )
+    # when they would change output — never a silent ignore.
+    # presence/frequency penalties and logit_bias run on-device via the
+    # penalized decode chunk; n/best_of/echo are handled by the
+    # completions fan-out (_parse_fanout).
+    if body.get("suffix") is not None:
+        raise HTTPError(400, '"suffix" is not supported by this server')
     # nullable like the sampling knobs: explicit JSON null = the default
     max_tokens = body.get("max_tokens")
     if max_tokens is None:
@@ -321,10 +316,121 @@ def _parse_request(ctx: Any, default_max: int) -> tuple:
     return body, max_tokens, sampler, stop_ids, want_logprobs, adapter
 
 
+_FANOUT_CAP = 16  # pool-slot-scale bound on n/best_of; beyond it is a 400
+
+
+def _parse_fanout(body: dict, allow_best_of: bool) -> tuple[int, int, bool]:
+    """(n, best_of, echo) with OpenAI constraints: best_of >= n, both
+    capped, echo completions-only. Streaming fan-out is rejected at the
+    call site (interleaved multi-index SSE is not implemented)."""
+
+    def positive(key: str, default: int) -> int:
+        value = body.get(key)
+        if value is None:
+            return default
+        if not isinstance(value, int) or isinstance(value, bool) or value < 1:
+            raise HTTPError(400, f'"{key}" must be a positive integer')
+        if value > _FANOUT_CAP:
+            raise HTTPError(
+                400, f'"{key}" is capped at {_FANOUT_CAP} on this server'
+            )
+        return value
+
+    n = positive("n", 1)
+    best_of = positive("best_of", 1)  # type/range-checked on BOTH endpoints
+    if not allow_best_of and best_of != 1:
+        raise HTTPError(400, '"best_of" is a completions-only parameter')
+    if body.get("best_of") is not None and best_of < n:
+        raise HTTPError(400, '"best_of" must be >= "n"')
+    best_of = max(n, best_of)
+    echo = body.get("echo")
+    if echo is None:
+        echo = False
+    elif not isinstance(echo, bool):
+        # bool("false") is True — a loud 400 beats echoing a prompt the
+        # client asked not to echo
+        raise HTTPError(400, '"echo" must be a boolean')
+    if not allow_best_of and echo:
+        raise HTTPError(400, '"echo" is a completions-only parameter')
+    return n, best_of, echo
+
+
+def _fanout_generate(
+    ctx: Any, body: dict, prompt_ids: list, max_tokens: int,
+    sampler: Any, stop_ids: Any, want_logprobs: bool, adapter: Any,
+    n: int, best_of: int,
+) -> tuple[list, int]:
+    """Generate ``best_of`` candidates and keep the ``n`` best. Returns
+    ([(tokens, logprobs_or_None), ...] of length n, total tokens
+    generated across ALL candidates — usage must count discarded
+    best_of candidates too, the OpenAI accounting).
+
+    - Deterministic requests (temperature 0) produce identical candidates:
+      ONE generation is replicated, not recomputed (and billed once per
+      replica, matching what the response carries).
+    - Sampled candidates run CONCURRENTLY: the continuous-batching pool
+      decodes unseeded requests in one lockstep dispatch, so n streams
+      cost ~one stream's wall time. A seeded request derives per-candidate
+      seeds (seed + index) so the whole fan-out stays reproducible.
+    - best_of > n ranks by mean token logprob (generated with logprobs
+      internally; stripped from the response unless requested)."""
+    score = best_of > n
+    need_lp = want_logprobs or score
+    if sampler.greedy:
+        out = ctx.tpu.generate(
+            prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+            adapter=adapter, logprobs=want_logprobs,
+        )
+        toks, lps = out if want_logprobs else (out, None)
+        return [(toks, lps)] * n, len(toks) * n
+
+    seed = body.get("seed")
+    if seed is not None:
+        try:
+            seed = int(seed)
+        except (TypeError, ValueError):
+            raise HTTPError(400, '"seed" must be an integer') from None
+    samplers = [
+        _sampler({**body, "seed": seed + i} if seed is not None else body)
+        for i in range(best_of)
+    ]
+
+    def one(s):
+        out = ctx.tpu.generate(
+            prompt_ids, max_tokens, sampler=s, stop_tokens=stop_ids,
+            adapter=adapter, logprobs=need_lp,
+        )
+        return out if need_lp else (out, None)
+
+    if best_of == 1:
+        results = [one(samplers[0])]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=best_of) as pool:
+            results = list(pool.map(one, samplers))
+    generated = sum(len(toks) for toks, _ in results)
+    if score:
+        def mean_lp(item):
+            toks, lps = item
+            return sum(lps) / len(lps) if lps else float("-inf")
+
+        results = sorted(results, key=mean_lp, reverse=True)[:n]
+    if not want_logprobs:
+        results = [(toks, None) for toks, _ in results]
+    return results, generated
+
+
 def completions(ctx: Any) -> Any:
     body, max_tokens, sampler, stop_ids, want_logprobs, adapter = (
         _parse_request(ctx, default_max=16)
     )
+    n, best_of, echo = _parse_fanout(body, allow_best_of=True)
+    if echo and want_logprobs:
+        raise HTTPError(
+            400, '"echo" with "logprobs" is not supported (prompt-token '
+            "logprobs are not computed); drop one of the two"
+        )
     if "prompt" not in body:
         # a missing prompt is almost always a caller bug (misspelled key):
         # generating from a magic default would 200 on garbage
@@ -336,6 +442,11 @@ def completions(ctx: Any) -> Any:
     tok = ctx.tpu.tokenizer
 
     if body.get("stream"):
+        if n > 1 or best_of > 1:
+            raise HTTPError(
+                400, 'streaming with "n" > 1 or "best_of" > 1 is not '
+                "supported (interleaved multi-index SSE)"
+            )
         import json as _json
 
         from gofr_tpu.http.response import Stream
@@ -367,18 +478,25 @@ def completions(ctx: Any) -> Any:
             })
 
         def events():
-            n = 0
+            emitted = 0
             dec = tok.stream_decoder() if tok is not None else None
             try:
+                if echo:
+                    # prompt replay first, matching the non-stream shape
+                    if dec is not None:
+                        yield chunk(tok.decode(prompt_ids))
+                    else:
+                        for t in prompt_ids:
+                            yield chunk("", token=t)
                 for item in stream_iter:
                     token, lp = item if want_logprobs else (item, None)
-                    n += 1
+                    emitted += 1
                     if dec is not None:
                         yield chunk(dec.feed(token), lp)
                     else:
                         yield chunk("", lp, token=token)
                 tail = dec.flush() if dec is not None else ""
-                finish = "length" if n >= max_tokens else "stop"
+                finish = "length" if emitted >= max_tokens else "stop"
                 yield chunk(tail, None, finish)
                 yield "[DONE]"
             except Exception as exc:
@@ -386,21 +504,24 @@ def completions(ctx: Any) -> Any:
 
         return Stream(events())
 
-    out = ctx.tpu.generate(
-        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
-        adapter=adapter, logprobs=want_logprobs,
+    results, generated = _fanout_generate(
+        ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
+        want_logprobs, adapter, n, best_of,
     )
-    logprobs = None
-    if want_logprobs:
-        out, logprobs = out
-    choice: dict[str, Any] = {
-        "text": tok.decode(out) if tok is not None else "",
-        "index": 0,
-        "finish_reason": "length" if len(out) >= max_tokens else "stop",
-        "logprobs": {"token_logprobs": logprobs} if logprobs is not None else None,
-    }
-    if tok is None:
-        choice["tokens"] = out  # no tokenizer: ids are the payload
+    choices = []
+    for i, (out, logprobs) in enumerate(results):
+        text_ids = (prompt_ids + out) if echo else out
+        choice: dict[str, Any] = {
+            "text": tok.decode(text_ids) if tok is not None else "",
+            "index": i,
+            "finish_reason": "length" if len(out) >= max_tokens else "stop",
+            "logprobs": (
+                {"token_logprobs": logprobs} if logprobs is not None else None
+            ),
+        }
+        if tok is None:
+            choice["tokens"] = text_ids  # no tokenizer: ids are the payload
+        choices.append(choice)
     from gofr_tpu.http.response import Raw
 
     # OpenAI clients expect the completion object at the top level, not
@@ -410,11 +531,11 @@ def completions(ctx: Any) -> Any:
         "object": "text_completion",
         "created": created,
         "model": model,
-        "choices": [choice],
+        "choices": choices,
         "usage": {
             "prompt_tokens": len(prompt_ids),
-            "completion_tokens": len(out),
-            "total_tokens": len(prompt_ids) + len(out),
+            "completion_tokens": generated,
+            "total_tokens": len(prompt_ids) + generated,
         },
     })
 
@@ -440,7 +561,14 @@ def chat_completions(ctx: Any) -> Any:
     created = int(time.time())
     chat_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
 
+    n, _, _ = _parse_fanout(body, allow_best_of=False)
+
     if body.get("stream"):
+        if n > 1:
+            raise HTTPError(
+                400, 'streaming with "n" > 1 is not supported '
+                "(interleaved multi-index SSE)"
+            )
         import json as _json
 
         from gofr_tpu.http.response import Stream
@@ -464,51 +592,52 @@ def chat_completions(ctx: Any) -> Any:
             })
 
         def events():
-            n = 0
+            emitted = 0
             dec = tok.stream_decoder()
             yield chunk({"role": "assistant"})  # role arrives first
             try:
                 for item in stream_iter:
                     token, lp = item if want_logprobs else (item, None)
-                    n += 1
+                    emitted += 1
                     text = dec.feed(token)
                     if text or lp is not None:
                         yield chunk({"content": text}, lp=lp)
                 tail = dec.flush()
                 if tail:
                     yield chunk({"content": tail})
-                yield chunk({}, "length" if n >= max_tokens else "stop")
+                yield chunk({}, "length" if emitted >= max_tokens else "stop")
                 yield "[DONE]"
             except Exception as exc:
                 yield _json.dumps({"error": {"message": str(exc)}})
 
         return Stream(events())
 
-    out = ctx.tpu.generate(
-        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
-        adapter=adapter, logprobs=want_logprobs,
+    results, generated = _fanout_generate(
+        ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
+        want_logprobs, adapter, n, n,
     )
-    logprobs = None
-    if want_logprobs:
-        out, logprobs = out
     from gofr_tpu.http.response import Raw
 
-    return Raw({
-        "id": chat_id,
-        "object": "chat.completion",
-        "created": created,
-        "model": model,
-        "choices": [{
-            "index": 0,
+    choices = [
+        {
+            "index": i,
             "message": {"role": "assistant", "content": tok.decode(out)},
             "finish_reason": "length" if len(out) >= max_tokens else "stop",
             "logprobs": (
                 {"token_logprobs": logprobs} if logprobs is not None else None
             ),
-        }],
+        }
+        for i, (out, logprobs) in enumerate(results)
+    ]
+    return Raw({
+        "id": chat_id,
+        "object": "chat.completion",
+        "created": created,
+        "model": model,
+        "choices": choices,
         "usage": {
             "prompt_tokens": len(prompt_ids),
-            "completion_tokens": len(out),
-            "total_tokens": len(prompt_ids) + len(out),
+            "completion_tokens": generated,
+            "total_tokens": len(prompt_ids) + generated,
         },
     })

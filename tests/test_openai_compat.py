@@ -339,20 +339,101 @@ def test_embeddings_overlong_input_400(embed_base):
 
 
 def test_unsupported_openai_knobs_400_not_silent(base):
-    """n>1 / best_of / echo / suffix would change output if honored —
-    refusing loudly beats silently returning something else. No-op
-    values (n=1) pass."""
-    ok = {"prompt": [1, 2], "max_tokens": 2, "n": 1}
-    status, _ = _post(base, ok)
-    assert status == 200
-    for key, value in (("n", 2), ("best_of", 3), ("echo", True),
-                       ("suffix", "tail")):
+    """Knobs this server cannot honor must 400 loudly: suffix always;
+    fan-out (n/best_of) when streaming; echo with logprobs; constraint
+    violations (best_of < n, fan-out past the cap)."""
+    for payload, expect in (
+        ({"suffix": "tail"}, "suffix"),
+        ({"n": 2, "stream": True, "temperature": 1.0}, "stream"),
+        ({"best_of": 2, "stream": True, "temperature": 1.0}, "stream"),
+        ({"echo": True, "logprobs": 1}, "echo"),
+        ({"n": 3, "best_of": 2, "temperature": 1.0}, "best_of"),
+        ({"n": 999, "temperature": 1.0}, "n"),
+        ({"n": 0}, "n"),
+    ):
         try:
-            _post(base, {"prompt": [1, 2], "max_tokens": 2, key: value})
-            raise AssertionError(f"expected 400 for {key}={value}")
+            _post(base, {"prompt": [1, 2], "max_tokens": 2, **payload})
+            raise AssertionError(f"expected 400 for {payload}")
         except urllib.error.HTTPError as e:
             assert e.code == 400
-            assert key in e.read(300).decode()
+            assert expect in e.read(300).decode()
+
+
+def test_completions_fanout_n_best_of_echo(base):
+    """n parallel samples, best_of ranking, echo prompt replay."""
+    # greedy n: deterministic — one generation replicated across choices
+    status, body = _post(base, {"prompt": [1, 2, 3], "max_tokens": 4,
+                                "temperature": 0, "n": 2})
+    assert status == 200
+    assert [c["index"] for c in body["choices"]] == [0, 1]
+    assert body["choices"][0]["tokens"] == body["choices"][1]["tokens"]
+    assert body["usage"]["completion_tokens"] == 8  # summed across choices
+    # seeded sampled n: reproducible fan-out (per-choice derived seeds)
+    a = _post(base, {"prompt": [1, 2, 3], "max_tokens": 6,
+                     "temperature": 1.0, "seed": 11, "n": 3})[1]
+    b = _post(base, {"prompt": [1, 2, 3], "max_tokens": 6,
+                     "temperature": 1.0, "seed": 11, "n": 3})[1]
+    toks_a = [tuple(c["tokens"]) for c in a["choices"]]
+    assert toks_a == [tuple(c["tokens"]) for c in b["choices"]]
+    assert len(toks_a) == 3 and len(set(toks_a)) >= 2  # distinct streams
+    # best_of > n: n survive; logprobs stay internal unless requested;
+    # usage counts the DISCARDED candidates too (OpenAI accounting)
+    picked = _post(base, {"prompt": [1, 2, 3], "max_tokens": 4,
+                          "temperature": 1.0, "seed": 5,
+                          "best_of": 4, "n": 2})[1]
+    assert len(picked["choices"]) == 2
+    assert all(c["logprobs"] is None for c in picked["choices"])
+    assert picked["usage"]["completion_tokens"] == 16  # 4 candidates x 4
+    # a string seed is coerced, not a 500 (and stays reproducible)
+    s1 = _post(base, {"prompt": [1, 2], "max_tokens": 3,
+                      "temperature": 1.0, "seed": "7", "n": 2})[1]
+    s2 = _post(base, {"prompt": [1, 2], "max_tokens": 3,
+                      "temperature": 1.0, "seed": 7, "n": 2})[1]
+    assert ([c["tokens"] for c in s1["choices"]]
+            == [c["tokens"] for c in s2["choices"]])
+    # non-bool echo is a loud 400, not a truthy surprise
+    try:
+        _post(base, {"prompt": [1, 2], "max_tokens": 2, "echo": "false"})
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400 and "echo" in e.read(300).decode()
+    # echo replays the prompt ahead of the completion
+    echoed = _post(base, {"prompt": [9, 8, 7], "max_tokens": 3,
+                          "temperature": 0, "echo": True})[1]
+    assert echoed["choices"][0]["tokens"][:3] == [9, 8, 7]
+    assert len(echoed["choices"][0]["tokens"]) == 6
+
+
+def test_chat_fanout_n(chat_base):
+    """chat supports n; best_of and echo are completions-only 400s."""
+    status, body = _post(chat_base, {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4, "temperature": 1.0, "seed": 3, "n": 2,
+    }, path="/v1/chat/completions")
+    assert status == 200
+    assert [c["index"] for c in body["choices"]] == [0, 1]
+    assert all(c["message"]["role"] == "assistant" for c in body["choices"])
+    for key in ("best_of", "echo"):
+        try:
+            _post(chat_base, {
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 2, key: 2 if key == "best_of" else True,
+            }, path="/v1/chat/completions")
+            raise AssertionError(f"expected 400 for chat {key}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "completions-only" in e.read(300).decode()
+    # best_of=true must not slip past the completions-only gate via
+    # True == 1 — positive() rejects bools on both endpoints
+    try:
+        _post(chat_base, {
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 2, "best_of": True,
+        }, path="/v1/chat/completions")
+        raise AssertionError("expected 400 for chat best_of=true")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "best_of" in e.read(300).decode()
 
 
 def test_openai_penalties_honored(base):
