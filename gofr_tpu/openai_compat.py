@@ -17,11 +17,13 @@ translation shim. ``register_openai_routes(app)`` adds:
 - ``GET /v1/models`` — the single served model, from MODEL_NAME.
 
 Scope: the completions shape (prompt string or token list, max_tokens,
-temperature/top_p/seed, stop, logprobs, usage accounting). ``stop``
-accepts strings that encode to exactly ONE token (multi-token stop
-sequences would need rolling decoded-text matching on the hot path) or
-the ``stop_token_ids`` extension; anything else is a clear 400, never a
-silent ignore.
+temperature/top_p/seed, penalties/logit_bias, n/best_of/echo fan-out,
+stop, logprobs, usage accounting). ``stop`` takes up to 4 sequences:
+single-token encodings stop on-device, and every sequence is ALSO
+matched host-side against the rolling decoded text (``_StopScanner``),
+so multi-token stops and cross-token-boundary occurrences truncate
+correctly; ``stop_token_ids`` takes raw ids. Knobs this server cannot
+honor are a clear 400, never a silent ignore.
 """
 
 from __future__ import annotations
@@ -235,7 +237,11 @@ def _prompt_tokens(ctx: Any, prompt: Any) -> list[int]:
     )
 
 
-def _stop_token_ids(ctx: Any, body: dict) -> frozenset:
+def _parse_stops(ctx: Any, body: dict) -> tuple[frozenset, list]:
+    """(on-device stop token ids, host-matched stop strings). A stop
+    string that encodes to ONE token stops on-device (cheapest — the
+    decode chunk never emits it); multi-token strings are matched
+    host-side against the decoded text as it streams off the device."""
     ids = set()
     raw_ids = body.get("stop_token_ids")
     if raw_ids is not None:
@@ -246,24 +252,65 @@ def _stop_token_ids(ctx: Any, body: dict) -> frozenset:
         ids.update(raw_ids)
     stop = body.get("stop")
     if stop is None:
-        return frozenset(ids)
+        return frozenset(ids), []
     if isinstance(stop, str):
         stop = [stop]
-    if not isinstance(stop, list) or not all(isinstance(s, str) for s in stop):
-        raise HTTPError(400, '"stop" must be a string or list of strings')
+    if not isinstance(stop, list) or not all(
+        isinstance(s, str) and s for s in stop
+    ):
+        raise HTTPError(400, '"stop" must be a non-empty string or list of them')
+    if len(stop) > 4:
+        raise HTTPError(400, '"stop" accepts at most 4 sequences (OpenAI limit)')
     tok = ctx.tpu.tokenizer
     if tok is None:
         raise HTTPError(400, '"stop" strings need a tokenizer; use "stop_token_ids"')
+    strings = []
     for s in stop:
         encoded = tok.encode(s)
-        if len(encoded) != 1:
-            raise HTTPError(
-                400,
-                f'stop sequence {s!r} spans {len(encoded)} tokens — only '
-                'single-token stops are supported (or pass "stop_token_ids")',
-            )
-        ids.add(encoded[0])
-    return frozenset(ids)
+        if len(encoded) == 1:
+            # on-device stop for the exact-token emission (cheapest), but
+            # ALSO host-matched: the same text can arrive via a different
+            # tokenization (" the" as " t"+"he", or inside a larger
+            # token), which only the text scan catches
+            ids.add(encoded[0])
+        strings.append(s)
+    return frozenset(ids), strings
+
+
+class _StopScanner:
+    """Incremental multi-token stop matching with SSE hold-back:
+    ``feed`` returns (emit, done) where ``emit`` never contains a stop
+    string NOR a tail that could still grow into one — a stream must not
+    leak half a stop sequence it would have had to un-send."""
+
+    def __init__(self, stops: list):
+        self.stops = stops
+        self.buf = ""
+        self.consumed = 0  # total chars fed
+        self.match_pos = None  # absolute offset of the matched stop
+
+    def feed(self, text: str) -> tuple[str, bool]:
+        self.buf += text
+        self.consumed += len(text)
+        hits = [p for p in (self.buf.find(s) for s in self.stops) if p >= 0]
+        if hits:
+            idx = min(hits)
+            self.match_pos = self.consumed - len(self.buf) + idx
+            return self.buf[:idx], True
+        hold = 0
+        for s in self.stops:
+            for k in range(min(len(s) - 1, len(self.buf)), 0, -1):
+                if self.buf.endswith(s[:k]):
+                    hold = max(hold, k)
+                    break
+        cut = len(self.buf) - hold
+        emit, self.buf = self.buf[:cut], self.buf[cut:]
+        return emit, False
+
+    def flush(self) -> str:
+        """End of stream: held-back text can no longer become a stop."""
+        emit, self.buf = self.buf, ""
+        return emit
 
 
 def _sampler(body: dict) -> Any:
@@ -287,7 +334,8 @@ def _sampler(body: dict) -> Any:
 
 def _parse_request(ctx: Any, default_max: int) -> tuple:
     """Shared request parse for both endpoints: (body, max_tokens,
-    sampler, stop_ids, want_logprobs, adapter). One home, so a knob added
+    sampler, stop_ids, stop_strs, want_logprobs, adapter). One home, so
+    a knob added
     to completions cannot silently miss chat (they drifted once)."""
     if ctx.tpu is None:
         raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
@@ -308,12 +356,13 @@ def _parse_request(ctx: Any, default_max: int) -> tuple:
     if not isinstance(max_tokens, int) or max_tokens < 1:
         raise HTTPError(400, '"max_tokens" must be a positive integer')
     sampler = _sampler(body)
-    stop_ids = _stop_token_ids(ctx, body)
+    stop_ids, stop_strs = _parse_stops(ctx, body)
     want_logprobs = body.get("logprobs") not in (None, False, 0)
     adapter = body.get("adapter")  # multi-LoRA extension
     if adapter is not None and not isinstance(adapter, str):
         raise HTTPError(400, '"adapter" must be a string')
-    return body, max_tokens, sampler, stop_ids, want_logprobs, adapter
+    return (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs,
+            adapter)
 
 
 _FANOUT_CAP = 16  # pool-slot-scale bound on n/best_of; beyond it is a 400
@@ -355,15 +404,75 @@ def _parse_fanout(body: dict, allow_best_of: bool) -> tuple[int, int, bool]:
     return n, best_of, echo
 
 
+def _consume_stream(
+    ctx: Any, prompt_ids: list, max_tokens: int, sampler: Any,
+    stop_ids: Any, stop_strs: list, need_lp: bool, adapter: Any,
+) -> tuple[list, Any, str, str]:
+    """Generate through the streaming bridge, matching multi-token stop
+    strings host-side as text streams off the device and CANCELLING the
+    background decode at the first match (closing the iterator frees the
+    pool slot — a matched stop must not keep generating to max_tokens).
+    Returns (tokens, logprobs_or_None, text, finish_reason); ``text`` is
+    truncated before the stop string, tokens/logprobs cover everything
+    actually generated (usage accounting)."""
+    tok = ctx.tpu.tokenizer  # _parse_stops guarantees one for stop_strs
+    dec = tok.stream_decoder()
+    scan = _StopScanner(stop_strs)
+    it = ctx.tpu.generate_stream(
+        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+        adapter=adapter, logprobs=need_lp,
+    )
+    toks: list = []
+    lps: list = []
+    parts: list = []
+    starts: list = []  # decoded-text offset where each token's text began
+    decoded = 0
+    finish = None
+    try:
+        for item in it:
+            t, lp = item if need_lp else (item, None)
+            toks.append(t)
+            if lp is not None:
+                lps.append(lp)
+            piece = dec.feed(t)
+            starts.append(decoded)
+            decoded += len(piece)
+            emit, done = scan.feed(piece)
+            parts.append(emit)
+            if done:
+                finish = "stop"
+                break
+        if finish is None:
+            emit, done = scan.feed(dec.flush())
+            parts.append(emit)
+            if done:
+                finish = "stop"
+            else:
+                parts.append(scan.flush())
+                finish = "length" if len(toks) >= max_tokens else "stop"
+    finally:
+        it.close()
+    if need_lp and scan.match_pos is not None:
+        # align response logprobs with the TRUNCATED text: keep tokens
+        # whose text starts before the match (usage still bills the full
+        # toks list — the tokens were generated)
+        vis = sum(1 for s in starts if s < scan.match_pos)
+        lps = lps[:vis]
+    return toks, (lps if need_lp else None), "".join(parts), finish
+
+
 def _fanout_generate(
     ctx: Any, body: dict, prompt_ids: list, max_tokens: int,
-    sampler: Any, stop_ids: Any, want_logprobs: bool, adapter: Any,
-    n: int, best_of: int,
+    sampler: Any, stop_ids: Any, stop_strs: list, want_logprobs: bool,
+    adapter: Any, n: int, best_of: int,
 ) -> tuple[list, int]:
     """Generate ``best_of`` candidates and keep the ``n`` best. Returns
-    ([(tokens, logprobs_or_None), ...] of length n, total tokens
-    generated across ALL candidates — usage must count discarded
-    best_of candidates too, the OpenAI accounting).
+    ([(tokens, logprobs_or_None, text_or_None, finish_or_None), ...] of
+    length n, total tokens generated across ALL candidates — usage must
+    count discarded best_of candidates too, the OpenAI accounting).
+    ``text``/``finish`` are set only on the multi-token-stop path (the
+    host-matched truncation IS the text); otherwise the caller decodes
+    the ids itself.
 
     - Deterministic requests (temperature 0) produce identical candidates:
       ONE generation is replicated, not recomputed (and billed once per
@@ -376,13 +485,25 @@ def _fanout_generate(
       internally; stripped from the response unless requested)."""
     score = best_of > n
     need_lp = want_logprobs or score
-    if sampler.greedy:
+
+    def one(s):
+        if stop_strs:
+            return _consume_stream(
+                ctx, prompt_ids, max_tokens, s, stop_ids, stop_strs,
+                need_lp, adapter,
+            )
         out = ctx.tpu.generate(
-            prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
-            adapter=adapter, logprobs=want_logprobs,
+            prompt_ids, max_tokens, sampler=s, stop_tokens=stop_ids,
+            adapter=adapter, logprobs=need_lp,
         )
-        toks, lps = out if want_logprobs else (out, None)
-        return [(toks, lps)] * n, len(toks) * n
+        toks, lps = out if need_lp else (out, None)
+        return toks, lps, None, None
+
+    if sampler.greedy:
+        toks, lps, text, finish = one(sampler)
+        if not want_logprobs:
+            lps = None
+        return [(toks, lps, text, finish)] * n, len(toks) * n
 
     seed = body.get("seed")
     if seed is not None:
@@ -394,14 +515,6 @@ def _fanout_generate(
         _sampler({**body, "seed": seed + i} if seed is not None else body)
         for i in range(best_of)
     ]
-
-    def one(s):
-        out = ctx.tpu.generate(
-            prompt_ids, max_tokens, sampler=s, stop_tokens=stop_ids,
-            adapter=adapter, logprobs=need_lp,
-        )
-        return out if need_lp else (out, None)
-
     if best_of == 1:
         results = [one(samplers[0])]
     else:
@@ -409,20 +522,21 @@ def _fanout_generate(
 
         with ThreadPoolExecutor(max_workers=best_of) as pool:
             results = list(pool.map(one, samplers))
-    generated = sum(len(toks) for toks, _ in results)
+    generated = sum(len(r[0]) for r in results)
     if score:
         def mean_lp(item):
-            toks, lps = item
+            lps = item[1]
             return sum(lps) / len(lps) if lps else float("-inf")
 
         results = sorted(results, key=mean_lp, reverse=True)[:n]
     if not want_logprobs:
-        results = [(toks, None) for toks, _ in results]
+        results = [(toks, None, text, finish)
+                   for toks, _, text, finish in results]
     return results, generated
 
 
 def completions(ctx: Any) -> Any:
-    body, max_tokens, sampler, stop_ids, want_logprobs, adapter = (
+    body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs, adapter = (
         _parse_request(ctx, default_max=16)
     )
     n, best_of, echo = _parse_fanout(body, allow_best_of=True)
@@ -479,7 +593,11 @@ def completions(ctx: Any) -> Any:
 
         def events():
             emitted = 0
+            finish = None
             dec = tok.stream_decoder() if tok is not None else None
+            # stop_strs imply a tokenizer (enforced at parse), so dec
+            # is always live when the scanner is
+            scan = _StopScanner(stop_strs) if stop_strs else None
             try:
                 if echo:
                     # prompt replay first, matching the non-stream shape
@@ -491,36 +609,68 @@ def completions(ctx: Any) -> Any:
                 for item in stream_iter:
                     token, lp = item if want_logprobs else (item, None)
                     emitted += 1
-                    if dec is not None:
-                        yield chunk(dec.feed(token), lp)
-                    else:
+                    if dec is None:
                         yield chunk("", lp, token=token)
+                        continue
+                    text = dec.feed(token)
+                    if scan is not None:
+                        text, done = scan.feed(text)
+                        if done:
+                            # matched mid-stream: emit up to the stop and
+                            # cancel the decode (frees the pool slot). No
+                            # lp: the matched token's text is excluded, so
+                            # its logprob must not ride this chunk either
+                            yield chunk(text, None)
+                            finish = "stop"
+                            break
+                    yield chunk(text, lp)
                 tail = dec.flush() if dec is not None else ""
-                finish = "length" if emitted >= max_tokens else "stop"
+                if finish is None:
+                    if scan is not None:
+                        tail, done = scan.feed(tail)
+                        if done:
+                            finish = "stop"
+                        else:
+                            tail += scan.flush()
+                    if finish is None:
+                        finish = "length" if emitted >= max_tokens else "stop"
+                else:
+                    tail = ""
                 yield chunk(tail, None, finish)
                 yield "[DONE]"
             except Exception as exc:
                 yield _json.dumps({"error": {"message": str(exc)}})
+            finally:
+                stream_iter.close()  # no-op if already exhausted
 
         return Stream(events())
 
     results, generated = _fanout_generate(
-        ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
+        ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
         want_logprobs, adapter, n, best_of,
     )
     choices = []
-    for i, (out, logprobs) in enumerate(results):
-        text_ids = (prompt_ids + out) if echo else out
+    for i, (out, logprobs, text, finish) in enumerate(results):
+        if text is None:
+            text_ids = (prompt_ids + out) if echo else out
+            text_val = tok.decode(text_ids) if tok is not None else ""
+            finish = "length" if len(out) >= max_tokens else "stop"
+        else:
+            # host-matched stop truncation: the scanner's text IS the
+            # completion (a tokenizer is guaranteed on this path, so the
+            # tokens extension below never applies); echo prepends the
+            # decoded prompt
+            text_val = (tok.decode(prompt_ids) + text) if echo else text
         choice: dict[str, Any] = {
-            "text": tok.decode(text_ids) if tok is not None else "",
+            "text": text_val,
             "index": i,
-            "finish_reason": "length" if len(out) >= max_tokens else "stop",
+            "finish_reason": finish,
             "logprobs": (
                 {"token_logprobs": logprobs} if logprobs is not None else None
             ),
         }
         if tok is None:
-            choice["tokens"] = text_ids  # no tokenizer: ids are the payload
+            choice["tokens"] = (prompt_ids + out) if echo else out
         choices.append(choice)
     from gofr_tpu.http.response import Raw
 
@@ -545,7 +695,7 @@ def chat_completions(ctx: Any) -> Any:
     ``completions``; only the prompt construction (chat template) and the
     response shapes (chat.completion / chat.completion.chunk with deltas)
     differ."""
-    body, max_tokens, sampler, stop_ids, want_logprobs, adapter = (
+    body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs, adapter = (
         _parse_request(ctx, default_max=64)
     )
     tok = ctx.tpu.tokenizer
@@ -593,27 +743,51 @@ def chat_completions(ctx: Any) -> Any:
 
         def events():
             emitted = 0
+            finish = None
             dec = tok.stream_decoder()
+            scan = _StopScanner(stop_strs) if stop_strs else None
             yield chunk({"role": "assistant"})  # role arrives first
             try:
                 for item in stream_iter:
                     token, lp = item if want_logprobs else (item, None)
                     emitted += 1
                     text = dec.feed(token)
+                    if scan is not None:
+                        text, done = scan.feed(text)
+                        if done:
+                            if text:
+                                # no lp: the matched token's text is
+                                # excluded from the stream
+                                yield chunk({"content": text})
+                            finish = "stop"
+                            break
                     if text or lp is not None:
                         yield chunk({"content": text}, lp=lp)
                 tail = dec.flush()
+                if finish is None:
+                    if scan is not None:
+                        tail, done = scan.feed(tail)
+                        if done:
+                            finish = "stop"
+                        else:
+                            tail += scan.flush()
+                    if finish is None:
+                        finish = "length" if emitted >= max_tokens else "stop"
+                else:
+                    tail = ""
                 if tail:
                     yield chunk({"content": tail})
-                yield chunk({}, "length" if emitted >= max_tokens else "stop")
+                yield chunk({}, finish)
                 yield "[DONE]"
             except Exception as exc:
                 yield _json.dumps({"error": {"message": str(exc)}})
+            finally:
+                stream_iter.close()  # no-op if already exhausted
 
         return Stream(events())
 
     results, generated = _fanout_generate(
-        ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
+        ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
         want_logprobs, adapter, n, n,
     )
     from gofr_tpu.http.response import Raw
@@ -621,13 +795,19 @@ def chat_completions(ctx: Any) -> Any:
     choices = [
         {
             "index": i,
-            "message": {"role": "assistant", "content": tok.decode(out)},
-            "finish_reason": "length" if len(out) >= max_tokens else "stop",
+            "message": {
+                "role": "assistant",
+                "content": text if text is not None else tok.decode(out),
+            },
+            "finish_reason": (
+                finish if finish is not None
+                else ("length" if len(out) >= max_tokens else "stop")
+            ),
             "logprobs": (
                 {"token_logprobs": logprobs} if logprobs is not None else None
             ),
         }
-        for i, (out, logprobs) in enumerate(results)
+        for i, (out, logprobs, text, finish) in enumerate(results)
     ]
     return Raw({
         "id": chat_id,

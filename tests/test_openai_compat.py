@@ -404,6 +404,69 @@ def test_completions_fanout_n_best_of_echo(base):
     assert len(echoed["choices"][0]["tokens"]) == 6
 
 
+def test_multitoken_stop_strings(chat_base):
+    """Multi-token "stop" strings match host-side against the decoded
+    text: truncation before the match, finish_reason stop, early decode
+    cancel; streaming holds back text that could still grow into a stop
+    so a partial stop never leaks."""
+    full = _post(chat_base, {"prompt": "ab", "max_tokens": 12,
+                             "temperature": 0})[1]
+    text = full["choices"][0]["text"]
+    assert len(text) >= 5
+    stop = text[2:4]  # two byte-tokens under the byte tokenizer
+    cut = _post(chat_base, {"prompt": "ab", "max_tokens": 12,
+                            "temperature": 0, "stop": stop})[1]
+    c = cut["choices"][0]
+    assert c["finish_reason"] == "stop"
+    assert c["text"] == text[: text.find(stop)]
+    assert stop not in c["text"]
+    # usage still counts what was actually generated (may exceed the
+    # truncated text, never the untruncated run)
+    assert 1 <= cut["usage"]["completion_tokens"] <= len(text) + 2
+    # streaming: same final text, no partial-stop leak, finish stop
+    req = urllib.request.Request(
+        chat_base + "/v1/completions",
+        data=json.dumps({"prompt": "ab", "max_tokens": 12,
+                         "temperature": 0, "stop": stop,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        raw = resp.read().decode()
+    events = [ln[len("data: "):] for ln in raw.splitlines()
+              if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    parsed = [json.loads(e) for e in events[:-1]]
+    streamed = "".join(p["choices"][0]["text"] for p in parsed)
+    assert streamed == c["text"]
+    assert parsed[-1]["choices"][0]["finish_reason"] == "stop"
+    # chat: the same stop semantics through the chat shape
+    chat_cut = _post(chat_base, {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 12, "temperature": 0, "stop": stop,
+    }, path="/v1/chat/completions")[1]
+    assert stop not in chat_cut["choices"][0]["message"]["content"]
+    # single-token stop strings stop on-device AND are host-matched —
+    # truncation lands before the first text occurrence either way
+    ch = text[3]
+    cut1 = _post(chat_base, {"prompt": "ab", "max_tokens": 12,
+                             "temperature": 0, "stop": ch})[1]
+    assert ch not in cut1["choices"][0]["text"]
+    assert cut1["choices"][0]["text"] == text[: text.find(ch)]
+    # logprobs align with the truncated text, not the full generation
+    lp_cut = _post(chat_base, {"prompt": "ab", "max_tokens": 12,
+                               "temperature": 0, "stop": stop,
+                               "logprobs": 1})[1]["choices"][0]
+    assert len(lp_cut["logprobs"]["token_logprobs"]) <= len(lp_cut["text"]) + 1
+    # the OpenAI 4-sequence limit stays loud
+    try:
+        _post(chat_base, {"prompt": "ab", "max_tokens": 2,
+                          "stop": ["aa", "bb", "cc", "dd", "ee"]})
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400 and "4" in e.read(300).decode()
+
+
 def test_chat_fanout_n(chat_base):
     """chat supports n; best_of and echo are completions-only 400s."""
     status, body = _post(chat_base, {
