@@ -468,6 +468,63 @@ def decode_chunk_pool(
     return toks, toks[:, -1:], key, cache
 
 
+def decode_chunk_pool_penalized(
+    params: dict,
+    token: jnp.ndarray,
+    cache: dict,
+    cfg: TransformerConfig,
+    n_steps: int,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    min_p: jnp.ndarray,
+    presence: jnp.ndarray,
+    rep: jnp.ndarray,
+    counts: jnp.ndarray,
+    presence_penalty: jnp.ndarray,
+    frequency_penalty: jnp.ndarray,
+    bias: jnp.ndarray,
+) -> tuple:
+    """``decode_chunk_pool`` with PER-SLOT penalty state: ``presence``
+    [B, V] bool, ``counts`` [B, V] f32 and ``bias`` [B, V] f32 rows plus
+    per-row scalars ``rep``/``presence_penalty``/``frequency_penalty``
+    [B]. Slots without penalties carry identity knobs (rep 1, penalties
+    0, zero bias row) and sample exactly as the plain pool executable
+    does — ONE executable serves any penalized/plain slot mix, chosen by
+    the pool only when at least one active slot is penalized (the extra
+    [B, V] elementwise work is noise next to the decode matmuls, but the
+    plain pool path stays untouched for penalty-free deployments).
+    Returns (tokens [B, n_steps], next token [B, 1], advanced key,
+    cache, presence, counts)."""
+    from gofr_tpu.ops.sampling import (
+        apply_penalties,
+        sample_logits_rows,
+        update_counts,
+        update_presence,
+    )
+
+    rep = jnp.asarray(rep, jnp.float32).reshape(-1, 1)
+    pp = jnp.asarray(presence_penalty, jnp.float32).reshape(-1, 1)
+    fp = jnp.asarray(frequency_penalty, jnp.float32).reshape(-1, 1)
+    key, sub = jax.random.split(key)
+
+    def body(carry, _):
+        tok, c, k, pres, cnt = carry
+        logits, c = decode_step(params, tok, c, cfg)
+        k, s = jax.random.split(k)
+        penalized = apply_penalties(logits, pres, rep, cnt, pp, fp, bias)
+        nxt = sample_logits_rows(penalized, s, temperature, top_k, top_p, min_p)
+        pres = update_presence(pres, nxt)
+        cnt = update_counts(cnt, nxt)
+        return (nxt[:, None], c, k, pres, cnt), nxt
+
+    (tok, cache, _, presence, counts), toks = jax.lax.scan(
+        body, (token, cache, sub, presence, counts), None, length=n_steps
+    )
+    return jnp.transpose(toks), tok, key, cache, presence, counts
+
+
 def decode_chunk_rows(
     params: dict,
     token: jnp.ndarray,
@@ -483,8 +540,9 @@ def decode_chunk_rows(
     """``decode_chunk`` with PER-ROW sampling params ([B] each) — the
     continuous-batching decode pool runs many requests' decode in one
     fixed-shape dispatch, each slot with its own temperature/top-k/
-    top-p/min-p. (Repetition-penalized requests decode solo through
-    ``decode_chunk``'s presence path — the pool stays presence-free.)"""
+    top-p/min-p. (Penalized requests pool through
+    ``decode_chunk_pool_penalized``'s per-slot penalty state; this
+    penalty-free variant is the common-traffic fast path.)"""
     from gofr_tpu.ops.sampling import sample_logits_rows
 
     def body(carry, _):

@@ -28,9 +28,10 @@ def apply_repetition_penalty(
     """CTRL-style repetition penalty: tokens already in the context
     (``presence`` [B, V] bool — prompt plus generated) have positive
     logits divided by ``penalty`` and negative logits multiplied by it.
-    ``penalty`` is a scalar (1 = off; penalized requests decode solo, so
-    there is no per-row form); applies BEFORE the greedy/sampled split so
-    greedy decode is penalized too (the HF semantics)."""
+    ``penalty`` is a scalar or a per-row [B, 1] array (1 = off — the
+    penalized pool executable carries one knob per slot); applies BEFORE
+    the greedy/sampled split so greedy decode is penalized too (the HF
+    semantics)."""
     logits = logits.astype(jnp.float32)
     penalty = jnp.asarray(penalty, jnp.float32)
     penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
@@ -348,8 +349,9 @@ class Sampler:
     @property
     def penalized(self) -> bool:
         """True when any penalty or logit bias is active: such requests
-        decode solo through the presence/counts/bias chunk variant (the
-        pool stays penalty-free)."""
+        thread presence/counts/bias state through decode — pooled via
+        per-slot penalty rows (``DECODE_POOL_PENALTIES``), or the solo
+        chunk variant when also seeded/logprobs/adapter-bound."""
         return (
             self.repetition_penalty != 1.0
             or self.presence_penalty != 0.0

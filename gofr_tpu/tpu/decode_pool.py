@@ -113,17 +113,33 @@ class DecodePool:
         peak_hbm_bw: Any = None,
         model: str = "",
         pipeline_depth: int = PIPELINE_DEPTH,
+        penalties: str = "lazy",
     ):
         from gofr_tpu.models.transformer import decode_chunk_pool
 
         if pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        if penalties not in ("lazy", "eager", "off"):
+            raise ValueError(
+                f"penalties must be lazy|eager|off, got {penalties!r}"
+            )
         self.pipeline_depth = pipeline_depth
         self.cfg = cfg
         self.params = params
         self.n_slots = n_slots
         self.chunk = chunk
         self.max_len = cfg.max_seq
+        self._init_cache = init_cache
+        # per-slot penalty machinery (presence/counts/bias rows + knob
+        # vectors + the penalized executable): "off" never pools penalized
+        # requests (they decode solo, the pre-r04 behavior); "lazy" builds
+        # it in a BACKGROUND thread on the first penalized submit (that
+        # request solos while the executable compiles — the serving path
+        # never compiles under the pool lock); "eager" builds it at boot
+        self._pen_mode = penalties
+        self._pen_ready = False
+        self._pen_starting = False
+        self._pen_slots: set[int] = set()
         # under a serving mesh the pool cache takes the SAME placement as
         # the prefill cache (slot axis over dp/fsdp, kv heads over tp) so
         # the pooled decode compiles as one SPMD program — row caches
@@ -217,8 +233,98 @@ class DecodePool:
         toks.block_until_ready()
         self.cache = self._place(init_cache(cfg, n_slots))  # reset the warmup writes
         self._last_tokens = jnp.zeros((n_slots, 1), jnp.int32)
+        if penalties == "eager":
+            self._enable_penalties()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+
+    # -- per-slot penalties ---------------------------------------------------
+    def _enable_penalties(self) -> None:
+        """Build the penalized-pool machinery: the [slots, V] presence/
+        counts/bias state, per-slot knob vectors, slot write/zero ops, and
+        the penalized executable (warmed on THROWAWAY state — the live
+        cache must not be donated into a warmup)."""
+        from gofr_tpu.models.transformer import decode_chunk_pool_penalized
+
+        cfg, chunk, n = self.cfg, self.chunk, self.n_slots
+        v = cfg.vocab_size
+        decode_pen = jax.jit(
+            lambda p, t, c, key, temp, tk, tp, mp, pres, rep, cnt, pp, fp,
+            bias: decode_chunk_pool_penalized(
+                p, t, c, cfg, chunk, key, temp, tk, tp, mp, pres, rep,
+                cnt, pp, fp, bias,
+            ),
+            donate_argnums=(2, 3, 8, 10),
+        )
+
+        def write_rows(pres, cnt, bias, pr, cr, br, i):
+            return (
+                jax.lax.dynamic_update_slice(pres, pr, (i, 0)),
+                jax.lax.dynamic_update_slice(cnt, cr, (i, 0)),
+                jax.lax.dynamic_update_slice(bias, br, (i, 0)),
+            )
+
+        def zero_bias_row(bias, i):
+            return jax.lax.dynamic_update_slice(
+                bias, jnp.zeros((1, v), jnp.float32), (i, 0)
+            )
+
+        write_rows_j = jax.jit(write_rows, donate_argnums=(0, 1, 2))
+        zero_bias_j = jax.jit(zero_bias_row, donate_argnums=(0,))
+        # compile AHEAD OF TIME on abstract shapes: a live-serving lazy
+        # build must not allocate a throwaway [slots] KV cache next to
+        # the real one (the pool cache is the largest live buffer — a
+        # second copy could OOM a cache-sized deployment mid-traffic).
+        # Shapes/dtypes/shardings come from the LIVE state's metadata.
+        def abs_of(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+
+        with self._work:
+            cache_meta = jax.tree.map(abs_of, self.cache)
+            tok_meta = abs_of(self._last_tokens)
+            key_meta = abs_of(self._key)
+        params_meta = jax.tree.map(abs_of, self.params)
+        f32v = jax.ShapeDtypeStruct((n,), jnp.float32)
+        i32v = jax.ShapeDtypeStruct((n,), jnp.int32)
+        rows_b = jax.ShapeDtypeStruct((n, v), jnp.bool_)
+        rows_f = jax.ShapeDtypeStruct((n, v), jnp.float32)
+        decode_pen_exec = decode_pen.lower(
+            params_meta, tok_meta, cache_meta, key_meta,
+            f32v, i32v, f32v, f32v, rows_b, f32v, rows_f, f32v, f32v,
+            rows_f,
+        ).compile()
+        with self._work:
+            self._decode_pen = decode_pen_exec
+            self._write_rows = write_rows_j
+            self._zero_bias = zero_bias_j
+            self._pres = jnp.zeros((n, v), jnp.bool_)
+            self._cnts = jnp.zeros((n, v), jnp.float32)
+            self._bias = jnp.zeros((n, v), jnp.float32)
+            self._reps = np.ones(n, np.float32)
+            self._pps = np.zeros(n, np.float32)
+            self._fps = np.zeros(n, np.float32)
+            self._pen_dirty = True
+            self._reps_dev = self._pps_dev = self._fps_dev = None
+            self._pen_ready = True
+            self._pen_starting = False
+
+    def _pen_kick(self) -> None:
+        """Start the one-shot background build of the penalty machinery
+        (caller holds the pool lock)."""
+        if self._pen_starting or self._pen_ready:
+            return
+        self._pen_starting = True
+
+        def build() -> None:
+            try:
+                self._enable_penalties()
+            except BaseException:
+                # a failed build must not wedge the flag: the next
+                # penalized submit retries (requests solo meanwhile)
+                self._pen_starting = False
+                raise
+
+        threading.Thread(target=build, daemon=True).start()
 
     def _place(self, cache: dict) -> dict:
         if self._cache_shardings is None:
@@ -235,14 +341,29 @@ class DecodePool:
         sampler: Any,
         stop: Optional[threading.Event] = None,
         stop_tokens: frozenset = frozenset(),
+        penalty: Optional[tuple] = None,
     ) -> "queue.Queue":
         """Claim a slot for a prefilled request; returns the queue its
         decoded token ids (then DONE) arrive on. Raises queue.Full when all
-        slots are busy — callers fall back to the solo decode path."""
+        slots are busy — callers fall back to the solo decode path.
+
+        ``penalty`` pools a penalized request: (presence_row [1, V] bool,
+        counts_row [1, V] f32, bias_row [1, V] f32, repetition_penalty,
+        presence_penalty, frequency_penalty) — rows already include the
+        first emitted token, matching ``first_token``. Raises queue.Full
+        while the penalized machinery is off/still building (the caller
+        solos; a lazy build starts in the background on first use)."""
         out: "queue.Queue" = queue.Queue()
         with self._work:
             if self._closed:
                 raise RuntimeError("decode pool closed")
+            if penalty is not None and not self._pen_ready:
+                if self._pen_mode == "lazy":
+                    self._pen_kick()
+                raise queue.Full(
+                    "penalized pool path "
+                    + ("disabled" if self._pen_mode == "off" else "warming")
+                )
             if not self._free:
                 raise queue.Full("no free decode slots")
             slot = self._free.pop()
@@ -259,6 +380,18 @@ class DecodePool:
                 self._top_ps[slot.index] = sampler.top_p
                 self._min_ps[slot.index] = sampler.min_p
                 self._sampling_dirty = True
+            if penalty is not None:
+                pres_row, cnt_row, bias_row, rep, pp, fp = penalty
+                self._pres, self._cnts, self._bias = self._write_rows(
+                    self._pres, self._cnts, self._bias,
+                    pres_row, cnt_row.astype(jnp.float32),
+                    bias_row.astype(jnp.float32), slot.index,
+                )
+                self._reps[slot.index] = rep
+                self._pps[slot.index] = pp
+                self._fps[slot.index] = fp
+                self._pen_dirty = True
+                self._pen_slots.add(slot.index)
             # cache/token writes happen under the lock: jax sequences them
             # after any in-flight chunk (their inputs are its outputs), so
             # the new request's first real decode lands in the next
@@ -292,6 +425,7 @@ class DecodePool:
             slot.request = None
         self._active.clear()
         self._free = list(reversed(self._slots))
+        self._pen_slots.clear()
 
     def _loop(self) -> None:
         in_flight: deque = deque()  # (records, toks_dev, dispatch_start)
@@ -319,12 +453,29 @@ class DecodePool:
                         self._sampling_dirty = False
                     dispatch_start = _perf_counter()
                     # ONE dispatch: RNG advance and the feed-forward token
-                    # slice happen inside the jitted chunk
-                    toks_dev, self._last_tokens, self._key, self.cache = self._decode(
-                        self.params, self._last_tokens, self.cache, self._key,
-                        self._temps_dev, self._top_ks_dev, self._top_ps_dev,
-                        self._min_ps_dev,
-                    )
+                    # slice happen inside the jitted chunk. The penalized
+                    # executable runs only while a penalized slot is
+                    # active — penalty-free traffic keeps the plain one
+                    if self._pen_slots:
+                        if self._pen_dirty:
+                            self._reps_dev = jnp.asarray(self._reps)
+                            self._pps_dev = jnp.asarray(self._pps)
+                            self._fps_dev = jnp.asarray(self._fps)
+                            self._pen_dirty = False
+                        (toks_dev, self._last_tokens, self._key, self.cache,
+                         self._pres, self._cnts) = self._decode_pen(
+                            self.params, self._last_tokens, self.cache,
+                            self._key, self._temps_dev, self._top_ks_dev,
+                            self._top_ps_dev, self._min_ps_dev, self._pres,
+                            self._reps_dev, self._cnts, self._pps_dev,
+                            self._fps_dev, self._bias,
+                        )
+                    else:
+                        toks_dev, self._last_tokens, self._key, self.cache = self._decode(
+                            self.params, self._last_tokens, self.cache, self._key,
+                            self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                            self._min_ps_dev,
+                        )
                     # start the D2H copy NOW: the transfer begins the moment
                     # the chunk's compute finishes, so the blocking fetch
                     # below waits on an already-in-flight copy and the
@@ -426,6 +577,20 @@ class DecodePool:
                         self._top_ps[index] = 1.0
                         self._min_ps[index] = 0.0
                         self._sampling_dirty = True
+                    if index in self._pen_slots:
+                        # identity knobs: a plain request reusing the slot
+                        # under the penalized executable must sample
+                        # exactly like the plain one. Presence/counts need
+                        # no reset — identity knobs neutralize them (and
+                        # lockstep garbage decode re-dirties them anyway);
+                        # the bias row is written only at submit and
+                        # applied unconditionally, so IT must be zeroed.
+                        self._pen_slots.discard(index)
+                        self._reps[index] = 1.0
+                        self._pps[index] = 0.0
+                        self._fps[index] = 0.0
+                        self._pen_dirty = True
+                        self._bias = self._zero_bias(self._bias, index)
         if self._depth_gauge:
             self._depth_gauge.set(len(self._active))
         if self._mfu_gauge is not None and delivered:

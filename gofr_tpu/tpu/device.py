@@ -238,6 +238,16 @@ class TPUDevice:
         )
         if self._pool_depth < 1:
             raise ValueError("DECODE_PIPELINE must be >= 1")
+        # lazy (default): the penalized-pool executable builds in the
+        # background on first penalized request (which solos meanwhile);
+        # eager: build at boot; off: penalized requests always decode solo
+        self._pool_penalties = config.get_or_default(
+            "DECODE_POOL_PENALTIES", "lazy"
+        ).strip().lower()
+        if self._pool_penalties not in ("lazy", "eager", "off"):
+            raise ValueError(
+                "DECODE_POOL_PENALTIES must be lazy, eager, or off"
+            )
         self._last_reinit = 0.0
         self._reinit_lock = threading.Lock()
         # prefill MFU steady-state window (see _run_batch): completions
@@ -393,6 +403,7 @@ class TPUDevice:
                 peak_hbm_bw=self.peak_hbm_bw,
                 model=self.model_name,
                 pipeline_depth=self._pool_depth,
+                penalties=self._pool_penalties,
             )
         self.batcher = DynamicBatcher(
             self._run_batch,
@@ -1347,20 +1358,30 @@ class _TransformerRunner:
             )
 
         # continuous batching: unseeded requests decode in the shared pool
-        # (seeded ones need the exact per-request key sequence — solo path)
+        # (seeded ones need the exact per-request key sequence — solo
+        # path). Penalized requests join too: their presence/counts/bias
+        # rows ride per-slot pool state (the pool raises Full while that
+        # machinery is off or still building, and they solo below)
         if (
             decode_pool is not None and not sampler.seeded
-            and presence is None and not logprobs and adapter is None
+            and not logprobs and adapter is None
         ):
             import queue as queue_mod
 
             from gofr_tpu.tpu.decode_pool import DONE, PoolFailure
 
+            penalty = None
+            if presence is not None:
+                penalty = (
+                    presence, counts, bias_row,
+                    sampler.repetition_penalty, sampler.presence_penalty,
+                    sampler.frequency_penalty,
+                )
             try:
                 slot_q = decode_pool.submit(
                     state["cache"], state["length"], token,
                     max_new_tokens - 1, sampler, stop,
-                    stop_tokens=stop_tokens,
+                    stop_tokens=stop_tokens, penalty=penalty,
                 )
             except (queue_mod.Full, RuntimeError) as exc:
                 from gofr_tpu.tpu.decode_pool import _POOL_DEBUG
