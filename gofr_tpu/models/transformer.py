@@ -349,6 +349,121 @@ def verify_chunk(
     return next_ids, new_cache
 
 
+def verify_chunk_sampled(
+    params: dict,
+    tokens: jnp.ndarray,
+    cache: dict,
+    cfg: TransformerConfig,
+    draft_toks: jnp.ndarray,
+    q: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray | float,
+    top_k: jnp.ndarray | int = 0,
+    top_p: jnp.ndarray | float = 1.0,
+    min_p: jnp.ndarray | float = 0.0,
+) -> tuple:
+    """Canonical speculative SAMPLING verification (accept draft token x
+    with prob min(1, p(x)/q(x)); on the first reject, resample from the
+    residual normalize(max(p - q, 0)); after a full accept, sample the
+    bonus from p) — the emitted sequence is distributed EXACTLY as
+    sampling from the target's warped p, whatever the draft proposes.
+
+    ``tokens`` [B, k] is the pending token + k-1 draft tokens;
+    ``draft_toks`` [B, k-1] and ``q`` [B, k-1, V] are the draft's
+    choices and the warped distributions it sampled them from (same
+    temperature/top-k/top-p/min-p knobs — the guarantee is for the
+    warped target distribution). Only k-1 drafts are tested so the
+    accepted prefix always fits the draft cache's k written positions
+    (the greedy path's same invariant). Returns (emitted [B, k], n_acc
+    [B], advanced key, cache): emitted[:, j] for j < n_acc are accepted
+    drafts, emitted[:, n_acc] is the correction/bonus, positions beyond
+    are garbage."""
+    from gofr_tpu.ops.sampling import warped_probs
+
+    b, s = tokens.shape
+    k_drafts = s - 1
+    x, k_new, v_new, starts = _run_cached(params, tokens, cache, cfg)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)  # [B, S, V]
+    v = logits.shape[-1]
+    p = warped_probs(
+        logits.reshape(b * s, v), temperature, top_k, top_p, min_p
+    ).reshape(b, s, v)
+    # accept tests for the k-1 drafts: u*q(x) < p(x) avoids the division
+    px = jnp.take_along_axis(
+        p[:, :k_drafts, :], draft_toks[..., None], axis=-1
+    )[..., 0]  # [B, k-1]
+    qx = jnp.take_along_axis(q, draft_toks[..., None], axis=-1)[..., 0]
+    key, ku, kc = jax.random.split(key, 3)
+    u = jax.random.uniform(ku, (b, k_drafts))
+    acc = (u * qx < px).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(acc, axis=1), axis=1)  # [B], <= k-1
+    # correction at the reject position (residual) or bonus at position
+    # k-1 after a full accept: padding q with a zero row makes the
+    # residual there collapse to p — exactly the bonus distribution
+    idx = n_acc[:, None, None]
+    p_at = jnp.take_along_axis(p, idx, axis=1)[:, 0]  # [B, V]
+    q_pad = jnp.pad(q, ((0, 0), (0, 1), (0, 0)))
+    q_at = jnp.take_along_axis(q_pad, idx, axis=1)[:, 0]
+    resid = jnp.maximum(p_at - q_at, 0.0)
+    mass = jnp.sum(resid, axis=-1, keepdims=True)
+    # p <= q pointwise means rejection probability 0 — unreachable save
+    # for float dust; fall back to p rather than divide by ~0
+    dist = jnp.where(mass > 1e-9, resid / jnp.maximum(mass, 1e-9), p_at)
+    corr = jax.random.categorical(
+        kc, jnp.log(dist + 1e-30), axis=-1
+    ).astype(jnp.int32)  # [B]
+    pos = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+    draft_pad = jnp.pad(draft_toks, ((0, 0), (0, 1)))
+    emitted = jnp.where(
+        pos < n_acc[:, None], draft_pad,
+        jnp.where(pos == n_acc[:, None], corr[:, None], 0),
+    )
+    new_cache = {"k": k_new, "v": v_new, "lengths": starts + s}
+    return emitted, n_acc, key, new_cache
+
+
+def draft_chunk_sampled(
+    params: dict,
+    token: jnp.ndarray,
+    cache: dict,
+    cfg: TransformerConfig,
+    n_steps: int,
+    key: jax.Array,
+    temperature: jnp.ndarray | float,
+    top_k: jnp.ndarray | int = 0,
+    top_p: jnp.ndarray | float = 1.0,
+    min_p: jnp.ndarray | float = 0.0,
+) -> tuple:
+    """Draft proposal for speculative SAMPLING: ``n_steps`` sampled
+    steps that also return the warped per-step distributions q
+    [B, n_steps, V] — the verify side needs q at the chosen tokens for
+    the accept tests and the full rows for the residual. Returns
+    (tokens [B, n_steps], q, advanced key, cache)."""
+    from gofr_tpu.ops.sampling import warped_probs
+
+    key, sub = jax.random.split(key)
+
+    def body(carry, _):
+        tok, c, k = carry
+        logits, c = decode_step(params, tok, c, cfg)
+        k, s = jax.random.split(k)
+        qrow = warped_probs(logits, temperature, top_k, top_p, min_p)
+        nxt = jax.random.categorical(
+            s, jnp.log(qrow + 1e-30), axis=-1
+        ).astype(jnp.int32)
+        return (nxt[:, None], c, k), (nxt, qrow)
+
+    (_, cache, _), (toks, qs) = jax.lax.scan(
+        body, (token, cache, sub), None, length=n_steps
+    )
+    return (
+        jnp.transpose(toks),
+        jnp.transpose(qs, (1, 0, 2)),
+        key,
+        cache,
+    )
+
+
 def decode_chunk(
     params: dict,
     token: jnp.ndarray,

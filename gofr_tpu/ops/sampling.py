@@ -163,6 +163,32 @@ def _filter_top_k_top_p(
     return jnp.where(scaled < cutoff, _NEG_INF, scaled)
 
 
+def warped_probs(
+    logits: jnp.ndarray,
+    temperature: jnp.ndarray | float,
+    top_k: jnp.ndarray | int = 0,
+    top_p: jnp.ndarray | float = 1.0,
+    min_p: jnp.ndarray | float = 0.0,
+) -> jnp.ndarray:
+    """[N, V] logits -> the exact warped DISTRIBUTION ``sample_logits``
+    samples from (temperature scale, then top-k/top-p/min-p filters,
+    then softmax). Speculative sampling needs the full rows: the draft
+    samples from its warped q and returns it, and the target's accept
+    test and residual max(p - q, 0) both compare whole distributions.
+    Call only with temperature > 0 (greedy spec takes the argmax path)."""
+    logits = logits.astype(jnp.float32)
+    n = logits.shape[0]
+    temperature = jnp.asarray(temperature, jnp.float32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    filtered = _filter_top_k_top_p(
+        scaled,
+        jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (n,)),
+        jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (n, 1)),
+        jnp.broadcast_to(jnp.asarray(min_p, jnp.float32), (n, 1)),
+    )
+    return jax.nn.softmax(filtered, axis=-1)
+
+
 @jax.jit
 def sample_logits(
     logits: jnp.ndarray,

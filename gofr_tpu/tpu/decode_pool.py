@@ -293,13 +293,27 @@ class DecodePool:
             f32v, i32v, f32v, f32v, rows_b, f32v, rows_f, f32v, f32v,
             rows_f,
         ).compile()
+        # warm the slot write/zero ops here too: submit and _deliver call
+        # them under the pool lock, where a first-use trace+compile would
+        # stall every pooled stream for the compile duration
+        pres0 = jnp.zeros((n, v), jnp.bool_)
+        cnt0 = jnp.zeros((n, v), jnp.float32)
+        bias0 = jnp.zeros((n, v), jnp.float32)
+        pres0, cnt0, bias0 = write_rows_j(
+            pres0, cnt0, bias0,
+            jnp.zeros((1, v), jnp.bool_), jnp.zeros((1, v), jnp.float32),
+            jnp.zeros((1, v), jnp.float32), 0,
+        )
+        bias0 = zero_bias_j(bias0, 0)
+        bias0.block_until_ready()
         with self._work:
             self._decode_pen = decode_pen_exec
             self._write_rows = write_rows_j
             self._zero_bias = zero_bias_j
-            self._pres = jnp.zeros((n, v), jnp.bool_)
-            self._cnts = jnp.zeros((n, v), jnp.float32)
-            self._bias = jnp.zeros((n, v), jnp.float32)
+            # the warmup wrote zero rows into zeros — still all-zero state
+            self._pres = pres0
+            self._cnts = cnt0
+            self._bias = bias0
             self._reps = np.ones(n, np.float32)
             self._pps = np.zeros(n, np.float32)
             self._fps = np.zeros(n, np.float32)

@@ -1144,9 +1144,20 @@ class _TransformerRunner:
         self._prefix_lock = threading.Lock()
         self.prefix_stats = {"hits": 0, "misses": 0}
         if self.spec is not None:
-            from gofr_tpu.models.transformer import verify_chunk
+            from gofr_tpu.models.transformer import (
+                verify_chunk,
+                verify_chunk_sampled,
+            )
 
             self._verify = jax.jit(lambda p, t, c: verify_chunk(p, t, c, cfg))
+            # speculative SAMPLING verify (temperature > 0): warmed in
+            # warmup() next to the greedy verify
+            self._verify_sampled = jax.jit(
+                lambda p, t, c, d, q, key, temp, tk, tp, mp:
+                verify_chunk_sampled(
+                    p, t, c, cfg, d, q, key, temp, tk, tp, mp
+                )
+            )
             self._set_cache_len = _cache_with_len
         # shared key for greedy decode (temperature 0 ignores it): skips a
         # per-chunk split op, which costs a dispatch on tunneled links
@@ -1344,17 +1355,26 @@ class _TransformerRunner:
         if max_new_tokens <= 1:
             return (out, lps) if logprobs else out
 
-        # speculative decoding: greedy requests with a configured draft
-        # take the draft-and-verify path (exactly the target's greedy
-        # output; DRAFT_MODEL_NAME opts the deployment into latency mode,
-        # so these requests bypass the throughput pool)
-        if (
-            self.spec is not None and sampler.greedy and presence is None
+        # speculative decoding: requests with a configured draft take the
+        # draft-and-verify path (DRAFT_MODEL_NAME opts the deployment
+        # into latency mode, so these requests bypass the throughput
+        # pool). Greedy emits exactly the target's argmax; sampled
+        # (unseeded, k >= 2) uses canonical speculative sampling — the
+        # emitted sequence is distributed exactly as the target's warped
+        # distribution, whatever the draft proposes.
+        spec_ok = (
+            self.spec is not None and presence is None
             and not logprobs and adapter is None
-        ):
+        )
+        if spec_ok and sampler.greedy:
             return self._spec_generate(
                 state, ids, out, token, max_new_tokens, on_token, stop,
                 stop_tokens,
+            )
+        if spec_ok and not sampler.seeded and self.spec.k >= 2:
+            return self._spec_generate_sampled(
+                state, ids, out, token, max_new_tokens, on_token, stop,
+                stop_tokens, sampler,
             )
 
         # continuous batching: unseeded requests decode in the shared pool
@@ -1564,6 +1584,68 @@ class _TransformerRunner:
             while len(self._prefix_cache) > self._prefix_cache_size:
                 self._prefix_cache.popitem(last=False)
 
+    def _spec_emit_fn(
+        self, out: list[int], on_token: Any, stop: Any,
+        stop_tokens: frozenset, max_new_tokens: int,
+    ) -> Any:
+        """The one emit helper both spec paths share: append tokens,
+        honoring stop tokens / budget / cancellation; True = keep going."""
+
+        def emit(tokens_host: list[int]) -> bool:
+            for t in tokens_host:
+                if t in stop_tokens:
+                    return False
+                out.append(t)
+                if on_token:
+                    on_token(t)
+                if len(out) >= max_new_tokens:
+                    return False
+                if stop is not None and stop.is_set():
+                    return False
+            return True
+
+        return emit
+
+    def _spec_prefill_draft(self, ids: np.ndarray) -> dict:
+        """Draft-cache prefill mirroring the target's chunk/clip policy."""
+        chunked = ids.size > self.buckets[-1] and self._can_chunk_prefill()
+        return self.spec.prefill_prompt(
+            ids,
+            self.buckets[-1] if chunked else self._bucket_for(int(ids.size)),
+            chunked,
+        )
+
+    def _spec_tail(
+        self, cache: Any, cache_len: int, max_len: int, token: int,
+        out: list[int], max_new_tokens: int, emit: Any, stop: Any,
+        key_fn: Any, temp: float, tk: int, tp_: float, mp: float,
+    ) -> None:
+        """Capacity-tail fallback both spec paths share: the cache got
+        too full for a verify but budget remains — finish with plain
+        single-step decodes through the already-warmed n=1 chunk (the
+        sampling knobs are dynamic operands, so greedy and sampled use
+        the same executable)."""
+        if not (
+            len(out) < max_new_tokens
+            and not (stop is not None and stop.is_set())
+            and cache_len < max_len
+        ):
+            return
+        cache = self._set_cache_len(cache, cache_len)
+        while (
+            len(out) < max_new_tokens
+            and not (stop is not None and stop.is_set())
+            and cache_len < max_len
+        ):
+            toks, cache = self._decode_chunk(
+                self.params, jnp.asarray([[token]], jnp.int32), cache,
+                key_fn(), temp, tk, tp_, mp, 1,
+            )
+            token = int(np.asarray(toks)[0, 0])
+            cache_len += 1
+            if not emit([token]):
+                break
+
     def _spec_generate(
         self,
         state: Any,
@@ -1594,27 +1676,10 @@ class _TransformerRunner:
         cache_len = state["length"]
         state = None
         max_len = int(cache["k"].shape[2])
-        chunked = ids.size > self.buckets[-1] and self._can_chunk_prefill()
-        dcache = spec.prefill_prompt(
-            ids,
-            self.buckets[-1] if chunked else self._bucket_for(int(ids.size)),
-            chunked,
-        )
+        dcache = self._spec_prefill_draft(ids)
         stats = self.spec_stats
-
-        def emit(tokens_host: list[int]) -> bool:
-            """Append tokens, honoring stop conditions; True = keep going."""
-            for t in tokens_host:
-                if t in stop_tokens:
-                    return False
-                out.append(t)
-                if on_token:
-                    on_token(t)
-                if len(out) >= max_new_tokens:
-                    return False
-                if stop is not None and stop.is_set():
-                    return False
-            return True
+        emit = self._spec_emit_fn(out, on_token, stop, stop_tokens,
+                                  max_new_tokens)
 
         while (
             len(out) < max_new_tokens
@@ -1654,28 +1719,97 @@ class _TransformerRunner:
             token = int(a[n_use])  # bonus token: emitted, not yet in cache
         else:
             # natural exhaustion only (a break above means a stop
-            # condition already fired): if the cache got too full for a
-            # k+1 verify but tokens remain, finish with plain single-step
-            # decodes through the already-compiled chunk
-            if (
-                len(out) < max_new_tokens
-                and not (stop is not None and stop.is_set())
-                and cache_len < max_len
-            ):
-                cache = self._set_cache_len(cache, cache_len)
-                while (
-                    len(out) < max_new_tokens
-                    and not (stop is not None and stop.is_set())
-                    and cache_len < max_len
-                ):
-                    toks, cache = self._decode_chunk(
-                        self.params, jnp.asarray([[token]], jnp.int32), cache,
-                        self._greedy_key, 0.0, 0, 1.0, 0.0, 1,
-                    )
-                    token = int(np.asarray(toks)[0, 0])
-                    cache_len += 1
-                    if not emit([token]):  # handles stop tokens/events/max
-                        break
+            # condition already fired)
+            self._spec_tail(
+                cache, cache_len, max_len, token, out, max_new_tokens,
+                emit, stop, lambda: self._greedy_key, 0.0, 0, 1.0, 0.0,
+            )
+        return out
+
+    def _spec_generate_sampled(
+        self,
+        state: Any,
+        ids: np.ndarray,
+        out: list[int],
+        token: int,
+        max_new_tokens: int,
+        on_token: Any,
+        stop: Any,
+        stop_tokens: frozenset,
+        sampler: Any,
+    ) -> list[int]:
+        """Speculative SAMPLING (temperature > 0): per cycle the draft
+        proposes k sampled tokens with their warped distributions q, the
+        target verifies k-1 of them in one forward with the canonical
+        accept test (u < p/q) and residual resampling — every emitted
+        token is distributed exactly as sampling the target's warped p,
+        whatever the draft proposes (draft quality only sets acceptance).
+        Cache accounting mirrors the greedy path: the draft chunk writes
+        k positions (pending + k-1 drafts), so at most k-1 drafts commit
+        per cycle and the correction/bonus becomes the next pending
+        token."""
+        spec = self.spec
+        kd = spec.k - 1  # drafts tested per cycle
+        cache = state["cache"]
+        cache_len = state["length"]
+        state = None
+        max_len = int(cache["k"].shape[2])
+        dcache = self._spec_prefill_draft(ids)
+        stats = self.spec_stats
+        temp, tk, tp_ = sampler.temperature, sampler.top_k, sampler.top_p
+        mp = sampler.min_p
+        # independent keys for draft and verify: the acceptance math is
+        # exact for ANY draft randomness, and unseeded requests carry no
+        # reproducibility contract (seeded ones decode solo)
+        import secrets
+
+        dkey = jax.random.key(secrets.randbits(63))
+        vkey = jax.random.key(secrets.randbits(63))
+        emit = self._spec_emit_fn(out, on_token, stop, stop_tokens,
+                                  max_new_tokens)
+
+        while (
+            len(out) < max_new_tokens
+            and not (stop is not None and stop.is_set())
+            and cache_len + kd + 1 <= max_len
+        ):
+            token_dev = jnp.asarray([[token]], jnp.int32)
+            draft_toks, qs, dkey, dcache = spec.propose_sampled(
+                token_dev, dcache, dkey, temp, tk, tp_, mp
+            )  # [1, k], [1, k, V]
+            verify_in = jnp.concatenate(
+                [token_dev, draft_toks[:, :kd]], axis=1
+            )  # [1, kd+1]
+            emitted_dev, n_acc_dev, vkey, cache = self._verify_sampled(
+                self.params, verify_in, cache, draft_toks[:, :kd],
+                qs[:, :kd], vkey, temp, tk, tp_, mp,
+            )
+            packed = np.asarray(
+                jnp.concatenate([emitted_dev, n_acc_dev[:, None]], axis=1)
+            )  # ONE host fetch per cycle
+            row = packed[0, : kd + 1]
+            n_acc = int(packed[0, kd + 1])
+            n_use = max(min(n_acc, max_new_tokens - len(out) - 1), 0)
+            with self._spec_lock:
+                stats["cycles"] += 1
+                stats["drafted"] += kd
+                stats["accepted"] += n_acc
+            # row[:n_use] accepted drafts + row[n_use] correction/bonus
+            # (or, under the budget clamp, an accepted draft — equally a
+            # sample from p); the last emitted token becomes the pending
+            # one and is NOT yet in the cache
+            keep_going = emit([int(t) for t in row[: n_use + 1]])
+            cache_len += 1 + n_use
+            if not keep_going:
+                break
+            cache = self._set_cache_len(cache, cache_len)
+            dcache = spec.reset_len(dcache, cache_len)
+            token = int(row[n_use])
+        else:
+            self._spec_tail(
+                cache, cache_len, max_len, token, out, max_new_tokens,
+                emit, stop, sampler.take_key, temp, tk, tp_, mp,
+            )
         return out
 
     def warmup(self, progress: Any = None) -> None:
@@ -1771,7 +1905,33 @@ class _TransformerRunner:
                 self._greedy_key, 0.0, 0, 1.0, 0.0, 1,
             )
             t1.block_until_ready()
-            self._set_cache_len(vcache, 1)
+            # _cache_with_len donates: keep the RESULT for the sampled
+            # warm below (the input array is deleted)
+            vcache = self._set_cache_len(vcache, 1)
+            if spec.k >= 2:
+                # speculative SAMPLING executables (draft sampled chunk +
+                # sampled verify): the first unseeded temperature>0
+                # request must not pay two full-model compiles.
+                # reset_len DONATES its input — rebuild the throwaway
+                # draft cache rather than reuse a deleted array
+                if progress:
+                    progress("compiling sampled draft chunk + verify")
+                dcache = spec.prefill_prompt(
+                    np.ones((4,), np.int32), self.buckets[0], False
+                )
+                stoks, sq, _, dcache = spec.propose_sampled(
+                    jnp.zeros((1, 1), jnp.int32), dcache,
+                    jax.random.key(0), 1.0, 0, 1.0, 0.0,
+                )
+                sin = jnp.concatenate(
+                    [jnp.zeros((1, 1), jnp.int32), stoks[:, : spec.k - 1]],
+                    axis=1,
+                )
+                se, _, _, _ = self._verify_sampled(
+                    self.params, sin, vcache, stoks[:, : spec.k - 1],
+                    sq[:, : spec.k - 1], jax.random.key(1), 1.0, 0, 1.0, 0.0,
+                )
+                se.block_until_ready()
 
 
 def _prompt_chunks(ids: np.ndarray, bucket: int):
@@ -1876,6 +2036,27 @@ class _SpecEngine:
                 p, t, c, dcfg, k, jax.random.key(0), 0.0, 0, 1.0
             )
         )
+        from gofr_tpu.models.transformer import draft_chunk_sampled
+
+        # sampled proposals share the greedy chunk's k-step cache-write
+        # pattern (the verify side tests k-1 of them); warmed in the
+        # device's warmup() next to the greedy chunk
+        self._chunk_sampled = jax.jit(
+            lambda p, t, c, key, temp, tk, tp, mp: draft_chunk_sampled(
+                p, t, c, dcfg, k, key, temp, tk, tp, mp
+            )
+        )
+
+    def propose_sampled(
+        self, token_dev: Any, cache: dict, key: Any,
+        temp: float, tk: int, tp: float, mp: float,
+    ) -> tuple:
+        """k sampled draft tokens [1, k] plus their warped distributions
+        [1, k, V] and the advanced draft key."""
+        return self._chunk_sampled(
+            self.params, token_dev, cache, key, temp, tk, tp, mp
+        )
+
     def prefill_prompt(self, ids: np.ndarray, bucket: int, chunked: bool) -> dict:
         """Run the prompt through the draft -> a fresh [1]-row draft cache
         holding exactly the prompt (mirrors the target-cache invariant).

@@ -102,14 +102,17 @@ def test_spec_cache_capacity_tail(plain, spec):
         plain.generate(prompt, max_new_tokens=50)
 
 
-def test_sampled_requests_skip_spec(spec):
+def test_seeded_requests_skip_spec(spec):
+    # SEEDED sampled requests bypass the draft (exact per-request key
+    # sequence); unseeded sampled ones take speculative sampling — see
+    # tests/test_spec_sampling.py
     from gofr_tpu.ops.sampling import Sampler
 
     before = dict(spec.runner.spec_stats)
     s = Sampler(temperature=1.0, seed=3)
     out = spec.generate([1, 2, 3], max_new_tokens=5, sampler=s)
     assert len(out) == 5
-    assert spec.runner.spec_stats == before  # sampled path never drafts
+    assert spec.runner.spec_stats == before  # seeded path never drafts
 
 
 def test_spec_overlong_prompt_chunks_like_target():
