@@ -25,6 +25,9 @@ from gofr_tpu.context import Context
 from gofr_tpu.handler import (
     Handler,
     catch_all_handler,
+    adapter_load_handler,
+    adapter_unload_handler,
+    adapters_list_handler,
     favicon_handler,
     health_handler,
     make_endpoint,
@@ -143,6 +146,9 @@ class App:
         self.router.add("GET", "/admin/profiler", make_endpoint(profiler_status_handler, self.container))
         self.router.add("POST", "/admin/profiler/start", make_endpoint(profiler_start_handler, self.container))
         self.router.add("POST", "/admin/profiler/stop", make_endpoint(profiler_stop_handler, self.container))
+        self.router.add("GET", "/admin/adapters", make_endpoint(adapters_list_handler, self.container))
+        self.router.add("POST", "/admin/adapters", make_endpoint(adapter_load_handler, self.container))
+        self.router.add("DELETE", "/admin/adapters/{name}", make_endpoint(adapter_unload_handler, self.container))
         self.router.set_not_found(make_endpoint(catch_all_handler, self.container))
 
     def run(self) -> None:

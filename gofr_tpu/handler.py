@@ -128,6 +128,39 @@ def _check_admin(ctx: Context) -> None:
         raise UnauthenticatedError("admin token required")
 
 
+def adapters_list_handler(ctx: Context) -> Any:
+    _check_admin(ctx)
+    if ctx.tpu is None:
+        from gofr_tpu.errors import HTTPError
+
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    return {"adapters": ctx.tpu.list_adapters()}
+
+
+def adapter_load_handler(ctx: Context) -> Any:
+    """POST /admin/adapters {name, path}: load a LoRA adapter artifact
+    over the serving base at runtime — no restart, no reload of the base
+    weights (n adapters cost n x adapter bytes)."""
+    from gofr_tpu.errors import HTTPError, InvalidParamError
+
+    _check_admin(ctx)
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    body = ctx.bind() if ctx.request.body else {}
+    if not isinstance(body, dict) or "name" not in body or "path" not in body:
+        raise InvalidParamError('body (expected {"name": ..., "path": ...})')
+    return {"adapters": ctx.tpu.load_adapter(body["name"], body["path"])}
+
+
+def adapter_unload_handler(ctx: Context) -> Any:
+    from gofr_tpu.errors import HTTPError
+
+    _check_admin(ctx)
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    return {"adapters": ctx.tpu.unload_adapter(ctx.request.path_param("name"))}
+
+
 def profiler_status_handler(ctx: Context) -> Any:
     from gofr_tpu.profiling import profiler
 

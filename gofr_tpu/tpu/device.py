@@ -468,6 +468,7 @@ class TPUDevice:
         stop_tokens: Optional[Any] = None,
         logprobs: bool = False,
         adapter: Optional[str] = None,
+        adapter_params: Optional[Any] = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         """Autoregressive generation (transformer models): prefill goes
         through the dynamic batcher (TTFT path); decode steps run per
@@ -490,7 +491,7 @@ class TPUDevice:
                 sampler=sampler, stop_tokens=stop_tokens,
                 decode_pool=self.decode_pool,
                 prefill_batcher=self.batcher, logprobs=logprobs,
-                adapter=adapter,
+                adapter=adapter, adapter_params=adapter_params,
                 ttft_cb=lambda: self._ttft.observe(
                     time.perf_counter() - start, model=self.model_name, op="generate"
                 ),
@@ -524,12 +525,18 @@ class TPUDevice:
         each item is a (token, raw_logprob) pair instead of a bare id.
         Closing the iterator (client disconnect) cancels the background
         decode instead of letting it run to completion unread."""
+        adapter_params = None
         if adapter is not None:
             # validate EAGERLY (this wrapper is not a generator, so the
             # check runs before the transport commits a 200): an unknown
-            # adapter must 400 exactly like the non-streaming path
+            # adapter must 400 exactly like the non-streaming path. The
+            # resolved TREE is pinned and passed down — a concurrent
+            # runtime unload between this check and the background
+            # decode thread must not turn the committed 200 into an
+            # error frame
             self.wait_ready(600.0)
-            if adapter not in getattr(self.runner, "adapters", {}):
+            adapter_params = getattr(self.runner, "adapters", {}).get(adapter)
+            if adapter_params is None:
                 from gofr_tpu.errors import InvalidParamError
 
                 raise InvalidParamError(
@@ -550,11 +557,13 @@ class TPUDevice:
 
                 raise InvalidParamError(str(exc)) from None
         return self._stream_iter(
-            tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs
+            tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs,
+            adapter_params,
         )
 
     def _stream_iter(
-        self, tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs
+        self, tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs,
+        adapter_params=None,
     ) -> Any:
         import queue as queue_mod
         import threading
@@ -569,7 +578,7 @@ class TPUDevice:
                 self.generate(
                     tokens, max_new_tokens, on_token=out.put, stop=stop,
                     sampler=sampler, stop_tokens=stop_tokens, adapter=adapter,
-                    logprobs=logprobs,
+                    logprobs=logprobs, adapter_params=adapter_params,
                 )
             except BaseException as exc:
                 failure.append(exc)
@@ -789,6 +798,72 @@ class TPUDevice:
         # tiny device round-trip proves the runtime is alive
         probe = jnp.zeros((8,), jnp.float32) + 1.0
         return bool(np.asarray(probe).sum() == 8.0)
+
+    # -- runtime multi-LoRA management (admin surface) -----------------------
+    def list_adapters(self) -> list[str]:
+        self.wait_ready(600.0)
+        return sorted(getattr(self.runner, "adapters", None) or {})
+
+    def load_adapter(self, name: str, path: str) -> list[str]:
+        """Load a LoRA adapter artifact over the serving base at RUNTIME
+        (same artifact format as the boot-time ``LORA_ADAPTERS`` spec).
+        The swap is one dict assignment: in-flight requests keep the tree
+        they resolved, new requests see the new adapter immediately.
+        Returns the loaded-adapter names."""
+        from gofr_tpu.errors import InvalidParamError
+
+        self.wait_ready(600.0)
+        runner = self.runner
+        if not isinstance(name, str) or not name:
+            raise InvalidParamError('"name" must be a non-empty string')
+        if not isinstance(path, str) or not path:
+            raise InvalidParamError('"path" must be a non-empty string')
+        if getattr(runner, "adapters", None) is None:
+            raise InvalidParamError(
+                "adapters need a transformer model (MODEL_NAME)"
+            )
+        mesh = getattr(runner, "mesh", None)
+        if mesh is not None and (
+            mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1) > 1
+        ):
+            # the same gate the boot-time LORA_ADAPTERS path enforces
+            raise InvalidParamError(
+                "adapters serve single-row (solo) requests — use a "
+                "tp-only TPU_MESH or no mesh"
+            )
+        from gofr_tpu.models.lora import apply_adapter
+        from gofr_tpu.training.checkpoint import restore_params
+
+        try:
+            wrapped = apply_adapter(runner.params, restore_params(path))
+        except Exception as exc:
+            # a bad path/artifact is a caller error, not a server fault
+            raise InvalidParamError(
+                f"cannot load adapter from {path!r}: {exc}"
+            ) from exc
+        # record in the BOOT SPEC too: a device reinit (auto-rebuild on
+        # probe failure) reconstructs the runner from _lora_adapters, and
+        # a runtime-loaded adapter must survive that — and if a reinit
+        # replaced the runner mid-load, the spec is what heals the set
+        self._lora_adapters[name] = path
+        self.runner.adapters[name] = wrapped
+        self.logger.info(f"adapter '{name}' loaded from {path}")
+        return sorted(self.runner.adapters)
+
+    def unload_adapter(self, name: str) -> list[str]:
+        """Drop a named adapter. In-flight requests that already resolved
+        it finish on the tree they hold; new requests get a 400."""
+        from gofr_tpu.errors import InvalidParamError
+
+        self.wait_ready(600.0)
+        adapters = getattr(self.runner, "adapters", None) or {}
+        if adapters.pop(name, None) is None:
+            raise InvalidParamError(
+                f"adapter '{name}' (loaded: {sorted(adapters)})"
+            )
+        self._lora_adapters.pop(name, None)  # keep the reinit spec in sync
+        self.logger.info(f"adapter '{name}' unloaded")
+        return sorted(adapters)
 
     def close(self) -> None:
         self._closed = True  # an in-flight background boot self-tears-down
@@ -1255,6 +1330,7 @@ class _TransformerRunner:
         ttft_cb: Any = None,
         logprobs: bool = False,
         adapter: Optional[str] = None,
+        adapter_params: Optional[Any] = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         if sampler is None:
             from gofr_tpu.ops.sampling import Sampler
@@ -1264,13 +1340,21 @@ class _TransformerRunner:
         ids = self.prepare(tokens)
         prm = self.params
         if adapter is not None:
-            if adapter not in self.adapters:
+            # ONE dict read: adapters can be unloaded at runtime, so a
+            # membership check followed by a second lookup could race.
+            # The streaming bridge passes the tree it pinned at its eager
+            # pre-commit check (adapter_params) — a concurrent unload
+            # must not fail a stream the transport already accepted.
+            prm = (
+                adapter_params if adapter_params is not None
+                else self.adapters.get(adapter)
+            )
+            if prm is None:
                 from gofr_tpu.errors import InvalidParamError
 
                 raise InvalidParamError(
                     f"adapter '{adapter}' (loaded: {sorted(self.adapters)})"
                 )
-            prm = self.adapters[adapter]
             # adapter weights differ from the batch's: prefill solo (one
             # [1, bucket] row, bucket sized to the prompt) and skip the
             # shared prefix cache/pool/spec

@@ -146,3 +146,103 @@ def test_adapters_serve_over_w8a8_base(adapter_paths):
         assert (ad_t, ad_lp) == dev.generate(
             prompt, max_new_tokens=8, adapter=name, logprobs=True
         )
+
+
+def test_runtime_adapter_management(adapter_paths):
+    """Adapters load/unload at RUNTIME (no restart): the swap is one
+    dict assignment, new requests see it immediately, and errors are
+    parameter errors, never 500s."""
+    _, paths = adapter_paths
+    (n1, (p1, _)), (n2, (p2, _)) = list(paths.items())
+    with serving_device(DECODE_CHUNK="4") as dev:  # boots with NO adapters
+        assert dev.list_adapters() == []
+        with pytest.raises(InvalidParamError):
+            dev.generate([1, 2, 3], max_new_tokens=4, adapter=n1)
+        assert dev.load_adapter(n1, p1) == [n1]
+        base = dev.generate([1, 2, 3], max_new_tokens=8, logprobs=True)
+        a1 = dev.generate([1, 2, 3], max_new_tokens=8, adapter=n1,
+                          logprobs=True)
+        assert a1 != base  # the runtime-loaded adapter reaches the forward
+        assert dev.load_adapter(n2, p2) == sorted([n1, n2])
+        assert dev.unload_adapter(n1) == [n2]
+        with pytest.raises(InvalidParamError):
+            dev.generate([1, 2, 3], max_new_tokens=4, adapter=n1)
+        with pytest.raises(InvalidParamError):
+            dev.unload_adapter("nope")
+        with pytest.raises(InvalidParamError):
+            dev.load_adapter(n1, "/no/such/path")
+        with pytest.raises(InvalidParamError):
+            dev.load_adapter("", p1)
+
+
+def test_admin_adapter_routes(adapter_paths, tmp_path):
+    """The /admin/adapters surface over HTTP: token-gated, loads and
+    unloads against a live server."""
+    import json as _json
+    import socket
+    import urllib.error
+    import urllib.request
+
+    import gofr_tpu
+
+    _, paths = adapter_paths
+    name, (path, _) = next(iter(paths.items()))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {"HTTP_PORT": str(port), "LOG_LEVEL": "FATAL", "MODEL_NAME": "tiny",
+           "BATCH_MAX_SIZE": "2", "BATCH_TIMEOUT_MS": "1",
+           "ADMIN_TOKEN": "hunter2"}
+    old = {k: os.environ.get(k) for k in env}
+    # EnvConfig reads the live environment per get(): ADMIN_TOKEN must
+    # stay set while requests run — ONE try restores it on every exit
+    # path (incl. a failed boot), so nothing leaks into later tests
+    os.environ.update(env)
+    app = None
+    cwd = os.getcwd()
+
+    def call(method, route, payload=None, token="hunter2"):
+        req = urllib.request.Request(
+            base + route, method=method,
+            data=_json.dumps(payload).encode() if payload is not None else None,
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {token}"} if token else {})},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, _json.loads(resp.read())
+
+    try:
+        os.chdir(tmp_path)
+        try:
+            app = gofr_tpu.new()
+        finally:
+            os.chdir(cwd)
+        app.start()
+        base = f"http://127.0.0.1:{app.http_port}"
+        try:
+            call("GET", "/admin/adapters", token=None)
+            raise AssertionError("expected 401")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+        status, body = call("GET", "/admin/adapters")
+        assert (status, body["data"]["adapters"]) == (200, [])
+        status, body = call("POST", "/admin/adapters",
+                            {"name": name, "path": path})
+        assert body["data"]["adapters"] == [name]
+        status, body = call("DELETE", f"/admin/adapters/{name}")
+        assert body["data"]["adapters"] == []
+        try:
+            call("DELETE", f"/admin/adapters/{name}")
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        try:
+            call("POST", "/admin/adapters", {"name": "x"})
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        if app is not None:
+            app.shutdown()
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
