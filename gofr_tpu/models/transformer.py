@@ -530,11 +530,7 @@ def decode_chunk(
         nxt = sample_logits(sample_in, sub, temperature, top_k, top_p, min_p)
         outs = nxt
         if with_logprobs:
-            lp = jnp.take_along_axis(
-                jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
-                nxt[:, None], axis=-1,
-            )[:, 0]
-            outs = (nxt, lp)
+            outs = (nxt, _chosen_logprobs(logits, nxt))
         if presence is None:
             return (nxt[:, None], c, k), outs
         pres = update_presence(pres, nxt)
@@ -556,6 +552,16 @@ def decode_chunk(
     return result
 
 
+def _chosen_logprobs(logits: jnp.ndarray, nxt: jnp.ndarray) -> jnp.ndarray:
+    """[B] f32 RAW log-probabilities of the chosen tokens — log-softmax of
+    the UNPENALIZED logits, the one logprob convention every decode path
+    (solo, pool, penalized pool) shares."""
+    return jnp.take_along_axis(
+        jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+        nxt[:, None], axis=-1,
+    )[:, 0]
+
+
 def decode_chunk_pool(
     params: dict,
     token: jnp.ndarray,
@@ -567,20 +573,37 @@ def decode_chunk_pool(
     top_k: jnp.ndarray,
     top_p: jnp.ndarray,
     min_p: jnp.ndarray | float = 0.0,
-) -> tuple[jnp.ndarray, jnp.ndarray, jax.Array, dict]:
-    """``decode_chunk_rows`` plus the on-device RNG advance and the
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jax.Array, dict]:
+    """PER-ROW sampling params plus the on-device RNG advance and the
     feed-forward token slice, so one pooled chunk is exactly ONE dispatch:
     on tunneled/remote devices every extra tiny host-driven op (a key
     split, a [B,1] slice) costs a dispatch round trip — measured ~135ms of
     overhead per chunk on a v5e tunnel, nearly the chunk's own compute.
-    Returns (sampled tokens [B, n_steps], next input token [B, 1],
-    advanced key, cache)."""
+
+    The chosen tokens' RAW log-softmax [B, n_steps] f32 rides every chunk
+    unconditionally: one [B, V] log-softmax per step is noise next to the
+    weight stream decode is bound by, and folding it in keeps the pool at
+    ONE executable while letting logprobs requests (including every
+    best_of candidate, which scores by mean logprob) share the batch
+    instead of decoding solo. Returns (sampled tokens [B, n_steps],
+    logprobs [B, n_steps], next input token [B, 1], advanced key,
+    cache)."""
+    from gofr_tpu.ops.sampling import sample_logits_rows
+
     key, sub = jax.random.split(key)
-    toks, cache = decode_chunk_rows(
-        params, token, cache, cfg, n_steps, sub, temperature, top_k, top_p,
-        min_p,
+
+    def body(carry, _):
+        tok, c, k = carry
+        logits, c = decode_step(params, tok, c, cfg)
+        k, s = jax.random.split(k)
+        nxt = sample_logits_rows(logits, s, temperature, top_k, top_p, min_p)
+        lp = _chosen_logprobs(logits, nxt)
+        return (nxt[:, None], c, k), (nxt, lp)
+
+    (tok, cache, _), (toks, lps) = jax.lax.scan(
+        body, (token, cache, sub), None, length=n_steps
     )
-    return toks, toks[:, -1:], key, cache
+    return jnp.transpose(toks), jnp.transpose(lps), tok, key, cache
 
 
 def decode_chunk_pool_penalized(
@@ -610,8 +633,9 @@ def decode_chunk_pool_penalized(
     the pool only when at least one active slot is penalized (the extra
     [B, V] elementwise work is noise next to the decode matmuls, but the
     plain pool path stays untouched for penalty-free deployments).
-    Returns (tokens [B, n_steps], next token [B, 1], advanced key,
-    cache, presence, counts)."""
+    Returns (tokens [B, n_steps], RAW logprobs [B, n_steps] — log-softmax
+    of the UNPENALIZED logits, the solo path's convention — next token
+    [B, 1], advanced key, cache, presence, counts)."""
     from gofr_tpu.ops.sampling import (
         apply_penalties,
         sample_logits_rows,
@@ -630,44 +654,13 @@ def decode_chunk_pool_penalized(
         k, s = jax.random.split(k)
         penalized = apply_penalties(logits, pres, rep, cnt, pp, fp, bias)
         nxt = sample_logits_rows(penalized, s, temperature, top_k, top_p, min_p)
+        lp = _chosen_logprobs(logits, nxt)
         pres = update_presence(pres, nxt)
         cnt = update_counts(cnt, nxt)
-        return (nxt[:, None], c, k, pres, cnt), nxt
+        return (nxt[:, None], c, k, pres, cnt), (nxt, lp)
 
-    (tok, cache, _, presence, counts), toks = jax.lax.scan(
+    (tok, cache, _, presence, counts), (toks, lps) = jax.lax.scan(
         body, (token, cache, sub, presence, counts), None, length=n_steps
     )
-    return jnp.transpose(toks), tok, key, cache, presence, counts
-
-
-def decode_chunk_rows(
-    params: dict,
-    token: jnp.ndarray,
-    cache: dict,
-    cfg: TransformerConfig,
-    n_steps: int,
-    key: jax.Array,
-    temperature: jnp.ndarray,
-    top_k: jnp.ndarray,
-    top_p: jnp.ndarray,
-    min_p: jnp.ndarray | float = 0.0,
-) -> tuple[jnp.ndarray, dict]:
-    """``decode_chunk`` with PER-ROW sampling params ([B] each) — the
-    continuous-batching decode pool runs many requests' decode in one
-    fixed-shape dispatch, each slot with its own temperature/top-k/
-    top-p/min-p. (Penalized requests pool through
-    ``decode_chunk_pool_penalized``'s per-slot penalty state; this
-    penalty-free variant is the common-traffic fast path.)"""
-    from gofr_tpu.ops.sampling import sample_logits_rows
-
-    def body(carry, _):
-        tok, c, k = carry
-        logits, c = decode_step(params, tok, c, cfg)
-        k, sub = jax.random.split(k)
-        nxt = sample_logits_rows(logits, sub, temperature, top_k, top_p, min_p)
-        return (nxt[:, None], c, k), nxt
-
-    (_, cache, _), toks = jax.lax.scan(
-        body, (token, cache, key), None, length=n_steps
-    )
-    return jnp.transpose(toks), cache
+    return (jnp.transpose(toks), jnp.transpose(lps), tok, key, cache,
+            presence, counts)

@@ -77,17 +77,22 @@ class _Request:
     delivery)."""
 
     __slots__ = (
-        "out_queue", "remaining", "cache_len", "stop", "stop_tokens", "finished",
+        "out_queue", "remaining", "cache_len", "stop", "stop_tokens",
+        "finished", "want_lp",
     )
 
     def __init__(self, out_queue: "queue.Queue", remaining: int, cache_len: int,
-                 stop: Optional[threading.Event], stop_tokens: frozenset):
+                 stop: Optional[threading.Event], stop_tokens: frozenset,
+                 want_lp: bool = False):
         self.out_queue: Optional[queue.Queue] = out_queue
         self.remaining = remaining
         self.cache_len = cache_len
         self.stop = stop
         self.stop_tokens = stop_tokens
         self.finished = False
+        # bursts become (token, logprob) pairs; the lps ride every chunk
+        # anyway (computed in-executable), this only picks the delivery shape
+        self.want_lp = want_lp
 
 
 class _Slot:
@@ -224,7 +229,7 @@ class DecodePool:
             )
         # warm the [n_slots]-shaped executable NOW: the first pooled request
         # must not compile under the pool lock on the serving path
-        toks, _, self._key, self.cache = self._decode(
+        toks, _, _, self._key, self.cache = self._decode(
             self.params, self._last_tokens, self.cache,
             self._key, jnp.asarray(self._temps),
             jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
@@ -356,6 +361,7 @@ class DecodePool:
         stop: Optional[threading.Event] = None,
         stop_tokens: frozenset = frozenset(),
         penalty: Optional[tuple] = None,
+        want_logprobs: bool = False,
     ) -> "queue.Queue":
         """Claim a slot for a prefilled request; returns the queue its
         decoded token ids (then DONE) arrive on. Raises queue.Full when all
@@ -382,7 +388,8 @@ class DecodePool:
                 raise queue.Full("no free decode slots")
             slot = self._free.pop()
             slot.request = _Request(out, max_new, start_len, stop,
-                                    frozenset(stop_tokens or ()))
+                                    frozenset(stop_tokens or ()),
+                                    want_lp=want_logprobs)
             if (
                 self._temps[slot.index] != sampler.temperature
                 or self._top_ks[slot.index] != sampler.top_k
@@ -442,7 +449,7 @@ class DecodePool:
         self._pen_slots.clear()
 
     def _loop(self) -> None:
-        in_flight: deque = deque()  # (records, toks_dev, dispatch_start)
+        in_flight: deque = deque()  # (records, toks_dev, lps_dev, dispatch_start)
         last_fetch_done: float = 0.0
         while True:
             with self._work:
@@ -476,8 +483,8 @@ class DecodePool:
                             self._pps_dev = jnp.asarray(self._pps)
                             self._fps_dev = jnp.asarray(self._fps)
                             self._pen_dirty = False
-                        (toks_dev, self._last_tokens, self._key, self.cache,
-                         self._pres, self._cnts) = self._decode_pen(
+                        (toks_dev, lps_dev, self._last_tokens, self._key,
+                         self.cache, self._pres, self._cnts) = self._decode_pen(
                             self.params, self._last_tokens, self.cache,
                             self._key, self._temps_dev, self._top_ks_dev,
                             self._top_ps_dev, self._min_ps_dev, self._pres,
@@ -485,7 +492,8 @@ class DecodePool:
                             self._fps_dev, self._bias,
                         )
                     else:
-                        toks_dev, self._last_tokens, self._key, self.cache = self._decode(
+                        (toks_dev, lps_dev, self._last_tokens, self._key,
+                         self.cache) = self._decode(
                             self.params, self._last_tokens, self.cache, self._key,
                             self._temps_dev, self._top_ks_dev, self._top_ps_dev,
                             self._min_ps_dev,
@@ -498,15 +506,17 @@ class DecodePool:
                     # serialized fetch — not compute — was the cap).
                     try:
                         toks_dev.copy_to_host_async()
+                        lps_dev.copy_to_host_async()
                     except (AttributeError, RuntimeError):
                         pass  # older jax / fully-addressable-only arrays
-                    in_flight.append((records, toks_dev, dispatch_start))
+                    in_flight.append((records, toks_dev, lps_dev, dispatch_start))
             # fetch the OLDEST chunk outside the lock: the device is
             # meanwhile executing the younger in-flight chunk(s), and new
             # submissions can take the lock to join the next dispatch
-            records, toks_dev, dispatch_start = in_flight.popleft()
+            records, toks_dev, lps_dev, dispatch_start = in_flight.popleft()
             fetch_start = _perf_counter()
             toks = np.asarray(toks_dev)
+            lps = np.asarray(lps_dev)
             fetch_done = _perf_counter()
             # throughput denominator: the interval between consecutive
             # deliveries at steady state (dispatch->fetch spans ~2 chunk
@@ -522,7 +532,7 @@ class DecodePool:
             )
             last_fetch_done = fetch_done
             with self._work:
-                self._deliver(records, toks, dispatch_elapsed)
+                self._deliver(records, toks, lps, dispatch_elapsed)
             if _POOL_DEBUG:
                 import sys
 
@@ -534,12 +544,14 @@ class DecodePool:
                     file=sys.stderr, flush=True,
                 )
 
-    def _deliver(self, records: list, toks: np.ndarray, elapsed: float) -> None:
+    def _deliver(self, records: list, toks: np.ndarray, lps: np.ndarray,
+                 elapsed: float) -> None:
         delivered = 0
         for index, req in records:
             if req is None or req.finished:
                 continue  # freed mid-pipeline; this chunk's row is garbage
             emitted = toks[index]
+            emitted_lps = lps[index]
             room = self.max_len - req.cache_len  # valid steps this chunk
             req.cache_len += self.chunk
             take = min(self.chunk, req.remaining, max(room, 0))
@@ -550,12 +562,15 @@ class DecodePool:
                 # per-token puts wake the consuming request thread up to
                 # chunk times per dispatch, and that GIL churn is on the
                 # worker's critical path between dispatches
-                burst: list[int] = []
-                for t in emitted[:take]:
+                burst: list = []
+                for j, t in enumerate(emitted[:take]):
                     if int(t) in req.stop_tokens:
                         hit_stop_token = True  # ends stream, not emitted
                         break
-                    burst.append(int(t))
+                    burst.append(
+                        (int(t), float(emitted_lps[j])) if req.want_lp
+                        else int(t)
+                    )
                 if burst:
                     req.out_queue.put(burst)
                     delivered += len(burst)  # only tokens a request received

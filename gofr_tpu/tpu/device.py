@@ -480,7 +480,8 @@ class TPUDevice:
         greedy. ``stop_tokens`` (iterable of ids) end generation; the stop
         token itself is not emitted. ``logprobs=True`` returns
         (tokens, logprobs) — the chosen tokens' RAW model log-softmax
-        values; these requests decode solo (like seeded ones)."""
+        values (delivered from the shared pool — logprobs ride every pool
+        chunk)."""
         self.wait_ready(600.0)
         if isinstance(tokens, str):
             tokens = self._detokenize(tokens)["tokens"]
@@ -1463,12 +1464,15 @@ class _TransformerRunner:
 
         # continuous batching: unseeded requests decode in the shared pool
         # (seeded ones need the exact per-request key sequence — solo
-        # path). Penalized requests join too: their presence/counts/bias
-        # rows ride per-slot pool state (the pool raises Full while that
-        # machinery is off or still building, and they solo below)
+        # path). Penalized requests join too (their presence/counts/bias
+        # rows ride per-slot pool state; the pool raises Full while that
+        # machinery is off or still building, and they solo below), and
+        # so do logprobs requests — the chosen tokens' logprobs ride
+        # every pool chunk, so best_of candidates and logprob evals share
+        # the batch instead of decoding solo
         if (
             decode_pool is not None and not sampler.seeded
-            and not logprobs and adapter is None
+            and adapter is None
         ):
             import queue as queue_mod
 
@@ -1486,6 +1490,7 @@ class _TransformerRunner:
                     state["cache"], state["length"], token,
                     max_new_tokens - 1, sampler, stop,
                     stop_tokens=stop_tokens, penalty=penalty,
+                    want_logprobs=logprobs,
                 )
             except (queue_mod.Full, RuntimeError) as exc:
                 from gofr_tpu.tpu.decode_pool import _POOL_DEBUG
@@ -1504,15 +1509,18 @@ class _TransformerRunner:
                     if isinstance(item, PoolFailure):
                         raise item.exc
                     for t in item:  # one burst list per decoded chunk
+                        if logprobs:
+                            t, lp = t
+                            lps.append(lp)
                         out.append(t)
                         if on_token:
-                            on_token(t)
+                            on_token((t, lps[-1]) if logprobs else t)
                         if stop is not None and stop.is_set():
                             # emission stops HERE even though the pipelined
                             # pool already queued more; the pool frees the
                             # slot at its next delivery (it checks stop too)
-                            return out
-                return out
+                            return (out, lps) if logprobs else out
+                return (out, lps) if logprobs else out
         # chunked decode: N steps + on-device sampling per dispatch, one
         # [1, N] fetch per chunk — the round trip, not the matmuls, bounds
         # tokens/sec on remote-attached devices. Length is tracked on the

@@ -270,3 +270,37 @@ def test_pool_close_mid_stream_raises_not_truncates():
     finally:
         dev.close()
         _restore(old)
+
+
+def test_pooled_logprobs_match_solo(pooled, solo):
+    """logprobs requests ride the pool (the chosen tokens' log-softmax
+    comes back with every chunk): tokens equal the solo path exactly,
+    logprobs to float tolerance (the [slots]-batch executable may
+    schedule the matmuls differently than the [1]-batch one)."""
+    import numpy as np
+
+    for prompt, n in (([1, 2, 3], 11), ([5, 6], 4)):
+        pt, plp = pooled.generate(prompt, max_new_tokens=n, logprobs=True)
+        st, slp = solo.generate(prompt, max_new_tokens=n, logprobs=True)
+        assert pt == st, (prompt, n)
+        np.testing.assert_allclose(plp, slp, rtol=1e-4, atol=1e-4)
+    # streaming consumers receive (token, logprob) pairs from the pool
+    got = []
+    out = pooled.generate([1, 2, 3], max_new_tokens=6, logprobs=True,
+                          on_token=got.append)
+    assert [t for t, _ in got] == out[0]
+    assert [lp for _, lp in got] == out[1]
+
+
+def test_pooled_penalized_logprobs(pooled, solo):
+    """Penalties + logprobs pool together; the logprobs stay RAW model
+    values (unpenalized log-softmax), matching the solo convention."""
+    import numpy as np
+
+    s = dict(presence_penalty=1.5, frequency_penalty=0.5)
+    pt, plp = pooled.generate([1, 2, 3], max_new_tokens=8, logprobs=True,
+                              sampler=Sampler(**s))
+    st, slp = solo.generate([1, 2, 3], max_new_tokens=8, logprobs=True,
+                            sampler=Sampler(**s))
+    assert pt == st
+    np.testing.assert_allclose(plp, slp, rtol=1e-4, atol=1e-4)
