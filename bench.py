@@ -116,11 +116,18 @@ def main() -> int:
         # jax.devices() call on a wedged tunnel; a subprocess probe bounds
         # that failure mode at ~3 minutes WITH an explicit diagnosis
         if not os.environ.get("BENCH_PLATFORM"):
+            probe_start = time.monotonic()
             probe_s = _probe_tunnel(errors)
             if probe_s is None:
                 result["device_tunnel"] = "wedged"
                 return 1  # the finally below prints the partial JSON
             result["device_probe_seconds"] = round(probe_s, 1)
+            # probing may have eaten into the driver window (the budgeted
+            # probe waits out a wedged-then-recovered tunnel): shrink the
+            # boot deadline so measurement time always remains
+            window = float(os.environ.get("BENCH_WINDOW", "900"))
+            spent = time.monotonic() - probe_start
+            boot_timeout = max(min(boot_timeout, window - spent - 180), 120)
         rc = _run(result, errors, model, clients, n_requests, prompt_len,
                   decode_tokens, boot_timeout, decode_streams)
     except BaseException as exc:
@@ -143,14 +150,28 @@ def _probe_tunnel(errors: list[str]) -> float | None:
     diagnosis) from "slow compile" (which this never penalises: compiles
     happen after the probe, under the boot deadline)."""
     timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
-    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+    # keep probing up to a time BUDGET: the r03/r04 tunnel wedges and
+    # recovers on its own, and a number landing after a mid-window
+    # recovery beats failing fast — a healthy run needs only ~400s of the
+    # driver's 900s window, so ~420s of probing still leaves room to boot
+    # and measure. A wedged-all-window run still exits with the explicit
+    # diagnosis well inside the window. Short (fast-fail) attempts sleep
+    # out their probe interval so the budget is honored in wall time, not
+    # burned in seconds of back-to-back failures. BENCH_PROBE_ATTEMPTS,
+    # when set, overrides the budget with a fixed attempt count (the
+    # pre-budget behavior some wrappers configure for fail-fast).
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET", "420"))
+    fixed = os.environ.get("BENCH_PROBE_ATTEMPTS")
+    deadline = time.monotonic() + (0 if fixed else budget)
+    attempts = int(fixed) if fixed else max(1, int(budget // timeout))
     script = (
         "import jax; ds = jax.devices(); "
         "print(len(ds), ds[0].platform)"
     )
-    for i in range(attempts):
-        log(f"probing device tunnel (attempt {i + 1}/{attempts}, "
-            f"{timeout:.0f}s timeout)")
+    i = 0
+    while i < attempts or (not fixed and time.monotonic() < deadline):
+        i += 1
+        log(f"probing device tunnel (attempt {i}, {timeout:.0f}s timeout)")
         start = time.perf_counter()
         try:
             proc = subprocess.run(
@@ -159,7 +180,7 @@ def _probe_tunnel(errors: list[str]) -> float | None:
             )
         except subprocess.TimeoutExpired:
             errors.append(
-                f"tunnel probe attempt {i + 1}: jax.devices() hung "
+                f"tunnel probe attempt {i}: jax.devices() hung "
                 f">{timeout:.0f}s in a fresh process"
             )
             log(errors[-1])
@@ -169,10 +190,14 @@ def _probe_tunnel(errors: list[str]) -> float | None:
             log(f"tunnel alive in {elapsed:.1f}s: {proc.stdout.strip()}")
             return elapsed
         tail = "\n".join(proc.stderr.strip().splitlines()[-3:])
-        errors.append(f"tunnel probe attempt {i + 1}: rc={proc.returncode} {tail}")
+        errors.append(f"tunnel probe attempt {i}: rc={proc.returncode} {tail}")
         log(errors[-1])
+        if not fixed and time.monotonic() < deadline:
+            # fast failure: wait out the probe interval so recovery
+            # mid-window is actually caught
+            time.sleep(max(0.0, timeout - elapsed))
     errors.append(
-        f"device tunnel wedged: {attempts} subprocess probes failed — "
+        f"device tunnel wedged: {i} subprocess probes failed — "
         "this is the environment, not the framework (see VERDICT r03)"
     )
     log(errors[-1])
