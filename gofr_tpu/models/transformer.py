@@ -552,6 +552,23 @@ def decode_chunk(
     return result
 
 
+def score_tokens(
+    params: dict, tokens: jnp.ndarray, cfg: TransformerConfig
+) -> jnp.ndarray:
+    """Teacher-forcing scoring: [B, S] token ids -> [B, S-1] f32 where
+    output[i-1] = log p(t_i | t_<i) — the loglikelihood primitive eval
+    harnesses drive (completions echo+logprobs / max_tokens=0). One
+    full-sequence forward; the [B, S, V] log-softmax stays on device and
+    only the [B, S-1] chosen values cross the link. Causal attention
+    makes bucket zero-padding safe: positions before the true length
+    never see the padded tail."""
+    logits = transformer_forward(params, tokens, cfg)
+    lps = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(
+        lps[:, :-1], tokens[:, 1:, None], axis=-1
+    )[..., 0]
+
+
 def _chosen_logprobs(logits: jnp.ndarray, nxt: jnp.ndarray) -> jnp.ndarray:
     """[B] f32 RAW log-probabilities of the chosen tokens — log-softmax of
     the UNPENALIZED logits, the one logprob convention every decode path

@@ -349,12 +349,20 @@ def _parse_request(ctx: Any, default_max: int) -> tuple:
     # completions fan-out (_parse_fanout).
     if body.get("suffix") is not None:
         raise HTTPError(400, '"suffix" is not supported by this server')
-    # nullable like the sampling knobs: explicit JSON null = the default
+    # nullable like the sampling knobs: explicit JSON null = the default.
+    # max_tokens=0 is legal ONLY with echo (pure prompt scoring, the
+    # eval-harness loglikelihood pattern) — without echo it would return
+    # nothing at all
     max_tokens = body.get("max_tokens")
     if max_tokens is None:
         max_tokens = default_max
-    if not isinstance(max_tokens, int) or max_tokens < 1:
-        raise HTTPError(400, '"max_tokens" must be a positive integer')
+    floor = 0 if body.get("echo") is True else 1
+    if not isinstance(max_tokens, int) or max_tokens < floor:
+        raise HTTPError(
+            400,
+            '"max_tokens" must be a positive integer'
+            + (" (0 allowed with echo)" if floor == 0 else ""),
+        )
     sampler = _sampler(body)
     stop_ids, stop_strs = _parse_stops(ctx, body)
     want_logprobs = body.get("logprobs") not in (None, False, 0)
@@ -540,10 +548,9 @@ def completions(ctx: Any) -> Any:
         _parse_request(ctx, default_max=16)
     )
     n, best_of, echo = _parse_fanout(body, allow_best_of=True)
-    if echo and want_logprobs:
+    if echo and want_logprobs and body.get("stream"):
         raise HTTPError(
-            400, '"echo" with "logprobs" is not supported (prompt-token '
-            "logprobs are not computed); drop one of the two"
+            400, '"echo" with "logprobs" is not supported when streaming'
         )
     if "prompt" not in body:
         # a missing prompt is almost always a caller bug (misspelled key):
@@ -560,6 +567,11 @@ def completions(ctx: Any) -> Any:
             raise HTTPError(
                 400, 'streaming with "n" > 1 or "best_of" > 1 is not '
                 "supported (interleaved multi-index SSE)"
+            )
+        if max_tokens == 0:
+            raise HTTPError(
+                400, 'streaming needs "max_tokens" >= 1 (use the '
+                "non-stream form for pure echo scoring)"
             )
         import json as _json
 
@@ -645,10 +657,32 @@ def completions(ctx: Any) -> Any:
 
         return Stream(events())
 
-    results, generated = _fanout_generate(
-        ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
-        want_logprobs, adapter, n, best_of,
-    )
+    prompt_lps = None
+    if echo and want_logprobs:
+        # teacher-forcing prompt scoring: log p(t_i | t_<i), with null
+        # for the first token (no conditional) — the OpenAI convention
+        # and the eval-harness loglikelihood pattern. The request's
+        # adapter scores too (and an unknown one 400s even on the
+        # max_tokens=0 path, where no generation would catch it)
+        prompt_lps = [None] + ctx.tpu.score(prompt_ids, adapter=adapter)
+    elif max_tokens == 0 and adapter is not None:
+        # pure echo without logprobs still must validate the adapter name
+        if adapter not in getattr(ctx.tpu.runner, "adapters", {}):
+            from gofr_tpu.errors import InvalidParamError
+
+            raise InvalidParamError(
+                f"adapter '{adapter}' "
+                f"(loaded: {sorted(getattr(ctx.tpu.runner, 'adapters', {}))})"
+            )
+    if max_tokens == 0:
+        # pure scoring (echo-only, enforced at parse): no decode at all
+        results = [([], [] if want_logprobs else None, None, "length")] * n
+        generated = 0
+    else:
+        results, generated = _fanout_generate(
+            ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
+            want_logprobs, adapter, n, best_of,
+        )
     choices = []
     for i, (out, logprobs, text, finish) in enumerate(results):
         if text is None:
@@ -661,12 +695,15 @@ def completions(ctx: Any) -> Any:
             # tokens extension below never applies); echo prepends the
             # decoded prompt
             text_val = (tok.decode(prompt_ids) + text) if echo else text
+        lp_list = logprobs
+        if prompt_lps is not None:
+            lp_list = prompt_lps + (logprobs or [])
         choice: dict[str, Any] = {
             "text": text_val,
             "index": i,
             "finish_reason": finish,
             "logprobs": (
-                {"token_logprobs": logprobs} if logprobs is not None else None
+                {"token_logprobs": lp_list} if lp_list is not None else None
             ),
         }
         if tok is None:

@@ -800,6 +800,27 @@ class TPUDevice:
         probe = jnp.zeros((8,), jnp.float32) + 1.0
         return bool(np.asarray(probe).sum() == 8.0)
 
+    def score(self, tokens: Any, adapter: Optional[str] = None) -> list[float]:
+        """Teacher-forcing prompt scoring: log p(t_i | t_<i) per position
+        (the loglikelihood primitive; see the runner's ``score``)."""
+        self.wait_ready(600.0)
+        if not hasattr(self.runner, "score"):
+            from gofr_tpu.errors import InvalidParamError
+
+            raise InvalidParamError(
+                "scoring needs an autoregressive transformer model"
+            )
+        if isinstance(tokens, str):
+            tokens = self._detokenize(tokens)["tokens"]
+        try:
+            out = self.runner.score(tokens, adapter=adapter)
+            self._requests.inc(model=self.model_name, op="score", status="ok")
+            return out
+        except Exception:
+            self._requests.inc(model=self.model_name, op="score",
+                               status="error")
+            raise
+
     # -- runtime multi-LoRA management (admin surface) -----------------------
     def list_adapters(self) -> list[str]:
         self.wait_ready(600.0)
@@ -1246,12 +1267,55 @@ class _TransformerRunner:
         # allocation dispatches (the tunneled device link makes every
         # dispatch expensive)
         self._zero_caches: dict[int, Any] = {}
+        # teacher-forcing scoring (echo+logprobs / max_tokens=0): ONE
+        # jitted callable — jax.jit's own shape-keyed cache handles the
+        # per-bucket executables; compiles lazily on first use
+        from gofr_tpu.models.transformer import score_tokens as _score_tokens
+
+        self._score_fn = jax.jit(lambda p, t: _score_tokens(p, t, cfg))
 
     def _bucket_for(self, length: int) -> int:
         for b in self.buckets:
             if length <= b:
                 return b
         return self.buckets[-1]
+
+    def score(self, tokens: Any, adapter: Optional[str] = None) -> list[float]:
+        """log p(t_i | t_<i) for every prompt position i >= 1 — the
+        teacher-forcing loglikelihood primitive (completions
+        echo+logprobs / max_tokens=0 scoring). The executable compiles
+        lazily per bucket on first use (a rare opt-in variant, by the
+        repo's compile policy); only the [S-1] chosen values cross the
+        link. ``adapter`` scores with that LoRA tree — an eval measuring
+        an adapter's loglikelihood must never silently get base-model
+        scores."""
+        from gofr_tpu.errors import InvalidParamError
+
+        # length check BEFORE prepare: prepare clips to the last max_seq
+        # tokens (the generation recency policy), which would silently
+        # misalign scores against the caller's full prompt
+        raw = tokens.get("tokens", tokens) if isinstance(tokens, dict) else tokens
+        if len(raw) > self.buckets[-1]:
+            raise InvalidParamError(
+                f"prompt of {len(raw)} tokens exceeds the largest "
+                f"compiled bucket ({self.buckets[-1]}) — scoring needs "
+                "one full-sequence forward"
+            )
+        prm = self.params
+        if adapter is not None:
+            prm = self.adapters.get(adapter)
+            if prm is None:
+                raise InvalidParamError(
+                    f"adapter '{adapter}' (loaded: {sorted(self.adapters)})"
+                )
+        ids = self.prepare(tokens)
+        n = int(ids.size)
+        if n < 2:
+            return []  # position 0 has no conditional
+        row = np.zeros((1, self._bucket_for(n)), np.int32)
+        row[0, :n] = ids
+        out = np.asarray(self._score_fn(prm, jnp.asarray(row)))[0, : n - 1]
+        return [float(x) for x in out]
 
     def prepare(self, payload: Any) -> np.ndarray:
         if isinstance(payload, dict):

@@ -346,7 +346,7 @@ def test_unsupported_openai_knobs_400_not_silent(base):
         ({"suffix": "tail"}, "suffix"),
         ({"n": 2, "stream": True, "temperature": 1.0}, "stream"),
         ({"best_of": 2, "stream": True, "temperature": 1.0}, "stream"),
-        ({"echo": True, "logprobs": 1}, "echo"),
+        ({"echo": True, "logprobs": 1, "stream": True}, "echo"),
         ({"n": 3, "best_of": 2, "temperature": 1.0}, "best_of"),
         ({"n": 999, "temperature": 1.0}, "n"),
         ({"n": 0}, "n"),
@@ -465,6 +465,69 @@ def test_multitoken_stop_strings(chat_base):
         raise AssertionError("expected 400")
     except urllib.error.HTTPError as e:
         assert e.code == 400 and "4" in e.read(300).decode()
+
+
+def test_echo_logprobs_prompt_scoring(base):
+    """echo+logprobs returns teacher-forcing prompt logprobs (first
+    null, the OpenAI convention) ahead of the completion's; max_tokens=0
+    with echo is pure scoring — the eval-harness loglikelihood pattern."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    prompt = [3, 1, 4, 1, 5]
+    status, body = _post(base, {"prompt": prompt, "max_tokens": 0,
+                                "temperature": 0, "echo": True,
+                                "logprobs": 1})
+    assert status == 200
+    choice = body["choices"][0]
+    lps = choice["logprobs"]["token_logprobs"]
+    assert lps[0] is None and len(lps) == len(prompt)
+    assert body["usage"]["completion_tokens"] == 0
+    assert choice["tokens"] == prompt  # echo, nothing generated
+    # oracle: the full no-cache forward's log-softmax at each position —
+    # the tiny serving device rebuilds exactly init_transformer(key(0))
+    # (the same seeded-base convention test_multi_lora relies on)
+    from gofr_tpu.models.llama import TINY
+    from gofr_tpu.models.transformer import init_transformer, transformer_forward
+
+    params = init_transformer(jax.random.key(0), TINY)
+    logits = transformer_forward(
+        params, jnp.asarray([prompt], jnp.int32), TINY
+    )
+    ref = jax.nn.log_softmax(logits[0].astype(jnp.float32), axis=-1)
+    for i in range(1, len(prompt)):
+        np.testing.assert_allclose(
+            lps[i], float(ref[i - 1, prompt[i]]), rtol=1e-4, atol=1e-4
+        )
+    # echo + logprobs + generation: prompt scores then completion scores
+    status, body = _post(base, {"prompt": prompt, "max_tokens": 3,
+                                "temperature": 0, "echo": True,
+                                "logprobs": 1})
+    full = body["choices"][0]["logprobs"]["token_logprobs"]
+    assert full[: len(prompt)] == lps and len(full) == len(prompt) + 3
+    # max_tokens=0 without echo stays a 400
+    try:
+        _post(base, {"prompt": prompt, "max_tokens": 0})
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400 and "max_tokens" in e.read(300).decode()
+    # an unknown adapter 400s even on the pure-scoring path (no
+    # generation runs to catch it)
+    for payload in ({"logprobs": 1}, {}):
+        try:
+            _post(base, {"prompt": prompt, "max_tokens": 0, "echo": True,
+                         "adapter": "nope", **payload})
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400 and "adapter" in e.read(300).decode()
+    # an over-long prompt is a loud 400, never a silently clipped score
+    try:
+        _post(base, {"prompt": list(range(1, 200)) * 4, "max_tokens": 0,
+                     "echo": True, "logprobs": 1})
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400 and "bucket" in e.read(300).decode()
 
 
 def test_chat_fanout_n(chat_base):
