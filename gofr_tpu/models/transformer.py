@@ -502,7 +502,8 @@ def decode_chunk(
 
     ``with_logprobs`` (static) also returns the chosen tokens' RAW model
     log-probabilities [B, n_steps] f32 — log-softmax of the unpenalized
-    logits, the standard serving-API logprob — as the last output."""
+    logits, the standard serving-API logprob — plus the top-k alternative
+    values/ids [B, n_steps, TOP_LOGPROBS] as the last outputs."""
     from gofr_tpu.ops.sampling import (
         apply_penalties,
         sample_logits,
@@ -530,7 +531,7 @@ def decode_chunk(
         nxt = sample_logits(sample_in, sub, temperature, top_k, top_p, min_p)
         outs = nxt
         if with_logprobs:
-            outs = (nxt, _chosen_logprobs(logits, nxt))
+            outs = (nxt, *_lp_outputs(logits, nxt))
         if presence is None:
             return (nxt[:, None], c, k), outs
         pres = update_presence(pres, nxt)
@@ -543,12 +544,16 @@ def decode_chunk(
     )
     carry, outs = jax.lax.scan(body, carry0, None, length=n_steps)
     cache = carry[1]
-    toks, lps = outs if with_logprobs else (outs, None)
+    toks, lps, tvals, tids = outs if with_logprobs else (outs, None, None, None)
     result: tuple = (jnp.transpose(toks), cache)
     if presence is not None:
         result = result + (carry[3], carry[4])
     if with_logprobs:
-        result = result + (jnp.transpose(lps),)
+        result = result + (
+            jnp.transpose(lps),
+            jnp.transpose(tvals, (1, 0, 2)),
+            jnp.transpose(tids, (1, 0, 2)),
+        )
     return result
 
 
@@ -569,6 +574,9 @@ def score_tokens(
     )[..., 0]
 
 
+TOP_LOGPROBS = 5  # OpenAI's completions cap; compiled into every chunk
+
+
 def _chosen_logprobs(logits: jnp.ndarray, nxt: jnp.ndarray) -> jnp.ndarray:
     """[B] f32 RAW log-probabilities of the chosen tokens — log-softmax of
     the UNPENALIZED logits, the one logprob convention every decode path
@@ -577,6 +585,18 @@ def _chosen_logprobs(logits: jnp.ndarray, nxt: jnp.ndarray) -> jnp.ndarray:
         jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
         nxt[:, None], axis=-1,
     )[:, 0]
+
+
+def _lp_outputs(
+    logits: jnp.ndarray, nxt: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(chosen lp [B], top-k vals [B, TOP_LOGPROBS] f32, top-k ids
+    [B, TOP_LOGPROBS] i32) from one shared log-softmax — the alternatives
+    OpenAI's ``logprobs: N`` returns, raw-logits convention throughout."""
+    lps = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    chosen = jnp.take_along_axis(lps, nxt[:, None], axis=-1)[:, 0]
+    tvals, tids = jax.lax.top_k(lps, TOP_LOGPROBS)
+    return chosen, tvals, tids.astype(jnp.int32)
 
 
 def decode_chunk_pool(
@@ -603,8 +623,8 @@ def decode_chunk_pool(
     ONE executable while letting logprobs requests (including every
     best_of candidate, which scores by mean logprob) share the batch
     instead of decoding solo. Returns (sampled tokens [B, n_steps],
-    logprobs [B, n_steps], next input token [B, 1], advanced key,
-    cache)."""
+    logprobs [B, n_steps], top-k logprob values/ids [B, n_steps,
+    TOP_LOGPROBS], next input token [B, 1], advanced key, cache)."""
     from gofr_tpu.ops.sampling import sample_logits_rows
 
     key, sub = jax.random.split(key)
@@ -614,13 +634,15 @@ def decode_chunk_pool(
         logits, c = decode_step(params, tok, c, cfg)
         k, s = jax.random.split(k)
         nxt = sample_logits_rows(logits, s, temperature, top_k, top_p, min_p)
-        lp = _chosen_logprobs(logits, nxt)
-        return (nxt[:, None], c, k), (nxt, lp)
+        lp, tv, ti = _lp_outputs(logits, nxt)
+        return (nxt[:, None], c, k), (nxt, lp, tv, ti)
 
-    (tok, cache, _), (toks, lps) = jax.lax.scan(
+    (tok, cache, _), (toks, lps, tvals, tids) = jax.lax.scan(
         body, (token, cache, sub), None, length=n_steps
     )
-    return jnp.transpose(toks), jnp.transpose(lps), tok, key, cache
+    return (jnp.transpose(toks), jnp.transpose(lps),
+            jnp.transpose(tvals, (1, 0, 2)), jnp.transpose(tids, (1, 0, 2)),
+            tok, key, cache)
 
 
 def decode_chunk_pool_penalized(
@@ -651,8 +673,9 @@ def decode_chunk_pool_penalized(
     [B, V] elementwise work is noise next to the decode matmuls, but the
     plain pool path stays untouched for penalty-free deployments).
     Returns (tokens [B, n_steps], RAW logprobs [B, n_steps] — log-softmax
-    of the UNPENALIZED logits, the solo path's convention — next token
-    [B, 1], advanced key, cache, presence, counts)."""
+    of the UNPENALIZED logits, the solo path's convention — top-k
+    values/ids [B, n_steps, TOP_LOGPROBS], next token [B, 1], advanced
+    key, cache, presence, counts)."""
     from gofr_tpu.ops.sampling import (
         apply_penalties,
         sample_logits_rows,
@@ -671,13 +694,14 @@ def decode_chunk_pool_penalized(
         k, s = jax.random.split(k)
         penalized = apply_penalties(logits, pres, rep, cnt, pp, fp, bias)
         nxt = sample_logits_rows(penalized, s, temperature, top_k, top_p, min_p)
-        lp = _chosen_logprobs(logits, nxt)
+        lp, tv, ti = _lp_outputs(logits, nxt)
         pres = update_presence(pres, nxt)
         cnt = update_counts(cnt, nxt)
-        return (nxt[:, None], c, k, pres, cnt), (nxt, lp)
+        return (nxt[:, None], c, k, pres, cnt), (nxt, lp, tv, ti)
 
-    (tok, cache, _, presence, counts), (toks, lps) = jax.lax.scan(
+    (tok, cache, _, presence, counts), (toks, lps, tvals, tids) = jax.lax.scan(
         body, (token, cache, sub, presence, counts), None, length=n_steps
     )
-    return (jnp.transpose(toks), jnp.transpose(lps), tok, key, cache,
-            presence, counts)
+    return (jnp.transpose(toks), jnp.transpose(lps),
+            jnp.transpose(tvals, (1, 0, 2)), jnp.transpose(tids, (1, 0, 2)),
+            tok, key, cache, presence, counts)

@@ -334,8 +334,8 @@ def _sampler(body: dict) -> Any:
 
 def _parse_request(ctx: Any, default_max: int) -> tuple:
     """Shared request parse for both endpoints: (body, max_tokens,
-    sampler, stop_ids, stop_strs, want_logprobs, adapter). One home, so
-    a knob added
+    sampler, stop_ids, stop_strs, want_logprobs, top_n, adapter). One
+    home, so a knob added
     to completions cannot silently miss chat (they drifted once)."""
     if ctx.tpu is None:
         raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
@@ -365,12 +365,72 @@ def _parse_request(ctx: Any, default_max: int) -> tuple:
         )
     sampler = _sampler(body)
     stop_ids, stop_strs = _parse_stops(ctx, body)
-    want_logprobs = body.get("logprobs") not in (None, False, 0)
+    lp_req = body.get("logprobs")
+    want_logprobs = lp_req not in (None, False, 0)
+    # alternatives: an integer logprobs >= 2 (the completions form) or
+    # the explicit chat-style "top_logprobs" key, which wins when both
+    # are present. logprobs 1/true stays chosen-token-only — the long-
+    # standing behavior of this endpoint, documented in the API guide
+    # (pass top_logprobs for one alternative per position)
+    top_n = 0
+    if isinstance(lp_req, int) and not isinstance(lp_req, bool) and lp_req >= 2:
+        top_n = lp_req
+    tl = body.get("top_logprobs")
+    if tl is not None:
+        if not isinstance(tl, int) or isinstance(tl, bool) or tl < 0:
+            raise HTTPError(400, '"top_logprobs" must be an integer >= 0')
+        top_n = tl
+        if tl > 0:
+            want_logprobs = True
+    from gofr_tpu.models.transformer import TOP_LOGPROBS
+
+    if top_n > TOP_LOGPROBS:
+        raise HTTPError(
+            400, f'the maximum value for "logprobs"/"top_logprobs" is '
+            f"{TOP_LOGPROBS}"
+        )
     adapter = body.get("adapter")  # multi-LoRA extension
     if adapter is not None and not isinstance(adapter, str):
         raise HTTPError(400, '"adapter" must be a string')
     return (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs,
-            adapter)
+            top_n, adapter)
+
+
+def _logprobs_obj(
+    tok: Any, lp_list: list, lp_ids: list, tops: Any, top_n: int,
+    prompt_positions: int = 0,
+) -> dict:
+    """The choice-level logprobs object: token_logprobs always; a
+    ``tokens`` list (single-token decodes, or stringified ids without a
+    tokenizer) aligned with it; and, when ``top_n`` > 0, per-position
+    ``top_logprobs`` maps of the N best alternatives (null for echoed
+    prompt positions — the prompt is scored chosen-only)."""
+
+    def key(t: int) -> str:
+        return tok.decode([t]) if tok is not None else str(t)
+
+    def alt_map(alts: list) -> dict:
+        # distinct ids can decode to the same string; alts is best-first,
+        # so keep the FIRST (best) value instead of letting a worse
+        # duplicate overwrite it
+        m: dict[str, float] = {}
+        for i, v in alts[:top_n]:
+            m.setdefault(key(i), v)
+        return m
+
+    obj: dict[str, Any] = {
+        "token_logprobs": lp_list,
+        # slice, never assume: a host-matched stop truncates lp_list to
+        # the visible prefix while the ids keep the full generation for
+        # usage accounting — tokens must stay ALIGNED with token_logprobs
+        "tokens": [key(t) for t in lp_ids[: len(lp_list)]],
+    }
+    if top_n and tops is not None:
+        obj["top_logprobs"] = (
+            [None] * prompt_positions
+            + [alt_map(alts) for alts in tops]
+        )
+    return obj
 
 
 _FANOUT_CAP = 16  # pool-slot-scale bound on n/best_of; beyond it is a 400
@@ -472,15 +532,18 @@ def _consume_stream(
 def _fanout_generate(
     ctx: Any, body: dict, prompt_ids: list, max_tokens: int,
     sampler: Any, stop_ids: Any, stop_strs: list, want_logprobs: bool,
-    adapter: Any, n: int, best_of: int,
+    top_n: int, adapter: Any, n: int, best_of: int,
 ) -> tuple[list, int]:
     """Generate ``best_of`` candidates and keep the ``n`` best. Returns
-    ([(tokens, logprobs_or_None, text_or_None, finish_or_None), ...] of
-    length n, total tokens generated across ALL candidates — usage must
-    count discarded best_of candidates too, the OpenAI accounting).
+    ([(tokens, logprobs_or_None, tops_or_None, text_or_None,
+    finish_or_None), ...] of length n, total tokens generated across ALL
+    candidates — usage must count discarded best_of candidates too, the
+    OpenAI accounting).
     ``text``/``finish`` are set only on the multi-token-stop path (the
     host-matched truncation IS the text); otherwise the caller decodes
-    the ids itself.
+    the ids itself. ``top_n`` > 0 also collects the top-k alternatives
+    per position (tops; None otherwise) — rejected with stop_strs at
+    the call sites, so the two never combine here.
 
     - Deterministic requests (temperature 0) produce identical candidates:
       ONE generation is replicated, not recomputed (and billed once per
@@ -496,22 +559,29 @@ def _fanout_generate(
 
     def one(s):
         if stop_strs:
-            return _consume_stream(
+            toks, lps, text, finish = _consume_stream(
                 ctx, prompt_ids, max_tokens, s, stop_ids, stop_strs,
                 need_lp, adapter,
             )
+            return toks, lps, None, text, finish
+        if top_n:
+            toks, lps, tops = ctx.tpu.generate(
+                prompt_ids, max_tokens, sampler=s, stop_tokens=stop_ids,
+                adapter=adapter, logprobs=True, top_logprobs=True,
+            )
+            return toks, lps, tops, None, None
         out = ctx.tpu.generate(
             prompt_ids, max_tokens, sampler=s, stop_tokens=stop_ids,
             adapter=adapter, logprobs=need_lp,
         )
         toks, lps = out if need_lp else (out, None)
-        return toks, lps, None, None
+        return toks, lps, None, None, None
 
     if sampler.greedy:
-        toks, lps, text, finish = one(sampler)
+        toks, lps, tops, text, finish = one(sampler)
         if not want_logprobs:
             lps = None
-        return [(toks, lps, text, finish)] * n, len(toks) * n
+        return [(toks, lps, tops, text, finish)] * n, len(toks) * n
 
     seed = body.get("seed")
     if seed is not None:
@@ -538,19 +608,23 @@ def _fanout_generate(
 
         results = sorted(results, key=mean_lp, reverse=True)[:n]
     if not want_logprobs:
-        results = [(toks, None, text, finish)
-                   for toks, _, text, finish in results]
+        results = [(toks, None, tops, text, finish)
+                   for toks, _, tops, text, finish in results]
     return results, generated
 
 
 def completions(ctx: Any) -> Any:
-    body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs, adapter = (
-        _parse_request(ctx, default_max=16)
-    )
+    (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs, top_n,
+     adapter) = _parse_request(ctx, default_max=16)
     n, best_of, echo = _parse_fanout(body, allow_best_of=True)
     if echo and want_logprobs and body.get("stream"):
         raise HTTPError(
             400, '"echo" with "logprobs" is not supported when streaming'
+        )
+    if top_n and stop_strs:
+        raise HTTPError(
+            400, "top-logprob alternatives with multi-token stop "
+            'sequences are not supported; use "stop_token_ids"'
         )
     if "prompt" not in body:
         # a missing prompt is almost always a caller bug (misspelled key):
@@ -572,6 +646,12 @@ def completions(ctx: Any) -> Any:
             raise HTTPError(
                 400, 'streaming needs "max_tokens" >= 1 (use the '
                 "non-stream form for pure echo scoring)"
+            )
+        if top_n:
+            raise HTTPError(
+                400, "top-logprob alternatives are not supported when "
+                "streaming; drop \"stream\" or request chosen-token "
+                "logprobs only"
             )
         import json as _json
 
@@ -676,15 +756,18 @@ def completions(ctx: Any) -> Any:
             )
     if max_tokens == 0:
         # pure scoring (echo-only, enforced at parse): no decode at all
-        results = [([], [] if want_logprobs else None, None, "length")] * n
+        results = [
+            ([], [] if want_logprobs else None, [] if top_n else None,
+             None, "length")
+        ] * n
         generated = 0
     else:
         results, generated = _fanout_generate(
             ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
-            want_logprobs, adapter, n, best_of,
+            want_logprobs, top_n, adapter, n, best_of,
         )
     choices = []
-    for i, (out, logprobs, text, finish) in enumerate(results):
+    for i, (out, logprobs, tops, text, finish) in enumerate(results):
         if text is None:
             text_ids = (prompt_ids + out) if echo else out
             text_val = tok.decode(text_ids) if tok is not None else ""
@@ -696,15 +779,22 @@ def completions(ctx: Any) -> Any:
             # decoded prompt
             text_val = (tok.decode(prompt_ids) + text) if echo else text
         lp_list = logprobs
+        lp_ids = out
         if prompt_lps is not None:
             lp_list = prompt_lps + (logprobs or [])
+            lp_ids = prompt_ids + out
+        lp_obj = None
+        if lp_list is not None:
+            lp_obj = _logprobs_obj(
+                tok, lp_list, lp_ids, tops, top_n,
+                prompt_positions=len(prompt_ids) if prompt_lps is not None
+                else 0,
+            )
         choice: dict[str, Any] = {
             "text": text_val,
             "index": i,
             "finish_reason": finish,
-            "logprobs": (
-                {"token_logprobs": lp_list} if lp_list is not None else None
-            ),
+            "logprobs": lp_obj,
         }
         if tok is None:
             choice["tokens"] = (prompt_ids + out) if echo else out
@@ -732,9 +822,8 @@ def chat_completions(ctx: Any) -> Any:
     ``completions``; only the prompt construction (chat template) and the
     response shapes (chat.completion / chat.completion.chunk with deltas)
     differ."""
-    body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs, adapter = (
-        _parse_request(ctx, default_max=64)
-    )
+    (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs, top_n,
+     adapter) = _parse_request(ctx, default_max=64)
     tok = ctx.tpu.tokenizer
     if tok is None:
         raise HTTPError(
@@ -749,12 +838,23 @@ def chat_completions(ctx: Any) -> Any:
     chat_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
 
     n, _, _ = _parse_fanout(body, allow_best_of=False)
+    if top_n and stop_strs:
+        raise HTTPError(
+            400, "top-logprob alternatives with multi-token stop "
+            'sequences are not supported; use "stop_token_ids"'
+        )
 
     if body.get("stream"):
         if n > 1:
             raise HTTPError(
                 400, 'streaming with "n" > 1 is not supported '
                 "(interleaved multi-index SSE)"
+            )
+        if top_n:
+            raise HTTPError(
+                400, "top-logprob alternatives are not supported when "
+                "streaming; drop \"stream\" or request chosen-token "
+                "logprobs only"
             )
         import json as _json
 
@@ -825,7 +925,7 @@ def chat_completions(ctx: Any) -> Any:
 
     results, generated = _fanout_generate(
         ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
-        want_logprobs, adapter, n, n,
+        want_logprobs, top_n, adapter, n, n,
     )
     from gofr_tpu.http.response import Raw
 
@@ -841,10 +941,11 @@ def chat_completions(ctx: Any) -> Any:
                 else ("length" if len(out) >= max_tokens else "stop")
             ),
             "logprobs": (
-                {"token_logprobs": logprobs} if logprobs is not None else None
+                _logprobs_obj(tok, logprobs, out, tops, top_n)
+                if logprobs is not None else None
             ),
         }
-        for i, (out, logprobs, text, finish) in enumerate(results)
+        for i, (out, logprobs, tops, text, finish) in enumerate(results)
     ]
     return Raw({
         "id": chat_id,

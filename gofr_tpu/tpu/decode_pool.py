@@ -78,21 +78,23 @@ class _Request:
 
     __slots__ = (
         "out_queue", "remaining", "cache_len", "stop", "stop_tokens",
-        "finished", "want_lp",
+        "finished", "want_lp", "want_top",
     )
 
     def __init__(self, out_queue: "queue.Queue", remaining: int, cache_len: int,
                  stop: Optional[threading.Event], stop_tokens: frozenset,
-                 want_lp: bool = False):
+                 want_lp: bool = False, want_top: bool = False):
         self.out_queue: Optional[queue.Queue] = out_queue
         self.remaining = remaining
         self.cache_len = cache_len
         self.stop = stop
         self.stop_tokens = stop_tokens
         self.finished = False
-        # bursts become (token, logprob) pairs; the lps ride every chunk
-        # anyway (computed in-executable), this only picks the delivery shape
+        # bursts become (token, logprob, tops|None) triples; the lps ride
+        # every chunk anyway (computed in-executable), these flags only
+        # pick the delivery shape and gate the top-k fetch
         self.want_lp = want_lp
+        self.want_top = want_top
 
 
 class _Slot:
@@ -229,7 +231,7 @@ class DecodePool:
             )
         # warm the [n_slots]-shaped executable NOW: the first pooled request
         # must not compile under the pool lock on the serving path
-        toks, _, _, self._key, self.cache = self._decode(
+        toks, _, _, _, _, self._key, self.cache = self._decode(
             self.params, self._last_tokens, self.cache,
             self._key, jnp.asarray(self._temps),
             jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
@@ -362,6 +364,7 @@ class DecodePool:
         stop_tokens: frozenset = frozenset(),
         penalty: Optional[tuple] = None,
         want_logprobs: bool = False,
+        want_top_logprobs: bool = False,
     ) -> "queue.Queue":
         """Claim a slot for a prefilled request; returns the queue its
         decoded token ids (then DONE) arrive on. Raises queue.Full when all
@@ -389,7 +392,8 @@ class DecodePool:
             slot = self._free.pop()
             slot.request = _Request(out, max_new, start_len, stop,
                                     frozenset(stop_tokens or ()),
-                                    want_lp=want_logprobs)
+                                    want_lp=want_logprobs,
+                                    want_top=want_top_logprobs)
             if (
                 self._temps[slot.index] != sampler.temperature
                 or self._top_ks[slot.index] != sampler.top_k
@@ -483,8 +487,9 @@ class DecodePool:
                             self._pps_dev = jnp.asarray(self._pps)
                             self._fps_dev = jnp.asarray(self._fps)
                             self._pen_dirty = False
-                        (toks_dev, lps_dev, self._last_tokens, self._key,
-                         self.cache, self._pres, self._cnts) = self._decode_pen(
+                        (toks_dev, lps_dev, tvals_dev, tids_dev,
+                         self._last_tokens, self._key, self.cache,
+                         self._pres, self._cnts) = self._decode_pen(
                             self.params, self._last_tokens, self.cache,
                             self._key, self._temps_dev, self._top_ks_dev,
                             self._top_ps_dev, self._min_ps_dev, self._pres,
@@ -492,7 +497,8 @@ class DecodePool:
                             self._fps_dev, self._bias,
                         )
                     else:
-                        (toks_dev, lps_dev, self._last_tokens, self._key,
+                        (toks_dev, lps_dev, tvals_dev, tids_dev,
+                         self._last_tokens, self._key,
                          self.cache) = self._decode(
                             self.params, self._last_tokens, self.cache, self._key,
                             self._temps_dev, self._top_ks_dev, self._top_ps_dev,
@@ -504,19 +510,38 @@ class DecodePool:
                     # per-chunk link round trips OVERLAP across the pipeline
                     # instead of serializing (on a tunneled link the
                     # serialized fetch — not compute — was the cap).
+                    # top-k alternatives cross the link only when some
+                    # active request asked for ALTERNATIVES (the
+                    # executables always compute them; fetching is the
+                    # opt-in part — plain logprobs requests stay at the
+                    # scalar-per-token fetch)
+                    want_top = any(
+                        req is not None and req.want_top for _, req in records
+                    )
+                    if not want_top:
+                        tvals_dev = tids_dev = None
                     try:
                         toks_dev.copy_to_host_async()
                         lps_dev.copy_to_host_async()
+                        if want_top:
+                            tvals_dev.copy_to_host_async()
+                            tids_dev.copy_to_host_async()
                     except (AttributeError, RuntimeError):
                         pass  # older jax / fully-addressable-only arrays
-                    in_flight.append((records, toks_dev, lps_dev, dispatch_start))
+                    in_flight.append(
+                        (records, toks_dev, lps_dev, tvals_dev, tids_dev,
+                         dispatch_start)
+                    )
             # fetch the OLDEST chunk outside the lock: the device is
             # meanwhile executing the younger in-flight chunk(s), and new
             # submissions can take the lock to join the next dispatch
-            records, toks_dev, lps_dev, dispatch_start = in_flight.popleft()
+            (records, toks_dev, lps_dev, tvals_dev, tids_dev,
+             dispatch_start) = in_flight.popleft()
             fetch_start = _perf_counter()
             toks = np.asarray(toks_dev)
             lps = np.asarray(lps_dev)
+            tvals = np.asarray(tvals_dev) if tvals_dev is not None else None
+            tids = np.asarray(tids_dev) if tids_dev is not None else None
             fetch_done = _perf_counter()
             # throughput denominator: the interval between consecutive
             # deliveries at steady state (dispatch->fetch spans ~2 chunk
@@ -532,7 +557,8 @@ class DecodePool:
             )
             last_fetch_done = fetch_done
             with self._work:
-                self._deliver(records, toks, lps, dispatch_elapsed)
+                self._deliver(records, toks, lps, tvals, tids,
+                              dispatch_elapsed)
             if _POOL_DEBUG:
                 import sys
 
@@ -545,7 +571,7 @@ class DecodePool:
                 )
 
     def _deliver(self, records: list, toks: np.ndarray, lps: np.ndarray,
-                 elapsed: float) -> None:
+                 tvals: Any, tids: Any, elapsed: float) -> None:
         delivered = 0
         for index, req in records:
             if req is None or req.finished:
@@ -567,10 +593,20 @@ class DecodePool:
                     if int(t) in req.stop_tokens:
                         hit_stop_token = True  # ends stream, not emitted
                         break
-                    burst.append(
-                        (int(t), float(emitted_lps[j])) if req.want_lp
-                        else int(t)
-                    )
+                    if req.want_lp:
+                        # (token, lp, tops|None): tops only for requests
+                        # that asked for alternatives — building 5 tuples
+                        # per token sits on the worker's critical path
+                        tops = None
+                        if req.want_top:
+                            tops = [
+                                (int(tids[index, j, m]),
+                                 float(tvals[index, j, m]))
+                                for m in range(tids.shape[-1])
+                            ]
+                        burst.append((int(t), float(emitted_lps[j]), tops))
+                    else:
+                        burst.append(int(t))
                 if burst:
                     req.out_queue.put(burst)
                     delivered += len(burst)  # only tokens a request received

@@ -467,9 +467,10 @@ class TPUDevice:
         sampler: Optional[Any] = None,
         stop_tokens: Optional[Any] = None,
         logprobs: bool = False,
+        top_logprobs: bool = False,
         adapter: Optional[str] = None,
         adapter_params: Optional[Any] = None,
-    ) -> "list[int] | tuple[list[int], list[float]]":
+    ) -> "list[int] | tuple[list[int], list[float]] | tuple":
         """Autoregressive generation (transformer models): prefill goes
         through the dynamic batcher (TTFT path); decode steps run per
         request. ``on_token`` streams each new token id (SSE endpoints);
@@ -481,7 +482,9 @@ class TPUDevice:
         token itself is not emitted. ``logprobs=True`` returns
         (tokens, logprobs) — the chosen tokens' RAW model log-softmax
         values (delivered from the shared pool — logprobs ride every pool
-        chunk)."""
+        chunk). ``top_logprobs=True`` returns (tokens, logprobs, tops)
+        where tops[i] is the TOP_LOGPROBS [(alt_id, alt_lp), ...]
+        alternatives at position i, best first."""
         self.wait_ready(600.0)
         if isinstance(tokens, str):
             tokens = self._detokenize(tokens)["tokens"]
@@ -492,6 +495,7 @@ class TPUDevice:
                 sampler=sampler, stop_tokens=stop_tokens,
                 decode_pool=self.decode_pool,
                 prefill_batcher=self.batcher, logprobs=logprobs,
+                top_logprobs=top_logprobs,
                 adapter=adapter, adapter_params=adapter_params,
                 ttft_cb=lambda: self._ttft.observe(
                     time.perf_counter() - start, model=self.model_name, op="generate"
@@ -1394,9 +1398,12 @@ class _TransformerRunner:
         prefill_batcher: Any = None,
         ttft_cb: Any = None,
         logprobs: bool = False,
+        top_logprobs: bool = False,
         adapter: Optional[str] = None,
         adapter_params: Optional[Any] = None,
-    ) -> "list[int] | tuple[list[int], list[float]]":
+    ) -> "list[int] | tuple[list[int], list[float]] | tuple":
+        if top_logprobs:
+            logprobs = True  # alternatives imply the chosen-token values
         if sampler is None:
             from gofr_tpu.ops.sampling import Sampler
 
@@ -1445,6 +1452,7 @@ class _TransformerRunner:
                     self._prefix_store(ids, state)
         out: list[int] = []
         lps: list[float] = []
+        tops: list = []  # per token: [(alt_id, alt_lp) x TOP_LOGPROBS]
         presence = counts = bias_row = None
         if sampler.penalized:
             # context presence penalizes the FIRST token too (greedy
@@ -1490,19 +1498,37 @@ class _TransformerRunner:
             token = sampler.pick(state["logits"])
         if ttft_cb:
             ttft_cb()
-        if token in stop_tokens:
+        def _done():
+            if top_logprobs:
+                return out, lps, tops
             return (out, lps) if logprobs else out
+
+        if token in stop_tokens:
+            return _done()
         out.append(token)
         if logprobs:
-            # RAW model logprob of the first token (one [V] row is on
-            # device already; logprobs requests tolerate this fetch)
-            row = jnp.asarray(state["logits"]).astype(jnp.float32)
-            lps.append(float(jax.nn.log_softmax(row)[token]))
+            # RAW model logprob of the first token. Chosen-only requests
+            # index on DEVICE and move one scalar (the [V] row transfer
+            # would sit on the TTFT path); only top_logprobs pays the
+            # full-row fetch, and argpartition beats a full sort for 5
+            row_dev = jax.nn.log_softmax(
+                jnp.asarray(state["logits"]).astype(jnp.float32)
+            )
+            if top_logprobs:
+                from gofr_tpu.models.transformer import TOP_LOGPROBS
+
+                row = np.asarray(row_dev)
+                lps.append(float(row[token]))
+                part = np.argpartition(row, -TOP_LOGPROBS)[-TOP_LOGPROBS:]
+                top_ids = part[np.argsort(row[part])[::-1]]
+                tops.append([(int(i), float(row[i])) for i in top_ids])
+            else:
+                lps.append(float(row_dev[token]))
         if on_token:
             # with logprobs, streaming consumers receive (token, logprob)
             on_token((token, lps[-1]) if logprobs else token)
         if max_new_tokens <= 1:
-            return (out, lps) if logprobs else out
+            return _done()
 
         # speculative decoding: requests with a configured draft take the
         # draft-and-verify path (DRAFT_MODEL_NAME opts the deployment
@@ -1554,7 +1580,7 @@ class _TransformerRunner:
                     state["cache"], state["length"], token,
                     max_new_tokens - 1, sampler, stop,
                     stop_tokens=stop_tokens, penalty=penalty,
-                    want_logprobs=logprobs,
+                    want_logprobs=logprobs, want_top_logprobs=top_logprobs,
                 )
             except (queue_mod.Full, RuntimeError) as exc:
                 from gofr_tpu.tpu.decode_pool import _POOL_DEBUG
@@ -1574,8 +1600,10 @@ class _TransformerRunner:
                         raise item.exc
                     for t in item:  # one burst list per decoded chunk
                         if logprobs:
-                            t, lp = t
+                            t, lp, t_tops = t
                             lps.append(lp)
+                            if top_logprobs and t_tops is not None:
+                                tops.append(t_tops)
                         out.append(t)
                         if on_token:
                             on_token((t, lps[-1]) if logprobs else t)
@@ -1583,8 +1611,8 @@ class _TransformerRunner:
                             # emission stops HERE even though the pipelined
                             # pool already queued more; the pool frees the
                             # slot at its next delivery (it checks stop too)
-                            return (out, lps) if logprobs else out
-                return (out, lps) if logprobs else out
+                            return _done()
+                return _done()
         # chunked decode: N steps + on-device sampling per dispatch, one
         # [1, N] fetch per chunk — the round trip, not the matmuls, bounds
         # tokens/sec on remote-attached devices. Length is tracked on the
@@ -1639,18 +1667,30 @@ class _TransformerRunner:
                 if presence is not None:
                     presence = rest.pop(0)
                     counts = rest.pop(0)
-                lps_dev = rest.pop(0) if logprobs else None
+                if logprobs:
+                    lps_dev, tvals_dev, tids_dev = rest[:3]
+                else:
+                    lps_dev = tvals_dev = tids_dev = None
                 token_dev = toks_dev[:, -1:]
-                pending.append((toks_dev, lps_dev, n))
+                pending.append((toks_dev, lps_dev, tvals_dev, tids_dev, n))
                 steps_in_flight += n
             if not pending:
                 break
-            toks_dev, lps_dev, n = pending.popleft()
+            toks_dev, lps_dev, tvals_dev, tids_dev, n = pending.popleft()
             chunk = [int(t) for t in np.asarray(toks_dev)[0]]
             chunk_lps = (
                 [float(x) for x in np.asarray(lps_dev)[0]]
                 if lps_dev is not None else None
             )
+            chunk_tops = None
+            if top_logprobs:
+                tv = np.asarray(tvals_dev)[0]
+                ti = np.asarray(tids_dev)[0]
+                chunk_tops = [
+                    [(int(ti[j, m]), float(tv[j, m]))
+                     for m in range(ti.shape[-1])]
+                    for j in range(ti.shape[0])
+                ]
             steps_in_flight -= n
             cache_len += n
             take = min(n, max_new_tokens - len(out))
@@ -1661,6 +1701,8 @@ class _TransformerRunner:
                 out.append(t)
                 if chunk_lps is not None:
                     lps.append(chunk_lps[j])
+                if chunk_tops is not None:
+                    tops.append(chunk_tops[j])
                 if on_token:
                     on_token((t, chunk_lps[j]) if logprobs else t)
                 if stop is not None and stop.is_set():
@@ -1668,7 +1710,7 @@ class _TransformerRunner:
                     break
             if len(out) >= max_new_tokens:
                 stopped = True
-        return (out, lps) if logprobs else out
+        return _done()
 
     def _can_chunk_prefill(self) -> bool:
         """Chunked prefill builds a [1]-row cache; under a mesh that only

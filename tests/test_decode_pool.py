@@ -304,3 +304,31 @@ def test_pooled_penalized_logprobs(pooled, solo):
                             sampler=Sampler(**s))
     assert pt == st
     np.testing.assert_allclose(plp, slp, rtol=1e-4, atol=1e-4)
+
+
+def test_top_logprobs_pooled_and_solo(pooled, solo):
+    """top_logprobs=True returns the TOP_LOGPROBS alternatives per
+    position, best first; greedy's chosen token IS the top-1 entry, and
+    the pooled and solo paths agree."""
+    import numpy as np
+
+    from gofr_tpu.models.transformer import TOP_LOGPROBS
+
+    for dev in (pooled, solo):
+        out, lps, tops = dev.generate([1, 2, 3], max_new_tokens=6,
+                                      logprobs=True, top_logprobs=True)
+        assert len(out) == len(lps) == len(tops) == 6
+        for i, alts in enumerate(tops):
+            assert len(alts) == TOP_LOGPROBS
+            vals = [v for _, v in alts]
+            assert vals == sorted(vals, reverse=True)
+            assert alts[0][0] == out[i]  # greedy picks the argmax
+            np.testing.assert_allclose(alts[0][1], lps[i], rtol=1e-4,
+                                       atol=1e-4)
+    p = pooled.generate([1, 2, 3], max_new_tokens=6, logprobs=True,
+                        top_logprobs=True)
+    s = solo.generate([1, 2, 3], max_new_tokens=6, logprobs=True,
+                      top_logprobs=True)
+    assert p[0] == s[0]
+    assert [[i for i, _ in alts] for alts in p[2]] == \
+        [[i for i, _ in alts] for alts in s[2]]

@@ -530,6 +530,52 @@ def test_echo_logprobs_prompt_scoring(base):
         assert e.code == 400 and "bucket" in e.read(300).decode()
 
 
+def test_top_logprobs_alternatives(base):
+    """logprobs >= 2 (or the chat-style top_logprobs key) returns the
+    N best alternatives per position; greedy's chosen token is the top
+    entry; logprobs 1/true stays chosen-only (documented back-compat)."""
+    status, body = _post(base, {"prompt": [1, 2, 3], "max_tokens": 4,
+                                "temperature": 0, "logprobs": 3})
+    assert status == 200
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 4
+    assert len(lp["tokens"]) == 4  # aligned names (stringified ids here)
+    assert len(lp["top_logprobs"]) == 4
+    out = body["choices"][0]["tokens"]
+    for i, alts in enumerate(lp["top_logprobs"]):
+        assert len(alts) == 3
+        # greedy chosen token is the best alternative
+        assert str(out[i]) in alts
+        assert max(alts.values()) == alts[str(out[i])]
+    # explicit top_logprobs key works too
+    via_key = _post(base, {"prompt": [1, 2, 3], "max_tokens": 4,
+                           "temperature": 0, "logprobs": 1,
+                           "top_logprobs": 3})[1]
+    assert via_key["choices"][0]["logprobs"]["top_logprobs"] == \
+        lp["top_logprobs"]
+    # logprobs: 1 stays chosen-only
+    plain = _post(base, {"prompt": [1, 2, 3], "max_tokens": 4,
+                         "temperature": 0, "logprobs": 1})[1]
+    assert "top_logprobs" not in plain["choices"][0]["logprobs"]
+    # echo scoring: prompt positions carry null alternatives
+    echoed = _post(base, {"prompt": [1, 2, 3], "max_tokens": 2,
+                          "temperature": 0, "echo": True,
+                          "logprobs": 2})[1]
+    tl = echoed["choices"][0]["logprobs"]["top_logprobs"]
+    assert tl[:3] == [None, None, None] and len(tl) == 5
+    # bounds + streaming stay loud
+    for payload, expect in (
+        ({"logprobs": 9}, "maximum"),
+        ({"top_logprobs": -1}, "top_logprobs"),
+        ({"logprobs": 2, "stream": True, "temperature": 0}, "stream"),
+    ):
+        try:
+            _post(base, {"prompt": [1, 2], "max_tokens": 2, **payload})
+            raise AssertionError(f"expected 400 for {payload}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400 and expect in e.read(300).decode()
+
+
 def test_chat_fanout_n(chat_base):
     """chat supports n; best_of and echo are completions-only 400s."""
     status, body = _post(chat_base, {
