@@ -147,14 +147,23 @@ def main() -> int:
         )
     # 3. flagship bench with the bucket ladder (per-bucket compile seconds
     #    land in boot_stages)
-    if remaining() > 720:
+    if remaining() > 1320:
         run_stage(
             "ladder", [sys.executable, "bench.py"],
             # keep a kill+reap margin inside the deadline: the chip must
             # be free when the driver's own bench wants it
-            timeout=min(1800, remaining() - 120),
+            timeout=min(1800, remaining() - 720),
             env={**os.environ, "MODEL_BUCKETS": "64,512",
                  "BENCH_PROMPT_LEN": "48"},
+        )
+    # 4. BASELINE config 2: encoder embeddings through the batcher on the
+    #    real chip (bert-base; cheap boot, short run)
+    if remaining() > 600:
+        run_stage(
+            "bert", [sys.executable, "bench.py"],
+            timeout=min(900, remaining() - 120),
+            env={**os.environ, "BENCH_MODEL": "bert-base",
+                 "BENCH_PROMPT_LEN": "32", "BENCH_REQUESTS": "64"},
         )
     log("hardware agenda complete — results under " + OUT)
     return 0
