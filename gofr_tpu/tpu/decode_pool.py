@@ -157,6 +157,22 @@ class DecodePool:
         self._n_params = n_params
         self._peak = peak_flops
         self._model = model
+        # under a mesh, pin EVERY executable's feedback outputs (tokens,
+        # key) to replicated and the cache to its mesh placement: GSPMD
+        # otherwise picks shardings per-jit (e.g. tokens over dp), and
+        # the plain executable, the write ops, and the AOT penalized
+        # executable would disagree the moment traffic switches between
+        # them (reproduced as a dispatch-time sharding mismatch)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = (
+            next(iter(cache_shardings.values())).mesh
+            if cache_shardings else None
+        )
+        self._repl = (
+            NamedSharding(mesh, PartitionSpec()) if mesh is not None else None
+        )
+        repl = self._repl
         # donate the cache through both ops: the pool cache is the largest
         # live buffer and must be updated in place, not copied per chunk.
         # The key also donates (it threads through every chunk).
@@ -165,6 +181,10 @@ class DecodePool:
                 p, t, c, cfg, chunk, key, temp, tk, tp, mp
             ),
             donate_argnums=(2, 3),
+            out_shardings=(
+                (repl, repl, repl, repl, repl, repl, dict(cache_shardings))
+                if repl is not None else None
+            ),
         )
 
         def write_slot(pool: dict, row: dict, i) -> dict:
@@ -174,10 +194,14 @@ class DecodePool:
                 "lengths": jax.lax.dynamic_update_slice(pool["lengths"], row["lengths"], (i,)),
             }
 
-        self._write_slot = jax.jit(write_slot, donate_argnums=(0,))
+        self._write_slot = jax.jit(
+            write_slot, donate_argnums=(0,),
+            out_shardings=dict(cache_shardings) if repl is not None else None,
+        )
         self._write_token = jax.jit(
             lambda toks, tok, i: jax.lax.dynamic_update_slice(toks, tok, (i, 0)),
             donate_argnums=(0,),
+            out_shardings=repl,
         )
         self._slots = [_Slot(i) for i in range(n_slots)]
         self._free = list(reversed(self._slots))
@@ -255,14 +279,13 @@ class DecodePool:
 
         cfg, chunk, n = self.cfg, self.chunk, self.n_slots
         v = cfg.vocab_size
-        decode_pen = jax.jit(
-            lambda p, t, c, key, temp, tk, tp, mp, pres, rep, cnt, pp, fp,
-            bias: decode_chunk_pool_penalized(
+
+        def pen_fn(p, t, c, key, temp, tk, tp, mp, pres, rep, cnt, pp, fp,
+                   bias):
+            return decode_chunk_pool_penalized(
                 p, t, c, cfg, chunk, key, temp, tk, tp, mp, pres, rep,
                 cnt, pp, fp, bias,
-            ),
-            donate_argnums=(2, 3, 8, 10),
-        )
+            )
 
         def write_rows(pres, cnt, bias, pr, cr, br, i):
             return (
@@ -276,25 +299,64 @@ class DecodePool:
                 bias, jnp.zeros((1, v), jnp.float32), (i, 0)
             )
 
-        write_rows_j = jax.jit(write_rows, donate_argnums=(0, 1, 2))
-        zero_bias_j = jax.jit(zero_bias_row, donate_argnums=(0,))
         # compile AHEAD OF TIME on abstract shapes: a live-serving lazy
         # build must not allocate a throwaway [slots] KV cache next to
         # the real one (the pool cache is the largest live buffer — a
         # second copy could OOM a cache-sized deployment mid-traffic).
-        # Shapes/dtypes/shardings come from the LIVE state's metadata.
-        def abs_of(a):
-            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+        #
+        # Under a mesh, every lowering input takes the POOL's pinned
+        # shardings, never a live array's: params/cache keep their mesh
+        # placement, everything else — INCLUDING the fed-back token/key,
+        # whose live sharding at build time is whatever the plain
+        # executable last produced — lowers as replicated, matching the
+        # out_shardings every pool executable pins (a lazily built
+        # executable that trusted a live P('dp') token sharding crashed
+        # the first penalized dispatch under a dp mesh).
+        repl = self._repl
+
+        def abs_struct(shape, dtype):
+            if repl is not None:
+                return jax.ShapeDtypeStruct(shape, dtype, sharding=repl)
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+        def abs_repl(a):
+            return abs_struct(a.shape, a.dtype)
+
+        def abs_placed(a):
+            sh = getattr(a, "sharding", None)
+            if repl is not None and sh is not None:
+                return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        write_rows_j = jax.jit(
+            write_rows, donate_argnums=(0, 1, 2),
+            out_shardings=(repl, repl, repl) if repl is not None else None,
+        )
+        zero_bias_j = jax.jit(
+            zero_bias_row, donate_argnums=(0,), out_shardings=repl
+        )
 
         with self._work:
-            cache_meta = jax.tree.map(abs_of, self.cache)
-            tok_meta = abs_of(self._last_tokens)
-            key_meta = abs_of(self._key)
-        params_meta = jax.tree.map(abs_of, self.params)
-        f32v = jax.ShapeDtypeStruct((n,), jnp.float32)
-        i32v = jax.ShapeDtypeStruct((n,), jnp.int32)
-        rows_b = jax.ShapeDtypeStruct((n, v), jnp.bool_)
-        rows_f = jax.ShapeDtypeStruct((n, v), jnp.float32)
+            cache_meta = jax.tree.map(abs_placed, self.cache)
+            tok_meta = abs_repl(self._last_tokens)
+            key_meta = abs_repl(self._key)
+        params_meta = jax.tree.map(abs_placed, self.params)
+        f32v = abs_struct((n,), jnp.float32)
+        i32v = abs_struct((n,), jnp.int32)
+        rows_b = abs_struct((n, v), jnp.bool_)
+        rows_f = abs_struct((n, v), jnp.float32)
+        # outputs: (toks, lps, tvals, tids, next_tok, key, cache,
+        # presence, counts) — cache keeps its mesh placement, everything
+        # else (incl. the penalty state fed back as the next dispatch's
+        # input) stays replicated, matching the row ops above
+        decode_pen = jax.jit(
+            pen_fn, donate_argnums=(2, 3, 8, 10),
+            out_shardings=(
+                (repl, repl, repl, repl, repl, repl,
+                 dict(self._cache_shardings), repl, repl)
+                if repl is not None else None
+            ),
+        )
         decode_pen_exec = decode_pen.lower(
             params_meta, tok_meta, cache_meta, key_meta,
             f32v, i32v, f32v, f32v, rows_b, f32v, rows_f, f32v, f32v,

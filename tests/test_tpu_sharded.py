@@ -182,3 +182,65 @@ def test_batch_divisibility_enforced():
     # not an opaque device_put failure inside warmup
     with pytest.raises(ValueError, match="BATCH_MAX_SIZE"):
         _device(MODEL_NAME="tiny", BATCH_MAX_SIZE="2", TPU_MESH="dp=4")
+
+
+def test_penalized_pool_under_tp_mesh():
+    """The per-slot penalty machinery (presence/counts/bias rows, AOT
+    penalized executable) composes with a tensor-parallel serving mesh:
+    penalized pooled output equals the solo sharded path's, and logprobs
+    still ride the chunks."""
+    from gofr_tpu.ops.sampling import Sampler
+
+    pen = dict(presence_penalty=2.0, frequency_penalty=2.0)
+    solo = _device(MODEL_NAME="tiny", BATCH_MAX_SIZE="2",
+                   BATCH_TIMEOUT_MS="1", TPU_MESH="tp=2", DECODE_POOL="off")
+    try:
+        want = solo.generate(PROMPT["tokens"], max_new_tokens=8,
+                             sampler=Sampler(**pen))
+    finally:
+        solo.close()
+    pooled = _device(MODEL_NAME="tiny", BATCH_MAX_SIZE="2",
+                     BATCH_TIMEOUT_MS="1", TPU_MESH="tp=2",
+                     DECODE_POOL_PENALTIES="eager")
+    try:
+        got = pooled.generate(PROMPT["tokens"], max_new_tokens=8,
+                              sampler=Sampler(**pen))
+        assert got == want
+        toks, lps, tops = pooled.generate(
+            PROMPT["tokens"], max_new_tokens=4, logprobs=True,
+            top_logprobs=True, sampler=Sampler(**pen),
+        )
+        assert len(toks) == len(lps) == len(tops) == 4
+    finally:
+        pooled.close()
+
+
+def test_penalized_pool_lazy_under_dp_mesh():
+    """The LAZY penalty build under a dp mesh: plain pooled traffic runs
+    first (GSPMD would otherwise leave the fed-back token row sharded
+    over dp), then a penalized request triggers the background build and
+    a later one must POOL without a sharding mismatch — the exact crash
+    a lazily built executable hit when it trusted live shardings."""
+    import time
+
+    from gofr_tpu.ops.sampling import Sampler
+
+    pen = dict(presence_penalty=2.0, frequency_penalty=2.0)
+    d = _device(MODEL_NAME="tiny", BATCH_MAX_SIZE="4", BATCH_TIMEOUT_MS="1",
+                TPU_MESH="dp=2", DECODE_SLOTS="4")
+    try:
+        plain = d.generate(PROMPT["tokens"], max_new_tokens=8)
+        first = d.generate(PROMPT["tokens"], max_new_tokens=8,
+                           sampler=Sampler(**pen))  # solos; kicks the build
+        for _ in range(600):
+            if d.decode_pool._pen_ready:
+                break
+            time.sleep(0.1)
+        assert d.decode_pool._pen_ready
+        pooled = d.generate(PROMPT["tokens"], max_new_tokens=8,
+                            sampler=Sampler(**pen))
+        assert pooled == first  # greedy: pooled == solo
+        # plain traffic still clean after the penalized interlude
+        assert d.generate(PROMPT["tokens"], max_new_tokens=8) == plain
+    finally:
+        d.close()
