@@ -233,16 +233,16 @@ def _resolve_jinja_source(
                 return template
             if isinstance(template, list):
                 # HF multi-template form: [{"name": ..., "template": ...}]
-                chosen = None
+                # — only an entry NAMED "default" is safe to adopt;
+                # guessing template[0] could silently serve every chat
+                # request through e.g. the tool_use template
                 for entry in template:
-                    if isinstance(entry, dict) and entry.get("name") == "default":
-                        chosen = entry
-                        break
-                else:
-                    if template and isinstance(template[0], dict):
-                        chosen = template[0]
-                if chosen is not None and isinstance(chosen.get("template"), str):
-                    return chosen["template"]
+                    if (
+                        isinstance(entry, dict)
+                        and entry.get("name") == "default"
+                        and isinstance(entry.get("template"), str)
+                    ):
+                        return entry["template"]
             raise HTTPError(
                 500, f"unrecognized chat_template form in {cfg_path} — "
                 "set CHAT_TEMPLATE or CHAT_TEMPLATE_JINJA explicitly"
@@ -255,7 +255,14 @@ def _compiled_jinja(source: str) -> Any:
     """Compile once per template source (config is static per process).
     The HF convention: an IMMUTABLE SANDBOXED environment — checkpoint
     templates are data, not trusted code."""
-    from jinja2.sandbox import ImmutableSandboxedEnvironment
+    try:
+        from jinja2.sandbox import ImmutableSandboxedEnvironment
+    except ImportError:
+        raise HTTPError(
+            500, "jinja chat templates need the jinja2 package "
+            "(declared in pyproject; pip install jinja2) — or set "
+            "CHAT_TEMPLATE to use the simple template form"
+        ) from None
 
     env = ImmutableSandboxedEnvironment(trim_blocks=True, lstrip_blocks=True)
 

@@ -93,6 +93,41 @@ class TPULog:
         }
 
 
+def _checkpoint_eos_ids(model_path, tokenizer) -> set:
+    """EOS ids for default stopping: the checkpoint's
+    generation_config.json (eos_token_id int or list — Llama-3 instruct
+    lists BOTH <|end_of_text|> and <|eot_id|>), else the tokenizer's own
+    eos. Empty when neither exists (seeded test models)."""
+    import json as _json
+    import os as _os
+
+    if model_path:
+        base = model_path if _os.path.isdir(model_path) else _os.path.dirname(model_path)
+        gc_path = _os.path.join(base, "generation_config.json")
+        if _os.path.isfile(gc_path):
+            try:
+                with open(gc_path, encoding="utf-8") as fh:
+                    eos = _json.load(fh).get("eos_token_id")
+            except (OSError, ValueError) as exc:
+                # silently dropping the checkpoint's extra EOS ids (e.g.
+                # Llama-3's <|eot_id|>) would run every chat past the
+                # turn boundary — fail the boot loudly instead
+                raise ValueError(
+                    f"cannot read {gc_path}: {exc} — fix the checkpoint "
+                    "or set GEN_STOP_TOKENS / GEN_STOP_EOS=off"
+                ) from None
+            if isinstance(eos, int):
+                return {eos}
+            if isinstance(eos, list) and all(isinstance(t, int) for t in eos):
+                return set(eos)
+    if tokenizer is not None:
+        try:
+            return {tokenizer.special_id("eos")}
+        except ValueError:
+            pass
+    return set()
+
+
 class TPUDevice:
     def __init__(self, config: Any, logger: Any, metrics: Any):
         self.logger = logger
@@ -111,6 +146,28 @@ class TPUDevice:
         from gofr_tpu.tokenizer import load_tokenizer
 
         self.tokenizer = load_tokenizer(config)
+        # default stop ids: EVERY generation ends at the checkpoint's EOS
+        # (OpenAI semantics — a real instruct model must not run past
+        # <|eot_id|> to max_tokens). Sources, best first: GEN_STOP_TOKENS
+        # (explicit ids), the checkpoint's generation_config.json
+        # eos_token_id (int or list) next to MODEL_PATH, the tokenizer's
+        # own eos. GEN_STOP_EOS=off disables.
+        self.default_stop_ids: frozenset = frozenset()
+        if config.get_or_default("GEN_STOP_EOS", "on") != "off":
+            explicit = config.get("GEN_STOP_TOKENS")
+            if explicit:
+                try:
+                    self.default_stop_ids = frozenset(
+                        int(t) for t in str(explicit).split(",") if t.strip()
+                    )
+                except ValueError:
+                    raise ValueError(
+                        "GEN_STOP_TOKENS must be comma-separated token ids"
+                    ) from None
+            else:
+                self.default_stop_ids = frozenset(
+                    _checkpoint_eos_ids(self.model_path, self.tokenizer)
+                )
 
         # devices are NOT touched here: jax.devices() blocks on runtime
         # init, and on a wedged remote tunnel that would hang app
@@ -488,6 +545,9 @@ class TPUDevice:
         self.wait_ready(600.0)
         if isinstance(tokens, str):
             tokens = self._detokenize(tokens)["tokens"]
+        # the checkpoint's EOS always ends generation (OpenAI semantics);
+        # request stops compose with it
+        stop_tokens = frozenset(stop_tokens or ()) | self.default_stop_ids
         start = time.perf_counter()
         try:
             out = self.runner.generate(

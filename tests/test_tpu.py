@@ -739,3 +739,46 @@ def test_w8a8_serving_generates():
     finally:
         for k, v in old.items():
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_default_eos_stop(tmp_path):
+    """Generation stops at the checkpoint's EOS by default: the ids come
+    from generation_config.json (int or list) next to MODEL_PATH, else
+    the tokenizer's eos; GEN_STOP_TOKENS overrides; GEN_STOP_EOS=off
+    disables. (OpenAI semantics — a real instruct model must never run
+    past <|eot_id|> to max_tokens.)"""
+    import json
+
+    from gofr_tpu.testutil import serving_device
+    from gofr_tpu.tpu.device import _checkpoint_eos_ids
+
+    # unit: generation_config parsing
+    (tmp_path / "generation_config.json").write_text(
+        json.dumps({"eos_token_id": [128001, 128009]})
+    )
+    assert _checkpoint_eos_ids(str(tmp_path / "model.safetensors"), None) \
+        == {128001, 128009}
+    (tmp_path / "generation_config.json").write_text(
+        json.dumps({"eos_token_id": 7})
+    )
+    assert _checkpoint_eos_ids(str(tmp_path), None) == {7}
+    assert _checkpoint_eos_ids(None, None) == set()
+
+    # e2e: pick the plain greedy continuation's second token as the
+    # "eos" via GEN_STOP_TOKENS — generation must end before emitting it
+    with serving_device(DECODE_CHUNK="4", TOKENIZER="") as dev:
+        free = dev.generate([1, 2, 3], max_new_tokens=6)
+        assert dev.default_stop_ids == frozenset()  # no tokenizer/ckpt
+    with serving_device(DECODE_CHUNK="4",
+                        GEN_STOP_TOKENS=str(free[1])) as dev:
+        assert dev.default_stop_ids == {free[1]}
+        out = dev.generate([1, 2, 3], max_new_tokens=6)
+        assert out == free[:1]  # stopped before the configured id
+        # request stops COMPOSE with the default
+        out2 = dev.generate([1, 2, 3], max_new_tokens=6,
+                            stop_tokens=[free[0]])
+        assert out2 == []
+    with serving_device(DECODE_CHUNK="4", GEN_STOP_TOKENS=str(free[1]),
+                        GEN_STOP_EOS="off") as dev:
+        assert dev.default_stop_ids == frozenset()
+        assert dev.generate([1, 2, 3], max_new_tokens=6) == free
