@@ -562,6 +562,42 @@ def _logprobs_obj(
     return obj
 
 
+def _chat_lp_entry(tok: Any, token_id: int, lp: float) -> dict:
+    """One {token, logprob, bytes} content entry. ``bytes`` carries the
+    token's TRUE bytes (a byte-level BPE token can hold a fragment of a
+    multi-byte character — the field exists so clients can reassemble
+    text across such splits; round-tripping through the replaced string
+    would corrupt them)."""
+    raw = tok.decode_bytes([token_id])
+    return {
+        "token": raw.decode("utf-8", errors="replace"),
+        "logprob": lp,
+        "bytes": list(raw),
+    }
+
+
+def _chat_logprobs_obj(
+    tok: Any, lp_list: list, out_ids: list, tops: Any, top_n: int,
+) -> dict:
+    """Chat logprobs in the CURRENT OpenAI chat shape — a ``content``
+    list of {token, logprob, bytes, top_logprobs} entries that stock
+    SDKs parse (top_logprobs is ALWAYS present, [] when no alternatives
+    were requested — typed clients treat it as required) — alongside
+    this server's legacy completions-style fields
+    (token_logprobs/tokens/top_logprobs) for back-compat."""
+    obj = _logprobs_obj(tok, lp_list, out_ids, tops, top_n)
+    content = []
+    for j, (t, lp) in enumerate(zip(out_ids[: len(lp_list)], lp_list)):
+        e = _chat_lp_entry(tok, t, lp)
+        e["top_logprobs"] = (
+            [_chat_lp_entry(tok, i, v) for i, v in tops[j][:top_n]]
+            if top_n and tops is not None else []
+        )
+        content.append(e)
+    obj["content"] = content
+    return obj
+
+
 _FANOUT_CAP = 16  # pool-slot-scale bound on n/best_of; beyond it is a 400
 
 
@@ -994,14 +1030,23 @@ def chat_completions(ctx: Any) -> Any:
             adapter=adapter, logprobs=want_logprobs,
         )
 
-        def chunk(delta: dict, finish: Any = None, lp: Any = None) -> str:
+        def chunk(delta: dict, finish: Any = None, lp: Any = None,
+                  token_id: Any = None) -> str:
             choice: dict[str, Any] = {
                 "index": 0, "delta": delta, "finish_reason": finish,
             }
             if want_logprobs:
-                choice["logprobs"] = (
-                    {"token_logprobs": [lp]} if lp is not None else None
-                )
+                if lp is not None and token_id is not None:
+                    e = _chat_lp_entry(tok, token_id, lp)
+                    e["top_logprobs"] = []  # alternatives reject with stream
+                    choice["logprobs"] = {
+                        # the modern chat shape stock SDKs parse, plus
+                        # the legacy field this server has always sent
+                        "content": [e],
+                        "token_logprobs": [lp],
+                    }
+                else:
+                    choice["logprobs"] = None
             return _json.dumps({
                 "id": chat_id, "object": "chat.completion.chunk",
                 "created": created, "model": model, "choices": [choice],
@@ -1028,7 +1073,7 @@ def chat_completions(ctx: Any) -> Any:
                             finish = "stop"
                             break
                     if text or lp is not None:
-                        yield chunk({"content": text}, lp=lp)
+                        yield chunk({"content": text}, lp=lp, token_id=token)
                 tail = dec.flush()
                 if finish is None:
                     if scan is not None:
@@ -1070,7 +1115,7 @@ def chat_completions(ctx: Any) -> Any:
                 else ("length" if len(out) >= max_tokens else "stop")
             ),
             "logprobs": (
-                _logprobs_obj(tok, logprobs, out, tops, top_n)
+                _chat_logprobs_obj(tok, logprobs, out, tops, top_n)
                 if logprobs is not None else None
             ),
         }

@@ -268,15 +268,21 @@ class Tokenizer:
         return [self._ext_of[i] for i in ids if self._ext_of[i] >= 0]
 
     def decode(self, ids: list[int]) -> str:
+        return self.decode_bytes(ids).decode("utf-8", errors="replace")
+
+    def decode_bytes(self, ids: list[int]) -> bytes:
+        """The RAW bytes behind ``ids`` — a single byte-level BPE token
+        can hold a FRAGMENT of a multi-byte character, and consumers that
+        reassemble text across token boundaries (the OpenAI logprobs
+        ``bytes`` field) need the true fragment, not the replacement
+        character ``decode`` would substitute."""
         if self._int_of is not None:
             # external ids without a byte-level piece (specials) carry no text
             ids = [self._int_of[i] for i in ids if i in self._int_of]
         if self._native is not None:
-            data = self._decode_native(ids)
-        else:
-            top = 256 + len(self.merges)
-            data = b"".join(self._pieces[i] for i in ids if 0 <= i < top)
-        return data.decode("utf-8", errors="replace")
+            return self._decode_native(ids)
+        top = 256 + len(self.merges)
+        return b"".join(self._pieces[i] for i in ids if 0 <= i < top)
 
     def _encode_native(self, data: bytes) -> list[int]:
         lib = self._native

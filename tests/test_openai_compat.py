@@ -241,8 +241,28 @@ def test_chat_logprobs(chat_base):
         "messages": [{"role": "user", "content": "x"}],
         "max_tokens": 3, "temperature": 0, "logprobs": True,
     }, path="/v1/chat/completions")
-    lps = body["choices"][0]["logprobs"]["token_logprobs"]
+    lp_obj = body["choices"][0]["logprobs"]
+    lps = lp_obj["token_logprobs"]
     assert len(lps) == 3 and all(lp <= 0.0 for lp in lps)
+    # the CURRENT chat shape stock SDKs parse, alongside the legacy field
+    content = lp_obj["content"]
+    assert len(content) == 3
+    for e, lp in zip(content, lps):
+        assert e["logprob"] == lp
+        assert isinstance(e["token"], str)
+        assert e["bytes"] == list(e["token"].encode("utf-8"))
+    # alternatives ride content entries when requested
+    _, body2 = _post(chat_base, {
+        "messages": [{"role": "user", "content": "x"}],
+        "max_tokens": 3, "temperature": 0, "logprobs": True,
+        "top_logprobs": 2,
+    }, path="/v1/chat/completions")
+    c2 = body2["choices"][0]["logprobs"]["content"]
+    assert all(len(e["top_logprobs"]) == 2 for e in c2)
+    # greedy: the chosen token is the best alternative
+    for e in c2:
+        assert max(a["logprob"] for a in e["top_logprobs"]) == \
+            e["top_logprobs"][0]["logprob"]
 
 
 # -- embeddings (encoder models: BASELINE config 2's OpenAI face) ------------
