@@ -94,7 +94,14 @@ def run_grid(model: str, quant: str, buckets, batches, attn: str | None,
         buckets=tuple(sorted(set(buckets))), max_seq=max_seq, attn_impl=attn,
     )
     out = []
+    eff_max = runner.cfg.max_seq
     for bucket in buckets:
+        if bucket > eff_max:
+            # the runner clamps its compiled buckets to the model's
+            # max_seq; timing an unclamped shape would crash the grid
+            print(f"skip bucket {bucket} > max_seq {eff_max}",
+                  file=sys.stderr, flush=True)
+            continue
         for batch in batches:
             t = _time_prefill(runner, bucket, batch)
             tokens = bucket * batch
@@ -112,8 +119,9 @@ def run_grid(model: str, quant: str, buckets, batches, attn: str | None,
             }
             out.append(rec)
             print(json.dumps(rec), flush=True)
-    if trace_dir:
-        bucket, batch = buckets[-1], batches[-1]
+    if trace_dir and out:
+        # trace a shape that was actually measured, from one record
+        bucket, batch = out[-1]["bucket"], out[-1]["batch"]
         print(f"=== tracing one [{batch}, {bucket}] dispatch -> {trace_dir}",
               file=sys.stderr)
         jax.profiler.start_trace(trace_dir)
@@ -221,15 +229,20 @@ def main() -> int:
         except Exception as exc:  # missing tf, truncated .xplane.pb, ...
             print(f"trace summary skipped: {exc!r}", file=sys.stderr)
     if args.ablate:
-        # quant ablations at the largest shape (skip whichever mode the
-        # main grid already ran — each is minutes of XLA compile)
+        # quant ablations at the largest MEASURABLE shape (skip whichever
+        # mode the main grid already ran — each is minutes of XLA
+        # compile). buckets[-1] may exceed max_seq and be skipped by the
+        # grid; building a multi-GB runner to measure nothing would waste
+        # the whole ablation stage.
+        usable = [b for b in buckets if b <= args.max_seq]
+        top = usable[-1:] if usable else buckets[:1]
         for mode in ("", "w8a8"):
             if args.quant != mode:
-                results += run_grid(args.model, mode, buckets[-1:],
+                results += run_grid(args.model, mode, top,
                                     batches[-1:], None, args.max_seq, None)
         # attention impl: pallas flash vs xla at the largest shape
         for attn in ("xla", "pallas"):
-            results += run_grid(args.model, args.quant, buckets[-1:],
+            results += run_grid(args.model, args.quant, top,
                                 batches[-1:], attn, args.max_seq, None)
     ranked = sorted(results, key=lambda r: -r["mfu_device"])
     print("\n=== MFU ranking (mfu_device = link-amortized; mfu = one synced"
