@@ -331,16 +331,26 @@ def list_models(ctx: Any) -> Any:
         raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
     from gofr_tpu.http.response import Raw
 
-    # OpenAI clients expect the list object at top level, not inside the
-    # framework envelope
-    return Raw({
-        "object": "list",
-        "data": [{
-            "id": ctx.tpu.model_name,
+    # the base model plus every loaded LoRA adapter: gateways route by
+    # model name, and a request's "model" naming an adapter selects it
+    # (the multi-LoRA serving convention) — stock OpenAI clients cannot
+    # send the custom "adapter" key, but they can set model
+    entries = [{
+        "id": ctx.tpu.model_name,
+        "object": "model",
+        "owned_by": "gofr_tpu",
+    }]
+    # non-blocking snapshot: discovery must answer instantly during a
+    # background boot (list_adapters would wait for readiness)
+    adapters = getattr(getattr(ctx.tpu, "runner", None), "adapters", None) or {}
+    for name in sorted(adapters):
+        entries.append({
+            "id": name,
             "object": "model",
             "owned_by": "gofr_tpu",
-        }],
-    })
+            "root": ctx.tpu.model_name,  # the base it adapts
+        })
+    return Raw({"object": "list", "data": entries})
 
 
 def _prompt_tokens(ctx: Any, prompt: Any) -> list[int]:
@@ -521,6 +531,25 @@ def _parse_request(ctx: Any, default_max: int) -> tuple:
     adapter = body.get("adapter")  # multi-LoRA extension
     if adapter is not None and not isinstance(adapter, str):
         raise HTTPError(400, '"adapter" must be a string')
+    if adapter is None:
+        # OpenAI-conventional selection: "model" naming a loaded adapter
+        # routes to it (stock clients have no way to send "adapter");
+        # the explicit extension key wins when both are present. An
+        # UNKNOWN model name is a 404 exactly like the real API — a
+        # gateway routing to an unloaded adapter must never silently get
+        # base-model output (list_adapters waits for boot, so the
+        # routing decision always sees the post-boot adapter set)
+        requested = body.get("model")
+        if isinstance(requested, str) and requested != ctx.tpu.model_name:
+            loaded = ctx.tpu.list_adapters()
+            if requested in loaded:
+                adapter = requested
+            else:
+                raise HTTPError(
+                    404,
+                    f"model '{requested}' not found (serving: "
+                    f"{[ctx.tpu.model_name, *loaded]})",
+                )
     return (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs,
             top_n, adapter)
 
@@ -796,7 +825,7 @@ def completions(ctx: Any) -> Any:
         # generating from a magic default would 200 on garbage
         raise HTTPError(400, 'missing "prompt"')
     prompt_ids = _prompt_tokens(ctx, body["prompt"])
-    model = ctx.tpu.model_name
+    model = adapter or ctx.tpu.model_name  # adapters serve under their name
     created = int(time.time())
     cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
     tok = ctx.tpu.tokenizer
@@ -998,7 +1027,7 @@ def chat_completions(ctx: Any) -> Any:
     prompt_ids = tok.encode(prompt_text)
     if not prompt_ids:
         raise HTTPError(400, "messages encoded to zero tokens")
-    model = ctx.tpu.model_name
+    model = adapter or ctx.tpu.model_name  # adapters serve under their name
     created = int(time.time())
     chat_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
 

@@ -902,6 +902,12 @@ class TPUDevice:
         runner = self.runner
         if not isinstance(name, str) or not name:
             raise InvalidParamError('"name" must be a non-empty string')
+        if name == self.model_name:
+            # the OpenAI surface routes by model name: a collision would
+            # make the adapter unselectable and the listing ambiguous
+            raise InvalidParamError(
+                f"adapter name '{name}' collides with the base model name"
+            )
         if not isinstance(path, str) or not path:
             raise InvalidParamError('"path" must be a non-empty string')
         if getattr(runner, "adapters", None) is None:

@@ -241,6 +241,52 @@ def test_admin_adapter_routes(adapter_paths, tmp_path):
             raise AssertionError("expected 400")
         except urllib.error.HTTPError as e:
             assert e.code == 400
+        # OpenAI-conventional routing: /v1/models lists a loaded adapter
+        # and "model": <adapter> selects it without the custom key
+        from gofr_tpu.openai_compat import register_openai_routes
+
+        register_openai_routes(app)
+        status, body = call("POST", "/admin/adapters",
+                            {"name": name, "path": path})
+        req = urllib.request.Request(
+            base + "/v1/models",
+            headers={"Authorization": "Bearer hunter2"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            models = _json.loads(resp.read())
+        ids = [m["id"] for m in models["data"]]
+        assert "tiny" in ids and name in ids
+        def openai(payload):
+            r = urllib.request.Request(
+                base + "/v1/completions", data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(r, timeout=120) as resp:
+                return _json.loads(resp.read())
+        via_model = openai({"model": name, "prompt": [1, 2, 3],
+                            "max_tokens": 6, "temperature": 0,
+                            "logprobs": 1})
+        via_key = openai({"adapter": name, "prompt": [1, 2, 3],
+                          "max_tokens": 6, "temperature": 0,
+                          "logprobs": 1})
+        base_out = openai({"prompt": [1, 2, 3], "max_tokens": 6,
+                           "temperature": 0, "logprobs": 1})
+        assert via_model["model"] == name  # served under the adapter name
+        assert via_model["choices"][0]["logprobs"]["token_logprobs"] == \
+            via_key["choices"][0]["logprobs"]["token_logprobs"]
+        assert via_model["choices"][0]["logprobs"] != \
+            base_out["choices"][0]["logprobs"]
+        # an UNKNOWN model is a 404 like the real API — a gateway routed
+        # to an unloaded adapter must never silently get the base model
+        try:
+            openai({"model": "ghost", "prompt": [1, 2], "max_tokens": 2})
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404 and "ghost" in e.read(300).decode()
+        # an adapter named like the base would be unselectable: 400
+        try:
+            call("POST", "/admin/adapters", {"name": "tiny", "path": path})
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400 and "collides" in e.read(300).decode()
     finally:
         if app is not None:
             app.shutdown()

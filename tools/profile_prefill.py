@@ -228,14 +228,17 @@ def main() -> int:
             summarize_trace(args.trace)
         except Exception as exc:  # missing tf, truncated .xplane.pb, ...
             print(f"trace summary skipped: {exc!r}", file=sys.stderr)
-    if args.ablate:
-        # quant ablations at the largest MEASURABLE shape (skip whichever
-        # mode the main grid already ran — each is minutes of XLA
-        # compile). buckets[-1] may exceed max_seq and be skipped by the
-        # grid; building a multi-GB runner to measure nothing would waste
-        # the whole ablation stage.
-        usable = [b for b in buckets if b <= args.max_seq]
-        top = usable[-1:] if usable else buckets[:1]
+    if args.ablate and not results:
+        # the main grid measured nothing: building more runners to skip
+        # the same shapes would waste the whole ablation stage
+        print("ablations skipped: the main grid measured nothing",
+              file=sys.stderr)
+    elif args.ablate:
+        # quant ablations at the largest shape the main grid actually
+        # MEASURED (its skip logic knows the model's effective max_seq;
+        # re-filtering on args.max_seq alone would rebuild multi-GB
+        # runners to measure nothing)
+        top = [max(r["bucket"] for r in results)]
         for mode in ("", "w8a8"):
             if args.quant != mode:
                 results += run_grid(args.model, mode, top,
