@@ -1,0 +1,138 @@
+"""Real-TPU pallas boot smoke: compile + run the flash-attention kernel
+family on the actual device (interpret=False) and compare against the
+plain-attention reference. This is the FIRST stage of any hardware
+window: the r04 lse/dvec tiling fix (671cbf7) targets a bug class that
+interpret mode cannot observe (real Mosaic lowering rejects block shapes
+interpret mode accepts — see /tmp/r04_hw/sweep.log in round 4), so the
+kernels are only "known good" once this has passed on hardware.
+
+Prints ONE JSON line: {"ok": bool, "cases": {...}, "platform": "..."}.
+Exit 0 iff every case matched.
+
+    python tools/boot_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("BOOT_SMOKE_CPU"):
+        # script-validation mode: the ambient sitecustomize force-registers
+        # the TPU plugin even under JAX_PLATFORMS=cpu; only this sticks
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gofr_tpu.ops.flash import _reference, flash_attention
+
+    platform = jax.devices()[0].platform
+    interpret = platform != "tpu" and platform != "axon"
+    rng = np.random.default_rng(0)
+    cases: dict[str, dict] = {}
+    ok = True
+
+    def run(name: str, fn) -> None:
+        nonlocal ok
+        t0 = time.time()
+        try:
+            err = float(fn())
+            cases[name] = {
+                "ok": err < 2e-2, "max_err": err,
+                "seconds": round(time.time() - t0, 2),
+            }
+            ok = ok and cases[name]["ok"]
+        except Exception as exc:  # a lowering failure IS the finding
+            cases[name] = {
+                "ok": False, "error": repr(exc)[:500],
+                "seconds": round(time.time() - t0, 2),
+            }
+            ok = False
+
+    def mk(b, s, h, d, dtype=jnp.bfloat16):
+        return jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+
+    def case_prefill():
+        q, k, v = mk(2, 256, 4, 64), mk(2, 256, 4, 64), mk(2, 256, 4, 64)
+        out = flash_attention(q, k, v, causal=True, interpret=interpret)
+        ref = _reference(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), jnp.zeros((2,), jnp.int32),
+            jnp.full((2,), 256, jnp.int32), True, 64 ** -0.5,
+        )
+        return jnp.max(jnp.abs(out.astype(jnp.float32) - ref))
+
+    def case_gqa():
+        q, k, v = mk(1, 256, 8, 64), mk(1, 256, 2, 64), mk(1, 256, 2, 64)
+        out = flash_attention(q, k, v, causal=True, interpret=interpret)
+        kr = jnp.repeat(k, 4, axis=2).astype(jnp.float32)
+        vr = jnp.repeat(v, 4, axis=2).astype(jnp.float32)
+        ref = _reference(
+            q.astype(jnp.float32), kr, vr, jnp.zeros((1,), jnp.int32),
+            jnp.full((1,), 256, jnp.int32), True, 64 ** -0.5,
+        )
+        return jnp.max(jnp.abs(out.astype(jnp.float32) - ref))
+
+    def case_ragged_decode():
+        # Sq=1 rows at per-request absolute offsets with a padded KV tail
+        q = mk(4, 1, 4, 64)
+        k, v = mk(4, 512, 4, 64), mk(4, 512, 4, 64)
+        offs = jnp.asarray([3, 100, 257, 511], jnp.int32)
+        lens = offs + 1
+        out = flash_attention(
+            q, k, v, causal=True, q_offset=offs, kv_lens=lens,
+            interpret=interpret,
+        )
+        ref = _reference(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), offs, lens, True, 64 ** -0.5,
+        )
+        return jnp.max(jnp.abs(out.astype(jnp.float32) - ref))
+
+    def case_bwd():
+        # the lse residual feeds the fused backward — the exact path the
+        # r04 tiling fix changed
+        q, k, v = mk(1, 128, 2, 64), mk(1, 128, 2, 64), mk(1, 128, 2, 64)
+
+        def loss_flash(q_, k_, v_):
+            return jnp.sum(
+                flash_attention(q_, k_, v_, causal=True, interpret=interpret)
+                .astype(jnp.float32)
+            )
+
+        def loss_ref(q_, k_, v_):
+            return jnp.sum(_reference(
+                q_.astype(jnp.float32), k_.astype(jnp.float32),
+                v_.astype(jnp.float32), jnp.zeros((1,), jnp.int32),
+                jnp.full((1,), 128, jnp.int32), True, 64 ** -0.5,
+            ))
+
+        gq, gk, gv = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        rq, rk, rv = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        return max(
+            float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+            for a, b in ((gq, rq), (gk, rk), (gv, rv))
+        )
+
+    run("prefill_256", case_prefill)
+    run("gqa_4to1", case_gqa)
+    run("ragged_decode", case_ragged_decode)
+    run("fused_bwd", case_bwd)
+
+    print(json.dumps({
+        "ok": ok, "platform": platform, "interpret": bool(interpret),
+        "cases": cases, "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
