@@ -28,8 +28,15 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
   (models/lora.py::export_adapter, orbax-saved) served over the shared
   base; requests select one via generate(adapter=...) and decode solo
 - ``PREFIX_CACHE``: keep the KV rows of the n most recent distinct
-  prompts — an exact repeat (system prompts, retries) skips prefill
-  entirely on the generate path (hit ratio on /metrics)
+  prompts — an exact repeat (retries) skips prefill entirely on the
+  generate path, and a prompt sharing a long-enough common prefix with a
+  cached entry (shared system prompt, differing user turn) resumes from
+  its KV and prefills only the tail (exact-hit and partial-hit ratios on
+  /metrics: ``gofr_tpu_prefix_hit_ratio`` counts exact hits per lookup,
+  ``gofr_tpu_prefix_partial_hit_ratio`` partial hits per lookup)
+- ``PREFIX_LCP_MIN``: minimum shared-prefix tokens for a partial hit
+  (default 0 = the smallest compiled bucket; -1 = exact-only matching,
+  restoring the pre-LCP behavior and skipping its warmup compiles)
 - ``TPU_BOOT``: "background" boots the stack off-thread; the server
   accepts immediately and /.well-known/ready reports warmup progress
 - ``BATCH_MAX_SIZE`` / ``BATCH_TIMEOUT_MS``: batcher shape
@@ -213,7 +220,12 @@ class TPUDevice:
         )
         self._prefix_gauge = metrics.gauge(
             "gofr_tpu_prefix_hit_ratio",
-            "prefix cache: prompt hits / lookups",
+            "prefix cache: exact prompt hits / lookups",
+            labels=("model",),
+        )
+        self._prefix_partial_gauge = metrics.gauge(
+            "gofr_tpu_prefix_partial_hit_ratio",
+            "prefix cache: shared-prefix (tail-only prefill) hits / lookups",
             labels=("model",),
         )
 
@@ -284,6 +296,13 @@ class TPUDevice:
         self._prefix_cache_size = int(config.get_or_default("PREFIX_CACHE", "0"))
         if self._prefix_cache_size < 0:
             raise ValueError("PREFIX_CACHE must be >= 0")
+        # PREFIX_LCP_MIN=n: minimum shared-prefix tokens for a PARTIAL hit
+        # (resume from a cached entry's KV, prefill only the tail);
+        # 0 = one smallest-bucket's worth (the default worthwhileness bar);
+        # -1 = exact-only (no LCP scan, no tail-prefill warmup compiles)
+        self._prefix_lcp_min = int(config.get_or_default("PREFIX_LCP_MIN", "0"))
+        if self._prefix_lcp_min < -1:
+            raise ValueError("PREFIX_LCP_MIN must be >= -1")
         self._pool_enabled = config.get_or_default("DECODE_POOL", "on") != "off"
         self._pool_slots = int(config.get_or_default("DECODE_SLOTS", str(self.max_batch)))
         from gofr_tpu.tpu.decode_pool import PIPELINE_DEPTH
@@ -424,6 +443,7 @@ class TPUDevice:
             draft_tokens=self._draft_tokens, draft_path=self._draft_path,
             attn_impl=self._attn_impl,
             prefix_cache=self._prefix_cache_size,
+            prefix_lcp_min=self._prefix_lcp_min,
             lora_adapters=self._lora_adapters,
         )
         self.runner.warmup(progress=self._boot_progress)
@@ -568,11 +588,16 @@ class TPUDevice:
                     ratio = stats["accepted"] / stats["drafted"]
                 self._spec_gauge.set(ratio, model=self.model_name)
             pstats = getattr(self.runner, "prefix_stats", None)
-            if pstats and (pstats["hits"] + pstats["misses"]):
-                self._prefix_gauge.set(
-                    pstats["hits"] / (pstats["hits"] + pstats["misses"]),
-                    model=self.model_name,
-                )
+            if pstats:
+                partial = pstats.get("partial_hits", 0)
+                lookups = pstats["hits"] + partial + pstats["misses"]
+                if lookups:
+                    self._prefix_gauge.set(
+                        pstats["hits"] / lookups, model=self.model_name
+                    )
+                    self._prefix_partial_gauge.set(
+                        partial / lookups, model=self.model_name
+                    )
             return out
         except Exception:
             self._requests.inc(model=self.model_name, op="generate", status="error")
@@ -1144,6 +1169,7 @@ class _TransformerRunner:
         draft_path: Optional[str] = None,
         attn_impl: Optional[str] = None,
         prefix_cache: int = 0,
+        prefix_lcp_min: int = 0,
         lora_adapters: Optional[dict] = None,
     ):
         self.max_batch = max_batch
@@ -1301,15 +1327,29 @@ class _TransformerRunner:
         # prefix cache: prompt bytes -> (cache_row, length, next_token).
         # Rows are shared read-only: neither the solo decode chunk nor the
         # pool's write_slot donates/mutates its row input, so one stored
-        # row can seed any number of later generations.
+        # row can seed any number of later generations. Beyond exact
+        # repeats, a prompt sharing a long-enough common prefix with a
+        # stored entry resumes from that entry's KV and prefills only the
+        # tail (shared system prompts with differing user turns — the
+        # dominant real-traffic shape; no reference equivalent).
         from collections import OrderedDict
 
         self._prefix_cache: Optional[OrderedDict] = (
             OrderedDict() if prefix_cache > 0 else None
         )
         self._prefix_cache_size = prefix_cache
+        # minimum shared-prefix length worth a partial hit: below this the
+        # row copy + rolled-back tail prefill costs more than it saves.
+        # Default = the smallest compiled bucket (one bucket's worth of
+        # prefill skipped); PREFIX_LCP_MIN overrides for short-prompt
+        # deployments
+        # -1 disables LCP entirely (exact-only cache: no scan on miss, no
+        # tail-prefill warmup); 0 defaults to the smallest compiled bucket
+        self._prefix_lcp_min = (
+            prefix_lcp_min if prefix_lcp_min != 0 else self.buckets[0]
+        )
         self._prefix_lock = threading.Lock()
-        self.prefix_stats = {"hits": 0, "misses": 0}
+        self.prefix_stats = {"hits": 0, "partial_hits": 0, "misses": 0}
         if self.spec is not None:
             from gofr_tpu.models.transformer import (
                 verify_chunk,
@@ -1815,23 +1855,90 @@ class _TransformerRunner:
         }
 
     def _prefix_lookup(self, ids: np.ndarray) -> Optional[dict]:
-        """Exact-match prompt lookup -> a private state (copied cache row;
-        shared read-only logits) or None. LRU order updates on hit."""
+        """Prompt lookup -> a private state (copied cache row; shared
+        read-only logits) or None. Exact match skips prefill entirely;
+        otherwise the entry sharing the longest common token prefix (of at
+        least ``_prefix_lcp_min``) seeds a tail-only prefill. LRU order
+        updates on either kind of hit."""
         key = ids.tobytes()
         with self._prefix_lock:
             entry = self._prefix_cache.get(key)
-            if entry is None:
-                self.prefix_stats["misses"] += 1
-                return None
-            self._prefix_cache.move_to_end(key)
-            self.prefix_stats["hits"] += 1
-        row, length, next_token, logits = entry
-        return {
-            "cache": self._copy_row(row),
-            "length": length,
-            "next_token": next_token,
-            "logits": logits,
+            if entry is not None:
+                self._prefix_cache.move_to_end(key)
+                self.prefix_stats["hits"] += 1
+            else:
+                shared, row = (
+                    self._lcp_scan(ids)
+                    if self._prefix_lcp_min >= 0 and self._can_chunk_prefill()
+                    else (0, None)
+                )
+                if row is None:
+                    self.prefix_stats["misses"] += 1
+                    return None
+                self.prefix_stats["partial_hits"] += 1
+        if entry is not None:  # device work outside the lock
+            row, length, next_token, logits = entry
+            return {
+                "cache": self._copy_row(row),
+                "length": length,
+                "next_token": next_token,
+                "logits": logits,
+            }
+        return self._tail_prefill(ids, row, shared)
+
+    def _lcp_scan(self, ids: np.ndarray) -> tuple:
+        """Under ``_prefix_lock``: find the entry with the longest common
+        token prefix. The shared length is capped at ``ids.size - 1`` so
+        the tail always keeps >= 1 token — the final-position logits and
+        next_token come from prefilling the tail, never from the entry
+        (whose continuation belongs to a DIFFERENT prompt). Linear scan:
+        the cache holds PREFIX_CACHE (tens of) entries and one numpy
+        compare per entry is nanoseconds against the prefill it saves."""
+        best_shared, best_key, best_row = 0, None, None
+        limit = int(ids.size) - 1
+        for key, entry in self._prefix_cache.items():
+            cand = np.frombuffer(key, dtype=np.int32)
+            n = min(cand.size, limit)
+            if n <= best_shared:
+                continue
+            neq = np.nonzero(cand[:n] != ids[:n])[0]
+            shared = int(neq[0]) if neq.size else n
+            if shared > best_shared:
+                best_shared, best_key, best_row = shared, key, entry[0]
+        if best_row is None or best_shared < self._prefix_lcp_min:
+            return 0, None
+        self._prefix_cache.move_to_end(best_key)
+        return best_shared, best_row
+
+    def _tail_prefill(self, ids: np.ndarray, row: Any, shared: int) -> dict:
+        """Resume prefill from a cached shared-prefix row: copy the row
+        (stored rows are shared read-only), roll its write head back to
+        ``shared`` (the donated copy, never the stored row), and run only
+        the tail through the bucketed prefill at its ragged offset — the
+        same mechanics as chunked prefill. Stale KV past ``shared`` is
+        masked by attention (lengths bounds the valid prefix) and
+        overwritten as the tail lands. The completed full-prompt state is
+        stored for future exact hits."""
+        cache = _cache_with_len(
+            self._copy_row(row), jnp.asarray(shared, jnp.int32)
+        )
+        tail = ids[shared:]
+        bucket = self._bucket_for(int(tail.size))
+        logits = next_ids = None
+        total = shared
+        for tokens, lengths, size in _prompt_chunks(tail, bucket):
+            logits, next_ids, cache = self._prefill(
+                self.params, tokens, cache, lengths
+            )
+            total += size
+        state = {
+            "cache": cache,
+            "length": total,
+            "next_token": int(np.asarray(next_ids)[0]),
+            "logits": logits[0],
         }
+        self._prefix_store(ids, state)
+        return state
 
     def _prefix_store(self, ids: np.ndarray, state: Any) -> None:
         """Store this prompt's prefill result (copied row — the live row
@@ -2113,6 +2220,26 @@ class _TransformerRunner:
         if self._prefix_cache is not None:
             # prefix-cache row copies must not compile on the serving path
             self._copy_row(one)["lengths"].block_until_ready()
+            if self._prefix_lcp_min >= 0 and self._can_chunk_prefill():
+                # partial (shared-prefix) hits tail-prefill at [1, bucket]
+                # per bucket plus the 1-row length rollback — warm both so
+                # the feature built to CUT TTFT never pays a mid-request
+                # compile (the warmup contract above)
+                for i, b_ in enumerate(self.buckets):
+                    if progress:
+                        progress(
+                            f"compiling tail prefill bucket {b_} "
+                            f"({i + 1}/{len(self.buckets)})"
+                        )
+                    # tail of b_-1 tokens lands in bucket b_ (> previous
+                    # bucket); total stays within max_seq
+                    st = self._tail_prefill(np.ones((b_,), np.int32), one, 1)
+                    del st
+                # the warmup probes above polluted the cache with fake
+                # prompt entries — serving must start empty
+                with self._prefix_lock:
+                    self._prefix_cache.clear()
+                    self.prefix_stats.update(hits=0, partial_hits=0, misses=0)
         if self.adapters:
             # LoRA-wrapped trees have a different pytree structure, so the
             # adapter prefill/decode executables are separate compiles —
@@ -2410,6 +2537,7 @@ def _build_runner(
     draft_path: Optional[str] = None,
     attn_impl: Optional[str] = None,
     prefix_cache: int = 0,
+    prefix_lcp_min: int = 0,
     lora_adapters: Optional[dict] = None,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
@@ -2429,7 +2557,7 @@ def _build_runner(
             kv_dtype=kv_dtype, draft_name=draft_name,
             draft_tokens=draft_tokens, draft_path=draft_path,
             attn_impl=attn_impl, prefix_cache=prefix_cache,
-            lora_adapters=lora_adapters,
+            prefix_lcp_min=prefix_lcp_min, lora_adapters=lora_adapters,
         )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "

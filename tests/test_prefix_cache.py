@@ -91,3 +91,93 @@ def test_negative_size_rejected():
     with pytest.raises(ValueError, match="PREFIX_CACHE"):
         with serving_device(PREFIX_CACHE="-1"):
             pass
+
+
+# -- longest-common-prefix (partial) reuse -----------------------------------
+# Two prompts sharing a system prefix: the second resumes from the first's
+# cached KV and prefills only its tail. PREFIX_LCP_MIN=4 lowers the
+# worthwhileness bar (default = smallest bucket = 64) to test scale.
+
+SYSTEM = [7, 3, 9, 2, 11, 5]  # the shared "system prompt"
+
+
+@pytest.fixture(scope="module")
+def lcp():
+    with serving_device(
+        PREFIX_CACHE="4", PREFIX_LCP_MIN="4", DECODE_CHUNK="4"
+    ) as dev:
+        yield dev
+
+
+def test_shared_prefix_partial_hit_matches(lcp, plain):
+    a = SYSTEM + [21, 22, 23]
+    b = SYSTEM + [31, 32]  # same system prompt, different user turn
+    want_a = plain.generate(a, max_new_tokens=8)
+    want_b = plain.generate(b, max_new_tokens=8)
+    got_a = lcp.generate(a, max_new_tokens=8)  # miss; stores entry
+    before = dict(lcp.runner.prefix_stats)
+    got_b = lcp.generate(b, max_new_tokens=8)  # partial hit off a's KV
+    after = lcp.runner.prefix_stats
+    assert got_a == want_a
+    assert got_b == want_b
+    assert after["partial_hits"] == before["partial_hits"] + 1
+    assert after["misses"] == before["misses"]
+
+
+def test_partial_hit_stores_full_prompt_for_exact_reuse(lcp):
+    b = SYSTEM + [41, 42, 43, 44]
+    first = lcp.generate(b, max_new_tokens=6)
+    before = dict(lcp.runner.prefix_stats)
+    second = lcp.generate(b, max_new_tokens=6)  # exact hit on the stored tail state
+    assert second == first
+    assert lcp.runner.prefix_stats["hits"] == before["hits"] + 1
+
+
+def test_short_shared_prefix_stays_a_miss(lcp):
+    lcp.generate([1, 2, 3, 50, 51, 52], max_new_tokens=4)
+    before = dict(lcp.runner.prefix_stats)
+    lcp.generate([1, 2, 3, 60, 61, 62], max_new_tokens=4)  # LCP=3 < min 4
+    after = lcp.runner.prefix_stats
+    assert after["partial_hits"] == before["partial_hits"]
+    assert after["misses"] == before["misses"] + 1
+
+
+def test_query_shorter_than_cached_entry(lcp, plain):
+    long = SYSTEM + [71, 72, 73, 74, 75]
+    short = SYSTEM + [71]  # strict prefix of the cached prompt
+    lcp.generate(long, max_new_tokens=4)
+    want = plain.generate(short, max_new_tokens=6)
+    before = dict(lcp.runner.prefix_stats)
+    got = lcp.generate(short, max_new_tokens=6)
+    assert got == want
+    assert lcp.runner.prefix_stats["partial_hits"] == before["partial_hits"] + 1
+
+
+def test_partial_hit_ratio_exposed(lcp):
+    # self-sufficient: labeled gauges emit no sample until set, so drive
+    # one partial hit here rather than depending on module test order
+    lcp.generate(SYSTEM + [91, 92, 93], max_new_tokens=2)
+    lcp.generate(SYSTEM + [94, 95], max_new_tokens=2)
+    text = lcp.metrics.expose()
+    assert any(
+        ln.startswith('gofr_tpu_prefix_partial_hit_ratio{model="tiny"}')
+        for ln in text.splitlines()
+    ), text
+
+
+def test_below_off_lcp_min_rejected():
+    # -1 is the documented off switch; anything below is a config error
+    with pytest.raises(ValueError, match="PREFIX_LCP_MIN"):
+        with serving_device(PREFIX_CACHE="2", PREFIX_LCP_MIN="-2"):
+            pass
+
+
+def test_lcp_off_restores_exact_only():
+    with serving_device(
+        PREFIX_CACHE="2", PREFIX_LCP_MIN="-1", DECODE_CHUNK="4"
+    ) as dev:
+        dev.generate(SYSTEM + [21, 22, 23], max_new_tokens=2)
+        dev.generate(SYSTEM + [31, 32], max_new_tokens=2)  # would LCP-hit
+        stats = dev.runner.prefix_stats
+        assert stats["partial_hits"] == 0
+        assert stats["misses"] == 2
