@@ -57,6 +57,102 @@ def lora_mm(x: jnp.ndarray, w: dict, base_mm: Any) -> jnp.ndarray:
     return y + (delta * w["lora_scale"]).astype(y.dtype)
 
 
+def is_lora_stack(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and "lora_stack_a" in leaf
+
+
+def plora_mm(x: jnp.ndarray, w: dict, base_mm: Any) -> jnp.ndarray:
+    """``mm`` for a pooled multi-LoRA leaf: every batch row selects its own
+    adapter from the stacked bank. ``w`` carries ``lora_stack_a`` [A, in,
+    r] / ``lora_stack_b`` [A, r, out] / ``lora_stack_scale`` [A, 1, 1]
+    (A = adapters + 1; index 0 is the zero/identity adapter base rows use)
+    and ``lora_ids`` [B] attached per dispatch by ``attach_lora_ids``. The
+    per-row gather is tiny next to the base matmul (rank x dim vs dim x
+    dim) and XLA keeps the skinny einsums beside it — the vLLM-class
+    batched-multi-adapter decode, TPU-style: no custom gather kernel, the
+    bank rides the executable as a normal stacked operand."""
+    y = base_mm(x, w["w"])
+    a = jnp.take(w["lora_stack_a"], w["lora_ids"], axis=0)      # [B, in, r]
+    b = jnp.take(w["lora_stack_b"], w["lora_ids"], axis=0)      # [B, r, out]
+    s = jnp.take(w["lora_stack_scale"], w["lora_ids"], axis=0)  # [B, 1, 1]
+    # x is [B, ..., in] — [B, S, in] through the layers, [B, in] at the
+    # last-position lm_head — so the adapter axes contract via ellipsis
+    delta = jnp.einsum("b...i,bir->b...r", x, a)
+    delta = jnp.einsum("b...r,bro->b...o", delta, b)
+    s = s.reshape(s.shape[0], *([1] * (delta.ndim - 1)))
+    return y + (delta * s).astype(y.dtype)
+
+
+def build_lora_stack(base: dict, wrapped: "dict[str, dict]") -> dict:
+    """Stack named wrapped trees (``apply_adapter`` outputs over ONE shared
+    base) into a single pooled tree for per-slot adapter decode: each
+    targeted leaf becomes ``{"w": base_leaf, "lora_stack_a/b/scale":
+    [.., A, ..]}`` with index 0 the zero (identity) adapter and insertion
+    order i at index i+1. Raises ValueError when adapters disagree on
+    targets or rank (the pool needs one uniform bank; such sets serve
+    solo)."""
+    trees = list(wrapped.values())
+
+    def walk(b: Any, ws: list, path: str) -> Any:
+        if any(is_lora(w) for w in ws):
+            if not all(is_lora(w) for w in ws):
+                raise ValueError(
+                    f"adapters disagree on target weight at {path or '/'}"
+                )
+            ranks = {w["lora_a"].shape[-1] for w in ws}
+            if len(ranks) != 1:
+                raise ValueError(
+                    f"adapter rank mismatch at {path or '/'}: {sorted(ranks)}"
+                )
+            zeros = (
+                jnp.zeros_like(ws[0]["lora_a"]),
+                jnp.zeros_like(ws[0]["lora_b"]),
+                jnp.zeros_like(ws[0]["lora_scale"]),
+            )
+            # axis=-3 inserts the adapter axis just before (in|r|1, r|out|1),
+            # after any stacked-layer leading dims — lax.scan still slices
+            # the layer axis first, leaving [A, in, r] inside the layer
+            return {
+                "w": b,
+                "lora_stack_a": jnp.stack(
+                    [zeros[0]] + [w["lora_a"] for w in ws], axis=-3
+                ),
+                "lora_stack_b": jnp.stack(
+                    [zeros[1]] + [w["lora_b"] for w in ws], axis=-3
+                ),
+                "lora_stack_scale": jnp.stack(
+                    [zeros[2]] + [w["lora_scale"] for w in ws], axis=-3
+                ),
+            }
+        if isinstance(b, dict) and not _is_packed(b):
+            return {
+                k: walk(b[k], [w[k] for w in ws], f"{path}/{k}") for k in b
+            }
+        return b
+
+    return walk(base, trees, "")
+
+
+def attach_lora_ids(stacked: Any, ids: jnp.ndarray) -> Any:
+    """Insert the per-row adapter selection [B] into every stacked leaf
+    (broadcast over stacked-layer leading dims so ``lax.scan`` slices it
+    alongside the bank). Called inside the jitted pool chunk — costs
+    nothing at runtime."""
+
+    def walk(t: Any) -> Any:
+        if is_lora_stack(t):
+            lead = t["lora_stack_a"].shape[:-3]
+            return {
+                **t,
+                "lora_ids": jnp.broadcast_to(ids, (*lead, ids.shape[0])),
+            }
+        if isinstance(t, dict):
+            return {k: walk(v) for k, v in t.items()}
+        return t
+
+    return walk(stacked)
+
+
 def add_lora(
     params: dict,
     key: jax.Array,

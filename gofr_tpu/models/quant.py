@@ -167,6 +167,12 @@ def mm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
         from gofr_tpu.models.lora import lora_mm
 
         return lora_mm(x, w, mm)
+    if isinstance(w, dict) and "lora_stack_a" in w:
+        # pooled multi-LoRA leaf: per-batch-row adapter selection from a
+        # stacked bank (decode_chunk_pool_lora attaches the row ids)
+        from gofr_tpu.models.lora import plora_mm
+
+        return plora_mm(x, w, mm)
     if is_quantized(w):
         y = jax.lax.dot_general(
             x, w["q"], (((x.ndim - 1,), (0,)), ((), ())),

@@ -645,6 +645,36 @@ def decode_chunk_pool(
             tok, key, cache)
 
 
+def decode_chunk_pool_lora(
+    stacked: dict,
+    adapter_ids: jnp.ndarray,
+    token: jnp.ndarray,
+    cache: dict,
+    cfg: TransformerConfig,
+    n_steps: int,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    min_p: jnp.ndarray | float = 0.0,
+) -> tuple:
+    """``decode_chunk_pool`` with PER-SLOT LoRA adapter selection:
+    ``stacked`` is a ``build_lora_stack`` tree (the shared base plus a
+    stacked adapter bank on every targeted weight) and ``adapter_ids``
+    [B] i32 picks each slot's adapter (0 = base). Slots on the base
+    gather the zero adapter — delta is exactly zero — so one executable
+    serves any adapter/base slot mix, and adapter traffic shares the
+    continuous-batching pool instead of decoding solo. Same outputs as
+    ``decode_chunk_pool``."""
+    from gofr_tpu.models.lora import attach_lora_ids
+
+    params = attach_lora_ids(stacked, adapter_ids)
+    return decode_chunk_pool(
+        params, token, cache, cfg, n_steps, key, temperature, top_k,
+        top_p, min_p,
+    )
+
+
 def decode_chunk_pool_penalized(
     params: dict,
     token: jnp.ndarray,

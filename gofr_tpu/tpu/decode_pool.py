@@ -28,7 +28,11 @@ Mechanics:
 - per-request host-tracked lengths stop a request at the cache bound;
 - requests with an explicit sampling seed bypass the pool (the
   per-request path reproduces exactly; pooled key order depends on
-  co-tenants).
+  co-tenants);
+- LoRA adapter requests decode in the pool through a stacked adapter
+  bank: per-slot ids gather each row's adapter (0 = a zero identity
+  entry for base rows) inside the chunk executable, so two adapters and
+  the base share one dispatch (``enable_lora``/``submit(adapter=...)``).
 """
 
 from __future__ import annotations
@@ -147,6 +151,22 @@ class DecodePool:
         self._pen_ready = False
         self._pen_starting = False
         self._pen_slots: set[int] = set()
+        # pooled multi-LoRA: a stacked adapter bank + per-slot adapter ids
+        # let adapter requests share the pool chunk instead of decoding
+        # solo (enable_lora builds the executable; the worker dispatches
+        # it only while an adapter slot is active). Penalized and adapter
+        # slots are mutually exclusive IN one chunk (different
+        # executables) — submit rejects the later arrival, which solos.
+        self._lora_ready = False
+        self._lora_slots: set[int] = set()
+        self._lora_index: dict[str, int] = {}
+        self._lora_params: Any = None
+        self._decode_lora: Any = None
+        self._lora_pending: Optional[tuple] = None
+        self._lora_ids = np.zeros(n_slots, np.int32)
+        self._lora_dirty = True
+        self._lora_ids_dev = None
+        self.lora_chunks = 0  # dispatches through the adapter executable
         # under a serving mesh the pool cache takes the SAME placement as
         # the prefill cache (slot axis over dp/fsdp, kv heads over tp) so
         # the pooled decode compiles as one SPMD program — row caches
@@ -409,6 +429,70 @@ class DecodePool:
 
         threading.Thread(target=build, daemon=True).start()
 
+    # -- pooled multi-LoRA ----------------------------------------------------
+    def enable_lora(self, stacked: dict, index: "dict[str, int]") -> None:
+        """Build (or rebuild) the per-slot adapter executable from a
+        ``build_lora_stack`` tree and its name -> bank-index map. Compiles
+        OUTSIDE the pool lock on abstract shapes (same AOT policy as the
+        penalized build). If adapter slots are mid-generation, the swap is
+        deferred to the worker (their ids index the OLD bank; new adapter
+        submits solo meanwhile) — an admin adapter load must never block
+        behind a long generation."""
+        from gofr_tpu.models.transformer import decode_chunk_pool_lora
+
+        if self._cache_shardings is not None:
+            raise ValueError(
+                "pooled multi-LoRA does not support a serving mesh yet — "
+                "adapter requests decode solo under TPU_MESH"
+            )
+        cfg, chunk = self.cfg, self.chunk
+
+        def lora_fn(p, ids, t, c, key, temp, tk, tp, mp):
+            return decode_chunk_pool_lora(
+                p, ids, t, c, cfg, chunk, key, temp, tk, tp, mp
+            )
+
+        def abs_of(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        with self._work:
+            cache_meta = jax.tree.map(abs_of, self.cache)
+            tok_meta = abs_of(self._last_tokens)
+            key_meta = abs_of(self._key)
+        n = self.n_slots
+        f32v = jax.ShapeDtypeStruct((n,), jnp.float32)
+        i32v = jax.ShapeDtypeStruct((n,), jnp.int32)
+        exe = jax.jit(lora_fn, donate_argnums=(3, 4)).lower(
+            jax.tree.map(abs_of, stacked), i32v, tok_meta, cache_meta,
+            key_meta, f32v, i32v, f32v, f32v,
+        ).compile()
+        with self._work:
+            if self._lora_slots:
+                self._lora_ready = False  # stop new submits on the old bank
+                self._lora_pending = (exe, stacked, dict(index))
+            else:
+                self._install_lora(exe, stacked, dict(index))
+
+    def _install_lora(self, exe: Any, stacked: dict,
+                      index: "dict[str, int]") -> None:
+        """Swap in a compiled bank (pool lock held, no adapter slot active)."""
+        self._decode_lora = exe
+        self._lora_params = stacked
+        self._lora_index = index
+        self._lora_ids[:] = 0
+        self._lora_dirty = True
+        self._lora_pending = None
+        self._lora_ready = True
+
+    def disable_lora(self) -> None:
+        """Stop pooling adapter requests (they solo). In-flight adapter
+        slots finish on the bank they hold — the bank stays referenced
+        until the next ``enable_lora`` replaces it."""
+        with self._work:
+            self._lora_ready = False
+            self._lora_index = {}
+            self._lora_pending = None
+
     def _place(self, cache: dict) -> dict:
         if self._cache_shardings is None:
             return cache
@@ -427,6 +511,7 @@ class DecodePool:
         penalty: Optional[tuple] = None,
         want_logprobs: bool = False,
         want_top_logprobs: bool = False,
+        adapter: Optional[str] = None,
     ) -> "queue.Queue":
         """Claim a slot for a prefilled request; returns the queue its
         decoded token ids (then DONE) arrive on. Raises queue.Full when all
@@ -437,11 +522,32 @@ class DecodePool:
         presence_penalty, frequency_penalty) — rows already include the
         first emitted token, matching ``first_token``. Raises queue.Full
         while the penalized machinery is off/still building (the caller
-        solos; a lazy build starts in the background on first use)."""
+        solos; a lazy build starts in the background on first use).
+
+        ``adapter`` pools a LoRA request: the slot decodes with that
+        adapter's bank entry while co-tenants keep theirs (or the base).
+        The name resolves against the CURRENT bank under the lock — never
+        a stale pre-checked index. Raises queue.Full when the bank is
+        off/rebuilding, the name is unknown to the bank, or a penalized
+        slot is active (the chunk runs ONE executable; the mix solos)."""
         out: "queue.Queue" = queue.Queue()
+        adapter_idx = 0
         with self._work:
             if self._closed:
                 raise RuntimeError("decode pool closed")
+            if adapter is not None:
+                if penalty is not None:
+                    raise queue.Full("penalized adapter requests decode solo")
+                if not self._lora_ready:
+                    raise queue.Full("adapter bank off or rebuilding")
+                if self._pen_slots:
+                    raise queue.Full("penalized slots active (one executable per chunk)")
+                idx = self._lora_index.get(adapter)
+                if idx is None:
+                    raise queue.Full(f"adapter '{adapter}' not in the pool bank")
+                adapter_idx = idx
+            if penalty is not None and self._lora_slots:
+                raise queue.Full("adapter slots active (one executable per chunk)")
             if penalty is not None and not self._pen_ready:
                 if self._pen_mode == "lazy":
                     self._pen_kick()
@@ -467,6 +573,10 @@ class DecodePool:
                 self._top_ps[slot.index] = sampler.top_p
                 self._min_ps[slot.index] = sampler.min_p
                 self._sampling_dirty = True
+            if adapter_idx:
+                self._lora_ids[slot.index] = adapter_idx
+                self._lora_dirty = True
+                self._lora_slots.add(slot.index)
             if penalty is not None:
                 pres_row, cnt_row, bias_row, rep, pp, fp = penalty
                 self._pres, self._cnts, self._bias = self._write_rows(
@@ -513,6 +623,11 @@ class DecodePool:
         self._active.clear()
         self._free = list(reversed(self._slots))
         self._pen_slots.clear()
+        self._lora_slots.clear()
+        self._lora_ids[:] = 0
+        self._lora_dirty = True
+        if self._lora_pending:
+            self._install_lora(*self._lora_pending)
 
     def _loop(self) -> None:
         in_flight: deque = deque()  # (records, toks_dev, lps_dev, dispatch_start)
@@ -543,7 +658,20 @@ class DecodePool:
                     # slice happen inside the jitted chunk. The penalized
                     # executable runs only while a penalized slot is
                     # active — penalty-free traffic keeps the plain one
-                    if self._pen_slots:
+                    if self._lora_slots:
+                        if self._lora_dirty:
+                            self._lora_ids_dev = jnp.asarray(self._lora_ids)
+                            self._lora_dirty = False
+                        self.lora_chunks += 1
+                        (toks_dev, lps_dev, tvals_dev, tids_dev,
+                         self._last_tokens, self._key,
+                         self.cache) = self._decode_lora(
+                            self._lora_params, self._lora_ids_dev,
+                            self._last_tokens, self.cache, self._key,
+                            self._temps_dev, self._top_ks_dev,
+                            self._top_ps_dev, self._min_ps_dev,
+                        )
+                    elif self._pen_slots:
                         if self._pen_dirty:
                             self._reps_dev = jnp.asarray(self._reps)
                             self._pps_dev = jnp.asarray(self._pps)
@@ -704,6 +832,17 @@ class DecodePool:
                         self._top_ps[index] = 1.0
                         self._min_ps[index] = 0.0
                         self._sampling_dirty = True
+                    if index in self._lora_slots:
+                        # the freed slot must stop selecting the adapter:
+                        # a plain request reusing it under the adapter
+                        # executable gathers bank entry 0 (exact zero
+                        # delta = base numerics)
+                        self._lora_slots.discard(index)
+                        self._lora_ids[index] = 0
+                        self._lora_dirty = True
+                        if self._lora_pending and not self._lora_slots:
+                            # a bank rebuild waited for these slots
+                            self._install_lora(*self._lora_pending)
                     if index in self._pen_slots:
                         # identity knobs: a plain request reusing the slot
                         # under the penalized executable must sample

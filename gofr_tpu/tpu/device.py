@@ -26,7 +26,10 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
   requests bypass the continuous-batching pool)
 - ``LORA_ADAPTERS``: "name=path,..." named LoRA adapter artifacts
   (models/lora.py::export_adapter, orbax-saved) served over the shared
-  base; requests select one via generate(adapter=...) and decode solo
+  base; requests select one via generate(adapter=...). Adapter requests
+  prefill solo but DECODE IN THE SHARED POOL via a stacked adapter bank
+  (per-slot selection); they fall back to solo decode under a serving
+  mesh, for rank/target-mismatched adapter sets, or mid bank rebuild
 - ``PREFIX_CACHE``: keep the KV rows of the n most recent distinct
   prompts — an exact repeat (retries) skips prefill entirely on the
   generate path, and a prompt sharing a long-enough common prefix with a
@@ -326,6 +329,11 @@ class TPUDevice:
             )
         self._last_reinit = 0.0
         self._reinit_lock = threading.Lock()
+        # serializes adapter admin (load/unload + pool-bank rebuild):
+        # without it, two concurrent loads race their bank compiles and
+        # the LAST COMPILE TO FINISH — not the last call — would win,
+        # silently installing a stale bank
+        self._adapter_lock = threading.Lock()
         # prefill MFU steady-state window (see _run_batch): completions
         # arrive from the batcher's dispatch-pool threads
         self._last_batch_done = 0.0
@@ -482,6 +490,9 @@ class TPUDevice:
                 pipeline_depth=self._pool_depth,
                 penalties=self._pool_penalties,
             )
+            if getattr(self.runner, "adapters", None):
+                self._boot_progress("warming pooled multi-LoRA bank")
+                self._refresh_pool_lora()
         self.batcher = DynamicBatcher(
             self._run_batch,
             max_batch=self.max_batch,
@@ -911,6 +922,39 @@ class TPUDevice:
             raise
 
     # -- runtime multi-LoRA management (admin surface) -----------------------
+    def _refresh_pool_lora(self) -> None:
+        """(Re)build the decode pool's stacked adapter bank from the
+        runner's named adapters so adapter traffic shares the
+        continuous-batching pool. Mesh deployments and rank/target-
+        mismatched adapter sets fall back to solo adapter decode
+        (logged) — never an error: solo is always correct."""
+        pool = self.decode_pool
+        runner = self.runner
+        if pool is None or getattr(runner, "adapters", None) is None:
+            return
+        if not runner.adapters:
+            pool.disable_lora()
+            return
+        if getattr(runner, "_cache_shardings", None) is not None:
+            self.logger.warnf(
+                "pooled multi-LoRA unavailable under a serving mesh — "
+                "adapter requests decode solo"
+            )
+            return
+        from gofr_tpu.models.lora import build_lora_stack
+
+        try:
+            stack = build_lora_stack(runner.params, runner.adapters)
+        except ValueError as exc:
+            self.logger.warnf(
+                "pooled multi-LoRA disabled: %s — adapter requests decode "
+                "solo", exc,
+            )
+            pool.disable_lora()
+            return
+        index = {name: i + 1 for i, name in enumerate(runner.adapters)}
+        pool.enable_lora(stack, index)
+
     def list_adapters(self) -> list[str]:
         self.wait_ready(600.0)
         return sorted(getattr(self.runner, "adapters", None) or {})
@@ -962,10 +1006,17 @@ class TPUDevice:
         # probe failure) reconstructs the runner from _lora_adapters, and
         # a runtime-loaded adapter must survive that — and if a reinit
         # replaced the runner mid-load, the spec is what heals the set
-        self._lora_adapters[name] = path
-        self.runner.adapters[name] = wrapped
+        with self._adapter_lock:
+            self._lora_adapters[name] = path
+            self.runner.adapters[name] = wrapped
+            # rebuild the pool's adapter bank (one pool-shape compile; an
+            # admin load pays it here so request paths never do — and a
+            # swap never interrupts in-flight adapter slots, which keep
+            # their bank)
+            self._refresh_pool_lora()
+            loaded = sorted(self.runner.adapters)
         self.logger.info(f"adapter '{name}' loaded from {path}")
-        return sorted(self.runner.adapters)
+        return loaded
 
     def unload_adapter(self, name: str) -> list[str]:
         """Drop a named adapter. In-flight requests that already resolved
@@ -973,14 +1024,17 @@ class TPUDevice:
         from gofr_tpu.errors import InvalidParamError
 
         self.wait_ready(600.0)
-        adapters = getattr(self.runner, "adapters", None) or {}
-        if adapters.pop(name, None) is None:
-            raise InvalidParamError(
-                f"adapter '{name}' (loaded: {sorted(adapters)})"
-            )
-        self._lora_adapters.pop(name, None)  # keep the reinit spec in sync
+        with self._adapter_lock:
+            adapters = getattr(self.runner, "adapters", None) or {}
+            if adapters.pop(name, None) is None:
+                raise InvalidParamError(
+                    f"adapter '{name}' (loaded: {sorted(adapters)})"
+                )
+            self._lora_adapters.pop(name, None)  # keep the reinit spec in sync
+            self._refresh_pool_lora()  # shrink (or disable) the pool bank
+            remaining = sorted(adapters)
         self.logger.info(f"adapter '{name}' unloaded")
-        return sorted(adapters)
+        return remaining
 
     def close(self) -> None:
         self._closed = True  # an in-flight background boot self-tears-down
@@ -1296,7 +1350,8 @@ class _TransformerRunner:
         self.buckets = [b for b in bucket_source if b <= cfg.max_seq] or [cfg.max_seq]
         # multi-LoRA serving: named adapter sets over the SHARED base
         # arrays (n adapters cost n x adapter bytes, not n x model bytes);
-        # requests pick one per call and decode solo
+        # requests pick one per call — prefill runs solo with the wrapped
+        # tree, decode joins the pool via its stacked adapter bank
         self.adapters: dict[str, Any] = {}
         if lora_adapters:
             if mesh is not None and (
@@ -1535,7 +1590,8 @@ class _TransformerRunner:
                 )
             # adapter weights differ from the batch's: prefill solo (one
             # [1, bucket] row, bucket sized to the prompt) and skip the
-            # shared prefix cache/pool/spec
+            # shared prefix cache/spec; decode joins the pool below via
+            # its per-slot adapter bank
             state = self._chunked_prefill(
                 ids, prm, bucket=self._bucket_for(int(ids.size))
             )
@@ -1665,11 +1721,11 @@ class _TransformerRunner:
         # machinery is off or still building, and they solo below), and
         # so do logprobs requests — the chosen tokens' logprobs ride
         # every pool chunk, so best_of candidates and logprob evals share
-        # the batch instead of decoding solo
-        if (
-            decode_pool is not None and not sampler.seeded
-            and adapter is None
-        ):
+        # the batch instead of decoding solo. ADAPTER requests join via
+        # the pool's stacked bank (per-slot adapter selection); the pool
+        # rejects them — and they solo — while the bank is off,
+        # rebuilding, mesh-disabled, or a penalized slot is active.
+        if decode_pool is not None and not sampler.seeded:
             import queue as queue_mod
 
             from gofr_tpu.tpu.decode_pool import DONE, PoolFailure
@@ -1687,6 +1743,7 @@ class _TransformerRunner:
                     max_new_tokens - 1, sampler, stop,
                     stop_tokens=stop_tokens, penalty=penalty,
                     want_logprobs=logprobs, want_top_logprobs=top_logprobs,
+                    adapter=adapter,
                 )
             except (queue_mod.Full, RuntimeError) as exc:
                 from gofr_tpu.tpu.decode_pool import _POOL_DEBUG

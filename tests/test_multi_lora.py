@@ -87,6 +87,110 @@ def _greedy_reference(params, prompt, n):
     return out
 
 
+def test_adapters_and_base_share_pool_chunk(adapter_paths):
+    """Two adapters + the base decode CONCURRENTLY in one continuous-
+    batching pool via the stacked adapter bank, and every stream matches
+    its solo (pool-off) output token-for-token."""
+    import threading
+
+    _, paths = adapter_paths
+    spec = ",".join(f"{n}={p}" for n, (p, _) in paths.items())
+    prompt = [1, 2, 3]
+    with serving_device(
+        LORA_ADAPTERS=spec, DECODE_CHUNK="4", DECODE_POOL="off"
+    ) as dev:
+        want = {
+            name: dev.generate(prompt, max_new_tokens=12, adapter=name)
+            for name in (None, "calm", "wild")
+        }
+    with serving_device(
+        LORA_ADAPTERS=spec, DECODE_CHUNK="4", DECODE_SLOTS="4",
+        BATCH_MAX_SIZE="4",
+    ) as dev:
+        got: dict = {}
+        errs: list = []
+        barrier = threading.Barrier(3)
+
+        def run(name):
+            try:
+                barrier.wait(timeout=60)
+                got[name] = dev.generate(
+                    prompt, max_new_tokens=12, adapter=name
+                )
+            except Exception as exc:  # surfaced below — threads must not hide it
+                errs.append((name, exc))
+
+        threads = [
+            threading.Thread(target=run, args=(n,))
+            for n in (None, "calm", "wild")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errs, errs
+        assert got == want
+        # the adapter executable actually carried chunks (no solo fallback)
+        assert dev.decode_pool.lora_chunks > 0
+
+
+def test_runtime_loads_rebuild_pool_bank(adapter_paths):
+    """Runtime-loaded adapters join the pool bank; loads and unloads
+    rebuild it and pooled outputs stay stable across rebuilds."""
+    _, paths = adapter_paths
+    (n1, (p1, _)), (n2, (p2, _)) = list(paths.items())
+    with serving_device(DECODE_CHUNK="4", DECODE_SLOTS="4") as dev:
+        dev.load_adapter(n1, p1)
+        before = dev.decode_pool.lora_chunks
+        out1 = dev.generate([1, 2, 3], max_new_tokens=8, adapter=n1)
+        assert dev.decode_pool.lora_chunks > before  # pooled, not solo
+        dev.load_adapter(n2, p2)  # bank rebuild (2 adapters)
+        out2 = dev.generate([1, 2, 3], max_new_tokens=8, adapter=n2)
+        assert len(out1) == len(out2) == 8
+        dev.unload_adapter(n1)
+        # n2 still pooled after the shrink rebuild
+        before = dev.decode_pool.lora_chunks
+        again = dev.generate([1, 2, 3], max_new_tokens=8, adapter=n2)
+        assert again == out2
+        assert dev.decode_pool.lora_chunks > before
+
+
+def test_rank_mismatched_bank_disables_and_solos(adapter_paths, tmp_path):
+    """A rank-mismatched adapter set cannot form one stacked bank: the
+    pool bank disables (logged, never an error) and adapter requests
+    SOLO with correct outputs — the fallback path the pool's queue.Full
+    rejection feeds."""
+    base, paths = adapter_paths
+    name, (path, state) = next(iter(paths.items()))
+    # a second adapter at a DIFFERENT rank over the same base
+    wrapped = add_lora(base, jax.random.key(3), rank=2)
+    opt = optax.adam(5e-2)
+    st = init_lora_train_state(wrapped, opt)
+    stepf = make_lora_train_step(TINY, opt)
+    toks = jnp.asarray(
+        np.random.RandomState(3).randint(1, 200, (2, 16)), jnp.int32
+    )
+    for _ in range(2):
+        st, _ = stepf(st, toks)
+    odd_path = str(tmp_path / "odd")
+    save_params(odd_path, export_adapter(st))
+    with serving_device(
+        LORA_ADAPTERS=f"{name}={path},odd={odd_path}", DECODE_CHUNK="4",
+        DECODE_SLOTS="4",
+    ) as dev:
+        assert sorted(dev.list_adapters()) == sorted([name, "odd"])
+        # both adapters serve correctly — solo, since no bank exists
+        merged = merge_lora(combine_lora(state["adapters"], state["rest"]))
+        got = dev.generate([1, 2, 3], max_new_tokens=8, adapter=name)
+        assert got == _greedy_reference(merged, [1, 2, 3], 8)
+        assert len(dev.generate([1, 2], max_new_tokens=4, adapter="odd")) == 4
+        assert dev.decode_pool.lora_chunks == 0  # never pooled
+        # unloading the odd-rank adapter restores a uniform bank
+        dev.unload_adapter("odd")
+        dev.generate([1, 2, 3], max_new_tokens=8, adapter=name)
+        assert dev.decode_pool.lora_chunks > 0
+
+
 def test_unknown_adapter_rejected(adapter_paths):
     _, paths = adapter_paths
     name, (path, _) = next(iter(paths.items()))
