@@ -1,0 +1,58 @@
+"""OpenAI-compatible completions surface over the TPU datasource.
+
+Not a reference-parity component (GoFr has no LLM API) — a TPU-native
+addition so clients speaking the de-facto completions protocol (SDKs,
+load-testing harnesses, gateway routers) can hit this framework without a
+translation shim. ``register_openai_routes(app)`` adds:
+
+- ``POST /v1/completions`` — prompt in, text out; ``"stream": true``
+  switches to SSE chunks terminated by ``data: [DONE]``.
+- ``POST /v1/chat/completions`` — messages in, assistant message out
+  (requires a tokenizer; the prompt is rendered through CHAT_TEMPLATE,
+  default ``[{role}]: {content}\\n`` per message, and the assistant-turn
+  opener is everything the template puts BEFORE {content} — override
+  with CHAT_TEMPLATE_OPENER for formats that need more).
+- ``POST /v1/embeddings`` — encoder models (MODEL_NAME=bert-*); multi-
+  item inputs pack into one batcher dispatch.
+- ``GET /v1/models`` — the served base model plus loaded LoRA adapters.
+
+Scope: the completions shape (prompt string or token list, max_tokens,
+temperature/top_p/seed, penalties/logit_bias, n/best_of/echo fan-out,
+stop, logprobs, usage accounting). ``stop`` takes up to 4 sequences:
+single-token encodings stop on-device, and every sequence is ALSO
+matched host-side against the rolling decoded text (``_StopScanner``),
+so multi-token stops and cross-token-boundary occurrences truncate
+correctly; ``stop_token_ids`` takes raw ids. Knobs this server cannot
+honor are a clear 400, never a silent ignore.
+
+Module layout (each under 500 lines by policy): ``parse`` (request
+knobs, stops, fan-out constraints), ``template`` (chat prompt
+construction), ``logprobs`` (response logprob objects), ``fanout``
+(candidate generation + streaming consumer), ``completions`` / ``chat``
+/ ``embeddings`` (the endpoints).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from gofr_tpu.openai.chat import chat_completions
+from gofr_tpu.openai.completions import completions
+from gofr_tpu.openai.embeddings import embeddings, list_models
+from gofr_tpu.openai.template import render_chat_prompt
+
+__all__ = [
+    "register_openai_routes",
+    "completions",
+    "chat_completions",
+    "embeddings",
+    "list_models",
+    "render_chat_prompt",
+]
+
+
+def register_openai_routes(app: Any) -> None:
+    app.post("/v1/completions", completions)
+    app.post("/v1/chat/completions", chat_completions)
+    app.post("/v1/embeddings", embeddings)
+    app.get("/v1/models", list_models)

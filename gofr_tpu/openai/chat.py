@@ -1,0 +1,183 @@
+"""POST /v1/chat/completions: messages -> assistant message. Same
+generation core as completions; only prompt construction (chat
+template) and response shapes differ."""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any
+
+from gofr_tpu.openai.fanout import _fanout_generate
+from gofr_tpu.openai.logprobs import _chat_logprobs_obj, _chat_lp_entry
+from gofr_tpu.openai.parse import _StopScanner, _parse_fanout, _parse_request
+from gofr_tpu.openai.template import render_chat_prompt
+
+from gofr_tpu.errors import HTTPError
+
+def _stream_chat(
+    ctx: Any, prompt_ids: list, max_tokens: int, sampler: Any,
+    stop_ids: Any, stop_strs: list, want_logprobs: bool, top_n: int,
+    adapter: Any, n: int, chat_id: str, created: int, model: str,
+    tok: Any,
+) -> Any:
+    """The SSE branch of /v1/chat/completions: delta chunks with the
+    role first, host-side stop matching, terminated by [DONE]."""
+    if n > 1:
+        raise HTTPError(
+            400, 'streaming with "n" > 1 is not supported '
+            "(interleaved multi-index SSE)"
+        )
+    if top_n:
+        raise HTTPError(
+            400, "top-logprob alternatives are not supported when "
+            "streaming; drop \"stream\" or request chosen-token "
+            "logprobs only"
+        )
+    import json as _json
+
+    from gofr_tpu.http.response import Stream
+
+    stream_iter = ctx.tpu.generate_stream(
+        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+        adapter=adapter, logprobs=want_logprobs,
+    )
+
+    def chunk(delta: dict, finish: Any = None, lp: Any = None,
+              token_id: Any = None) -> str:
+        choice: dict[str, Any] = {
+            "index": 0, "delta": delta, "finish_reason": finish,
+        }
+        if want_logprobs:
+            if lp is not None and token_id is not None:
+                e = _chat_lp_entry(tok, token_id, lp)
+                e["top_logprobs"] = []  # alternatives reject with stream
+                choice["logprobs"] = {
+                    # the modern chat shape stock SDKs parse, plus
+                    # the legacy field this server has always sent
+                    "content": [e],
+                    "token_logprobs": [lp],
+                }
+            else:
+                choice["logprobs"] = None
+        return _json.dumps({
+            "id": chat_id, "object": "chat.completion.chunk",
+            "created": created, "model": model, "choices": [choice],
+        })
+
+    def events():
+        emitted = 0
+        finish = None
+        dec = tok.stream_decoder()
+        scan = _StopScanner(stop_strs) if stop_strs else None
+        yield chunk({"role": "assistant"})  # role arrives first
+        try:
+            for item in stream_iter:
+                token, lp = item if want_logprobs else (item, None)
+                emitted += 1
+                text = dec.feed(token)
+                if scan is not None:
+                    text, done = scan.feed(text)
+                    if done:
+                        if text:
+                            # no lp: the matched token's text is
+                            # excluded from the stream
+                            yield chunk({"content": text})
+                        finish = "stop"
+                        break
+                if text or lp is not None:
+                    yield chunk({"content": text}, lp=lp, token_id=token)
+            tail = dec.flush()
+            if finish is None:
+                if scan is not None:
+                    tail, done = scan.feed(tail)
+                    if done:
+                        finish = "stop"
+                    else:
+                        tail += scan.flush()
+                if finish is None:
+                    finish = "length" if emitted >= max_tokens else "stop"
+            else:
+                tail = ""
+            if tail:
+                yield chunk({"content": tail})
+            yield chunk({}, finish)
+            yield "[DONE]"
+        except Exception as exc:
+            yield _json.dumps({"error": {"message": str(exc)}})
+        finally:
+            stream_iter.close()  # no-op if already exhausted
+
+    return Stream(events())
+
+
+def chat_completions(ctx: Any) -> Any:
+    """Messages -> assistant message. Same generation core as
+    ``completions``; only the prompt construction (chat template) and the
+    response shapes (chat.completion / chat.completion.chunk with deltas)
+    differ."""
+    (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs, top_n,
+     adapter) = _parse_request(ctx, default_max=64)
+    tok = ctx.tpu.tokenizer
+    if tok is None:
+        raise HTTPError(
+            400, "chat completions need a tokenizer (set TOKENIZER_PATH)"
+        )
+    prompt_text = render_chat_prompt(ctx, body.get("messages"))
+    prompt_ids = tok.encode(prompt_text)
+    if not prompt_ids:
+        raise HTTPError(400, "messages encoded to zero tokens")
+    model = adapter or ctx.tpu.model_name  # adapters serve under their name
+    created = int(time.time())
+    chat_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+
+    n, _, _ = _parse_fanout(body, allow_best_of=False)
+    if top_n and stop_strs:
+        raise HTTPError(
+            400, "top-logprob alternatives with multi-token stop "
+            'sequences are not supported; use "stop_token_ids"'
+        )
+
+    if body.get("stream"):
+        return _stream_chat(
+            ctx, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
+            want_logprobs, top_n, adapter, n, chat_id, created, model,
+            tok,
+        )
+
+    results, generated = _fanout_generate(
+        ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
+        want_logprobs, top_n, adapter, n, n,
+    )
+    from gofr_tpu.http.response import Raw
+
+    choices = [
+        {
+            "index": i,
+            "message": {
+                "role": "assistant",
+                "content": text if text is not None else tok.decode(out),
+            },
+            "finish_reason": (
+                finish if finish is not None
+                else ("length" if len(out) >= max_tokens else "stop")
+            ),
+            "logprobs": (
+                _chat_logprobs_obj(tok, logprobs, out, tops, top_n)
+                if logprobs is not None else None
+            ),
+        }
+        for i, (out, logprobs, tops, text, finish) in enumerate(results)
+    ]
+    return Raw({
+        "id": chat_id,
+        "object": "chat.completion",
+        "created": created,
+        "model": model,
+        "choices": choices,
+        "usage": {
+            "prompt_tokens": len(prompt_ids),
+            "completion_tokens": generated,
+            "total_tokens": len(prompt_ids) + generated,
+        },
+    })

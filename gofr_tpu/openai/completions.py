@@ -1,0 +1,235 @@
+"""POST /v1/completions: prompt in, text out; SSE when streaming;
+echo+logprobs teacher-forcing scoring; n/best_of fan-out."""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any
+
+from gofr_tpu.openai.fanout import _fanout_generate
+from gofr_tpu.openai.logprobs import _logprobs_obj
+from gofr_tpu.openai.parse import _StopScanner, _parse_fanout, _parse_request, _prompt_tokens
+
+from gofr_tpu.errors import HTTPError
+
+def _stream_completion(
+    ctx: Any, prompt_ids: list, max_tokens: int, sampler: Any,
+    stop_ids: Any, stop_strs: list, want_logprobs: bool, top_n: int,
+    adapter: Any, n: int, best_of: int, echo: bool,
+    cmpl_id: str, created: int, model: str, tok: Any,
+) -> Any:
+    """The SSE branch of /v1/completions: per-token text chunks with
+    host-side stop matching, terminated by ``data: [DONE]``."""
+    if n > 1 or best_of > 1:
+        raise HTTPError(
+            400, 'streaming with "n" > 1 or "best_of" > 1 is not '
+            "supported (interleaved multi-index SSE)"
+        )
+    if max_tokens == 0:
+        raise HTTPError(
+            400, 'streaming needs "max_tokens" >= 1 (use the '
+            "non-stream form for pure echo scoring)"
+        )
+    if top_n:
+        raise HTTPError(
+            400, "top-logprob alternatives are not supported when "
+            "streaming; drop \"stream\" or request chosen-token "
+            "logprobs only"
+        )
+    import json as _json
+
+    from gofr_tpu.http.response import Stream
+
+    # constructed OUTSIDE events(): parameter errors (unknown adapter,
+    # bad sampler) must 400 before the SSE 200 commits
+    stream_iter = ctx.tpu.generate_stream(
+        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+        adapter=adapter, logprobs=want_logprobs,
+    )
+
+    def chunk(text: str, lp: Any = None, finish: Any = None,
+              token: Any = None) -> str:
+        choice: dict[str, Any] = {
+            "text": text, "index": 0, "finish_reason": finish,
+        }
+        if token is not None:
+            # no tokenizer: bare str(token) text would concatenate
+            # ambiguously ("12"+"3" == "1"+"23") — ids ride a tokens
+            # extension instead, matching the non-stream path
+            choice["tokens"] = [token]
+        if want_logprobs:
+            choice["logprobs"] = (
+                {"token_logprobs": [lp]} if lp is not None else None
+            )
+        return _json.dumps({
+            "id": cmpl_id, "object": "text_completion",
+            "created": created, "model": model, "choices": [choice],
+        })
+
+    def events():
+        emitted = 0
+        finish = None
+        dec = tok.stream_decoder() if tok is not None else None
+        # stop_strs imply a tokenizer (enforced at parse), so dec
+        # is always live when the scanner is
+        scan = _StopScanner(stop_strs) if stop_strs else None
+        try:
+            if echo:
+                # prompt replay first, matching the non-stream shape
+                if dec is not None:
+                    yield chunk(tok.decode(prompt_ids))
+                else:
+                    for t in prompt_ids:
+                        yield chunk("", token=t)
+            for item in stream_iter:
+                token, lp = item if want_logprobs else (item, None)
+                emitted += 1
+                if dec is None:
+                    yield chunk("", lp, token=token)
+                    continue
+                text = dec.feed(token)
+                if scan is not None:
+                    text, done = scan.feed(text)
+                    if done:
+                        # matched mid-stream: emit up to the stop and
+                        # cancel the decode (frees the pool slot). No
+                        # lp: the matched token's text is excluded, so
+                        # its logprob must not ride this chunk either
+                        yield chunk(text, None)
+                        finish = "stop"
+                        break
+                yield chunk(text, lp)
+            tail = dec.flush() if dec is not None else ""
+            if finish is None:
+                if scan is not None:
+                    tail, done = scan.feed(tail)
+                    if done:
+                        finish = "stop"
+                    else:
+                        tail += scan.flush()
+                if finish is None:
+                    finish = "length" if emitted >= max_tokens else "stop"
+            else:
+                tail = ""
+            yield chunk(tail, None, finish)
+            yield "[DONE]"
+        except Exception as exc:
+            yield _json.dumps({"error": {"message": str(exc)}})
+        finally:
+            stream_iter.close()  # no-op if already exhausted
+
+    return Stream(events())
+
+
+def completions(ctx: Any) -> Any:
+    (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs, top_n,
+     adapter) = _parse_request(ctx, default_max=16)
+    n, best_of, echo = _parse_fanout(body, allow_best_of=True)
+    if echo and want_logprobs and body.get("stream"):
+        raise HTTPError(
+            400, '"echo" with "logprobs" is not supported when streaming'
+        )
+    if top_n and stop_strs:
+        raise HTTPError(
+            400, "top-logprob alternatives with multi-token stop "
+            'sequences are not supported; use "stop_token_ids"'
+        )
+    if "prompt" not in body:
+        # a missing prompt is almost always a caller bug (misspelled key):
+        # generating from a magic default would 200 on garbage
+        raise HTTPError(400, 'missing "prompt"')
+    prompt_ids = _prompt_tokens(ctx, body["prompt"])
+    model = adapter or ctx.tpu.model_name  # adapters serve under their name
+    created = int(time.time())
+    cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
+    tok = ctx.tpu.tokenizer
+
+    if body.get("stream"):
+        return _stream_completion(
+            ctx, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
+            want_logprobs, top_n, adapter, n, best_of, echo,
+            cmpl_id, created, model, tok,
+        )
+
+    prompt_lps = None
+    if echo and want_logprobs:
+        # teacher-forcing prompt scoring: log p(t_i | t_<i), with null
+        # for the first token (no conditional) — the OpenAI convention
+        # and the eval-harness loglikelihood pattern. The request's
+        # adapter scores too (and an unknown one 400s even on the
+        # max_tokens=0 path, where no generation would catch it)
+        prompt_lps = [None] + ctx.tpu.score(prompt_ids, adapter=adapter)
+    elif max_tokens == 0 and adapter is not None:
+        # pure echo without logprobs still must validate the adapter name.
+        # list_adapters (not a direct runner read): it waits for readiness,
+        # so a request landing mid background-boot blocks like every other
+        # path instead of 500ing on a not-yet-built runner
+        loaded = ctx.tpu.list_adapters()
+        if adapter not in loaded:
+            from gofr_tpu.errors import InvalidParamError
+
+            raise InvalidParamError(
+                f"adapter '{adapter}' (loaded: {loaded})"
+            )
+    if max_tokens == 0:
+        # pure scoring (echo-only, enforced at parse): no decode at all
+        results = [
+            ([], [] if want_logprobs else None, [] if top_n else None,
+             None, "length")
+        ] * n
+        generated = 0
+    else:
+        results, generated = _fanout_generate(
+            ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
+            want_logprobs, top_n, adapter, n, best_of,
+        )
+    choices = []
+    for i, (out, logprobs, tops, text, finish) in enumerate(results):
+        if text is None:
+            text_ids = (prompt_ids + out) if echo else out
+            text_val = tok.decode(text_ids) if tok is not None else ""
+            finish = "length" if len(out) >= max_tokens else "stop"
+        else:
+            # host-matched stop truncation: the scanner's text IS the
+            # completion (a tokenizer is guaranteed on this path, so the
+            # tokens extension below never applies); echo prepends the
+            # decoded prompt
+            text_val = (tok.decode(prompt_ids) + text) if echo else text
+        lp_list = logprobs
+        lp_ids = out
+        if prompt_lps is not None:
+            lp_list = prompt_lps + (logprobs or [])
+            lp_ids = prompt_ids + out
+        lp_obj = None
+        if lp_list is not None:
+            lp_obj = _logprobs_obj(
+                tok, lp_list, lp_ids, tops, top_n,
+                prompt_positions=len(prompt_ids) if prompt_lps is not None
+                else 0,
+            )
+        choice: dict[str, Any] = {
+            "text": text_val,
+            "index": i,
+            "finish_reason": finish,
+            "logprobs": lp_obj,
+        }
+        if tok is None:
+            choice["tokens"] = (prompt_ids + out) if echo else out
+        choices.append(choice)
+    from gofr_tpu.http.response import Raw
+
+    # OpenAI clients expect the completion object at the top level, not
+    # inside this framework's {"data": ...} envelope
+    return Raw({
+        "id": cmpl_id,
+        "object": "text_completion",
+        "created": created,
+        "model": model,
+        "choices": choices,
+        "usage": {
+            "prompt_tokens": len(prompt_ids),
+            "completion_tokens": generated,
+            "total_tokens": len(prompt_ids) + generated,
+        },
+    })

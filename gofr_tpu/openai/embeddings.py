@@ -1,0 +1,135 @@
+"""POST /v1/embeddings (encoder models through the dynamic batcher)
+and GET /v1/models (base model + loaded LoRA adapters)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from gofr_tpu.errors import HTTPError
+
+async def embeddings(ctx: Any) -> Any:
+    """OpenAI embeddings shape over an encoder model (MODEL_NAME=bert-*).
+    ``input`` is a string, list of strings, token-id list, or list of
+    id lists; items run through the dynamic batcher CONCURRENTLY, so a
+    multi-item request packs into one device dispatch."""
+    import asyncio
+
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    if not ctx.tpu.model_name.startswith("bert"):
+        # checked BEFORE any inference: a decoder deployment must 400 for
+        # free, not run (and cache) a full prefill per item first
+        raise HTTPError(
+            400,
+            "embeddings need an encoder model (MODEL_NAME=bert-tiny or "
+            f"bert-base); '{ctx.tpu.model_name}' is a decoder",
+        )
+    body = ctx.bind() if ctx.request.body else {}
+    if not isinstance(body, dict):
+        raise HTTPError(400, "request body must be a JSON object")
+    raw = body.get("input")
+    if isinstance(raw, str) or (
+        isinstance(raw, list) and raw and all(isinstance(t, int) for t in raw)
+    ):
+        items = [raw]
+    elif isinstance(raw, list) and raw:
+        items = raw
+    else:
+        raise HTTPError(
+            400,
+            '"input" must be a string, list of strings, or token-id list(s)',
+        )
+    tok = ctx.tpu.tokenizer
+    # the encoder pads/slices to one fixed bucket: over-long input must
+    # 400 (OpenAI behavior), never silently embed a truncated prefix
+    # while usage reports the full count. wait_ready: the bucket lives on
+    # the runner, which a background boot builds late.
+    ctx.tpu.wait_ready(60.0)
+    bucket = getattr(ctx.tpu.runner, "bucket", None)
+
+    def tokenize_items() -> tuple[int, list]:
+        """CPU-bound BPE over possibly many strings — runs in the
+        executor below, never on the event loop (the async handler
+        contract: the loop is for enqueueing, not computing)."""
+        n = 0
+        payloads = []
+        for item in items:
+            if isinstance(item, str):
+                if tok is None:
+                    raise HTTPError(
+                        400,
+                        "string input needs a tokenizer (set TOKENIZER_PATH)",
+                    )
+                ids = tok.encode(item)
+            elif isinstance(item, list) and item and all(
+                isinstance(t, int) for t in item
+            ):
+                ids = item
+            else:
+                raise HTTPError(400, f"invalid input item: {item!r:.80}")
+            if not ids:
+                raise HTTPError(400, "input item encoded to zero tokens")
+            if bucket is not None and len(ids) > bucket:
+                raise HTTPError(
+                    400,
+                    f"input item is {len(ids)} tokens; this encoder "
+                    f"accepts at most {bucket}",
+                )
+            n += len(ids)
+            payloads.append({"tokens": ids})
+        return n, payloads
+
+    loop = asyncio.get_running_loop()
+    n_tokens, payloads = await loop.run_in_executor(None, tokenize_items)
+    results = await asyncio.gather(
+        *(ctx.tpu.infer_async(p) for p in payloads)
+    )
+
+    def to_rows() -> list:
+        import numpy as np
+
+        return [
+            {
+                "object": "embedding",
+                "index": i,
+                "embedding": np.asarray(out).reshape(-1).tolist(),
+            }
+            for i, out in enumerate(results)
+        ]
+
+    data = await loop.run_in_executor(None, to_rows)
+    from gofr_tpu.http.response import Raw
+
+    return Raw({
+        "object": "list",
+        "model": ctx.tpu.model_name,
+        "data": data,
+        "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+    })
+
+
+def list_models(ctx: Any) -> Any:
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    from gofr_tpu.http.response import Raw
+
+    # the base model plus every loaded LoRA adapter: gateways route by
+    # model name, and a request's "model" naming an adapter selects it
+    # (the multi-LoRA serving convention) — stock OpenAI clients cannot
+    # send the custom "adapter" key, but they can set model
+    entries = [{
+        "id": ctx.tpu.model_name,
+        "object": "model",
+        "owned_by": "gofr_tpu",
+    }]
+    # non-blocking snapshot: discovery must answer instantly during a
+    # background boot (list_adapters would wait for readiness)
+    adapters = getattr(getattr(ctx.tpu, "runner", None), "adapters", None) or {}
+    for name in sorted(adapters):
+        entries.append({
+            "id": name,
+            "object": "model",
+            "owned_by": "gofr_tpu",
+            "root": ctx.tpu.model_name,  # the base it adapts
+        })
+    return Raw({"object": "list", "data": entries})

@@ -1,0 +1,169 @@
+"""Generation fan-out: the streaming consumer with host-side stop
+matching, and n/best_of candidate generation with mean-logprob ranking."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from gofr_tpu.openai.parse import _StopScanner, _sampler
+
+from gofr_tpu.errors import HTTPError
+
+def _consume_stream(
+    ctx: Any, prompt_ids: list, max_tokens: int, sampler: Any,
+    stop_ids: Any, stop_strs: list, need_lp: bool, adapter: Any,
+) -> tuple[list, Any, str, str]:
+    """Generate through the streaming bridge, matching multi-token stop
+    strings host-side as text streams off the device and CANCELLING the
+    background decode at the first match (closing the iterator frees the
+    pool slot — a matched stop must not keep generating to max_tokens).
+    Returns (tokens, logprobs_or_None, text, finish_reason); ``text`` is
+    truncated before the stop string, tokens/logprobs cover everything
+    actually generated (usage accounting)."""
+    tok = ctx.tpu.tokenizer  # _parse_stops guarantees one for stop_strs
+    dec = tok.stream_decoder()
+    scan = _StopScanner(stop_strs)
+    it = ctx.tpu.generate_stream(
+        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+        adapter=adapter, logprobs=need_lp,
+    )
+    toks: list = []
+    lps: list = []
+    parts: list = []
+    starts: list = []  # decoded-text offset where each token's text began
+    decoded = 0
+    finish = None
+    try:
+        for item in it:
+            t, lp = item if need_lp else (item, None)
+            toks.append(t)
+            if lp is not None:
+                lps.append(lp)
+            piece = dec.feed(t)
+            starts.append(decoded)
+            decoded += len(piece)
+            emit, done = scan.feed(piece)
+            parts.append(emit)
+            if done:
+                finish = "stop"
+                break
+        if finish is None:
+            emit, done = scan.feed(dec.flush())
+            parts.append(emit)
+            if done:
+                finish = "stop"
+            else:
+                parts.append(scan.flush())
+                finish = "length" if len(toks) >= max_tokens else "stop"
+    finally:
+        it.close()
+    if need_lp and scan.match_pos is not None:
+        # align response logprobs with the TRUNCATED text: keep tokens
+        # whose text starts before the match (usage still bills the full
+        # toks list — the tokens were generated)
+        vis = sum(1 for s in starts if s < scan.match_pos)
+        lps = lps[:vis]
+    return toks, (lps if need_lp else None), "".join(parts), finish
+
+
+def _fanout_generate(
+    ctx: Any, body: dict, prompt_ids: list, max_tokens: int,
+    sampler: Any, stop_ids: Any, stop_strs: list, want_logprobs: bool,
+    top_n: int, adapter: Any, n: int, best_of: int,
+) -> tuple[list, int]:
+    """Generate ``best_of`` candidates and keep the ``n`` best. Returns
+    ([(tokens, logprobs_or_None, tops_or_None, text_or_None,
+    finish_or_None), ...] of length n, total tokens generated across ALL
+    candidates — usage must count discarded best_of candidates too, the
+    OpenAI accounting).
+    ``text``/``finish`` are set only on the multi-token-stop path (the
+    host-matched truncation IS the text); otherwise the caller decodes
+    the ids itself. ``top_n`` > 0 also collects the top-k alternatives
+    per position (tops; None otherwise) — rejected with stop_strs at
+    the call sites, so the two never combine here.
+
+    - Deterministic requests (temperature 0) produce identical candidates:
+      ONE generation is replicated, not recomputed (and billed once per
+      replica, matching what the response carries).
+    - Sampled candidates run CONCURRENTLY: the continuous-batching pool
+      decodes unseeded requests in one lockstep dispatch, so n streams
+      cost ~one stream's wall time. A seeded request derives per-candidate
+      seeds (seed + index) so the whole fan-out stays reproducible.
+    - best_of > n ranks by mean token logprob (generated with logprobs
+      internally; stripped from the response unless requested)."""
+    score = best_of > n
+    need_lp = want_logprobs or score
+
+    def one(s):
+        if stop_strs:
+            toks, lps, text, finish = _consume_stream(
+                ctx, prompt_ids, max_tokens, s, stop_ids, stop_strs,
+                need_lp, adapter,
+            )
+            return toks, lps, None, text, finish
+        if top_n:
+            toks, lps, tops = ctx.tpu.generate(
+                prompt_ids, max_tokens, sampler=s, stop_tokens=stop_ids,
+                adapter=adapter, logprobs=True, top_logprobs=True,
+            )
+            return toks, lps, tops, None, None
+        out = ctx.tpu.generate(
+            prompt_ids, max_tokens, sampler=s, stop_tokens=stop_ids,
+            adapter=adapter, logprobs=need_lp,
+        )
+        toks, lps = out if need_lp else (out, None)
+        return toks, lps, None, None, None
+
+    if sampler.greedy:
+        toks, lps, tops, text, finish = one(sampler)
+        if not want_logprobs:
+            lps = None
+        return [(toks, lps, tops, text, finish)] * n, len(toks) * n
+
+    seed = body.get("seed")
+    if seed is not None:
+        try:
+            seed = int(seed)
+        except (TypeError, ValueError):
+            raise HTTPError(400, '"seed" must be an integer') from None
+    samplers = [
+        _sampler({**body, "seed": seed + i} if seed is not None else body)
+        for i in range(best_of)
+    ]
+    if best_of == 1:
+        results = [one(samplers[0])]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        # concurrency scales with the DEPLOYMENT, not the request: a
+        # fixed best_of-wide fan-out would let one n=16 request occupy
+        # every decode-pool slot (or spawn 16 solo seeded decodes) and
+        # starve concurrent traffic. Default: ~3/4 of the pool slots;
+        # candidates beyond it serialize through pool.map. A seeded
+        # fan-out decodes solo, so the same bound caps its thread count.
+        raw = ctx.config.get_or_default("OPENAI_FANOUT_WORKERS", "")
+        if raw:
+            try:
+                workers = max(1, min(best_of, int(raw)))
+            except ValueError:
+                raise HTTPError(
+                    500, "OPENAI_FANOUT_WORKERS must be an integer"
+                ) from None
+        else:
+            slots = getattr(
+                getattr(ctx.tpu, "decode_pool", None), "n_slots", None
+            ) or 4
+            workers = max(1, min(best_of, (slots * 3) // 4 or 1))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            results = list(pool.map(one, samplers))
+    generated = sum(len(r[0]) for r in results)
+    if score:
+        def mean_lp(item):
+            lps = item[1]
+            return sum(lps) / len(lps) if lps else float("-inf")
+
+        results = sorted(results, key=mean_lp, reverse=True)[:n]
+    if not want_logprobs:
+        results = [(toks, None, tops, text, finish)
+                   for toks, _, tops, text, finish in results]
+    return results, generated

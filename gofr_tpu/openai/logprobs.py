@@ -1,0 +1,101 @@
+"""Response logprobs objects: the legacy completions shape
+(token_logprobs/tokens/top_logprobs/text_offset) and the modern chat
+``content`` entries with true token bytes."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def _logprobs_obj(
+    tok: Any, lp_list: list, lp_ids: list, tops: Any, top_n: int,
+    prompt_positions: int = 0,
+) -> dict:
+    """The choice-level logprobs object: token_logprobs always; a
+    ``tokens`` list (single-token decodes, or stringified ids without a
+    tokenizer) aligned with it; ``text_offset`` — each token's character
+    start within the choice text, the field eval harnesses use to locate
+    the prompt/continuation boundary under echo; and, when ``top_n`` > 0,
+    per-position ``top_logprobs`` maps of the N best alternatives (null
+    for echoed prompt positions — the prompt is scored chosen-only)."""
+
+    def key(t: int) -> str:
+        return tok.decode([t]) if tok is not None else str(t)
+
+    def alt_map(alts: list) -> dict:
+        # distinct ids can decode to the same string; alts is best-first,
+        # so keep the FIRST (best) value instead of letting a worse
+        # duplicate overwrite it
+        m: dict[str, float] = {}
+        for i, v in alts[:top_n]:
+            m.setdefault(key(i), v)
+        return m
+
+    # slice, never assume: a host-matched stop truncates lp_list to
+    # the visible prefix while the ids keep the full generation for
+    # usage accounting — tokens must stay ALIGNED with token_logprobs
+    visible = lp_ids[: len(lp_list)]
+    tokens = [key(t) for t in visible]
+    # offsets come from the STREAM decoder, not the per-token decode
+    # lengths: a byte-level BPE token can hold a fragment of a multi-byte
+    # character, and only incremental decoding tiles the choice text the
+    # response actually carries (per-token decode yields U+FFFD per
+    # fragment and would shift every later offset)
+    offsets: list[int] = []
+    pos = 0
+    if tok is not None:
+        dec = tok.stream_decoder()
+        for t in visible:
+            offsets.append(pos)
+            pos += len(dec.feed(t))
+    else:
+        for t in tokens:
+            offsets.append(pos)
+            pos += len(t)
+    obj: dict[str, Any] = {
+        "token_logprobs": lp_list,
+        "tokens": tokens,
+        "text_offset": offsets,
+    }
+    if top_n and tops is not None:
+        obj["top_logprobs"] = (
+            [None] * prompt_positions
+            + [alt_map(alts) for alts in tops]
+        )
+    return obj
+
+
+def _chat_lp_entry(tok: Any, token_id: int, lp: float) -> dict:
+    """One {token, logprob, bytes} content entry. ``bytes`` carries the
+    token's TRUE bytes (a byte-level BPE token can hold a fragment of a
+    multi-byte character — the field exists so clients can reassemble
+    text across such splits; round-tripping through the replaced string
+    would corrupt them)."""
+    raw = tok.decode_bytes([token_id])
+    return {
+        "token": raw.decode("utf-8", errors="replace"),
+        "logprob": lp,
+        "bytes": list(raw),
+    }
+
+
+def _chat_logprobs_obj(
+    tok: Any, lp_list: list, out_ids: list, tops: Any, top_n: int,
+) -> dict:
+    """Chat logprobs in the CURRENT OpenAI chat shape — a ``content``
+    list of {token, logprob, bytes, top_logprobs} entries that stock
+    SDKs parse (top_logprobs is ALWAYS present, [] when no alternatives
+    were requested — typed clients treat it as required) — alongside
+    this server's legacy completions-style fields
+    (token_logprobs/tokens/top_logprobs) for back-compat."""
+    obj = _logprobs_obj(tok, lp_list, out_ids, tops, top_n)
+    content = []
+    for j, (t, lp) in enumerate(zip(out_ids[: len(lp_list)], lp_list)):
+        e = _chat_lp_entry(tok, t, lp)
+        e["top_logprobs"] = (
+            [_chat_lp_entry(tok, i, v) for i, v in tops[j][:top_n]]
+            if top_n and tops is not None else []
+        )
+        content.append(e)
+    obj["content"] = content
+    return obj

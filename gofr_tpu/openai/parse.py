@@ -1,0 +1,255 @@
+"""Request parsing shared by every OpenAI endpoint: prompts, stop
+sequences (device ids + host-matched strings), sampling knobs, the
+shared knob parse, and n/best_of/echo fan-out constraints."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from gofr_tpu.errors import HTTPError
+
+def _prompt_tokens(ctx: Any, prompt: Any) -> list[int]:
+    if isinstance(prompt, str):
+        tok = ctx.tpu.tokenizer
+        if tok is None:
+            raise HTTPError(
+                400,
+                "string prompt needs a tokenizer (set TOKENIZER_PATH); "
+                "token-id lists work without one",
+            )
+        ids = tok.encode(prompt)
+        if not ids:
+            raise HTTPError(400, "prompt encoded to zero tokens")
+        return ids
+    if (
+        isinstance(prompt, list) and prompt
+        and all(isinstance(t, int) for t in prompt)
+    ):
+        return prompt
+    raise HTTPError(
+        400, '"prompt" must be a non-empty string or list of token ids'
+    )
+
+
+def _parse_stops(ctx: Any, body: dict) -> tuple[frozenset, list]:
+    """(on-device stop token ids, host-matched stop strings). A stop
+    string that encodes to ONE token stops on-device (cheapest — the
+    decode chunk never emits it); multi-token strings are matched
+    host-side against the decoded text as it streams off the device."""
+    ids = set()
+    raw_ids = body.get("stop_token_ids")
+    if raw_ids is not None:
+        if not isinstance(raw_ids, list) or not all(
+            isinstance(t, int) for t in raw_ids
+        ):
+            raise HTTPError(400, '"stop_token_ids" must be a list of ints')
+        ids.update(raw_ids)
+    stop = body.get("stop")
+    if stop is None:
+        return frozenset(ids), []
+    if isinstance(stop, str):
+        stop = [stop]
+    if not isinstance(stop, list) or not all(
+        isinstance(s, str) and s for s in stop
+    ):
+        raise HTTPError(400, '"stop" must be a non-empty string or list of them')
+    if len(stop) > 4:
+        raise HTTPError(400, '"stop" accepts at most 4 sequences (OpenAI limit)')
+    tok = ctx.tpu.tokenizer
+    if tok is None:
+        raise HTTPError(400, '"stop" strings need a tokenizer; use "stop_token_ids"')
+    strings = []
+    for s in stop:
+        encoded = tok.encode(s)
+        if len(encoded) == 1:
+            # on-device stop for the exact-token emission (cheapest), but
+            # ALSO host-matched: the same text can arrive via a different
+            # tokenization (" the" as " t"+"he", or inside a larger
+            # token), which only the text scan catches
+            ids.add(encoded[0])
+        strings.append(s)
+    return frozenset(ids), strings
+
+
+class _StopScanner:
+    """Incremental multi-token stop matching with SSE hold-back:
+    ``feed`` returns (emit, done) where ``emit`` never contains a stop
+    string NOR a tail that could still grow into one — a stream must not
+    leak half a stop sequence it would have had to un-send."""
+
+    def __init__(self, stops: list):
+        self.stops = stops
+        self.buf = ""
+        self.consumed = 0  # total chars fed
+        self.match_pos = None  # absolute offset of the matched stop
+
+    def feed(self, text: str) -> tuple[str, bool]:
+        self.buf += text
+        self.consumed += len(text)
+        hits = [p for p in (self.buf.find(s) for s in self.stops) if p >= 0]
+        if hits:
+            idx = min(hits)
+            self.match_pos = self.consumed - len(self.buf) + idx
+            return self.buf[:idx], True
+        hold = 0
+        for s in self.stops:
+            for k in range(min(len(s) - 1, len(self.buf)), 0, -1):
+                if self.buf.endswith(s[:k]):
+                    hold = max(hold, k)
+                    break
+        cut = len(self.buf) - hold
+        emit, self.buf = self.buf[:cut], self.buf[cut:]
+        return emit, False
+
+    def flush(self) -> str:
+        """End of stream: held-back text can no longer become a stop."""
+        emit, self.buf = self.buf, ""
+        return emit
+
+
+def _sampler(body: dict) -> Any:
+    from gofr_tpu.ops.sampling import Sampler
+
+    try:
+        # pass the WHOLE body through the shared parse so every natively
+        # supported knob (top_k, min_p, repetition_penalty, seed) works
+        # here too — only the defaults differ: OpenAI semantics default
+        # to temperature 1.0 (the native /generate defaults to greedy).
+        # Explicit nulls are stripped BEFORE the merge so "temperature":
+        # null falls back to the OpenAI default here, not from_body's
+        # greedy default (the OpenAI fields are nullable).
+        return Sampler.from_body({
+            "temperature": 1.0, "top_p": 1.0,
+            **{k: v for k, v in body.items() if v is not None},
+        })
+    except (TypeError, ValueError) as exc:
+        raise HTTPError(400, f"invalid sampling params: {exc}")
+
+
+def _parse_request(ctx: Any, default_max: int) -> tuple:
+    """Shared request parse for both endpoints: (body, max_tokens,
+    sampler, stop_ids, stop_strs, want_logprobs, top_n, adapter). One
+    home, so a knob added
+    to completions cannot silently miss chat (they drifted once)."""
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    body = ctx.bind() if ctx.request.body else {}
+    if not isinstance(body, dict):
+        raise HTTPError(400, "request body must be a JSON object")
+    # protocol knobs this server does not implement must be a clear 400
+    # when they would change output — never a silent ignore.
+    # presence/frequency penalties and logit_bias run on-device via the
+    # penalized decode chunk; n/best_of/echo are handled by the
+    # completions fan-out (_parse_fanout).
+    if body.get("suffix") is not None:
+        raise HTTPError(400, '"suffix" is not supported by this server')
+    # nullable like the sampling knobs: explicit JSON null = the default.
+    # max_tokens=0 is legal ONLY with echo (pure prompt scoring, the
+    # eval-harness loglikelihood pattern) — without echo it would return
+    # nothing at all
+    max_tokens = body.get("max_tokens")
+    if max_tokens is None:
+        max_tokens = default_max
+    floor = 0 if body.get("echo") is True else 1
+    if not isinstance(max_tokens, int) or max_tokens < floor:
+        raise HTTPError(
+            400,
+            '"max_tokens" must be a positive integer'
+            + (" (0 allowed with echo)" if floor == 0 else ""),
+        )
+    sampler = _sampler(body)
+    stop_ids, stop_strs = _parse_stops(ctx, body)
+    lp_req = body.get("logprobs")
+    want_logprobs = lp_req not in (None, False, 0)
+    # alternatives: an integer logprobs >= 2 (the completions form) or
+    # the explicit chat-style "top_logprobs" key, which wins when both
+    # are present. logprobs 1/true stays chosen-token-only — the long-
+    # standing behavior of this endpoint, documented in the API guide
+    # (pass top_logprobs for one alternative per position)
+    top_n = 0
+    if isinstance(lp_req, int) and not isinstance(lp_req, bool) and lp_req >= 2:
+        top_n = lp_req
+    tl = body.get("top_logprobs")
+    if tl is not None:
+        if not isinstance(tl, int) or isinstance(tl, bool) or tl < 0:
+            raise HTTPError(400, '"top_logprobs" must be an integer >= 0')
+        top_n = tl
+        if tl > 0:
+            want_logprobs = True
+    from gofr_tpu.models.transformer import TOP_LOGPROBS
+
+    if top_n > TOP_LOGPROBS:
+        raise HTTPError(
+            400, f'the maximum value for "logprobs"/"top_logprobs" is '
+            f"{TOP_LOGPROBS}"
+        )
+    adapter = body.get("adapter")  # multi-LoRA extension
+    if adapter is not None and not isinstance(adapter, str):
+        raise HTTPError(400, '"adapter" must be a string')
+    if adapter is None:
+        # OpenAI-conventional selection: "model" naming a loaded adapter
+        # routes to it (stock clients have no way to send "adapter");
+        # the explicit extension key wins when both are present. An
+        # UNKNOWN model name is a 404 exactly like the real API — a
+        # gateway routing to an unloaded adapter must never silently get
+        # base-model output (list_adapters waits for boot, so the
+        # routing decision always sees the post-boot adapter set)
+        requested = body.get("model")
+        if isinstance(requested, str) and requested != ctx.tpu.model_name:
+            loaded = ctx.tpu.list_adapters()
+            if requested in loaded:
+                adapter = requested
+            elif ctx.config.get_or_default(
+                "OPENAI_ACCEPT_UNKNOWN_MODEL", ""
+            ) in ("1", "true", "on"):
+                # pre-r04 behavior for clients with a hardcoded model
+                # string: serve the base model whatever "model" says
+                # (documented breaking-change escape hatch)
+                pass
+            else:
+                raise HTTPError(
+                    404,
+                    f"model '{requested}' not found (serving: "
+                    f"{[ctx.tpu.model_name, *loaded]})",
+                )
+    return (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs,
+            top_n, adapter)
+
+
+_FANOUT_CAP = 16  # pool-slot-scale bound on n/best_of; beyond it is a 400
+
+
+def _parse_fanout(body: dict, allow_best_of: bool) -> tuple[int, int, bool]:
+    """(n, best_of, echo) with OpenAI constraints: best_of >= n, both
+    capped, echo completions-only. Streaming fan-out is rejected at the
+    call site (interleaved multi-index SSE is not implemented)."""
+
+    def positive(key: str, default: int) -> int:
+        value = body.get(key)
+        if value is None:
+            return default
+        if not isinstance(value, int) or isinstance(value, bool) or value < 1:
+            raise HTTPError(400, f'"{key}" must be a positive integer')
+        if value > _FANOUT_CAP:
+            raise HTTPError(
+                400, f'"{key}" is capped at {_FANOUT_CAP} on this server'
+            )
+        return value
+
+    n = positive("n", 1)
+    best_of = positive("best_of", 1)  # type/range-checked on BOTH endpoints
+    if not allow_best_of and best_of != 1:
+        raise HTTPError(400, '"best_of" is a completions-only parameter')
+    if body.get("best_of") is not None and best_of < n:
+        raise HTTPError(400, '"best_of" must be >= "n"')
+    best_of = max(n, best_of)
+    echo = body.get("echo")
+    if echo is None:
+        echo = False
+    elif not isinstance(echo, bool):
+        # bool("false") is True — a loud 400 beats echoing a prompt the
+        # client asked not to echo
+        raise HTTPError(400, '"echo" must be a boolean')
+    if not allow_best_of and echo:
+        raise HTTPError(400, '"echo" is a completions-only parameter')
+    return n, best_of, echo

@@ -1,0 +1,191 @@
+"""Chat prompt construction: the simple {role}/{content} CHAT_TEMPLATE
+form, jinja templates (CHAT_TEMPLATE_JINJA or the checkpoint's own
+tokenizer_config.json chat_template), and the assistant-turn opener."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+from gofr_tpu.errors import HTTPError
+
+DEFAULT_CHAT_TEMPLATE = "[{role}]: {content}\n"
+
+_SENTINEL = "\x00GOFR_CONTENT\x00"
+
+
+def _chat_template(ctx: Any) -> tuple[str, str]:
+    """(template, assistant opener), both validated — a broken operator
+    template must be a clear error, not a per-request 500 from str.format
+    or silently dropped message content. The opener is everything the
+    template renders BEFORE the content slot for role=assistant (correct
+    for markup-wrapped formats like ChatML, where stripping trailing
+    newlines would emit a CLOSED empty assistant turn); override with
+    CHAT_TEMPLATE_OPENER when a format needs something else."""
+    template = ctx.config.get_or_default("CHAT_TEMPLATE", DEFAULT_CHAT_TEMPLATE)
+    try:
+        probe = template.format(role="assistant", content=_SENTINEL)
+    except (KeyError, IndexError, ValueError) as exc:
+        raise HTTPError(
+            500,
+            f"CHAT_TEMPLATE is invalid ({exc!r}) — it must use only "
+            "{role} and {content} placeholders",
+        )
+    if _SENTINEL not in probe:
+        raise HTTPError(
+            500, "CHAT_TEMPLATE must contain a {content} placeholder"
+        )
+    opener = ctx.config.get_or_default(
+        "CHAT_TEMPLATE_OPENER", probe.split(_SENTINEL)[0]
+    )
+    return template, opener
+
+
+def _jinja_template_source(ctx: Any) -> Any:
+    """The jinja chat template to use, or None for the simple
+    CHAT_TEMPLATE path. Precedence: CHAT_TEMPLATE_JINJA (a file path or
+    an inline template) > an explicit CHAT_TEMPLATE or
+    CHAT_TEMPLATE_OPENER (either means the operator chose the simple
+    form — a customized opener must never be silently ignored) > the
+    checkpoint's own tokenizer_config.json chat_template next to
+    TOKENIZER_PATH — serving a real instruct checkpoint through the
+    wrong template silently degrades it, so the official template is
+    adopted automatically. Resolution (incl. the file reads) is cached:
+    config is static per process, and per-request disk I/O on the chat
+    handler thread is waste."""
+    return _resolve_jinja_source(
+        ctx.config.get("CHAT_TEMPLATE_JINJA") or "",
+        bool(ctx.config.get("CHAT_TEMPLATE"))
+        or bool(ctx.config.get("CHAT_TEMPLATE_OPENER")),
+        ctx.config.get("TOKENIZER_PATH") or "",
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _resolve_jinja_source(
+    explicit: str, simple_form: bool, tok_path: str
+) -> Any:
+    import os
+
+    if explicit:
+        if os.path.isfile(explicit):
+            with open(explicit, encoding="utf-8") as fh:
+                return fh.read()
+        return explicit
+    if simple_form:
+        return None
+    if tok_path.endswith(".json"):
+        cfg_path = os.path.join(
+            os.path.dirname(tok_path), "tokenizer_config.json"
+        )
+        if os.path.isfile(cfg_path):
+            import json as _json
+
+            try:
+                with open(cfg_path, encoding="utf-8") as fh:
+                    template = _json.load(fh).get("chat_template")
+            except (OSError, ValueError) as exc:
+                # a corrupt checkpoint sidecar silently falling back to
+                # the generic template is EXACTLY the degradation this
+                # discovery exists to prevent — fail loudly instead
+                raise HTTPError(
+                    500, f"cannot read {cfg_path}: {exc} — fix the "
+                    "checkpoint or set CHAT_TEMPLATE explicitly"
+                )
+            if template is None:
+                return None
+            if isinstance(template, str):
+                return template
+            if isinstance(template, list):
+                # HF multi-template form: [{"name": ..., "template": ...}]
+                # — only an entry NAMED "default" is safe to adopt;
+                # guessing template[0] could silently serve every chat
+                # request through e.g. the tool_use template
+                for entry in template:
+                    if (
+                        isinstance(entry, dict)
+                        and entry.get("name") == "default"
+                        and isinstance(entry.get("template"), str)
+                    ):
+                        return entry["template"]
+            raise HTTPError(
+                500, f"unrecognized chat_template form in {cfg_path} — "
+                "set CHAT_TEMPLATE or CHAT_TEMPLATE_JINJA explicitly"
+            )
+    return None
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_jinja(source: str) -> Any:
+    """Compile once per template source (config is static per process).
+    The HF convention: an IMMUTABLE SANDBOXED environment — checkpoint
+    templates are data, not trusted code."""
+    try:
+        from jinja2.sandbox import ImmutableSandboxedEnvironment
+    except ImportError:
+        raise HTTPError(
+            500, "jinja chat templates need the jinja2 package "
+            "(declared in pyproject; pip install jinja2) — or set "
+            "CHAT_TEMPLATE to use the simple template form"
+        ) from None
+
+    env = ImmutableSandboxedEnvironment(trim_blocks=True, lstrip_blocks=True)
+
+    def raise_exception(message: str) -> None:
+        from jinja2.exceptions import TemplateError
+
+        raise TemplateError(message)
+
+    env.globals["raise_exception"] = raise_exception
+    return env.from_string(source)
+
+
+def _render_jinja(ctx: Any, source: str, messages: list) -> str:
+    from jinja2.exceptions import TemplateError
+
+    tok = ctx.tpu.tokenizer if ctx.tpu is not None else None
+    specials = {"bos_token": "", "eos_token": ""}
+    if tok is not None:
+        ids = getattr(tok, "_special_ids", {})
+        for content, ext_id in getattr(tok, "_token_ids", {}).items():
+            for name in ("bos", "eos"):
+                if ids.get(name) == ext_id:
+                    specials[f"{name}_token"] = content
+    try:
+        return _compiled_jinja(source).render(
+            messages=messages, add_generation_prompt=True, **specials
+        )
+    except TemplateError as exc:
+        # an operator/checkpoint template problem, surfaced clearly —
+        # never a bare per-request 500
+        raise HTTPError(500, f"chat template failed to render: {exc}")
+
+
+def render_chat_prompt(ctx: Any, messages: Any) -> str:
+    """Messages -> prompt text. Jinja templates (CHAT_TEMPLATE_JINJA, or
+    the checkpoint's own tokenizer_config.json chat_template) render
+    with the HF conventions (``messages``, ``add_generation_prompt``,
+    ``bos_token``/``eos_token``, sandboxed environment); otherwise the
+    simple CHAT_TEMPLATE ({role}/{content} per message) + the assistant
+    turn opener applies."""
+    if not isinstance(messages, list) or not messages:
+        raise HTTPError(400, '"messages" must be a non-empty list')
+    for m in messages:
+        if (
+            not isinstance(m, dict)
+            or not isinstance(m.get("role"), str)
+            or not isinstance(m.get("content"), str)
+        ):
+            raise HTTPError(
+                400,
+                'each message must be {"role": str, "content": str}',
+            )
+    jinja_src = _jinja_template_source(ctx)
+    if jinja_src is not None:
+        return _render_jinja(ctx, jinja_src, messages)
+    template, opener = _chat_template(ctx)
+    parts = [
+        template.format(role=m["role"], content=m["content"])
+        for m in messages
+    ]
+    return "".join(parts) + opener

@@ -1,1164 +1,52 @@
-"""OpenAI-compatible completions surface over the TPU datasource.
-
-Not a reference-parity component (GoFr has no LLM API) — a TPU-native
-addition so clients speaking the de-facto completions protocol (SDKs,
-load-testing harnesses, gateway routers) can hit this framework without a
-translation shim. ``register_openai_routes(app)`` adds:
-
-- ``POST /v1/completions`` — prompt in, text out; ``"stream": true``
-  switches to SSE chunks terminated by ``data: [DONE]``.
-- ``POST /v1/chat/completions`` — messages in, assistant message out
-  (requires a tokenizer; the prompt is rendered through CHAT_TEMPLATE,
-  default ``[{role}]: {content}\\n`` per message, and the assistant-turn
-  opener is everything the template puts BEFORE {content} — override
-  with CHAT_TEMPLATE_OPENER for formats that need more).
-- ``POST /v1/embeddings`` — encoder models (MODEL_NAME=bert-*); multi-
-  item inputs pack into one batcher dispatch.
-- ``GET /v1/models`` — the single served model, from MODEL_NAME.
-
-Scope: the completions shape (prompt string or token list, max_tokens,
-temperature/top_p/seed, penalties/logit_bias, n/best_of/echo fan-out,
-stop, logprobs, usage accounting). ``stop`` takes up to 4 sequences:
-single-token encodings stop on-device, and every sequence is ALSO
-matched host-side against the rolling decoded text (``_StopScanner``),
-so multi-token stops and cross-token-boundary occurrences truncate
-correctly; ``stop_token_ids`` takes raw ids. Knobs this server cannot
-honor are a clear 400, never a silent ignore.
+"""Back-compat shim: the OpenAI surface moved to the ``gofr_tpu.openai``
+package (split by concern — parse/template/logprobs/fanout/endpoints —
+when the single module passed 1,100 lines). Import sites keep working;
+new code should import from ``gofr_tpu.openai``.
 """
 
 from __future__ import annotations
 
-import functools
-import time
-import uuid
-from typing import Any
-
-from gofr_tpu.errors import HTTPError
-
-
-def register_openai_routes(app: Any) -> None:
-    app.post("/v1/completions", completions)
-    app.post("/v1/chat/completions", chat_completions)
-    app.post("/v1/embeddings", embeddings)
-    app.get("/v1/models", list_models)
-
-
-async def embeddings(ctx: Any) -> Any:
-    """OpenAI embeddings shape over an encoder model (MODEL_NAME=bert-*).
-    ``input`` is a string, list of strings, token-id list, or list of
-    id lists; items run through the dynamic batcher CONCURRENTLY, so a
-    multi-item request packs into one device dispatch."""
-    import asyncio
-
-    if ctx.tpu is None:
-        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
-    if not ctx.tpu.model_name.startswith("bert"):
-        # checked BEFORE any inference: a decoder deployment must 400 for
-        # free, not run (and cache) a full prefill per item first
-        raise HTTPError(
-            400,
-            "embeddings need an encoder model (MODEL_NAME=bert-tiny or "
-            f"bert-base); '{ctx.tpu.model_name}' is a decoder",
-        )
-    body = ctx.bind() if ctx.request.body else {}
-    if not isinstance(body, dict):
-        raise HTTPError(400, "request body must be a JSON object")
-    raw = body.get("input")
-    if isinstance(raw, str) or (
-        isinstance(raw, list) and raw and all(isinstance(t, int) for t in raw)
-    ):
-        items = [raw]
-    elif isinstance(raw, list) and raw:
-        items = raw
-    else:
-        raise HTTPError(
-            400,
-            '"input" must be a string, list of strings, or token-id list(s)',
-        )
-    tok = ctx.tpu.tokenizer
-    # the encoder pads/slices to one fixed bucket: over-long input must
-    # 400 (OpenAI behavior), never silently embed a truncated prefix
-    # while usage reports the full count. wait_ready: the bucket lives on
-    # the runner, which a background boot builds late.
-    ctx.tpu.wait_ready(60.0)
-    bucket = getattr(ctx.tpu.runner, "bucket", None)
-
-    def tokenize_items() -> tuple[int, list]:
-        """CPU-bound BPE over possibly many strings — runs in the
-        executor below, never on the event loop (the async handler
-        contract: the loop is for enqueueing, not computing)."""
-        n = 0
-        payloads = []
-        for item in items:
-            if isinstance(item, str):
-                if tok is None:
-                    raise HTTPError(
-                        400,
-                        "string input needs a tokenizer (set TOKENIZER_PATH)",
-                    )
-                ids = tok.encode(item)
-            elif isinstance(item, list) and item and all(
-                isinstance(t, int) for t in item
-            ):
-                ids = item
-            else:
-                raise HTTPError(400, f"invalid input item: {item!r:.80}")
-            if not ids:
-                raise HTTPError(400, "input item encoded to zero tokens")
-            if bucket is not None and len(ids) > bucket:
-                raise HTTPError(
-                    400,
-                    f"input item is {len(ids)} tokens; this encoder "
-                    f"accepts at most {bucket}",
-                )
-            n += len(ids)
-            payloads.append({"tokens": ids})
-        return n, payloads
-
-    loop = asyncio.get_running_loop()
-    n_tokens, payloads = await loop.run_in_executor(None, tokenize_items)
-    results = await asyncio.gather(
-        *(ctx.tpu.infer_async(p) for p in payloads)
-    )
-
-    def to_rows() -> list:
-        import numpy as np
-
-        return [
-            {
-                "object": "embedding",
-                "index": i,
-                "embedding": np.asarray(out).reshape(-1).tolist(),
-            }
-            for i, out in enumerate(results)
-        ]
-
-    data = await loop.run_in_executor(None, to_rows)
-    from gofr_tpu.http.response import Raw
-
-    return Raw({
-        "object": "list",
-        "model": ctx.tpu.model_name,
-        "data": data,
-        "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
-    })
-
-
-DEFAULT_CHAT_TEMPLATE = "[{role}]: {content}\n"
-
-_SENTINEL = "\x00GOFR_CONTENT\x00"
-
-
-def _chat_template(ctx: Any) -> tuple[str, str]:
-    """(template, assistant opener), both validated — a broken operator
-    template must be a clear error, not a per-request 500 from str.format
-    or silently dropped message content. The opener is everything the
-    template renders BEFORE the content slot for role=assistant (correct
-    for markup-wrapped formats like ChatML, where stripping trailing
-    newlines would emit a CLOSED empty assistant turn); override with
-    CHAT_TEMPLATE_OPENER when a format needs something else."""
-    template = ctx.config.get_or_default("CHAT_TEMPLATE", DEFAULT_CHAT_TEMPLATE)
-    try:
-        probe = template.format(role="assistant", content=_SENTINEL)
-    except (KeyError, IndexError, ValueError) as exc:
-        raise HTTPError(
-            500,
-            f"CHAT_TEMPLATE is invalid ({exc!r}) — it must use only "
-            "{role} and {content} placeholders",
-        )
-    if _SENTINEL not in probe:
-        raise HTTPError(
-            500, "CHAT_TEMPLATE must contain a {content} placeholder"
-        )
-    opener = ctx.config.get_or_default(
-        "CHAT_TEMPLATE_OPENER", probe.split(_SENTINEL)[0]
-    )
-    return template, opener
-
-
-def _jinja_template_source(ctx: Any) -> Any:
-    """The jinja chat template to use, or None for the simple
-    CHAT_TEMPLATE path. Precedence: CHAT_TEMPLATE_JINJA (a file path or
-    an inline template) > an explicit CHAT_TEMPLATE or
-    CHAT_TEMPLATE_OPENER (either means the operator chose the simple
-    form — a customized opener must never be silently ignored) > the
-    checkpoint's own tokenizer_config.json chat_template next to
-    TOKENIZER_PATH — serving a real instruct checkpoint through the
-    wrong template silently degrades it, so the official template is
-    adopted automatically. Resolution (incl. the file reads) is cached:
-    config is static per process, and per-request disk I/O on the chat
-    handler thread is waste."""
-    return _resolve_jinja_source(
-        ctx.config.get("CHAT_TEMPLATE_JINJA") or "",
-        bool(ctx.config.get("CHAT_TEMPLATE"))
-        or bool(ctx.config.get("CHAT_TEMPLATE_OPENER")),
-        ctx.config.get("TOKENIZER_PATH") or "",
-    )
-
-
-@functools.lru_cache(maxsize=8)
-def _resolve_jinja_source(
-    explicit: str, simple_form: bool, tok_path: str
-) -> Any:
-    import os
-
-    if explicit:
-        if os.path.isfile(explicit):
-            with open(explicit, encoding="utf-8") as fh:
-                return fh.read()
-        return explicit
-    if simple_form:
-        return None
-    if tok_path.endswith(".json"):
-        cfg_path = os.path.join(
-            os.path.dirname(tok_path), "tokenizer_config.json"
-        )
-        if os.path.isfile(cfg_path):
-            import json as _json
-
-            try:
-                with open(cfg_path, encoding="utf-8") as fh:
-                    template = _json.load(fh).get("chat_template")
-            except (OSError, ValueError) as exc:
-                # a corrupt checkpoint sidecar silently falling back to
-                # the generic template is EXACTLY the degradation this
-                # discovery exists to prevent — fail loudly instead
-                raise HTTPError(
-                    500, f"cannot read {cfg_path}: {exc} — fix the "
-                    "checkpoint or set CHAT_TEMPLATE explicitly"
-                )
-            if template is None:
-                return None
-            if isinstance(template, str):
-                return template
-            if isinstance(template, list):
-                # HF multi-template form: [{"name": ..., "template": ...}]
-                # — only an entry NAMED "default" is safe to adopt;
-                # guessing template[0] could silently serve every chat
-                # request through e.g. the tool_use template
-                for entry in template:
-                    if (
-                        isinstance(entry, dict)
-                        and entry.get("name") == "default"
-                        and isinstance(entry.get("template"), str)
-                    ):
-                        return entry["template"]
-            raise HTTPError(
-                500, f"unrecognized chat_template form in {cfg_path} — "
-                "set CHAT_TEMPLATE or CHAT_TEMPLATE_JINJA explicitly"
-            )
-    return None
-
-
-@functools.lru_cache(maxsize=8)
-def _compiled_jinja(source: str) -> Any:
-    """Compile once per template source (config is static per process).
-    The HF convention: an IMMUTABLE SANDBOXED environment — checkpoint
-    templates are data, not trusted code."""
-    try:
-        from jinja2.sandbox import ImmutableSandboxedEnvironment
-    except ImportError:
-        raise HTTPError(
-            500, "jinja chat templates need the jinja2 package "
-            "(declared in pyproject; pip install jinja2) — or set "
-            "CHAT_TEMPLATE to use the simple template form"
-        ) from None
-
-    env = ImmutableSandboxedEnvironment(trim_blocks=True, lstrip_blocks=True)
-
-    def raise_exception(message: str) -> None:
-        from jinja2.exceptions import TemplateError
-
-        raise TemplateError(message)
-
-    env.globals["raise_exception"] = raise_exception
-    return env.from_string(source)
-
-
-def _render_jinja(ctx: Any, source: str, messages: list) -> str:
-    from jinja2.exceptions import TemplateError
-
-    tok = ctx.tpu.tokenizer if ctx.tpu is not None else None
-    specials = {"bos_token": "", "eos_token": ""}
-    if tok is not None:
-        ids = getattr(tok, "_special_ids", {})
-        for content, ext_id in getattr(tok, "_token_ids", {}).items():
-            for name in ("bos", "eos"):
-                if ids.get(name) == ext_id:
-                    specials[f"{name}_token"] = content
-    try:
-        return _compiled_jinja(source).render(
-            messages=messages, add_generation_prompt=True, **specials
-        )
-    except TemplateError as exc:
-        # an operator/checkpoint template problem, surfaced clearly —
-        # never a bare per-request 500
-        raise HTTPError(500, f"chat template failed to render: {exc}")
-
-
-def render_chat_prompt(ctx: Any, messages: Any) -> str:
-    """Messages -> prompt text. Jinja templates (CHAT_TEMPLATE_JINJA, or
-    the checkpoint's own tokenizer_config.json chat_template) render
-    with the HF conventions (``messages``, ``add_generation_prompt``,
-    ``bos_token``/``eos_token``, sandboxed environment); otherwise the
-    simple CHAT_TEMPLATE ({role}/{content} per message) + the assistant
-    turn opener applies."""
-    if not isinstance(messages, list) or not messages:
-        raise HTTPError(400, '"messages" must be a non-empty list')
-    for m in messages:
-        if (
-            not isinstance(m, dict)
-            or not isinstance(m.get("role"), str)
-            or not isinstance(m.get("content"), str)
-        ):
-            raise HTTPError(
-                400,
-                'each message must be {"role": str, "content": str}',
-            )
-    jinja_src = _jinja_template_source(ctx)
-    if jinja_src is not None:
-        return _render_jinja(ctx, jinja_src, messages)
-    template, opener = _chat_template(ctx)
-    parts = [
-        template.format(role=m["role"], content=m["content"])
-        for m in messages
-    ]
-    return "".join(parts) + opener
-
-
-def list_models(ctx: Any) -> Any:
-    if ctx.tpu is None:
-        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
-    from gofr_tpu.http.response import Raw
-
-    # the base model plus every loaded LoRA adapter: gateways route by
-    # model name, and a request's "model" naming an adapter selects it
-    # (the multi-LoRA serving convention) — stock OpenAI clients cannot
-    # send the custom "adapter" key, but they can set model
-    entries = [{
-        "id": ctx.tpu.model_name,
-        "object": "model",
-        "owned_by": "gofr_tpu",
-    }]
-    # non-blocking snapshot: discovery must answer instantly during a
-    # background boot (list_adapters would wait for readiness)
-    adapters = getattr(getattr(ctx.tpu, "runner", None), "adapters", None) or {}
-    for name in sorted(adapters):
-        entries.append({
-            "id": name,
-            "object": "model",
-            "owned_by": "gofr_tpu",
-            "root": ctx.tpu.model_name,  # the base it adapts
-        })
-    return Raw({"object": "list", "data": entries})
-
-
-def _prompt_tokens(ctx: Any, prompt: Any) -> list[int]:
-    if isinstance(prompt, str):
-        tok = ctx.tpu.tokenizer
-        if tok is None:
-            raise HTTPError(
-                400,
-                "string prompt needs a tokenizer (set TOKENIZER_PATH); "
-                "token-id lists work without one",
-            )
-        ids = tok.encode(prompt)
-        if not ids:
-            raise HTTPError(400, "prompt encoded to zero tokens")
-        return ids
-    if (
-        isinstance(prompt, list) and prompt
-        and all(isinstance(t, int) for t in prompt)
-    ):
-        return prompt
-    raise HTTPError(
-        400, '"prompt" must be a non-empty string or list of token ids'
-    )
-
-
-def _parse_stops(ctx: Any, body: dict) -> tuple[frozenset, list]:
-    """(on-device stop token ids, host-matched stop strings). A stop
-    string that encodes to ONE token stops on-device (cheapest — the
-    decode chunk never emits it); multi-token strings are matched
-    host-side against the decoded text as it streams off the device."""
-    ids = set()
-    raw_ids = body.get("stop_token_ids")
-    if raw_ids is not None:
-        if not isinstance(raw_ids, list) or not all(
-            isinstance(t, int) for t in raw_ids
-        ):
-            raise HTTPError(400, '"stop_token_ids" must be a list of ints')
-        ids.update(raw_ids)
-    stop = body.get("stop")
-    if stop is None:
-        return frozenset(ids), []
-    if isinstance(stop, str):
-        stop = [stop]
-    if not isinstance(stop, list) or not all(
-        isinstance(s, str) and s for s in stop
-    ):
-        raise HTTPError(400, '"stop" must be a non-empty string or list of them')
-    if len(stop) > 4:
-        raise HTTPError(400, '"stop" accepts at most 4 sequences (OpenAI limit)')
-    tok = ctx.tpu.tokenizer
-    if tok is None:
-        raise HTTPError(400, '"stop" strings need a tokenizer; use "stop_token_ids"')
-    strings = []
-    for s in stop:
-        encoded = tok.encode(s)
-        if len(encoded) == 1:
-            # on-device stop for the exact-token emission (cheapest), but
-            # ALSO host-matched: the same text can arrive via a different
-            # tokenization (" the" as " t"+"he", or inside a larger
-            # token), which only the text scan catches
-            ids.add(encoded[0])
-        strings.append(s)
-    return frozenset(ids), strings
-
-
-class _StopScanner:
-    """Incremental multi-token stop matching with SSE hold-back:
-    ``feed`` returns (emit, done) where ``emit`` never contains a stop
-    string NOR a tail that could still grow into one — a stream must not
-    leak half a stop sequence it would have had to un-send."""
-
-    def __init__(self, stops: list):
-        self.stops = stops
-        self.buf = ""
-        self.consumed = 0  # total chars fed
-        self.match_pos = None  # absolute offset of the matched stop
-
-    def feed(self, text: str) -> tuple[str, bool]:
-        self.buf += text
-        self.consumed += len(text)
-        hits = [p for p in (self.buf.find(s) for s in self.stops) if p >= 0]
-        if hits:
-            idx = min(hits)
-            self.match_pos = self.consumed - len(self.buf) + idx
-            return self.buf[:idx], True
-        hold = 0
-        for s in self.stops:
-            for k in range(min(len(s) - 1, len(self.buf)), 0, -1):
-                if self.buf.endswith(s[:k]):
-                    hold = max(hold, k)
-                    break
-        cut = len(self.buf) - hold
-        emit, self.buf = self.buf[:cut], self.buf[cut:]
-        return emit, False
-
-    def flush(self) -> str:
-        """End of stream: held-back text can no longer become a stop."""
-        emit, self.buf = self.buf, ""
-        return emit
-
-
-def _sampler(body: dict) -> Any:
-    from gofr_tpu.ops.sampling import Sampler
-
-    try:
-        # pass the WHOLE body through the shared parse so every natively
-        # supported knob (top_k, min_p, repetition_penalty, seed) works
-        # here too — only the defaults differ: OpenAI semantics default
-        # to temperature 1.0 (the native /generate defaults to greedy).
-        # Explicit nulls are stripped BEFORE the merge so "temperature":
-        # null falls back to the OpenAI default here, not from_body's
-        # greedy default (the OpenAI fields are nullable).
-        return Sampler.from_body({
-            "temperature": 1.0, "top_p": 1.0,
-            **{k: v for k, v in body.items() if v is not None},
-        })
-    except (TypeError, ValueError) as exc:
-        raise HTTPError(400, f"invalid sampling params: {exc}")
-
-
-def _parse_request(ctx: Any, default_max: int) -> tuple:
-    """Shared request parse for both endpoints: (body, max_tokens,
-    sampler, stop_ids, stop_strs, want_logprobs, top_n, adapter). One
-    home, so a knob added
-    to completions cannot silently miss chat (they drifted once)."""
-    if ctx.tpu is None:
-        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
-    body = ctx.bind() if ctx.request.body else {}
-    if not isinstance(body, dict):
-        raise HTTPError(400, "request body must be a JSON object")
-    # protocol knobs this server does not implement must be a clear 400
-    # when they would change output — never a silent ignore.
-    # presence/frequency penalties and logit_bias run on-device via the
-    # penalized decode chunk; n/best_of/echo are handled by the
-    # completions fan-out (_parse_fanout).
-    if body.get("suffix") is not None:
-        raise HTTPError(400, '"suffix" is not supported by this server')
-    # nullable like the sampling knobs: explicit JSON null = the default.
-    # max_tokens=0 is legal ONLY with echo (pure prompt scoring, the
-    # eval-harness loglikelihood pattern) — without echo it would return
-    # nothing at all
-    max_tokens = body.get("max_tokens")
-    if max_tokens is None:
-        max_tokens = default_max
-    floor = 0 if body.get("echo") is True else 1
-    if not isinstance(max_tokens, int) or max_tokens < floor:
-        raise HTTPError(
-            400,
-            '"max_tokens" must be a positive integer'
-            + (" (0 allowed with echo)" if floor == 0 else ""),
-        )
-    sampler = _sampler(body)
-    stop_ids, stop_strs = _parse_stops(ctx, body)
-    lp_req = body.get("logprobs")
-    want_logprobs = lp_req not in (None, False, 0)
-    # alternatives: an integer logprobs >= 2 (the completions form) or
-    # the explicit chat-style "top_logprobs" key, which wins when both
-    # are present. logprobs 1/true stays chosen-token-only — the long-
-    # standing behavior of this endpoint, documented in the API guide
-    # (pass top_logprobs for one alternative per position)
-    top_n = 0
-    if isinstance(lp_req, int) and not isinstance(lp_req, bool) and lp_req >= 2:
-        top_n = lp_req
-    tl = body.get("top_logprobs")
-    if tl is not None:
-        if not isinstance(tl, int) or isinstance(tl, bool) or tl < 0:
-            raise HTTPError(400, '"top_logprobs" must be an integer >= 0')
-        top_n = tl
-        if tl > 0:
-            want_logprobs = True
-    from gofr_tpu.models.transformer import TOP_LOGPROBS
-
-    if top_n > TOP_LOGPROBS:
-        raise HTTPError(
-            400, f'the maximum value for "logprobs"/"top_logprobs" is '
-            f"{TOP_LOGPROBS}"
-        )
-    adapter = body.get("adapter")  # multi-LoRA extension
-    if adapter is not None and not isinstance(adapter, str):
-        raise HTTPError(400, '"adapter" must be a string')
-    if adapter is None:
-        # OpenAI-conventional selection: "model" naming a loaded adapter
-        # routes to it (stock clients have no way to send "adapter");
-        # the explicit extension key wins when both are present. An
-        # UNKNOWN model name is a 404 exactly like the real API — a
-        # gateway routing to an unloaded adapter must never silently get
-        # base-model output (list_adapters waits for boot, so the
-        # routing decision always sees the post-boot adapter set)
-        requested = body.get("model")
-        if isinstance(requested, str) and requested != ctx.tpu.model_name:
-            loaded = ctx.tpu.list_adapters()
-            if requested in loaded:
-                adapter = requested
-            else:
-                raise HTTPError(
-                    404,
-                    f"model '{requested}' not found (serving: "
-                    f"{[ctx.tpu.model_name, *loaded]})",
-                )
-    return (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs,
-            top_n, adapter)
-
-
-def _logprobs_obj(
-    tok: Any, lp_list: list, lp_ids: list, tops: Any, top_n: int,
-    prompt_positions: int = 0,
-) -> dict:
-    """The choice-level logprobs object: token_logprobs always; a
-    ``tokens`` list (single-token decodes, or stringified ids without a
-    tokenizer) aligned with it; and, when ``top_n`` > 0, per-position
-    ``top_logprobs`` maps of the N best alternatives (null for echoed
-    prompt positions — the prompt is scored chosen-only)."""
-
-    def key(t: int) -> str:
-        return tok.decode([t]) if tok is not None else str(t)
-
-    def alt_map(alts: list) -> dict:
-        # distinct ids can decode to the same string; alts is best-first,
-        # so keep the FIRST (best) value instead of letting a worse
-        # duplicate overwrite it
-        m: dict[str, float] = {}
-        for i, v in alts[:top_n]:
-            m.setdefault(key(i), v)
-        return m
-
-    obj: dict[str, Any] = {
-        "token_logprobs": lp_list,
-        # slice, never assume: a host-matched stop truncates lp_list to
-        # the visible prefix while the ids keep the full generation for
-        # usage accounting — tokens must stay ALIGNED with token_logprobs
-        "tokens": [key(t) for t in lp_ids[: len(lp_list)]],
-    }
-    if top_n and tops is not None:
-        obj["top_logprobs"] = (
-            [None] * prompt_positions
-            + [alt_map(alts) for alts in tops]
-        )
-    return obj
-
-
-def _chat_lp_entry(tok: Any, token_id: int, lp: float) -> dict:
-    """One {token, logprob, bytes} content entry. ``bytes`` carries the
-    token's TRUE bytes (a byte-level BPE token can hold a fragment of a
-    multi-byte character — the field exists so clients can reassemble
-    text across such splits; round-tripping through the replaced string
-    would corrupt them)."""
-    raw = tok.decode_bytes([token_id])
-    return {
-        "token": raw.decode("utf-8", errors="replace"),
-        "logprob": lp,
-        "bytes": list(raw),
-    }
-
-
-def _chat_logprobs_obj(
-    tok: Any, lp_list: list, out_ids: list, tops: Any, top_n: int,
-) -> dict:
-    """Chat logprobs in the CURRENT OpenAI chat shape — a ``content``
-    list of {token, logprob, bytes, top_logprobs} entries that stock
-    SDKs parse (top_logprobs is ALWAYS present, [] when no alternatives
-    were requested — typed clients treat it as required) — alongside
-    this server's legacy completions-style fields
-    (token_logprobs/tokens/top_logprobs) for back-compat."""
-    obj = _logprobs_obj(tok, lp_list, out_ids, tops, top_n)
-    content = []
-    for j, (t, lp) in enumerate(zip(out_ids[: len(lp_list)], lp_list)):
-        e = _chat_lp_entry(tok, t, lp)
-        e["top_logprobs"] = (
-            [_chat_lp_entry(tok, i, v) for i, v in tops[j][:top_n]]
-            if top_n and tops is not None else []
-        )
-        content.append(e)
-    obj["content"] = content
-    return obj
-
-
-_FANOUT_CAP = 16  # pool-slot-scale bound on n/best_of; beyond it is a 400
-
-
-def _parse_fanout(body: dict, allow_best_of: bool) -> tuple[int, int, bool]:
-    """(n, best_of, echo) with OpenAI constraints: best_of >= n, both
-    capped, echo completions-only. Streaming fan-out is rejected at the
-    call site (interleaved multi-index SSE is not implemented)."""
-
-    def positive(key: str, default: int) -> int:
-        value = body.get(key)
-        if value is None:
-            return default
-        if not isinstance(value, int) or isinstance(value, bool) or value < 1:
-            raise HTTPError(400, f'"{key}" must be a positive integer')
-        if value > _FANOUT_CAP:
-            raise HTTPError(
-                400, f'"{key}" is capped at {_FANOUT_CAP} on this server'
-            )
-        return value
-
-    n = positive("n", 1)
-    best_of = positive("best_of", 1)  # type/range-checked on BOTH endpoints
-    if not allow_best_of and best_of != 1:
-        raise HTTPError(400, '"best_of" is a completions-only parameter')
-    if body.get("best_of") is not None and best_of < n:
-        raise HTTPError(400, '"best_of" must be >= "n"')
-    best_of = max(n, best_of)
-    echo = body.get("echo")
-    if echo is None:
-        echo = False
-    elif not isinstance(echo, bool):
-        # bool("false") is True — a loud 400 beats echoing a prompt the
-        # client asked not to echo
-        raise HTTPError(400, '"echo" must be a boolean')
-    if not allow_best_of and echo:
-        raise HTTPError(400, '"echo" is a completions-only parameter')
-    return n, best_of, echo
-
-
-def _consume_stream(
-    ctx: Any, prompt_ids: list, max_tokens: int, sampler: Any,
-    stop_ids: Any, stop_strs: list, need_lp: bool, adapter: Any,
-) -> tuple[list, Any, str, str]:
-    """Generate through the streaming bridge, matching multi-token stop
-    strings host-side as text streams off the device and CANCELLING the
-    background decode at the first match (closing the iterator frees the
-    pool slot — a matched stop must not keep generating to max_tokens).
-    Returns (tokens, logprobs_or_None, text, finish_reason); ``text`` is
-    truncated before the stop string, tokens/logprobs cover everything
-    actually generated (usage accounting)."""
-    tok = ctx.tpu.tokenizer  # _parse_stops guarantees one for stop_strs
-    dec = tok.stream_decoder()
-    scan = _StopScanner(stop_strs)
-    it = ctx.tpu.generate_stream(
-        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
-        adapter=adapter, logprobs=need_lp,
-    )
-    toks: list = []
-    lps: list = []
-    parts: list = []
-    starts: list = []  # decoded-text offset where each token's text began
-    decoded = 0
-    finish = None
-    try:
-        for item in it:
-            t, lp = item if need_lp else (item, None)
-            toks.append(t)
-            if lp is not None:
-                lps.append(lp)
-            piece = dec.feed(t)
-            starts.append(decoded)
-            decoded += len(piece)
-            emit, done = scan.feed(piece)
-            parts.append(emit)
-            if done:
-                finish = "stop"
-                break
-        if finish is None:
-            emit, done = scan.feed(dec.flush())
-            parts.append(emit)
-            if done:
-                finish = "stop"
-            else:
-                parts.append(scan.flush())
-                finish = "length" if len(toks) >= max_tokens else "stop"
-    finally:
-        it.close()
-    if need_lp and scan.match_pos is not None:
-        # align response logprobs with the TRUNCATED text: keep tokens
-        # whose text starts before the match (usage still bills the full
-        # toks list — the tokens were generated)
-        vis = sum(1 for s in starts if s < scan.match_pos)
-        lps = lps[:vis]
-    return toks, (lps if need_lp else None), "".join(parts), finish
-
-
-def _fanout_generate(
-    ctx: Any, body: dict, prompt_ids: list, max_tokens: int,
-    sampler: Any, stop_ids: Any, stop_strs: list, want_logprobs: bool,
-    top_n: int, adapter: Any, n: int, best_of: int,
-) -> tuple[list, int]:
-    """Generate ``best_of`` candidates and keep the ``n`` best. Returns
-    ([(tokens, logprobs_or_None, tops_or_None, text_or_None,
-    finish_or_None), ...] of length n, total tokens generated across ALL
-    candidates — usage must count discarded best_of candidates too, the
-    OpenAI accounting).
-    ``text``/``finish`` are set only on the multi-token-stop path (the
-    host-matched truncation IS the text); otherwise the caller decodes
-    the ids itself. ``top_n`` > 0 also collects the top-k alternatives
-    per position (tops; None otherwise) — rejected with stop_strs at
-    the call sites, so the two never combine here.
-
-    - Deterministic requests (temperature 0) produce identical candidates:
-      ONE generation is replicated, not recomputed (and billed once per
-      replica, matching what the response carries).
-    - Sampled candidates run CONCURRENTLY: the continuous-batching pool
-      decodes unseeded requests in one lockstep dispatch, so n streams
-      cost ~one stream's wall time. A seeded request derives per-candidate
-      seeds (seed + index) so the whole fan-out stays reproducible.
-    - best_of > n ranks by mean token logprob (generated with logprobs
-      internally; stripped from the response unless requested)."""
-    score = best_of > n
-    need_lp = want_logprobs or score
-
-    def one(s):
-        if stop_strs:
-            toks, lps, text, finish = _consume_stream(
-                ctx, prompt_ids, max_tokens, s, stop_ids, stop_strs,
-                need_lp, adapter,
-            )
-            return toks, lps, None, text, finish
-        if top_n:
-            toks, lps, tops = ctx.tpu.generate(
-                prompt_ids, max_tokens, sampler=s, stop_tokens=stop_ids,
-                adapter=adapter, logprobs=True, top_logprobs=True,
-            )
-            return toks, lps, tops, None, None
-        out = ctx.tpu.generate(
-            prompt_ids, max_tokens, sampler=s, stop_tokens=stop_ids,
-            adapter=adapter, logprobs=need_lp,
-        )
-        toks, lps = out if need_lp else (out, None)
-        return toks, lps, None, None, None
-
-    if sampler.greedy:
-        toks, lps, tops, text, finish = one(sampler)
-        if not want_logprobs:
-            lps = None
-        return [(toks, lps, tops, text, finish)] * n, len(toks) * n
-
-    seed = body.get("seed")
-    if seed is not None:
-        try:
-            seed = int(seed)
-        except (TypeError, ValueError):
-            raise HTTPError(400, '"seed" must be an integer') from None
-    samplers = [
-        _sampler({**body, "seed": seed + i} if seed is not None else body)
-        for i in range(best_of)
-    ]
-    if best_of == 1:
-        results = [one(samplers[0])]
-    else:
-        from concurrent.futures import ThreadPoolExecutor
-
-        with ThreadPoolExecutor(max_workers=best_of) as pool:
-            results = list(pool.map(one, samplers))
-    generated = sum(len(r[0]) for r in results)
-    if score:
-        def mean_lp(item):
-            lps = item[1]
-            return sum(lps) / len(lps) if lps else float("-inf")
-
-        results = sorted(results, key=mean_lp, reverse=True)[:n]
-    if not want_logprobs:
-        results = [(toks, None, tops, text, finish)
-                   for toks, _, tops, text, finish in results]
-    return results, generated
-
-
-def completions(ctx: Any) -> Any:
-    (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs, top_n,
-     adapter) = _parse_request(ctx, default_max=16)
-    n, best_of, echo = _parse_fanout(body, allow_best_of=True)
-    if echo and want_logprobs and body.get("stream"):
-        raise HTTPError(
-            400, '"echo" with "logprobs" is not supported when streaming'
-        )
-    if top_n and stop_strs:
-        raise HTTPError(
-            400, "top-logprob alternatives with multi-token stop "
-            'sequences are not supported; use "stop_token_ids"'
-        )
-    if "prompt" not in body:
-        # a missing prompt is almost always a caller bug (misspelled key):
-        # generating from a magic default would 200 on garbage
-        raise HTTPError(400, 'missing "prompt"')
-    prompt_ids = _prompt_tokens(ctx, body["prompt"])
-    model = adapter or ctx.tpu.model_name  # adapters serve under their name
-    created = int(time.time())
-    cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
-    tok = ctx.tpu.tokenizer
-
-    if body.get("stream"):
-        if n > 1 or best_of > 1:
-            raise HTTPError(
-                400, 'streaming with "n" > 1 or "best_of" > 1 is not '
-                "supported (interleaved multi-index SSE)"
-            )
-        if max_tokens == 0:
-            raise HTTPError(
-                400, 'streaming needs "max_tokens" >= 1 (use the '
-                "non-stream form for pure echo scoring)"
-            )
-        if top_n:
-            raise HTTPError(
-                400, "top-logprob alternatives are not supported when "
-                "streaming; drop \"stream\" or request chosen-token "
-                "logprobs only"
-            )
-        import json as _json
-
-        from gofr_tpu.http.response import Stream
-
-        # constructed OUTSIDE events(): parameter errors (unknown adapter,
-        # bad sampler) must 400 before the SSE 200 commits
-        stream_iter = ctx.tpu.generate_stream(
-            prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
-            adapter=adapter, logprobs=want_logprobs,
-        )
-
-        def chunk(text: str, lp: Any = None, finish: Any = None,
-                  token: Any = None) -> str:
-            choice: dict[str, Any] = {
-                "text": text, "index": 0, "finish_reason": finish,
-            }
-            if token is not None:
-                # no tokenizer: bare str(token) text would concatenate
-                # ambiguously ("12"+"3" == "1"+"23") — ids ride a tokens
-                # extension instead, matching the non-stream path
-                choice["tokens"] = [token]
-            if want_logprobs:
-                choice["logprobs"] = (
-                    {"token_logprobs": [lp]} if lp is not None else None
-                )
-            return _json.dumps({
-                "id": cmpl_id, "object": "text_completion",
-                "created": created, "model": model, "choices": [choice],
-            })
-
-        def events():
-            emitted = 0
-            finish = None
-            dec = tok.stream_decoder() if tok is not None else None
-            # stop_strs imply a tokenizer (enforced at parse), so dec
-            # is always live when the scanner is
-            scan = _StopScanner(stop_strs) if stop_strs else None
-            try:
-                if echo:
-                    # prompt replay first, matching the non-stream shape
-                    if dec is not None:
-                        yield chunk(tok.decode(prompt_ids))
-                    else:
-                        for t in prompt_ids:
-                            yield chunk("", token=t)
-                for item in stream_iter:
-                    token, lp = item if want_logprobs else (item, None)
-                    emitted += 1
-                    if dec is None:
-                        yield chunk("", lp, token=token)
-                        continue
-                    text = dec.feed(token)
-                    if scan is not None:
-                        text, done = scan.feed(text)
-                        if done:
-                            # matched mid-stream: emit up to the stop and
-                            # cancel the decode (frees the pool slot). No
-                            # lp: the matched token's text is excluded, so
-                            # its logprob must not ride this chunk either
-                            yield chunk(text, None)
-                            finish = "stop"
-                            break
-                    yield chunk(text, lp)
-                tail = dec.flush() if dec is not None else ""
-                if finish is None:
-                    if scan is not None:
-                        tail, done = scan.feed(tail)
-                        if done:
-                            finish = "stop"
-                        else:
-                            tail += scan.flush()
-                    if finish is None:
-                        finish = "length" if emitted >= max_tokens else "stop"
-                else:
-                    tail = ""
-                yield chunk(tail, None, finish)
-                yield "[DONE]"
-            except Exception as exc:
-                yield _json.dumps({"error": {"message": str(exc)}})
-            finally:
-                stream_iter.close()  # no-op if already exhausted
-
-        return Stream(events())
-
-    prompt_lps = None
-    if echo and want_logprobs:
-        # teacher-forcing prompt scoring: log p(t_i | t_<i), with null
-        # for the first token (no conditional) — the OpenAI convention
-        # and the eval-harness loglikelihood pattern. The request's
-        # adapter scores too (and an unknown one 400s even on the
-        # max_tokens=0 path, where no generation would catch it)
-        prompt_lps = [None] + ctx.tpu.score(prompt_ids, adapter=adapter)
-    elif max_tokens == 0 and adapter is not None:
-        # pure echo without logprobs still must validate the adapter name
-        if adapter not in getattr(ctx.tpu.runner, "adapters", {}):
-            from gofr_tpu.errors import InvalidParamError
-
-            raise InvalidParamError(
-                f"adapter '{adapter}' "
-                f"(loaded: {sorted(getattr(ctx.tpu.runner, 'adapters', {}))})"
-            )
-    if max_tokens == 0:
-        # pure scoring (echo-only, enforced at parse): no decode at all
-        results = [
-            ([], [] if want_logprobs else None, [] if top_n else None,
-             None, "length")
-        ] * n
-        generated = 0
-    else:
-        results, generated = _fanout_generate(
-            ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
-            want_logprobs, top_n, adapter, n, best_of,
-        )
-    choices = []
-    for i, (out, logprobs, tops, text, finish) in enumerate(results):
-        if text is None:
-            text_ids = (prompt_ids + out) if echo else out
-            text_val = tok.decode(text_ids) if tok is not None else ""
-            finish = "length" if len(out) >= max_tokens else "stop"
-        else:
-            # host-matched stop truncation: the scanner's text IS the
-            # completion (a tokenizer is guaranteed on this path, so the
-            # tokens extension below never applies); echo prepends the
-            # decoded prompt
-            text_val = (tok.decode(prompt_ids) + text) if echo else text
-        lp_list = logprobs
-        lp_ids = out
-        if prompt_lps is not None:
-            lp_list = prompt_lps + (logprobs or [])
-            lp_ids = prompt_ids + out
-        lp_obj = None
-        if lp_list is not None:
-            lp_obj = _logprobs_obj(
-                tok, lp_list, lp_ids, tops, top_n,
-                prompt_positions=len(prompt_ids) if prompt_lps is not None
-                else 0,
-            )
-        choice: dict[str, Any] = {
-            "text": text_val,
-            "index": i,
-            "finish_reason": finish,
-            "logprobs": lp_obj,
-        }
-        if tok is None:
-            choice["tokens"] = (prompt_ids + out) if echo else out
-        choices.append(choice)
-    from gofr_tpu.http.response import Raw
-
-    # OpenAI clients expect the completion object at the top level, not
-    # inside this framework's {"data": ...} envelope
-    return Raw({
-        "id": cmpl_id,
-        "object": "text_completion",
-        "created": created,
-        "model": model,
-        "choices": choices,
-        "usage": {
-            "prompt_tokens": len(prompt_ids),
-            "completion_tokens": generated,
-            "total_tokens": len(prompt_ids) + generated,
-        },
-    })
-
-
-def chat_completions(ctx: Any) -> Any:
-    """Messages -> assistant message. Same generation core as
-    ``completions``; only the prompt construction (chat template) and the
-    response shapes (chat.completion / chat.completion.chunk with deltas)
-    differ."""
-    (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs, top_n,
-     adapter) = _parse_request(ctx, default_max=64)
-    tok = ctx.tpu.tokenizer
-    if tok is None:
-        raise HTTPError(
-            400, "chat completions need a tokenizer (set TOKENIZER_PATH)"
-        )
-    prompt_text = render_chat_prompt(ctx, body.get("messages"))
-    prompt_ids = tok.encode(prompt_text)
-    if not prompt_ids:
-        raise HTTPError(400, "messages encoded to zero tokens")
-    model = adapter or ctx.tpu.model_name  # adapters serve under their name
-    created = int(time.time())
-    chat_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
-
-    n, _, _ = _parse_fanout(body, allow_best_of=False)
-    if top_n and stop_strs:
-        raise HTTPError(
-            400, "top-logprob alternatives with multi-token stop "
-            'sequences are not supported; use "stop_token_ids"'
-        )
-
-    if body.get("stream"):
-        if n > 1:
-            raise HTTPError(
-                400, 'streaming with "n" > 1 is not supported '
-                "(interleaved multi-index SSE)"
-            )
-        if top_n:
-            raise HTTPError(
-                400, "top-logprob alternatives are not supported when "
-                "streaming; drop \"stream\" or request chosen-token "
-                "logprobs only"
-            )
-        import json as _json
-
-        from gofr_tpu.http.response import Stream
-
-        stream_iter = ctx.tpu.generate_stream(
-            prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
-            adapter=adapter, logprobs=want_logprobs,
-        )
-
-        def chunk(delta: dict, finish: Any = None, lp: Any = None,
-                  token_id: Any = None) -> str:
-            choice: dict[str, Any] = {
-                "index": 0, "delta": delta, "finish_reason": finish,
-            }
-            if want_logprobs:
-                if lp is not None and token_id is not None:
-                    e = _chat_lp_entry(tok, token_id, lp)
-                    e["top_logprobs"] = []  # alternatives reject with stream
-                    choice["logprobs"] = {
-                        # the modern chat shape stock SDKs parse, plus
-                        # the legacy field this server has always sent
-                        "content": [e],
-                        "token_logprobs": [lp],
-                    }
-                else:
-                    choice["logprobs"] = None
-            return _json.dumps({
-                "id": chat_id, "object": "chat.completion.chunk",
-                "created": created, "model": model, "choices": [choice],
-            })
-
-        def events():
-            emitted = 0
-            finish = None
-            dec = tok.stream_decoder()
-            scan = _StopScanner(stop_strs) if stop_strs else None
-            yield chunk({"role": "assistant"})  # role arrives first
-            try:
-                for item in stream_iter:
-                    token, lp = item if want_logprobs else (item, None)
-                    emitted += 1
-                    text = dec.feed(token)
-                    if scan is not None:
-                        text, done = scan.feed(text)
-                        if done:
-                            if text:
-                                # no lp: the matched token's text is
-                                # excluded from the stream
-                                yield chunk({"content": text})
-                            finish = "stop"
-                            break
-                    if text or lp is not None:
-                        yield chunk({"content": text}, lp=lp, token_id=token)
-                tail = dec.flush()
-                if finish is None:
-                    if scan is not None:
-                        tail, done = scan.feed(tail)
-                        if done:
-                            finish = "stop"
-                        else:
-                            tail += scan.flush()
-                    if finish is None:
-                        finish = "length" if emitted >= max_tokens else "stop"
-                else:
-                    tail = ""
-                if tail:
-                    yield chunk({"content": tail})
-                yield chunk({}, finish)
-                yield "[DONE]"
-            except Exception as exc:
-                yield _json.dumps({"error": {"message": str(exc)}})
-            finally:
-                stream_iter.close()  # no-op if already exhausted
-
-        return Stream(events())
-
-    results, generated = _fanout_generate(
-        ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
-        want_logprobs, top_n, adapter, n, n,
-    )
-    from gofr_tpu.http.response import Raw
-
-    choices = [
-        {
-            "index": i,
-            "message": {
-                "role": "assistant",
-                "content": text if text is not None else tok.decode(out),
-            },
-            "finish_reason": (
-                finish if finish is not None
-                else ("length" if len(out) >= max_tokens else "stop")
-            ),
-            "logprobs": (
-                _chat_logprobs_obj(tok, logprobs, out, tops, top_n)
-                if logprobs is not None else None
-            ),
-        }
-        for i, (out, logprobs, tops, text, finish) in enumerate(results)
-    ]
-    return Raw({
-        "id": chat_id,
-        "object": "chat.completion",
-        "created": created,
-        "model": model,
-        "choices": choices,
-        "usage": {
-            "prompt_tokens": len(prompt_ids),
-            "completion_tokens": generated,
-            "total_tokens": len(prompt_ids) + generated,
-        },
-    })
+from gofr_tpu.openai import (  # noqa: F401
+    chat_completions,
+    completions,
+    embeddings,
+    list_models,
+    register_openai_routes,
+    render_chat_prompt,
+)
+from gofr_tpu.openai.fanout import (  # noqa: F401
+    _consume_stream,
+    _fanout_generate,
+)
+from gofr_tpu.openai.logprobs import (  # noqa: F401
+    _chat_logprobs_obj,
+    _chat_lp_entry,
+    _logprobs_obj,
+)
+from gofr_tpu.openai.parse import (  # noqa: F401
+    _FANOUT_CAP,
+    _StopScanner,
+    _parse_fanout,
+    _parse_request,
+    _parse_stops,
+    _prompt_tokens,
+    _sampler,
+)
+from gofr_tpu.openai.template import (  # noqa: F401
+    DEFAULT_CHAT_TEMPLATE,
+    _chat_template,
+    _compiled_jinja,
+    _jinja_template_source,
+    _render_jinja,
+    _resolve_jinja_source,
+)
+
+__all__ = [
+    "register_openai_routes",
+    "completions",
+    "chat_completions",
+    "embeddings",
+    "list_models",
+    "render_chat_prompt",
+    "DEFAULT_CHAT_TEMPLATE",
+]

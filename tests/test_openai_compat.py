@@ -845,3 +845,68 @@ def test_logit_bias_honored(base):
     status, body = _post(base, {"prompt": [1, 2], "max_tokens": None,
                                 "temperature": 0})
     assert status == 200 and body["usage"]["completion_tokens"] >= 1
+
+
+def test_text_offset_in_logprobs(chat_base):
+    """Completions logprobs carry text_offset — each token's character
+    start within the choice text (eval harnesses locate the prompt/
+    continuation boundary with it under echo)."""
+    prompt = "hi there"
+    status, body = _post(chat_base, {"prompt": prompt, "max_tokens": 4,
+                                     "temperature": 0, "echo": True,
+                                     "logprobs": 1})
+    assert status == 200
+    lp = body["choices"][0]["logprobs"]
+    toks, offs = lp["tokens"], lp["text_offset"]
+    text = body["choices"][0]["text"]
+    assert len(offs) == len(toks) == len(lp["token_logprobs"])
+    # offsets index into the choice text: start at 0, never decrease,
+    # never pass the end (they come from the STREAM decoder, so they stay
+    # correct even when generated byte tokens are UTF-8 fragments whose
+    # per-token decode would be U+FFFD)
+    assert offs[0] == 0
+    assert all(a <= b for a, b in zip(offs, offs[1:]))
+    assert all(o <= len(text) for o in offs)
+    # THE property eval harnesses rely on under echo: the first
+    # continuation token's offset is exactly the prompt/continuation
+    # boundary (the byte tokenizer maps the ASCII prompt 1:1)
+    assert offs[len(prompt)] == len(prompt)
+    assert text.startswith(prompt)
+    # the echoed-ASCII prefix tiles exactly
+    assert [o for o in offs[: len(prompt)]] == list(range(len(prompt)))
+    # tokenizer-less deployments still emit the field (stringified ids)
+    # — typed clients treat the completions logprobs shape as fixed
+
+
+def test_unknown_model_404_and_toggle(tmp_path_factory):
+    """An unknown "model" is a 404 (the r04 breaking change) unless
+    OPENAI_ACCEPT_UNKNOWN_MODEL restores the legacy accept-anything
+    routing, which serves the base model."""
+    import os
+
+    app = _make_app(tmp_path_factory, "openai-anymodel")
+    # EnvConfig reads the LIVE environment per get(), and _make_app
+    # restores env right after construction — the toggle must stay set
+    # while requests run (the ADMIN_TOKEN tests use the same pattern)
+    old = os.environ.get("OPENAI_ACCEPT_UNKNOWN_MODEL")
+    os.environ["OPENAI_ACCEPT_UNKNOWN_MODEL"] = "1"
+    try:
+        url = f"http://127.0.0.1:{app.http_port}"
+        status, body = _post(url, {"model": "gpt-4o", "prompt": [1, 2, 3],
+                                   "max_tokens": 2, "temperature": 0})
+        assert status == 200
+        assert body["model"] == "tiny"  # served as the base, honestly named
+    finally:
+        if old is None:
+            os.environ.pop("OPENAI_ACCEPT_UNKNOWN_MODEL", None)
+        else:
+            os.environ["OPENAI_ACCEPT_UNKNOWN_MODEL"] = old
+        app.shutdown()
+
+
+def test_unknown_model_404_default(base):
+    try:
+        _post(base, {"model": "gpt-4o", "prompt": [1, 2], "max_tokens": 2})
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404 and "gpt-4o" in e.read(300).decode()
