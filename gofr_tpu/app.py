@@ -143,12 +143,18 @@ class App:
         self.router.add("GET", "/favicon.ico", make_endpoint(favicon_handler, self.container))
         self.router.add("GET", "/metrics", make_endpoint(metrics_handler, self.container))
         # device profiler admin surface (off the serving hot path)
-        self.router.add("GET", "/admin/profiler", make_endpoint(profiler_status_handler, self.container))
-        self.router.add("POST", "/admin/profiler/start", make_endpoint(profiler_start_handler, self.container))
-        self.router.add("POST", "/admin/profiler/stop", make_endpoint(profiler_stop_handler, self.container))
-        self.router.add("GET", "/admin/adapters", make_endpoint(adapters_list_handler, self.container))
-        self.router.add("POST", "/admin/adapters", make_endpoint(adapter_load_handler, self.container))
-        self.router.add("DELETE", "/admin/adapters/{name}", make_endpoint(adapter_unload_handler, self.container))
+        self.router.add("GET", "/admin/profiler",
+                        make_endpoint(profiler_status_handler, self.container))
+        self.router.add("POST", "/admin/profiler/start",
+                        make_endpoint(profiler_start_handler, self.container))
+        self.router.add("POST", "/admin/profiler/stop",
+                        make_endpoint(profiler_stop_handler, self.container))
+        self.router.add("GET", "/admin/adapters",
+                        make_endpoint(adapters_list_handler, self.container))
+        self.router.add("POST", "/admin/adapters",
+                        make_endpoint(adapter_load_handler, self.container))
+        self.router.add("DELETE", "/admin/adapters/{name}",
+                        make_endpoint(adapter_unload_handler, self.container))
         self.router.set_not_found(make_endpoint(catch_all_handler, self.container))
 
     def run(self) -> None:

@@ -318,7 +318,9 @@ class GRPCServer:
         self.server.stop(grace)
 
 
-def _abort_for_error(container: Any, context: grpc.ServicerContext, method: str, exc: Exception) -> None:
+def _abort_for_error(
+    container: Any, context: grpc.ServicerContext, method: str, exc: Exception
+) -> None:
     """Shared error→status policy for unary and streaming JSON handlers:
     typed errors surface their message on the mapped status; unexpected
     errors are logged server-side and masked as INTERNAL."""

@@ -58,7 +58,8 @@ def make_endpoint(func: Handler, container: Any) -> Callable:
         if error is not None and not hasattr(error, "status_code"):
             # unknown errors are 500s; log them (parity with the reference's
             # responder hiding internals behind a generic message)
-            container.logger.errorf("handler error on %s %s: %r", request.method, request.path, error)
+            container.logger.errorf("handler error on %s %s: %r",
+                                    request.method, request.path, error)
         return respond(result, error, executor=container.handler_executor)
 
     return endpoint

@@ -98,7 +98,8 @@ def logging_middleware(logger: Any) -> Any:
             except Exception:
                 # Panic recovery: JSON 500 + stack trace log (logger.go:91-114).
                 logger.error(
-                    {"error": "panic recovered", "stack": traceback.format_exc(), "trace_id": trace_id}
+                    {"error": "panic recovered",
+                     "stack": traceback.format_exc(), "trace_id": trace_id}
                 )
                 response = Response(
                     status=500,

@@ -84,7 +84,8 @@ class Router:
         if allowed:
             return Response(
                 status=405,
-                headers={"Allow": ", ".join(sorted(set(allowed))), "Content-Type": "application/json"},
+                headers={"Allow": ", ".join(sorted(set(allowed))),
+                         "Content-Type": "application/json"},
                 body=b'{"error":{"message":"method not allowed"}}',
             )
         if self._not_found is not None:

@@ -158,7 +158,8 @@ class HTTPServer:
                 await self._write_simple(writer, exc.status, exc.body)
                 return False
             except asyncio.TimeoutError:
-                await self._write_simple(writer, 408, b'{"error":{"message":"body read timed out"}}')
+                await self._write_simple(
+                    writer, 408, b'{"error":{"message":"body read timed out"}}')
                 return False
         else:
             length = headers.get("content-length")
@@ -166,10 +167,12 @@ class HTTPServer:
                 try:
                     n = int(length)
                 except ValueError:
-                    await self._write_simple(writer, 400, b'{"error":{"message":"bad content-length"}}')
+                    await self._write_simple(
+                        writer, 400, b'{"error":{"message":"bad content-length"}}')
                     return False
                 if n > MAX_BODY_BYTES:
-                    await self._write_simple(writer, 413, b'{"error":{"message":"payload too large"}}')
+                    await self._write_simple(
+                        writer, 413, b'{"error":{"message":"payload too large"}}')
                     return False
                 if n:
                     try:

@@ -74,7 +74,9 @@ def init_moe(key: jax.Array, cfg: MoEConfig) -> dict:
                 "router": dense(next(keys), (cfg.dim, cfg.n_experts), cfg.dim).astype(jnp.float32),
                 "w_gate": dense(next(keys), (cfg.n_experts, cfg.dim, cfg.hidden_dim), cfg.dim),
                 "w_up": dense(next(keys), (cfg.n_experts, cfg.dim, cfg.hidden_dim), cfg.dim),
-                "w_down": dense(next(keys), (cfg.n_experts, cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
+                "w_down": dense(next(keys),
+                                (cfg.n_experts, cfg.hidden_dim, cfg.dim),
+                                cfg.hidden_dim),
             }
         )
     params["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)

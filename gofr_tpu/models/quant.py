@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 
 # weight names eligible for quantization (2-D matmul weights used via mm())
-_QUANT_KEYS = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head", "wqkv", "w_in", "w_out"}
+_QUANT_KEYS = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head",
+               "wqkv", "w_in", "w_out"}
 
 
 def moe_skip_keys(tree: dict) -> frozenset:

@@ -110,13 +110,16 @@ class HTTPService:
     async def async_get(self, path: str, params: Optional[dict] = None) -> ServiceResponse:
         return await self._offload(self.get, path, params)
 
-    async def async_post(self, path: str, params: Optional[dict] = None, body: Any = None) -> ServiceResponse:
+    async def async_post(self, path: str, params: Optional[dict] = None,
+                         body: Any = None) -> ServiceResponse:
         return await self._offload(self.post, path, params, body)
 
-    async def async_put(self, path: str, params: Optional[dict] = None, body: Any = None) -> ServiceResponse:
+    async def async_put(self, path: str, params: Optional[dict] = None,
+                        body: Any = None) -> ServiceResponse:
         return await self._offload(self.put, path, params, body)
 
-    async def async_patch(self, path: str, params: Optional[dict] = None, body: Any = None) -> ServiceResponse:
+    async def async_patch(self, path: str, params: Optional[dict] = None,
+                          body: Any = None) -> ServiceResponse:
         return await self._offload(self.patch, path, params, body)
 
     async def async_delete(self, path: str, body: Any = None) -> ServiceResponse:

@@ -1617,43 +1617,9 @@ class _TransformerRunner:
         tops: list = []  # per token: [(alt_id, alt_lp) x TOP_LOGPROBS]
         presence = counts = bias_row = None
         if sampler.penalized:
-            # context presence penalizes the FIRST token too (greedy
-            # argmax included), so the device-argmaxed id is not usable;
-            # the additive presence/frequency penalties count GENERATED
-            # tokens only, so their counts row starts at zero here —
-            # logit_bias, by contrast, applies to every step including
-            # this first one
-            from gofr_tpu.ops.sampling import (
-                apply_penalties,
-                bias_row_from_map,
-                presence_from_tokens,
-                update_counts,
-                update_presence,
+            token, presence, counts, bias_row = self._penalized_first(
+                sampler, ids, state
             )
-
-            presence = presence_from_tokens(ids, self.cfg.vocab_size)
-            counts = jnp.zeros(presence.shape, jnp.float32)
-            if sampler.logit_bias:
-                try:
-                    bias_row = bias_row_from_map(
-                        sampler.logit_bias, self.cfg.vocab_size
-                    )
-                except ValueError as exc:
-                    from gofr_tpu.errors import InvalidParamError
-
-                    raise InvalidParamError(str(exc)) from None
-            else:
-                bias_row = jnp.zeros(presence.shape, jnp.float32)
-            logits_pen = apply_penalties(
-                jnp.asarray(state["logits"])[None, :], presence,
-                sampler.repetition_penalty, counts,
-                sampler.presence_penalty, sampler.frequency_penalty,
-                bias_row,
-            )
-            token = sampler.pick(logits_pen)
-            first = jnp.asarray([token])
-            presence = update_presence(presence, first)
-            counts = update_counts(counts, first)
         elif sampler.greedy:
             token = state["next_token"]  # device-argmaxed; no logits fetch
         else:
@@ -1669,23 +1635,7 @@ class _TransformerRunner:
             return _done()
         out.append(token)
         if logprobs:
-            # RAW model logprob of the first token. Chosen-only requests
-            # index on DEVICE and move one scalar (the [V] row transfer
-            # would sit on the TTFT path); only top_logprobs pays the
-            # full-row fetch, and argpartition beats a full sort for 5
-            row_dev = jax.nn.log_softmax(
-                jnp.asarray(state["logits"]).astype(jnp.float32)
-            )
-            if top_logprobs:
-                from gofr_tpu.models.transformer import TOP_LOGPROBS
-
-                row = np.asarray(row_dev)
-                lps.append(float(row[token]))
-                part = np.argpartition(row, -TOP_LOGPROBS)[-TOP_LOGPROBS:]
-                top_ids = part[np.argsort(row[part])[::-1]]
-                tops.append([(int(i), float(row[i])) for i in top_ids])
-            else:
-                lps.append(float(row_dev[token]))
+            self._first_logprobs(state, token, top_logprobs, lps, tops)
         if on_token:
             # with logprobs, streaming consumers receive (token, logprob)
             on_token((token, lps[-1]) if logprobs else token)
@@ -1728,8 +1678,6 @@ class _TransformerRunner:
         if decode_pool is not None and not sampler.seeded:
             import queue as queue_mod
 
-            from gofr_tpu.tpu.decode_pool import DONE, PoolFailure
-
             penalty = None
             if presence is not None:
                 penalty = (
@@ -1755,46 +1703,49 @@ class _TransformerRunner:
                 slot_q = None  # pool saturated/closed -> solo decode below
             if slot_q is not None:
                 state = None
-                while True:
-                    item = slot_q.get()
-                    if item is DONE:
-                        break
-                    if isinstance(item, PoolFailure):
-                        raise item.exc
-                    for t in item:  # one burst list per decoded chunk
-                        if logprobs:
-                            t, lp, t_tops = t
-                            lps.append(lp)
-                            if top_logprobs and t_tops is not None:
-                                tops.append(t_tops)
-                        out.append(t)
-                        if on_token:
-                            on_token((t, lps[-1]) if logprobs else t)
-                        if stop is not None and stop.is_set():
-                            # emission stops HERE even though the pipelined
-                            # pool already queued more; the pool frees the
-                            # slot at its next delivery (it checks stop too)
-                            return _done()
+                self._consume_pool(
+                    slot_q, out, lps, tops, logprobs, top_logprobs,
+                    on_token, stop,
+                )
                 return _done()
-        # chunked decode: N steps + on-device sampling per dispatch, one
-        # [1, N] fetch per chunk — the round trip, not the matmuls, bounds
-        # tokens/sec on remote-attached devices. Length is tracked on the
-        # HOST (prompt length + emitted count): reading cache["lengths"]
-        # back every step would cost a round trip per token.
-        #
-        # PIPELINED: the feed-forward token stays on device (the next
-        # chunk's input is this chunk's last sampled column), so chunk N+1
-        # dispatches before chunk N's tokens are fetched — the fetch
-        # overlaps the next chunk's compute instead of idling the device
-        # one round trip per chunk. Stop conditions lag by at most one
-        # speculative chunk, whose results are simply abandoned.
-        from collections import deque
-
         cache = state["cache"]
         # cache holds exactly the prompt; each decode step writes one more
         # position, so the write head sits at cache_len
         cache_len = state["length"]
         state = None  # release the full-batch prefill buffers
+        self._solo_decode(
+            prm, cache, cache_len, token, out, lps, tops, max_new_tokens,
+            sampler, stop, stop_tokens, on_token, logprobs, top_logprobs,
+            presence, counts, bias_row,
+        )
+        return _done()
+
+    def _solo_decode(
+        self, prm: Any, cache: Any, cache_len: int, token: int, out: list,
+        lps: list, tops: list, max_new_tokens: int, sampler: Any,
+        stop: Any, stop_tokens: frozenset, on_token: Any, logprobs: bool,
+        top_logprobs: bool, presence: Any, counts: Any, bias_row: Any,
+    ) -> None:
+        """The solo chunked-decode tail of generate(): pipelined
+        N-step dispatches with on-device sampling, host-side stop
+        handling, and optional penalties/logprobs state threading.
+        Mutates out/lps/tops in place (the caller drops its prefill
+        state BEFORE calling, so the full-batch buffers release).
+
+        Chunked decode: N steps + on-device sampling per dispatch, one
+        [1, N] fetch per chunk — the round trip, not the matmuls, bounds
+        tokens/sec on remote-attached devices. Length is tracked on the
+        HOST (prompt length + emitted count): reading cache["lengths"]
+        back every step would cost a round trip per token.
+
+        PIPELINED: the feed-forward token stays on device (the next
+        chunk's input is this chunk's last sampled column), so chunk N+1
+        dispatches before chunk N's tokens are fetched — the fetch
+        overlaps the next chunk's compute instead of idling the device
+        one round trip per chunk. Stop conditions lag by at most one
+        speculative chunk, whose results are simply abandoned."""
+        from collections import deque
+
         max_len = int(cache["k"].shape[2])
         temp, tk, tp = sampler.temperature, sampler.top_k, sampler.top_p
         mp = sampler.min_p
@@ -1873,7 +1824,6 @@ class _TransformerRunner:
                     break
             if len(out) >= max_new_tokens:
                 stopped = True
-        return _done()
 
     def _can_chunk_prefill(self) -> bool:
         """Chunked prefill builds a [1]-row cache; under a mesh that only
@@ -1910,6 +1860,100 @@ class _TransformerRunner:
             "next_token": int(np.asarray(next_ids)[0]),
             "logits": logits[0],
         }
+
+    def _penalized_first(
+        self, sampler: Any, ids: np.ndarray, state: Any
+    ) -> tuple:
+        """First-token pick under penalties -> (token, presence, counts,
+        bias_row). Context presence penalizes the FIRST token too (greedy
+        argmax included), so the device-argmaxed id is not usable; the
+        additive presence/frequency penalties count GENERATED tokens only,
+        so their counts row starts at zero here — logit_bias, by contrast,
+        applies to every step including this first one."""
+        from gofr_tpu.ops.sampling import (
+            apply_penalties,
+            bias_row_from_map,
+            presence_from_tokens,
+            update_counts,
+            update_presence,
+        )
+
+        presence = presence_from_tokens(ids, self.cfg.vocab_size)
+        counts = jnp.zeros(presence.shape, jnp.float32)
+        if sampler.logit_bias:
+            try:
+                bias_row = bias_row_from_map(
+                    sampler.logit_bias, self.cfg.vocab_size
+                )
+            except ValueError as exc:
+                from gofr_tpu.errors import InvalidParamError
+
+                raise InvalidParamError(str(exc)) from None
+        else:
+            bias_row = jnp.zeros(presence.shape, jnp.float32)
+        logits_pen = apply_penalties(
+            jnp.asarray(state["logits"])[None, :], presence,
+            sampler.repetition_penalty, counts,
+            sampler.presence_penalty, sampler.frequency_penalty,
+            bias_row,
+        )
+        token = sampler.pick(logits_pen)
+        first = jnp.asarray([token])
+        return (
+            token, update_presence(presence, first),
+            update_counts(counts, first), bias_row,
+        )
+
+    def _first_logprobs(
+        self, state: Any, token: int, top_logprobs: bool,
+        lps: list, tops: list,
+    ) -> None:
+        """Append the first token's RAW model logprob (and, opt-in, its
+        top-k alternatives). Chosen-only requests index on DEVICE and move
+        one scalar (the [V] row transfer would sit on the TTFT path); only
+        top_logprobs pays the full-row fetch, and argpartition beats a
+        full sort for 5."""
+        row_dev = jax.nn.log_softmax(
+            jnp.asarray(state["logits"]).astype(jnp.float32)
+        )
+        if top_logprobs:
+            from gofr_tpu.models.transformer import TOP_LOGPROBS
+
+            row = np.asarray(row_dev)
+            lps.append(float(row[token]))
+            part = np.argpartition(row, -TOP_LOGPROBS)[-TOP_LOGPROBS:]
+            top_ids = part[np.argsort(row[part])[::-1]]
+            tops.append([(int(i), float(row[i])) for i in top_ids])
+        else:
+            lps.append(float(row_dev[token]))
+
+    def _consume_pool(
+        self, slot_q: Any, out: list, lps: list, tops: list,
+        logprobs: bool, top_logprobs: bool, on_token: Any, stop: Any,
+    ) -> None:
+        """Drain a decode-pool slot queue into out/lps/tops, re-raising a
+        worker failure and honoring caller cancellation (emission stops
+        immediately; the pool frees the slot at its next delivery — it
+        checks stop too)."""
+        from gofr_tpu.tpu.decode_pool import DONE, PoolFailure
+
+        while True:
+            item = slot_q.get()
+            if item is DONE:
+                return
+            if isinstance(item, PoolFailure):
+                raise item.exc
+            for t in item:  # one burst list per decoded chunk
+                if logprobs:
+                    t, lp, t_tops = t
+                    lps.append(lp)
+                    if top_logprobs and t_tops is not None:
+                        tops.append(t_tops)
+                out.append(t)
+                if on_token:
+                    on_token((t, lps[-1]) if logprobs else t)
+                if stop is not None and stop.is_set():
+                    return
 
     def _prefix_lookup(self, ids: np.ndarray) -> Optional[dict]:
         """Prompt lookup -> a private state (copied cache row; shared

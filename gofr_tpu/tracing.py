@@ -169,7 +169,10 @@ class ZipkinExporter:
                         break
                     if extra is not None:
                         batch.append(extra)
-            if batch and (len(batch) >= self.max_batch or time.monotonic() >= deadline or not running):
+            if batch and (
+                len(batch) >= self.max_batch
+                or time.monotonic() >= deadline or not running
+            ):
                 self._post(batch)
                 batch = []
             if time.monotonic() >= deadline:

@@ -25,7 +25,8 @@ class User:
 def db():
     logger = MockLogger(Level.DEBUG)
     database = DB(":memory:", logger)
-    database.execute("CREATE TABLE users (id INTEGER PRIMARY KEY, full_name TEXT, mail TEXT, junk TEXT)")
+    database.execute("CREATE TABLE users (id INTEGER PRIMARY KEY,"
+                     " full_name TEXT, mail TEXT, junk TEXT)")
     database.execute_many(
         "INSERT INTO users (id, full_name, mail, junk) VALUES (?, ?, ?, ?)",
         [(1, "Ada Lovelace", "ada@x.io", "z"), (2, "Alan Turing", "alan@x.io", "z")],

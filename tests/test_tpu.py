@@ -128,7 +128,8 @@ def test_mlp_infer(mlp_device):
 def test_mlp_infer_batched_concurrently(mlp_device):
     results = [None] * 6
     threads = [
-        threading.Thread(target=lambda i=i: results.__setitem__(i, mlp_device.infer([float(i)] * 64)))
+        threading.Thread(target=lambda i=i: results.__setitem__(
+            i, mlp_device.infer([float(i)] * 64)))
         for i in range(6)
     ]
     for t in threads:
