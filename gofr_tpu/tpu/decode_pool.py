@@ -82,12 +82,13 @@ class _Request:
 
     __slots__ = (
         "out_queue", "remaining", "cache_len", "stop", "stop_tokens",
-        "finished", "want_lp", "want_top",
+        "finished", "want_lp", "want_top", "want_kv",
     )
 
     def __init__(self, out_queue: "queue.Queue", remaining: int, cache_len: int,
                  stop: Optional[threading.Event], stop_tokens: frozenset,
-                 want_lp: bool = False, want_top: bool = False):
+                 want_lp: bool = False, want_top: bool = False,
+                 want_kv: bool = False):
         self.out_queue: Optional[queue.Queue] = out_queue
         self.remaining = remaining
         self.cache_len = cache_len
@@ -99,6 +100,10 @@ class _Request:
         # pick the delivery shape and gate the top-k fetch
         self.want_lp = want_lp
         self.want_top = want_top
+        # hand the slot's KV row back at finish (("kv", row) precedes
+        # DONE): the device stores it in the prefix cache so a follow-up
+        # turn reuses the WHOLE conversation's KV
+        self.want_kv = want_kv
 
 
 class _Slot:
@@ -223,6 +228,17 @@ class DecodePool:
             donate_argnums=(0,),
             out_shardings=repl,
         )
+
+        def read_slot(pool: dict, i) -> dict:
+            # COPY, not a view: the pool cache is donated into every later
+            # chunk dispatch; a handed-back row must own its buffers
+            return {
+                "k": jnp.copy(jax.lax.dynamic_slice_in_dim(pool["k"], i, 1, axis=1)),
+                "v": jnp.copy(jax.lax.dynamic_slice_in_dim(pool["v"], i, 1, axis=1)),
+                "lengths": jnp.copy(jax.lax.dynamic_slice(pool["lengths"], (i,), (1,))),
+            }
+
+        self._read_slot = jax.jit(read_slot)
         self._slots = [_Slot(i) for i in range(n_slots)]
         self._free = list(reversed(self._slots))
         self._active: dict[int, _Slot] = {}
@@ -282,6 +298,9 @@ class DecodePool:
             jnp.asarray(self._min_ps),
         )
         toks.block_until_ready()
+        # warm the finish-time row read too (prefix-cache hand-back): it
+        # must never compile on the serving path
+        self._read_slot(self.cache, 0)["lengths"].block_until_ready()
         self.cache = self._place(init_cache(cfg, n_slots))  # reset the warmup writes
         self._last_tokens = jnp.zeros((n_slots, 1), jnp.int32)
         if penalties == "eager":
@@ -512,6 +531,7 @@ class DecodePool:
         want_logprobs: bool = False,
         want_top_logprobs: bool = False,
         adapter: Optional[str] = None,
+        want_kv: bool = False,
     ) -> "queue.Queue":
         """Claim a slot for a prefilled request; returns the queue its
         decoded token ids (then DONE) arrive on. Raises queue.Full when all
@@ -561,7 +581,8 @@ class DecodePool:
             slot.request = _Request(out, max_new, start_len, stop,
                                     frozenset(stop_tokens or ()),
                                     want_lp=want_logprobs,
-                                    want_top=want_top_logprobs)
+                                    want_top=want_top_logprobs,
+                                    want_kv=want_kv)
             if (
                 self._temps[slot.index] != sampler.temperature
                 or self._top_ks[slot.index] != sampler.top_k
@@ -808,6 +829,22 @@ class DecodePool:
                 or req.cache_len >= self.max_len
             ):
                 req.finished = True
+                if (
+                    req.want_kv and not cancelled
+                    and req.out_queue is not None
+                    and self._slots[index].request is req
+                ):
+                    # hand the slot's KV row back before DONE so the
+                    # device can seed its prefix cache with the WHOLE
+                    # conversation. Enqueued under the pool lock: the
+                    # copy is ordered before any later dispatch donates
+                    # the cache, and before any write_slot reuses the
+                    # row — the prefix positions it reads are final.
+                    # (Lockstep garbage decode only APPENDS past the
+                    # request's length; the device rolls the copy back.)
+                    req.out_queue.put(
+                        ("kv", self._read_slot(self.cache, index))
+                    )
                 if req.out_queue is not None:
                     req.out_queue.put(DONE)
                 req.out_queue = None

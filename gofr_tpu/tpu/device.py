@@ -1597,7 +1597,12 @@ class _TransformerRunner:
             )
         else:
             state = (
-                self._prefix_lookup(ids)
+                self._prefix_lookup(
+                    ids,
+                    need_logits=(
+                        logprobs or sampler.penalized or not sampler.greedy
+                    ),
+                )
                 if self._prefix_cache is not None else None
             )
             if state is None:
@@ -1675,6 +1680,14 @@ class _TransformerRunner:
         # the pool's stacked bank (per-slot adapter selection); the pool
         # rejects them — and they solo — while the bank is off,
         # rebuilding, mesh-disabled, or a penalized slot is active.
+        # seed the prefix cache with the finish-time conversation KV (base
+        # requests on an unsharded-batch cache): a follow-up turn then
+        # reuses the WHOLE conversation's KV. ONE predicate for the
+        # pooled and solo paths — they must never drift
+        seed_kv = (
+            self._prefix_cache is not None and adapter is None
+            and self._can_chunk_prefill()
+        )
         if decode_pool is not None and not sampler.seeded:
             import queue as queue_mod
 
@@ -1691,7 +1704,7 @@ class _TransformerRunner:
                     max_new_tokens - 1, sampler, stop,
                     stop_tokens=stop_tokens, penalty=penalty,
                     want_logprobs=logprobs, want_top_logprobs=top_logprobs,
-                    adapter=adapter,
+                    adapter=adapter, want_kv=seed_kv,
                 )
             except (queue_mod.Full, RuntimeError) as exc:
                 from gofr_tpu.tpu.decode_pool import _POOL_DEBUG
@@ -1703,21 +1716,27 @@ class _TransformerRunner:
                 slot_q = None  # pool saturated/closed -> solo decode below
             if slot_q is not None:
                 state = None
-                self._consume_pool(
+                kv_row = self._consume_pool(
                     slot_q, out, lps, tops, logprobs, top_logprobs,
                     on_token, stop,
                 )
+                if kv_row is not None:
+                    self._prefix_store_generation(ids, out, kv_row, sampler)
                 return _done()
         cache = state["cache"]
         # cache holds exactly the prompt; each decode step writes one more
         # position, so the write head sits at cache_len
         cache_len = state["length"]
         state = None  # release the full-batch prefill buffers
-        self._solo_decode(
+        cache = self._solo_decode(
             prm, cache, cache_len, token, out, lps, tops, max_new_tokens,
             sampler, stop, stop_tokens, on_token, logprobs, top_logprobs,
             presence, counts, bias_row,
         )
+        if seed_kv:
+            # same conversation-KV seeding as the pooled path (the solo
+            # final cache is private and no longer needed — donated)
+            self._prefix_store_generation(ids, out, cache, sampler)
         return _done()
 
     def _solo_decode(
@@ -1725,12 +1744,14 @@ class _TransformerRunner:
         lps: list, tops: list, max_new_tokens: int, sampler: Any,
         stop: Any, stop_tokens: frozenset, on_token: Any, logprobs: bool,
         top_logprobs: bool, presence: Any, counts: Any, bias_row: Any,
-    ) -> None:
+    ) -> Any:
         """The solo chunked-decode tail of generate(): pipelined
         N-step dispatches with on-device sampling, host-side stop
         handling, and optional penalties/logprobs state threading.
         Mutates out/lps/tops in place (the caller drops its prefill
-        state BEFORE calling, so the full-batch buffers release).
+        state BEFORE calling, so the full-batch buffers release) and
+        returns the final cache (every dispatched chunk's writes landed
+        — the caller may seed the prefix cache from it).
 
         Chunked decode: N steps + on-device sampling per dispatch, one
         [1, N] fetch per chunk — the round trip, not the matmuls, bounds
@@ -1824,6 +1845,7 @@ class _TransformerRunner:
                     break
             if len(out) >= max_new_tokens:
                 stopped = True
+        return cache
 
     def _can_chunk_prefill(self) -> bool:
         """Chunked prefill builds a [1]-row cache; under a mesh that only
@@ -1930,19 +1952,24 @@ class _TransformerRunner:
     def _consume_pool(
         self, slot_q: Any, out: list, lps: list, tops: list,
         logprobs: bool, top_logprobs: bool, on_token: Any, stop: Any,
-    ) -> None:
+    ) -> Optional[dict]:
         """Drain a decode-pool slot queue into out/lps/tops, re-raising a
         worker failure and honoring caller cancellation (emission stops
         immediately; the pool frees the slot at its next delivery — it
-        checks stop too)."""
+        checks stop too). Returns the finish-time KV row when the submit
+        asked for one (("kv", row) precedes DONE), else None."""
         from gofr_tpu.tpu.decode_pool import DONE, PoolFailure
 
+        kv_row = None
         while True:
             item = slot_q.get()
             if item is DONE:
-                return
+                return kv_row
             if isinstance(item, PoolFailure):
                 raise item.exc
+            if isinstance(item, tuple) and item and item[0] == "kv":
+                kv_row = item[1]
+                continue
             for t in item:  # one burst list per decoded chunk
                 if logprobs:
                     t, lp, t_tops = t
@@ -1953,17 +1980,27 @@ class _TransformerRunner:
                 if on_token:
                     on_token((t, lps[-1]) if logprobs else t)
                 if stop is not None and stop.is_set():
-                    return
+                    return None  # cancelled: the row may still be mid-write
 
-    def _prefix_lookup(self, ids: np.ndarray) -> Optional[dict]:
+    def _prefix_lookup(
+        self, ids: np.ndarray, need_logits: bool = False
+    ) -> Optional[dict]:
         """Prompt lookup -> a private state (copied cache row; shared
         read-only logits) or None. Exact match skips prefill entirely;
         otherwise the entry sharing the longest common token prefix (of at
         least ``_prefix_lcp_min``) seeds a tail-only prefill. LRU order
-        updates on either kind of hit."""
+        updates on either kind of hit. ``need_logits``: the caller samples
+        or scores from the final-position logits — stored GENERATION
+        entries carry none, so they divert to the LCP tail-prefill (which
+        re-derives the logits) instead of exact-hitting."""
         key = ids.tobytes()
         with self._prefix_lock:
             entry = self._prefix_cache.get(key)
+            if entry is not None and (
+                (entry[3] is None and need_logits)
+                or entry[2] is None  # no trustworthy next_token stored
+            ):
+                entry = None
             if entry is not None:
                 self._prefix_cache.move_to_end(key)
                 self.prefix_stats["hits"] += 1
@@ -2040,6 +2077,45 @@ class _TransformerRunner:
         }
         self._prefix_store(ids, state)
         return state
+
+    def _prefix_store_generation(
+        self, ids: np.ndarray, out: list, row: Any, sampler: Any
+    ) -> None:
+        """Seed the prefix cache with the WHOLE conversation (prompt +
+        generated reply): a follow-up turn (prompt + reply + new message)
+        then LCP-hits everything already computed instead of re-prefilling
+        the conversation — the multi-turn chat shape. The final generated
+        token's KV may not be written yet (it was sampled but possibly
+        never fed back), so the entry covers prompt + out[:-1] with
+        out[-1] as its next_token — but ONLY when out[-1] is the plain
+        greedy continuation (unpenalized argmax): a sampled or
+        bias-warped token exact-served to a later greedy request would
+        break its bit-exactness vs a cache-off device, so such entries
+        store next_token=None and exact hits divert to the LCP
+        tail-prefill (KV reuse is token-content-determined and stays
+        valid either way). Stored generations carry no logits;
+        logits-needing lookups divert the same way. ``row`` must be
+        private (pool hand-back copy or the solo final cache) — its
+        write head is rolled back in place (donated)."""
+        if len(out) < 2 or self._prefix_cache is None:
+            return
+        full = np.concatenate(
+            [ids, np.asarray(out[:-1], np.int32)]
+        )
+        if full.size > self.cfg.max_seq:
+            return
+        entry_row = _cache_with_len(
+            row, jnp.asarray(int(full.size), jnp.int32)
+        )
+        exactable = sampler.greedy and not sampler.penalized
+        entry = (
+            entry_row, int(full.size),
+            int(out[-1]) if exactable else None, None,
+        )
+        with self._prefix_lock:
+            self._prefix_cache[full.tobytes()] = entry
+            while len(self._prefix_cache) > self._prefix_cache_size:
+                self._prefix_cache.popitem(last=False)
 
     def _prefix_store(self, ids: np.ndarray, state: Any) -> None:
         """Store this prompt's prefill result (copied row — the live row

@@ -165,6 +165,89 @@ def test_partial_hit_ratio_exposed(lcp):
     ), text
 
 
+# -- multi-turn conversation reuse -------------------------------------------
+# After a generation, the WHOLE conversation's KV (prompt + reply) is
+# stored; the follow-up turn (prompt + reply + new message) partial-hits
+# it and prefills only the new message.
+
+
+def test_multi_turn_conversation_reuse_pooled(lcp, plain):
+    turn1 = SYSTEM + [101, 102, 103]
+    reply = lcp.generate(turn1, max_new_tokens=8)
+    assert reply == plain.generate(turn1, max_new_tokens=8)
+    followup = turn1 + reply + [111, 112]
+    want = plain.generate(followup, max_new_tokens=6)
+    before = dict(lcp.runner.prefix_stats)
+    got = lcp.generate(followup, max_new_tokens=6)
+    assert got == want
+    # the conversation entry (len(turn1)+len(reply)-1 shared) was used
+    assert lcp.runner.prefix_stats["partial_hits"] == before["partial_hits"] + 1
+
+
+def test_multi_turn_conversation_reuse_solo():
+    with serving_device(
+        PREFIX_CACHE="4", PREFIX_LCP_MIN="4", DECODE_CHUNK="4",
+        DECODE_POOL="off",
+    ) as solo, serving_device(
+        PREFIX_CACHE="0", DECODE_CHUNK="4", DECODE_POOL="off"
+    ) as plain_solo:
+        turn1 = SYSTEM + [121, 122]
+        reply = solo.generate(turn1, max_new_tokens=6)
+        assert reply == plain_solo.generate(turn1, max_new_tokens=6)
+        followup = turn1 + reply + [131]
+        want = plain_solo.generate(followup, max_new_tokens=4)
+        before = dict(solo.runner.prefix_stats)
+        got = solo.generate(followup, max_new_tokens=4)
+        assert got == want
+        assert (
+            solo.runner.prefix_stats["partial_hits"]
+            == before["partial_hits"] + 1
+        )
+
+
+def test_generation_entry_exact_hit_greedy_and_sampled_divert(lcp, plain):
+    turn1 = SYSTEM + [141, 142, 143]
+    reply = lcp.generate(turn1, max_new_tokens=6)
+    conv_key = turn1 + reply[:-1]  # the stored generation entry's tokens
+    want = plain.generate(conv_key, max_new_tokens=4)
+    before = dict(lcp.runner.prefix_stats)
+    got = lcp.generate(conv_key, max_new_tokens=4)  # greedy: exact hit ok
+    assert got == want
+    assert lcp.runner.prefix_stats["hits"] == before["hits"] + 1
+    # a logprobs request needs final-position logits the stored
+    # generation lacks: it must DIVERT to the tail-prefill (partial hit)
+    # and still match a no-cache device
+    want_lp = plain.generate(conv_key, max_new_tokens=4, logprobs=True)
+    before = dict(lcp.runner.prefix_stats)
+    got_lp = lcp.generate(conv_key, max_new_tokens=4, logprobs=True)
+    assert got_lp[0] == want_lp[0]  # tokens bit-exact
+    # logprobs to float noise: the tail-prefill runs a [1, bucket] shape,
+    # the no-cache oracle a batched one — XLA reduces them differently
+    import numpy as np
+
+    np.testing.assert_allclose(got_lp[1], want_lp[1], rtol=1e-4, atol=1e-5)
+    after = lcp.runner.prefix_stats
+    assert after["hits"] == before["hits"]
+    assert after["partial_hits"] == before["partial_hits"] + 1
+
+
+def test_sampled_generation_entry_never_exact_serves_greedy(lcp, plain):
+    """A SAMPLED generation seeds the cache too (KV is token-content-
+    determined), but its next_token must never exact-serve a later
+    greedy request — that would emit a random token where the model's
+    argmax belongs. Such entries divert to the tail-prefill."""
+    turn1 = SYSTEM + [151, 152]
+    reply = lcp.generate(
+        turn1, max_new_tokens=6, sampler=Sampler(temperature=1.0)
+    )
+    conv_key = turn1 + reply[:-1]
+    want = plain.generate(conv_key, max_new_tokens=4)
+    before = dict(lcp.runner.prefix_stats)
+    got = lcp.generate(conv_key, max_new_tokens=4)
+    assert got == want  # greedy bit-exact despite the sampled-source entry
+    assert lcp.runner.prefix_stats["hits"] == before["hits"]  # diverted
+
+
 def test_below_off_lcp_min_rejected():
     # -1 is the documented off switch; anything below is a config error
     with pytest.raises(ValueError, match="PREFIX_LCP_MIN"):
