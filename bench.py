@@ -41,6 +41,11 @@ import urllib.error
 import urllib.request
 
 
+class _SkipPhase(Exception):
+    """Control-flow marker: a measurement phase that does not apply to
+    this model config (not an error; nothing lands in the errors list)."""
+
+
 def log(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
@@ -382,6 +387,13 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
 
         # -- phase: decode tok/s through the transport ------------------------
         try:
+            if getattr(app.container.tpu.runner, "decode_chunk_size", None) is None:
+                # encoder/MLP configs (BASELINE 1-2) have no decode loop
+                # (their generate() is a NotImplementedError guard);
+                # probing /generate anyway just pollutes the artifact's
+                # errors list with a 500 per run
+                log("decode phase skipped: model has no generate path")
+                raise _SkipPhase
             log(f"decode phase: {decode_streams} concurrent streams x "
                 f"{decode_tokens} tokens")
             result["decode_streams"] = decode_streams
@@ -394,6 +406,8 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
             )
             log(f"decode {result['decode_tok_per_sec']} tok/s "
                 f"(mfu {result['mfu_decode']} mbu {result['mbu_decode']})")
+        except _SkipPhase:
+            pass
         except Exception as exc:
             errors.append(f"decode phase: {_describe_http_error(exc)}")
             traceback.print_exc(file=sys.stderr)
