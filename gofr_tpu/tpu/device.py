@@ -156,28 +156,7 @@ class TPUDevice:
         from gofr_tpu.tokenizer import load_tokenizer
 
         self.tokenizer = load_tokenizer(config)
-        # default stop ids: EVERY generation ends at the checkpoint's EOS
-        # (OpenAI semantics — a real instruct model must not run past
-        # <|eot_id|> to max_tokens). Sources, best first: GEN_STOP_TOKENS
-        # (explicit ids), the checkpoint's generation_config.json
-        # eos_token_id (int or list) next to MODEL_PATH, the tokenizer's
-        # own eos. GEN_STOP_EOS=off disables.
-        self.default_stop_ids: frozenset = frozenset()
-        if config.get_or_default("GEN_STOP_EOS", "on") != "off":
-            explicit = config.get("GEN_STOP_TOKENS")
-            if explicit:
-                try:
-                    self.default_stop_ids = frozenset(
-                        int(t) for t in str(explicit).split(",") if t.strip()
-                    )
-                except ValueError:
-                    raise ValueError(
-                        "GEN_STOP_TOKENS must be comma-separated token ids"
-                    ) from None
-            else:
-                self.default_stop_ids = frozenset(
-                    _checkpoint_eos_ids(self.model_path, self.tokenizer)
-                )
+        self.default_stop_ids = self._resolve_default_stop_ids(config)
 
         # devices are NOT touched here: jax.devices() blocks on runtime
         # init, and on a wedged remote tunnel that would hang app
@@ -199,6 +178,63 @@ class TPUDevice:
         self.peak_flops = 0.0
         self.peak_hbm_bw = 0.0
 
+        self._init_metrics(metrics)
+
+        self._parse_serving_config(config)
+        self._last_reinit = 0.0
+        self._reinit_lock = threading.Lock()
+        # serializes adapter admin (load/unload + pool-bank rebuild):
+        # without it, two concurrent loads race their bank compiles and
+        # the LAST COMPILE TO FINISH — not the last call — would win,
+        # silently installing a stale bank
+        self._adapter_lock = threading.Lock()
+        # prefill MFU steady-state window (see _run_batch): completions
+        # arrive from the batcher's dispatch-pool threads
+        self._last_batch_done = 0.0
+        self._mfu_window_lock = threading.Lock()
+        # boot status: surfaced by /.well-known/ready and health details so
+        # a slow cold boot (8B-class warmup compiles) is observable, never
+        # indistinguishable from a hang
+        self.boot_status: dict[str, Any] = {"state": "booting", "detail": ""}
+        self._ready = threading.Event()
+        self._boot_error: Optional[BaseException] = None
+        # ValueError-class boot failures (mesh/bucket/config validation)
+        # are permanent: auto-reinit never retries them
+        self._boot_error_permanent = False
+        self._closed = False
+        if config.get_or_default("TPU_BOOT", "") == "background":
+            # serve /.well-known/ready (503 warming) while compiles run
+            threading.Thread(
+                target=self._boot, name="gofr-tpu-boot", daemon=True
+            ).start()
+        else:
+            self._boot()
+
+
+    def _resolve_default_stop_ids(self, config: Any) -> frozenset:
+        """Default stop ids: EVERY generation ends at the checkpoint's EOS
+        (OpenAI semantics — a real instruct model must not run past
+        <|eot_id|> to max_tokens). Sources, best first: GEN_STOP_TOKENS
+        (explicit ids), the checkpoint's generation_config.json
+        eos_token_id (int or list) next to MODEL_PATH, the tokenizer's
+        own eos. GEN_STOP_EOS=off disables."""
+        if config.get_or_default("GEN_STOP_EOS", "on") == "off":
+            return frozenset()
+        explicit = config.get("GEN_STOP_TOKENS")
+        if explicit:
+            try:
+                return frozenset(
+                    int(t) for t in str(explicit).split(",") if t.strip()
+                )
+            except ValueError:
+                raise ValueError(
+                    "GEN_STOP_TOKENS must be comma-separated token ids"
+                ) from None
+        return frozenset(
+            _checkpoint_eos_ids(self.model_path, self.tokenizer)
+        )
+
+    def _init_metrics(self, metrics: Any) -> None:
         self._requests = metrics.counter(
             "gofr_tpu_requests_total", "TPU inference requests", labels=("model", "op", "status")
         )
@@ -232,6 +268,11 @@ class TPUDevice:
             labels=("model",),
         )
 
+
+    def _parse_serving_config(self, config: Any) -> None:
+        """Config parsing + eager validation for every serving knob: a
+        typo must fail at construction, never minutes later behind a
+        background boot."""
         self._decode_chunk_cfg = int(config.get_or_default("DECODE_CHUNK", "8"))
         raw_max_seq = config.get("MODEL_MAX_SEQ")
         self._max_seq_cfg = int(raw_max_seq) if raw_max_seq else None
@@ -327,34 +368,6 @@ class TPUDevice:
             raise ValueError(
                 "DECODE_POOL_PENALTIES must be lazy, eager, or off"
             )
-        self._last_reinit = 0.0
-        self._reinit_lock = threading.Lock()
-        # serializes adapter admin (load/unload + pool-bank rebuild):
-        # without it, two concurrent loads race their bank compiles and
-        # the LAST COMPILE TO FINISH — not the last call — would win,
-        # silently installing a stale bank
-        self._adapter_lock = threading.Lock()
-        # prefill MFU steady-state window (see _run_batch): completions
-        # arrive from the batcher's dispatch-pool threads
-        self._last_batch_done = 0.0
-        self._mfu_window_lock = threading.Lock()
-        # boot status: surfaced by /.well-known/ready and health details so
-        # a slow cold boot (8B-class warmup compiles) is observable, never
-        # indistinguishable from a hang
-        self.boot_status: dict[str, Any] = {"state": "booting", "detail": ""}
-        self._ready = threading.Event()
-        self._boot_error: Optional[BaseException] = None
-        # ValueError-class boot failures (mesh/bucket/config validation)
-        # are permanent: auto-reinit never retries them
-        self._boot_error_permanent = False
-        self._closed = False
-        if config.get_or_default("TPU_BOOT", "") == "background":
-            # serve /.well-known/ready (503 warming) while compiles run
-            threading.Thread(
-                target=self._boot, name="gofr-tpu-boot", daemon=True
-            ).start()
-        else:
-            self._boot()
 
     def _probe_devices(self) -> None:
         """First touch of the device runtime (can block/fail on a wedged
@@ -2394,6 +2407,24 @@ class _TransformerRunner:
         if progress:
             progress("compiling decode step")
         one = _slice_cache(cache, 0)
+        self._warmup_prefix(progress, one)
+        self._warmup_adapters(progress)
+        step, _ = self._decode(self.params, jnp.zeros((1, 1), jnp.int32), one)
+        step.block_until_ready()
+        # warm the full decode chunk (remainder sizes compile on demand)
+        if progress:
+            progress(f"compiling decode chunk ({self.decode_chunk_size} steps)")
+        toks, _ = self._decode_chunk(
+            self.params, jnp.zeros((1, 1), jnp.int32), one,
+            jax.random.key(0), 0.0, 0, 1.0, 0.0, self.decode_chunk_size,
+        )
+        toks.block_until_ready()
+        self._warmup_spec(progress, one)
+
+    def _warmup_prefix(self, progress: Any, one: dict) -> None:
+        """Prefix-cache warm stage: the row copy and, under LCP, the
+        per-bucket tail prefills; probe entries purged so serving
+        starts empty."""
         if self._prefix_cache is not None:
             # prefix-cache row copies must not compile on the serving path
             self._copy_row(one)["lengths"].block_until_ready()
@@ -2417,6 +2448,10 @@ class _TransformerRunner:
                 with self._prefix_lock:
                     self._prefix_cache.clear()
                     self.prefix_stats.update(hits=0, partial_hits=0, misses=0)
+
+    def _warmup_adapters(self, progress: Any) -> None:
+        """Adapter warm stage: one prefill per bucket + the decode
+        chunk on a wrapped tree (shared by every adapter)."""
         if self.adapters:
             # LoRA-wrapped trees have a different pytree structure, so the
             # adapter prefill/decode executables are separate compiles —
@@ -2438,16 +2473,11 @@ class _TransformerRunner:
                 self._greedy_key, 0.0, 0, 1.0, 0.0, self.decode_chunk_size,
             )[0]
             a_toks.block_until_ready()
-        step, _ = self._decode(self.params, jnp.zeros((1, 1), jnp.int32), one)
-        step.block_until_ready()
-        # warm the full decode chunk (remainder sizes compile on demand)
-        if progress:
-            progress(f"compiling decode chunk ({self.decode_chunk_size} steps)")
-        toks, _ = self._decode_chunk(
-            self.params, jnp.zeros((1, 1), jnp.int32), one,
-            jax.random.key(0), 0.0, 0, 1.0, 0.0, self.decode_chunk_size,
-        )
-        toks.block_until_ready()
+
+    def _warmup_spec(self, progress: Any, one: dict) -> None:
+        """Speculative-decoding warm stage: draft prefills per bucket,
+        the greedy draft chunk + verify, the n=1 capacity-tail chunk,
+        and (k >= 2) the sampled draft chunk + sampled verify."""
         if self.spec is not None:
             # speculative path: draft prefill per bucket, draft chunk, and
             # the target verify — nothing compiles on the serving path
@@ -2500,6 +2530,7 @@ class _TransformerRunner:
                     sq[:, : spec.k - 1], jax.random.key(1), 1.0, 0, 1.0, 0.0,
                 )
                 se.block_until_ready()
+
 
 
 def _prompt_chunks(ids: np.ndarray, bucket: int):
