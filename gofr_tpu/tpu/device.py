@@ -31,12 +31,16 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
   (per-slot selection); they fall back to solo decode under a serving
   mesh, for rank/target-mismatched adapter sets, or mid bank rebuild
 - ``PREFIX_CACHE``: keep the KV rows of the n most recent distinct
-  prompts — an exact repeat (retries) skips prefill entirely on the
-  generate path, and a prompt sharing a long-enough common prefix with a
-  cached entry (shared system prompt, differing user turn) resumes from
-  its KV and prefills only the tail (exact-hit and partial-hit ratios on
-  /metrics: ``gofr_tpu_prefix_hit_ratio`` counts exact hits per lookup,
-  ``gofr_tpu_prefix_partial_hit_ratio`` partial hits per lookup)
+  prompts/conversations — an exact repeat (retries) skips prefill
+  entirely on the generate path; a prompt sharing a long-enough common
+  prefix with a cached entry (shared system prompt, differing user turn)
+  resumes from its KV and prefills only the tail; and completed
+  generations seed the cache with the whole conversation so multi-turn
+  follow-ups prefill only the new message. Sizing: each entry is one
+  FULL max_seq KV row of HBM (~1 GB for llama3-8b bf16 at 8k; halved by
+  MODEL_KV_DTYPE=f8) — ``gofr_tpu_prefix_entries`` gauges the live
+  count, ``gofr_tpu_prefix_hit_ratio`` / ``_partial_hit_ratio`` the
+  exact / shared-prefix hit rates per lookup
 - ``PREFIX_LCP_MIN``: minimum shared-prefix tokens for a partial hit
   (default 0 = the smallest compiled bucket; -1 = exact-only matching,
   restoring the pre-LCP behavior and skipping its warmup compiles)
@@ -265,6 +269,14 @@ class TPUDevice:
         self._prefix_partial_gauge = metrics.gauge(
             "gofr_tpu_prefix_partial_hit_ratio",
             "prefix cache: shared-prefix (tail-only prefill) hits / lookups",
+            labels=("model",),
+        )
+        # capacity planning: each entry is one FULL max_seq KV row
+        # (~n_layers x max_seq x kv_heads x head_dim x 2 x kv_bytes —
+        # ~1 GB for llama3-8b bf16 at 8k), so PREFIX_CACHE sizes HBM
+        self._prefix_entries_gauge = metrics.gauge(
+            "gofr_tpu_prefix_entries",
+            "prefix cache: live entries (each one max_seq KV row of HBM)",
             labels=("model",),
         )
 
@@ -621,6 +633,11 @@ class TPUDevice:
                     )
                     self._prefix_partial_gauge.set(
                         partial / lookups, model=self.model_name
+                    )
+                cache = getattr(self.runner, "_prefix_cache", None)
+                if cache is not None:
+                    self._prefix_entries_gauge.set(
+                        len(cache), model=self.model_name
                     )
             return out
         except Exception:
@@ -2530,7 +2547,6 @@ class _TransformerRunner:
                     sq[:, : spec.k - 1], jax.random.key(1), 1.0, 0, 1.0, 0.0,
                 )
                 se.block_until_ready()
-
 
 
 def _prompt_chunks(ids: np.ndarray, bucket: int):

@@ -910,3 +910,34 @@ def test_unknown_model_404_default(base):
         raise AssertionError("expected 404")
     except urllib.error.HTTPError as e:
         assert e.code == 404 and "gpt-4o" in e.read(300).decode()
+
+
+def test_chat_multi_turn_reuses_conversation_kv(tmp_path_factory):
+    """The real chat flow: the follow-up request carries the whole
+    history (system + user + assistant reply + new user turn) and must
+    partial-hit the cached conversation KV instead of re-prefilling it;
+    the entries gauge sizes the cache's HBM footprint."""
+    app = _make_app(tmp_path_factory, "openai-chat-mt",
+                    {"TOKENIZER": "byte", "PREFIX_CACHE": "4",
+                     "PREFIX_LCP_MIN": "8", "DECODE_CHUNK": "4"})
+    try:
+        base = f"http://127.0.0.1:{app.http_port}"
+        msgs = [{"role": "system", "content": "be brief"},
+                {"role": "user", "content": "hello there"}]
+        status, body = _post(base, {"messages": msgs, "max_tokens": 8,
+                                    "temperature": 0},
+                             "/v1/chat/completions")
+        assert status == 200
+        reply = body["choices"][0]["message"]["content"]
+        msgs2 = msgs + [{"role": "assistant", "content": reply},
+                        {"role": "user", "content": "more please"}]
+        status, _ = _post(base, {"messages": msgs2, "max_tokens": 4,
+                                 "temperature": 0}, "/v1/chat/completions")
+        assert status == 200
+        stats = app.container.tpu.runner.prefix_stats
+        assert stats["partial_hits"] >= 1, stats
+        metrics = urllib.request.urlopen(
+            base + "/metrics", timeout=30).read().decode()
+        assert 'gofr_tpu_prefix_entries{model="tiny"}' in metrics, metrics
+    finally:
+        app.shutdown()
