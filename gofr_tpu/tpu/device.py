@@ -1258,11 +1258,9 @@ class _TransformerRunner:
     ):
         self.max_batch = max_batch
         from gofr_tpu.models.llama import CONFIGS
-        from gofr_tpu.models.quant import quantize_params
         from gofr_tpu.models.transformer import (
             decode_step,
             init_cache,
-            init_transformer,
             prefill,
         )
 
@@ -1283,98 +1281,12 @@ class _TransformerRunner:
 
             self.cfg = dataclasses.replace(self.cfg, **overrides)
         self.decode_chunk_size = decode_chunk
-        from gofr_tpu.models.ingest import is_safetensors_path, load_llama_params
-
-        if model_path and is_safetensors_path(model_path):
-            # HF checkpoint: quantization happens DURING load (one layer in
-            # flight), same peak-memory contract as quantize-during-init
-            self.params = load_llama_params(model_path, self.cfg, quantize=quant)
-        elif model_path:
-            params = _load_or_init(
-                model_path, lambda: init_transformer(jax.random.key(0), self.cfg)
-            )
-            self.params = quantize_params(params, quant)
-        elif quant:
-            # quantize-during-init: peak memory = packed model + ONE bf16
-            # weight (init-then-quantize would peak ~3x and OOM 8B on 16GB)
-            self.params = init_transformer(jax.random.key(0), self.cfg, quantize=quant)
-        else:
-            self.params = init_transformer(jax.random.key(0), self.cfg)
-        self.mesh = mesh
-        self._token_sharding = None
-        self._cache_shardings = None
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from gofr_tpu.parallel.sharding import cache_specs, shard_params
-
-            tp = mesh.shape.get("tp", 1)
-            if self.cfg.n_kv_heads % tp:
-                raise ValueError(
-                    f"n_kv_heads={self.cfg.n_kv_heads} not divisible by "
-                    f"tp={tp} — KV cache shards its head axis over tp"
-                )
-            rows = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-            padded = next_pow2(max_batch)
-            if padded % rows:
-                raise ValueError(
-                    f"padded batch {padded} (next_pow2 of BATCH_MAX_SIZE="
-                    f"{max_batch}) not divisible by dp*fsdp={rows} — token "
-                    "batches shard their row axis over (dp, fsdp); raise "
-                    "BATCH_MAX_SIZE or shrink the dp/fsdp axes of TPU_MESH"
-                )
-            self.params = shard_params(self.params, mesh)
-            self._token_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
-            self._row_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
-            self._cache_shardings = {
-                k: NamedSharding(mesh, s) for k, s in cache_specs(None).items()
-            }
-        cfg = self.cfg
-        self._init_cache = init_cache
-        # prefill also argmaxes on device: the hot /infer path fetches [B]
-        # int32 next-token ids, never the [B, V] logits (the remote-attached
-        # device link charges ~per-round-trip + per-byte; see bench notes)
-        def _prefill_fn(p, t, c, l):
-            logits, new_cache = prefill(p, t, c, cfg, l)
-            return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32), new_cache
-
-        self._prefill = jax.jit(_prefill_fn)
-        self._decode = jax.jit(lambda p, t, c: decode_step(p, t, c, cfg))
-        from gofr_tpu.models.transformer import decode_chunk
-
-        # ONE parameterized family of decode-chunk executables keyed by
-        # (penalized, logprobs). Penalized chunks thread a [1, V] presence
-        # mask (such requests run solo — the pool stays presence-free);
-        # logprob chunks also return the chosen tokens' raw log-softmax.
-        # Only the plain (False, False) variant is warmed at boot; the
-        # opt-in variants compile on first use (same policy as remainder
-        # chunk sizes) — but every variant is built HERE from one helper,
-        # so a decode_chunk signature change cannot silently miss one.
-        def _make_chunk_fn(pen: bool, lp: bool) -> Any:
-            if pen:
-                return jax.jit(
-                    lambda p, t, c, key, temp, tk, tp, mp, pres, rp, cnt,
-                    pp, fp, bias, n:
-                    decode_chunk(
-                        p, t, c, cfg, n, key, temp, tk, tp, mp, pres, rp,
-                        cnt, pp, fp, bias, with_logprobs=lp,
-                    ),
-                    static_argnums=(14,),
-                )
-            return jax.jit(
-                lambda p, t, c, key, temp, tk, tp, mp, n: decode_chunk(
-                    p, t, c, cfg, n, key, temp, tk, tp, mp, with_logprobs=lp
-                ),
-                static_argnums=(8,),
-            )
-
-        self._chunk_fns = {
-            (pen, lp): _make_chunk_fn(pen, lp)
-            for pen in (False, True) for lp in (False, True)
-        }
-        self._decode_chunk = self._chunk_fns[(False, False)]
+        self._load_params(model_path, quant)
+        self._init_mesh(mesh, max_batch)
+        self._build_entry_points(init_cache, prefill, decode_step)
         from gofr_tpu.tpu.flops import transformer_param_count
 
+        cfg = self.cfg
         self.n_params = transformer_param_count(cfg)
         bucket_source = buckets if buckets else self.SEQ_BUCKETS
         self.buckets = [b for b in bucket_source if b <= cfg.max_seq] or [cfg.max_seq]
@@ -1468,6 +1380,116 @@ class _TransformerRunner:
         from gofr_tpu.models.transformer import score_tokens as _score_tokens
 
         self._score_fn = jax.jit(lambda p, t: _score_tokens(p, t, cfg))
+
+
+    def _load_params(self, model_path: Optional[str], quant: Any) -> None:
+        """Load/initialize serving weights (HF safetensors, orbax, or
+        seeded init), quantizing with the peak-memory contract each
+        path documents."""
+        from gofr_tpu.models.quant import quantize_params
+        from gofr_tpu.models.transformer import init_transformer
+
+        from gofr_tpu.models.ingest import is_safetensors_path, load_llama_params
+
+        if model_path and is_safetensors_path(model_path):
+            # HF checkpoint: quantization happens DURING load (one layer in
+            # flight), same peak-memory contract as quantize-during-init
+            self.params = load_llama_params(model_path, self.cfg, quantize=quant)
+        elif model_path:
+            params = _load_or_init(
+                model_path, lambda: init_transformer(jax.random.key(0), self.cfg)
+            )
+            self.params = quantize_params(params, quant)
+        elif quant:
+            # quantize-during-init: peak memory = packed model + ONE bf16
+            # weight (init-then-quantize would peak ~3x and OOM 8B on 16GB)
+            self.params = init_transformer(jax.random.key(0), self.cfg, quantize=quant)
+        else:
+            self.params = init_transformer(jax.random.key(0), self.cfg)
+
+    def _init_mesh(self, mesh: Optional[Any], max_batch: int) -> None:
+        """Serving-mesh placement: Megatron tp/fsdp param layout, KV
+        head axis over tp, token batches over (dp, fsdp); validates
+        divisibility eagerly."""
+        self.mesh = mesh
+        self._token_sharding = None
+        self._cache_shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from gofr_tpu.parallel.sharding import cache_specs, shard_params
+
+            tp = mesh.shape.get("tp", 1)
+            if self.cfg.n_kv_heads % tp:
+                raise ValueError(
+                    f"n_kv_heads={self.cfg.n_kv_heads} not divisible by "
+                    f"tp={tp} — KV cache shards its head axis over tp"
+                )
+            rows = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+            padded = next_pow2(max_batch)
+            if padded % rows:
+                raise ValueError(
+                    f"padded batch {padded} (next_pow2 of BATCH_MAX_SIZE="
+                    f"{max_batch}) not divisible by dp*fsdp={rows} — token "
+                    "batches shard their row axis over (dp, fsdp); raise "
+                    "BATCH_MAX_SIZE or shrink the dp/fsdp axes of TPU_MESH"
+                )
+            self.params = shard_params(self.params, mesh)
+            self._token_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+            self._row_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+            self._cache_shardings = {
+                k: NamedSharding(mesh, s) for k, s in cache_specs(None).items()
+            }
+
+    def _build_entry_points(self, init_cache: Any, prefill: Any,
+                            decode_step: Any) -> None:
+        """Build the jitted serving entry points: prefill (+on-device
+        argmax), the single decode step, and the parameterized family
+        of decode-chunk executables keyed by (penalized, logprobs)."""
+        cfg = self.cfg
+        self._init_cache = init_cache
+        # prefill also argmaxes on device: the hot /infer path fetches [B]
+        # int32 next-token ids, never the [B, V] logits (the remote-attached
+        # device link charges ~per-round-trip + per-byte; see bench notes)
+        def _prefill_fn(p, t, c, l):
+            logits, new_cache = prefill(p, t, c, cfg, l)
+            return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32), new_cache
+
+        self._prefill = jax.jit(_prefill_fn)
+        self._decode = jax.jit(lambda p, t, c: decode_step(p, t, c, cfg))
+        from gofr_tpu.models.transformer import decode_chunk
+
+        # ONE parameterized family of decode-chunk executables keyed by
+        # (penalized, logprobs). Penalized chunks thread a [1, V] presence
+        # mask (such requests run solo — the pool stays presence-free);
+        # logprob chunks also return the chosen tokens' raw log-softmax.
+        # Only the plain (False, False) variant is warmed at boot; the
+        # opt-in variants compile on first use (same policy as remainder
+        # chunk sizes) — but every variant is built HERE from one helper,
+        # so a decode_chunk signature change cannot silently miss one.
+        def _make_chunk_fn(pen: bool, lp: bool) -> Any:
+            if pen:
+                return jax.jit(
+                    lambda p, t, c, key, temp, tk, tp, mp, pres, rp, cnt,
+                    pp, fp, bias, n:
+                    decode_chunk(
+                        p, t, c, cfg, n, key, temp, tk, tp, mp, pres, rp,
+                        cnt, pp, fp, bias, with_logprobs=lp,
+                    ),
+                    static_argnums=(14,),
+                )
+            return jax.jit(
+                lambda p, t, c, key, temp, tk, tp, mp, n: decode_chunk(
+                    p, t, c, cfg, n, key, temp, tk, tp, mp, with_logprobs=lp
+                ),
+                static_argnums=(8,),
+            )
+
+        self._chunk_fns = {
+            (pen, lp): _make_chunk_fn(pen, lp)
+            for pen in (False, True) for lp in (False, True)
+        }
+        self._decode_chunk = self._chunk_fns[(False, False)]
 
     def _bucket_for(self, length: int) -> int:
         for b in self.buckets:
