@@ -16,18 +16,16 @@ from gofr_tpu.openai.template import render_chat_prompt
 from gofr_tpu.errors import HTTPError
 
 def _stream_chat(
-    ctx: Any, prompt_ids: list, max_tokens: int, sampler: Any,
+    ctx: Any, body: dict, prompt_ids: list, max_tokens: int, sampler: Any,
     stop_ids: Any, stop_strs: list, want_logprobs: bool, top_n: int,
     adapter: Any, n: int, chat_id: str, created: int, model: str,
     tok: Any,
 ) -> Any:
     """The SSE branch of /v1/chat/completions: delta chunks with the
-    role first, host-side stop matching, terminated by [DONE]."""
-    if n > 1:
-        raise HTTPError(
-            400, 'streaming with "n" > 1 is not supported '
-            "(interleaved multi-index SSE)"
-        )
+    role first, host-side stop matching, terminated by [DONE]. ``n`` > 1
+    streams candidates concurrently as interleaved chunks carrying their
+    choice ``index`` (greedy requests replicate one stream — the
+    non-stream fan-out's replication rule)."""
     if top_n:
         raise HTTPError(
             400, "top-logprob alternatives are not supported when "
@@ -38,15 +36,10 @@ def _stream_chat(
 
     from gofr_tpu.http.response import Stream
 
-    stream_iter = ctx.tpu.generate_stream(
-        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
-        adapter=adapter, logprobs=want_logprobs,
-    )
-
     def chunk(delta: dict, finish: Any = None, lp: Any = None,
-              token_id: Any = None) -> str:
+              token_id: Any = None, index: int = 0) -> str:
         choice: dict[str, Any] = {
-            "index": 0, "delta": delta, "finish_reason": finish,
+            "index": index, "delta": delta, "finish_reason": finish,
         }
         if want_logprobs:
             if lp is not None and token_id is not None:
@@ -64,6 +57,17 @@ def _stream_chat(
             "id": chat_id, "object": "chat.completion.chunk",
             "created": created, "model": model, "choices": [choice],
         })
+
+    if n > 1:
+        return _stream_chat_fanout(
+            ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
+            stop_strs, want_logprobs, adapter, n, chunk, tok,
+        )
+
+    stream_iter = ctx.tpu.generate_stream(
+        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+        adapter=adapter, logprobs=want_logprobs,
+    )
 
     def events():
         emitted = 0
@@ -111,6 +115,82 @@ def _stream_chat(
     return Stream(events())
 
 
+def _stream_chat_fanout(
+    ctx: Any, body: dict, prompt_ids: list, max_tokens: int, sampler: Any,
+    stop_ids: Any, stop_strs: list, want_logprobs: bool, adapter: Any,
+    n: int, chunk: Any, tok: Any,
+) -> Any:
+    """Interleaved multi-index chat SSE: n candidates stream
+    concurrently, each delta carrying its choice ``index``; every index
+    opens with its own role chunk and closes with its own finish. The
+    shared driver (_drive_stream_fanout) owns the replicate/multiplex
+    loops, stop-cancellation, and cleanup; this function supplies only
+    the chat frame shapes."""
+    import json as _json
+
+    from gofr_tpu.http.response import Stream
+    from gofr_tpu.openai.fanout import (
+        _drive_stream_fanout,
+        _stream_candidates,
+    )
+    from gofr_tpu.openai.parse import _StopScanner
+
+    replicate = sampler.greedy
+    iters = _stream_candidates(
+        ctx, body, prompt_ids, max_tokens, sampler, stop_ids, adapter,
+        want_logprobs, 1 if replicate else n,
+    )
+    decs = [tok.stream_decoder() for _ in range(n)]
+    scans = [_StopScanner(stop_strs) if stop_strs else None
+             for _ in range(n)]
+    emitted = [0] * n
+    finish: list = [None] * n
+
+    def open_frames():
+        for i in range(n):
+            yield chunk({"role": "assistant"}, index=i)
+
+    def feed(i, token, lp):
+        emitted[i] += 1
+        text = decs[i].feed(token)
+        if scans[i] is not None:
+            text, done = scans[i].feed(text)
+            if done:
+                finish[i] = "stop"
+                return [chunk({"content": text}, index=i)] if text else []
+        if text or lp is not None:
+            return [chunk({"content": text}, lp=lp, token_id=token,
+                          index=i)]
+        return []
+
+    def tail(i):
+        t = decs[i].flush()
+        if finish[i] is None:
+            if scans[i] is not None:
+                t, done = scans[i].feed(t)
+                if done:
+                    finish[i] = "stop"
+                else:
+                    t += scans[i].flush()
+            if finish[i] is None:
+                finish[i] = "length" if emitted[i] >= max_tokens else "stop"
+        else:
+            t = ""
+        frames = []
+        if t:
+            frames.append(chunk({"content": t}, index=i))
+        frames.append(chunk({}, finish[i], index=i))
+        return frames
+
+    def error_frame(exc):
+        return _json.dumps({"error": {"message": str(exc)}})
+
+    return Stream(_drive_stream_fanout(
+        iters, replicate, n, finish, want_logprobs, open_frames, feed,
+        tail, error_frame,
+    ))
+
+
 def chat_completions(ctx: Any) -> Any:
     """Messages -> assistant message. Same generation core as
     ``completions``; only the prompt construction (chat template) and the
@@ -140,9 +220,9 @@ def chat_completions(ctx: Any) -> Any:
 
     if body.get("stream"):
         return _stream_chat(
-            ctx, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
-            want_logprobs, top_n, adapter, n, chat_id, created, model,
-            tok,
+            ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
+            stop_strs, want_logprobs, top_n, adapter, n, chat_id,
+            created, model, tok,
         )
 
     results, generated = _fanout_generate(

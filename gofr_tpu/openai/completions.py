@@ -14,17 +14,23 @@ from gofr_tpu.openai.parse import _StopScanner, _parse_fanout, _parse_request, _
 from gofr_tpu.errors import HTTPError
 
 def _stream_completion(
-    ctx: Any, prompt_ids: list, max_tokens: int, sampler: Any,
+    ctx: Any, body: dict, prompt_ids: list, max_tokens: int, sampler: Any,
     stop_ids: Any, stop_strs: list, want_logprobs: bool, top_n: int,
     adapter: Any, n: int, best_of: int, echo: bool,
     cmpl_id: str, created: int, model: str, tok: Any,
 ) -> Any:
     """The SSE branch of /v1/completions: per-token text chunks with
-    host-side stop matching, terminated by ``data: [DONE]``."""
-    if n > 1 or best_of > 1:
+    host-side stop matching, terminated by ``data: [DONE]``. ``n`` > 1
+    streams candidates CONCURRENTLY as interleaved chunks carrying their
+    ``index`` (the OpenAI shape): unseeded candidates share the decode
+    pool, seeded ones derive per-candidate seeds, and deterministic
+    (greedy) requests replicate one stream across every index — the
+    non-stream fan-out's replication rule, so billing and content
+    match it."""
+    if best_of > n:
         raise HTTPError(
-            400, 'streaming with "n" > 1 or "best_of" > 1 is not '
-            "supported (interleaved multi-index SSE)"
+            400, '"best_of" > "n" is not supported when streaming '
+            "(candidates cannot be ranked and discarded mid-stream)"
         )
     if max_tokens == 0:
         raise HTTPError(
@@ -41,17 +47,10 @@ def _stream_completion(
 
     from gofr_tpu.http.response import Stream
 
-    # constructed OUTSIDE events(): parameter errors (unknown adapter,
-    # bad sampler) must 400 before the SSE 200 commits
-    stream_iter = ctx.tpu.generate_stream(
-        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
-        adapter=adapter, logprobs=want_logprobs,
-    )
-
     def chunk(text: str, lp: Any = None, finish: Any = None,
-              token: Any = None) -> str:
+              token: Any = None, index: int = 0) -> str:
         choice: dict[str, Any] = {
-            "text": text, "index": 0, "finish_reason": finish,
+            "text": text, "index": index, "finish_reason": finish,
         }
         if token is not None:
             # no tokenizer: bare str(token) text would concatenate
@@ -66,6 +65,19 @@ def _stream_completion(
             "id": cmpl_id, "object": "text_completion",
             "created": created, "model": model, "choices": [choice],
         })
+
+    if n > 1:
+        return _stream_completion_fanout(
+            ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
+            stop_strs, want_logprobs, adapter, n, echo, chunk, tok,
+        )
+
+    # constructed OUTSIDE events(): parameter errors (unknown adapter,
+    # bad sampler) must 400 before the SSE 200 commits
+    stream_iter = ctx.tpu.generate_stream(
+        prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+        adapter=adapter, logprobs=want_logprobs,
+    )
 
     def events():
         emitted = 0
@@ -122,6 +134,84 @@ def _stream_completion(
     return Stream(events())
 
 
+def _stream_completion_fanout(
+    ctx: Any, body: dict, prompt_ids: list, max_tokens: int, sampler: Any,
+    stop_ids: Any, stop_strs: list, want_logprobs: bool, adapter: Any,
+    n: int, echo: bool, chunk: Any, tok: Any,
+) -> Any:
+    """Interleaved multi-index SSE: n candidates stream concurrently,
+    each chunk carrying its choice ``index``. Deterministic (greedy)
+    requests run ONE stream replicated across indexes. The shared
+    driver (_drive_stream_fanout) owns the replicate/multiplex loops,
+    stop-cancellation, and cleanup; this function supplies only the
+    completions frame shapes."""
+    import json as _json
+
+    from gofr_tpu.http.response import Stream
+    from gofr_tpu.openai.fanout import (
+        _drive_stream_fanout,
+        _stream_candidates,
+    )
+    from gofr_tpu.openai.parse import _StopScanner
+
+    replicate = sampler.greedy
+    iters = _stream_candidates(
+        ctx, body, prompt_ids, max_tokens, sampler, stop_ids, adapter,
+        want_logprobs, 1 if replicate else n,
+    )
+    decs = [tok.stream_decoder() if tok is not None else None
+            for _ in range(n)]
+    scans = [_StopScanner(stop_strs) if stop_strs else None
+             for _ in range(n)]
+    emitted = [0] * n
+    finish: list = [None] * n
+
+    def open_frames():
+        if not echo:
+            return
+        for i in range(n):
+            if tok is not None:
+                yield chunk(tok.decode(prompt_ids), index=i)
+            else:
+                for t in prompt_ids:
+                    yield chunk("", token=t, index=i)
+
+    def feed(i, token, lp):
+        emitted[i] += 1
+        if decs[i] is None:
+            return [chunk("", lp, token=token, index=i)]
+        text = decs[i].feed(token)
+        if scans[i] is not None:
+            text, done = scans[i].feed(text)
+            if done:
+                finish[i] = "stop"
+                return [chunk(text, None, index=i)]
+        return [chunk(text, lp, index=i)]
+
+    def tail(i):
+        t = decs[i].flush() if decs[i] is not None else ""
+        if finish[i] is None:
+            if scans[i] is not None:
+                t, done = scans[i].feed(t)
+                if done:
+                    finish[i] = "stop"
+                else:
+                    t += scans[i].flush()
+            if finish[i] is None:
+                finish[i] = "length" if emitted[i] >= max_tokens else "stop"
+        else:
+            t = ""
+        return [chunk(t, None, finish[i], index=i)]
+
+    def error_frame(exc):
+        return _json.dumps({"error": {"message": str(exc)}})
+
+    return Stream(_drive_stream_fanout(
+        iters, replicate, n, finish, want_logprobs, open_frames, feed,
+        tail, error_frame,
+    ))
+
+
 def completions(ctx: Any) -> Any:
     (body, max_tokens, sampler, stop_ids, stop_strs, want_logprobs, top_n,
      adapter) = _parse_request(ctx, default_max=16)
@@ -147,8 +237,8 @@ def completions(ctx: Any) -> Any:
 
     if body.get("stream"):
         return _stream_completion(
-            ctx, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
-            want_logprobs, top_n, adapter, n, best_of, echo,
+            ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
+            stop_strs, want_logprobs, top_n, adapter, n, best_of, echo,
             cmpl_id, created, model, tok,
         )
 

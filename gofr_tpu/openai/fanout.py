@@ -9,6 +9,183 @@ from gofr_tpu.openai.parse import _StopScanner, _sampler
 
 from gofr_tpu.errors import HTTPError
 
+STREAM_END = object()  # per-index end marker on the multiplex queue
+
+
+def _candidate_samplers(body: dict, count: int) -> list:
+    """Per-candidate samplers with the seed+index derivation — THE
+    reproducibility contract the stream and non-stream fan-outs share
+    (stream candidates must byte-match non-stream candidates)."""
+    seed = body.get("seed")
+    if seed is not None:
+        try:
+            seed = int(seed)
+        except (TypeError, ValueError):
+            raise HTTPError(400, '"seed" must be an integer') from None
+    return [
+        _sampler({**body, "seed": seed + i} if seed is not None else body)
+        for i in range(count)
+    ]
+
+
+def _fanout_workers(ctx: Any, default_slots: int = 4) -> int:
+    """Deployment-scaled fan-out concurrency bound, shared by both
+    paths: ~3/4 of the decode pool's slots (one wide request must not
+    occupy every slot, nor spawn that many solo seeded decodes);
+    OPENAI_FANOUT_WORKERS overrides."""
+    raw = ctx.config.get_or_default("OPENAI_FANOUT_WORKERS", "")
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            raise HTTPError(
+                500, "OPENAI_FANOUT_WORKERS must be an integer"
+            ) from None
+    slots = getattr(
+        getattr(ctx.tpu, "decode_pool", None), "n_slots", None
+    ) or default_slots
+    return max(1, (slots * 3) // 4 or 1)
+
+
+def _stream_candidates(
+    ctx: Any, body: dict, prompt_ids: list, max_tokens: int,
+    sampler: Any, stop_ids: Any, adapter: Any, want_logprobs: bool,
+    n: int,
+) -> list:
+    """Construct the n candidate stream iterators for interleaved SSE.
+    Built BEFORE the 200 commits (parameter errors must 400 first).
+    Seeded fan-outs derive per-candidate seeds via _candidate_samplers;
+    unseeded candidates share the continuous-batching pool. Unlike the
+    non-stream path, candidates past the concurrency bound cannot
+    serialize (all indexes must progress for interleaved output), so an
+    over-wide n is a 400 scaled to the deployment: n may use up to the
+    pool's full slot count (OPENAI_FANOUT_WORKERS overrides). The
+    caller owns closing every iterator."""
+    if n == 1:
+        return [ctx.tpu.generate_stream(
+            prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
+            adapter=adapter, logprobs=want_logprobs,
+        )]
+    slots = getattr(
+        getattr(ctx.tpu, "decode_pool", None), "n_slots", None
+    ) or 4
+    bound = max(_fanout_workers(ctx), min(n, slots))
+    if n > bound:
+        raise HTTPError(
+            400, f'"n" is capped at {bound} when streaming on this '
+            "deployment (candidates stream concurrently and cannot be "
+            "serialized; raise DECODE_SLOTS or OPENAI_FANOUT_WORKERS)"
+        )
+    samplers = _candidate_samplers(body, n)
+    iters = []
+    try:
+        for s in samplers:
+            iters.append(ctx.tpu.generate_stream(
+                prompt_ids, max_tokens, sampler=s, stop_tokens=stop_ids,
+                adapter=adapter, logprobs=want_logprobs,
+            ))
+    except BaseException:
+        for it in iters:  # a late candidate failing must free the early ones
+            it.close()
+        raise
+    return iters
+
+
+def _drive_stream_fanout(
+    iters: list, replicate: bool, n: int, finish: list,
+    want_logprobs: bool, open_frames: Any, feed: Any, tail: Any,
+    error_frame: Any,
+) -> Any:
+    """The ONE interleaved-SSE driver both endpoints share: replicate
+    mode consumes a single iterator and fans frames across indexes;
+    multiplex mode merges n pump threads. ``finish`` is the caller's
+    per-index finish-reason list — ``feed``/``tail`` mutate it; when a
+    feed marks an index finished (stop match), its decode is cancelled
+    and anything else that index produces — including an error from the
+    cancellation itself — is dropped rather than aborting the healthy
+    candidates. Errors from UNFINISHED indexes abort the whole stream
+    with one error frame (the transport cannot re-status a committed
+    200)."""
+    cancels: list = []
+    try:
+        yield from open_frames()
+        if replicate:
+            for item in iters[0]:
+                token, lp = item if want_logprobs else (item, None)
+                for i in range(n):
+                    if finish[i] is None:
+                        yield from feed(i, token, lp)
+                if all(f is not None for f in finish):
+                    break
+            for i in range(n):
+                yield from tail(i)
+        else:
+            q, cancels_ = _multiplex(iters)
+            cancels.extend(cancels_)
+            active = n
+            while active:
+                i, item = q.get()
+                if item is STREAM_END:
+                    active -= 1
+                    yield from tail(i)
+                    continue
+                if finish[i] is not None:
+                    continue  # stop-matched: drop tokens AND late errors
+                if (
+                    isinstance(item, tuple) and len(item) == 2
+                    and item[0] == "error"
+                ):
+                    raise item[1]
+                token, lp = item if want_logprobs else (item, None)
+                yield from feed(i, token, lp)
+                if finish[i] is not None:
+                    cancels[i].set()  # stop matched: free its decode early
+        yield "[DONE]"
+    except Exception as exc:
+        yield error_frame(exc)
+    finally:
+        if replicate:
+            iters[0].close()  # same thread drives it: legal
+        else:
+            for ev in cancels:
+                ev.set()  # pump threads close their own iterators
+
+
+def _multiplex(iters: list) -> tuple:
+    """Merge n token iterators into ONE queue of (index, item) pairs;
+    each stream's end posts (index, STREAM_END), an error posts
+    (index, ("error", exc)) then STREAM_END. Returns (queue, cancels):
+    the PUMP thread owns each iterator's lifecycle — a raw generator
+    cannot be close()d from another thread while it executes — so the
+    consumer cancels index i by setting cancels[i]; the pump notices at
+    its next item, closes the iterator (the device's stop event cancels
+    the background decode), and posts STREAM_END."""
+    import queue as _queue
+    import threading
+
+    out: "_queue.Queue" = _queue.Queue()
+    cancels = [threading.Event() for _ in iters]
+
+    def pump(i: int, it: Any) -> None:
+        try:
+            for item in it:
+                if cancels[i].is_set():
+                    break
+                out.put((i, item))
+        except Exception as exc:  # surfaced as an SSE error frame
+            out.put((i, ("error", exc)))
+        finally:
+            it.close()  # suspended here, owned by this thread: legal
+            out.put((i, STREAM_END))
+
+    for i, it in enumerate(iters):
+        threading.Thread(
+            target=pump, args=(i, it), daemon=True,
+            name=f"gofr-sse-fanout-{i}",
+        ).start()
+    return out, cancels
+
+
 def _consume_stream(
     ctx: Any, prompt_ids: list, max_tokens: int, sampler: Any,
     stop_ids: Any, stop_strs: list, need_lp: bool, adapter: Any,
@@ -120,40 +297,17 @@ def _fanout_generate(
             lps = None
         return [(toks, lps, tops, text, finish)] * n, len(toks) * n
 
-    seed = body.get("seed")
-    if seed is not None:
-        try:
-            seed = int(seed)
-        except (TypeError, ValueError):
-            raise HTTPError(400, '"seed" must be an integer') from None
-    samplers = [
-        _sampler({**body, "seed": seed + i} if seed is not None else body)
-        for i in range(best_of)
-    ]
+    samplers = _candidate_samplers(body, best_of)
     if best_of == 1:
         results = [one(samplers[0])]
     else:
         from concurrent.futures import ThreadPoolExecutor
 
-        # concurrency scales with the DEPLOYMENT, not the request: a
-        # fixed best_of-wide fan-out would let one n=16 request occupy
-        # every decode-pool slot (or spawn 16 solo seeded decodes) and
-        # starve concurrent traffic. Default: ~3/4 of the pool slots;
-        # candidates beyond it serialize through pool.map. A seeded
-        # fan-out decodes solo, so the same bound caps its thread count.
-        raw = ctx.config.get_or_default("OPENAI_FANOUT_WORKERS", "")
-        if raw:
-            try:
-                workers = max(1, min(best_of, int(raw)))
-            except ValueError:
-                raise HTTPError(
-                    500, "OPENAI_FANOUT_WORKERS must be an integer"
-                ) from None
-        else:
-            slots = getattr(
-                getattr(ctx.tpu, "decode_pool", None), "n_slots", None
-            ) or 4
-            workers = max(1, min(best_of, (slots * 3) // 4 or 1))
+        # concurrency scales with the DEPLOYMENT, not the request
+        # (_fanout_workers): candidates beyond the bound serialize
+        # through pool.map; a seeded fan-out decodes solo, so the same
+        # bound caps its thread count.
+        workers = min(best_of, _fanout_workers(ctx))
         with ThreadPoolExecutor(max_workers=workers) as pool:
             results = list(pool.map(one, samplers))
     generated = sum(len(r[0]) for r in results)

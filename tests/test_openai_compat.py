@@ -360,12 +360,13 @@ def test_embeddings_overlong_input_400(embed_base):
 
 def test_unsupported_openai_knobs_400_not_silent(base):
     """Knobs this server cannot honor must 400 loudly: suffix always;
-    fan-out (n/best_of) when streaming; echo with logprobs; constraint
-    violations (best_of < n, fan-out past the cap)."""
+    best_of-ranking when streaming (candidates cannot be discarded
+    mid-stream); echo with logprobs; constraint violations (best_of <
+    n, fan-out past the cap). n > 1 streaming itself is SUPPORTED
+    (interleaved multi-index SSE — test_completions_stream_fanout)."""
     for payload, expect in (
         ({"suffix": "tail"}, "suffix"),
-        ({"n": 2, "stream": True, "temperature": 1.0}, "stream"),
-        ({"best_of": 2, "stream": True, "temperature": 1.0}, "stream"),
+        ({"best_of": 2, "stream": True, "temperature": 1.0}, "best_of"),
         ({"echo": True, "logprobs": 1, "stream": True}, "echo"),
         ({"n": 3, "best_of": 2, "temperature": 1.0}, "best_of"),
         ({"n": 999, "temperature": 1.0}, "n"),
@@ -941,3 +942,72 @@ def test_chat_multi_turn_reuses_conversation_kv(tmp_path_factory):
         assert 'gofr_tpu_prefix_entries{model="tiny"}' in metrics, metrics
     finally:
         app.shutdown()
+
+
+def _read_sse(base_url, payload, path="/v1/completions"):
+    req = urllib.request.Request(
+        base_url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=180) as resp:
+        assert resp.status == 200
+        raw = resp.read().decode()
+    return [ln[len("data: "):] for ln in raw.splitlines()
+            if ln.startswith("data: ")]
+
+
+def test_completions_stream_fanout(base):
+    """n > 1 streaming: interleaved chunks carry their choice index, and
+    a SEEDED fan-out's per-index token sequences reproduce the
+    non-stream fan-out's candidates exactly (same seed+i derivation)."""
+    req = {"prompt": [3, 1, 4], "max_tokens": 5, "temperature": 1.0,
+           "seed": 11, "n": 2}
+    _, want = _post(base, req)
+    events = _read_sse(base, {**req, "stream": True})
+    assert events[-1] == "[DONE]"
+    per_index: dict = {0: [], 1: []}
+    finishes: dict = {}
+    for e in events[:-1]:
+        choice = json.loads(e)["choices"][0]
+        i = choice["index"]
+        if choice.get("tokens"):
+            per_index[i].extend(choice["tokens"])
+        if choice["finish_reason"] is not None:
+            finishes[i] = choice["finish_reason"]
+    assert sorted(finishes) == [0, 1]
+    for i in (0, 1):
+        assert per_index[i] == want["choices"][i]["tokens"], i
+    # greedy n>1 replicates one stream across identical indexes
+    events = _read_sse(base, {"prompt": [3, 1, 4], "max_tokens": 4,
+                              "temperature": 0, "n": 2, "stream": True})
+    toks = {0: [], 1: []}
+    for e in events[:-1]:
+        c = json.loads(e)["choices"][0]
+        if c.get("tokens"):
+            toks[c["index"]].extend(c["tokens"])
+    assert toks[0] == toks[1] and len(toks[0]) == 4
+
+
+def test_chat_stream_fanout(chat_base):
+    """Chat n > 1 streaming: every index opens with its own role chunk
+    and closes with its own finish; greedy indexes carry identical
+    content."""
+    events = _read_sse(chat_base, {
+        "messages": [{"role": "user", "content": "go"}],
+        "max_tokens": 4, "temperature": 0, "n": 2, "stream": True,
+    }, path="/v1/chat/completions")
+    assert events[-1] == "[DONE]"
+    roles: dict = {}
+    content: dict = {0: "", 1: ""}
+    finishes: dict = {}
+    for e in events[:-1]:
+        c = json.loads(e)["choices"][0]
+        i = c["index"]
+        if c["delta"].get("role"):
+            roles[i] = c["delta"]["role"]
+        content[i] += c["delta"].get("content", "")
+        if c["finish_reason"] is not None:
+            finishes[i] = c["finish_reason"]
+    assert roles == {0: "assistant", 1: "assistant"}
+    assert sorted(finishes) == [0, 1]
+    assert content[0] == content[1] != ""
