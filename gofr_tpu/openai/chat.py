@@ -10,7 +10,12 @@ from typing import Any
 
 from gofr_tpu.openai.fanout import _fanout_generate
 from gofr_tpu.openai.logprobs import _chat_logprobs_obj, _chat_lp_entry
-from gofr_tpu.openai.parse import _StopScanner, _parse_fanout, _parse_request
+from gofr_tpu.openai.parse import (
+    _StopScanner,
+    _parse_fanout,
+    _parse_request,
+    _stream_usage_opt,
+)
 from gofr_tpu.openai.template import render_chat_prompt
 
 from gofr_tpu.errors import HTTPError
@@ -19,7 +24,7 @@ def _stream_chat(
     ctx: Any, body: dict, prompt_ids: list, max_tokens: int, sampler: Any,
     stop_ids: Any, stop_strs: list, want_logprobs: bool, top_n: int,
     adapter: Any, n: int, chat_id: str, created: int, model: str,
-    tok: Any,
+    tok: Any, include_usage: bool = False,
 ) -> Any:
     """The SSE branch of /v1/chat/completions: delta chunks with the
     role first, host-side stop matching, terminated by [DONE]. ``n`` > 1
@@ -53,15 +58,30 @@ def _stream_chat(
                 }
             else:
                 choice["logprobs"] = None
-        return _json.dumps({
+        frame = {
             "id": chat_id, "object": "chat.completion.chunk",
             "created": created, "model": model, "choices": [choice],
+        }
+        if include_usage:
+            frame["usage"] = None
+        return _json.dumps(frame)
+
+    def usage_frame(completion_tokens: int) -> str:
+        return _json.dumps({
+            "id": chat_id, "object": "chat.completion.chunk",
+            "created": created, "model": model, "choices": [],
+            "usage": {
+                "prompt_tokens": len(prompt_ids),
+                "completion_tokens": completion_tokens,
+                "total_tokens": len(prompt_ids) + completion_tokens,
+            },
         })
 
     if n > 1:
         return _stream_chat_fanout(
             ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
             stop_strs, want_logprobs, adapter, n, chunk, tok,
+            usage_frame if include_usage else None,
         )
 
     stream_iter = ctx.tpu.generate_stream(
@@ -106,6 +126,8 @@ def _stream_chat(
             if tail:
                 yield chunk({"content": tail})
             yield chunk({}, finish)
+            if include_usage:
+                yield usage_frame(emitted)
             yield "[DONE]"
         except Exception as exc:
             yield _json.dumps({"error": {"message": str(exc)}})
@@ -118,7 +140,7 @@ def _stream_chat(
 def _stream_chat_fanout(
     ctx: Any, body: dict, prompt_ids: list, max_tokens: int, sampler: Any,
     stop_ids: Any, stop_strs: list, want_logprobs: bool, adapter: Any,
-    n: int, chunk: Any, tok: Any,
+    n: int, chunk: Any, tok: Any, usage_frame: Any = None,
 ) -> Any:
     """Interleaved multi-index chat SSE: n candidates stream
     concurrently, each delta carrying its choice ``index``; every index
@@ -131,6 +153,8 @@ def _stream_chat_fanout(
     from gofr_tpu.http.response import Stream
     from gofr_tpu.openai.fanout import (
         _drive_stream_fanout,
+        _index_feed_text,
+        _index_tail_text,
         _stream_candidates,
     )
     from gofr_tpu.openai.parse import _StopScanner
@@ -151,31 +175,19 @@ def _stream_chat_fanout(
             yield chunk({"role": "assistant"}, index=i)
 
     def feed(i, token, lp):
-        emitted[i] += 1
-        text = decs[i].feed(token)
-        if scans[i] is not None:
-            text, done = scans[i].feed(text)
-            if done:
-                finish[i] = "stop"
-                return [chunk({"content": text}, index=i)] if text else []
+        text, stopped = _index_feed_text(
+            decs[i], scans[i], finish, i, emitted, token
+        )
+        if stopped:  # the matched token's lp is excluded with its text
+            return [chunk({"content": text}, index=i)] if text else []
         if text or lp is not None:
             return [chunk({"content": text}, lp=lp, token_id=token,
                           index=i)]
         return []
 
     def tail(i):
-        t = decs[i].flush()
-        if finish[i] is None:
-            if scans[i] is not None:
-                t, done = scans[i].feed(t)
-                if done:
-                    finish[i] = "stop"
-                else:
-                    t += scans[i].flush()
-            if finish[i] is None:
-                finish[i] = "length" if emitted[i] >= max_tokens else "stop"
-        else:
-            t = ""
+        t = _index_tail_text(decs[i], scans[i], finish, i, emitted,
+                             max_tokens)
         frames = []
         if t:
             frames.append(chunk({"content": t}, index=i))
@@ -185,9 +197,13 @@ def _stream_chat_fanout(
     def error_frame(exc):
         return _json.dumps({"error": {"message": str(exc)}})
 
+    usage_frames = (
+        (lambda: [usage_frame(sum(emitted))])
+        if usage_frame is not None else None
+    )
     return Stream(_drive_stream_fanout(
         iters, replicate, n, finish, want_logprobs, open_frames, feed,
-        tail, error_frame,
+        tail, error_frame, usage_frames,
     ))
 
 
@@ -218,11 +234,12 @@ def chat_completions(ctx: Any) -> Any:
             'sequences are not supported; use "stop_token_ids"'
         )
 
+    include_usage = _stream_usage_opt(body)  # validates even sans stream
     if body.get("stream"):
         return _stream_chat(
             ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
             stop_strs, want_logprobs, top_n, adapter, n, chat_id,
-            created, model, tok,
+            created, model, tok, include_usage,
         )
 
     results, generated = _fanout_generate(

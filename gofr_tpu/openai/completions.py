@@ -9,7 +9,13 @@ from typing import Any
 
 from gofr_tpu.openai.fanout import _fanout_generate
 from gofr_tpu.openai.logprobs import _logprobs_obj
-from gofr_tpu.openai.parse import _StopScanner, _parse_fanout, _parse_request, _prompt_tokens
+from gofr_tpu.openai.parse import (
+    _StopScanner,
+    _parse_fanout,
+    _parse_request,
+    _prompt_tokens,
+    _stream_usage_opt,
+)
 
 from gofr_tpu.errors import HTTPError
 
@@ -18,6 +24,7 @@ def _stream_completion(
     stop_ids: Any, stop_strs: list, want_logprobs: bool, top_n: int,
     adapter: Any, n: int, best_of: int, echo: bool,
     cmpl_id: str, created: int, model: str, tok: Any,
+    include_usage: bool = False,
 ) -> Any:
     """The SSE branch of /v1/completions: per-token text chunks with
     host-side stop matching, terminated by ``data: [DONE]``. ``n`` > 1
@@ -61,15 +68,30 @@ def _stream_completion(
             choice["logprobs"] = (
                 {"token_logprobs": [lp]} if lp is not None else None
             )
-        return _json.dumps({
+        frame = {
             "id": cmpl_id, "object": "text_completion",
             "created": created, "model": model, "choices": [choice],
+        }
+        if include_usage:
+            frame["usage"] = None
+        return _json.dumps(frame)
+
+    def usage_frame(completion_tokens: int) -> str:
+        return _json.dumps({
+            "id": cmpl_id, "object": "text_completion",
+            "created": created, "model": model, "choices": [],
+            "usage": {
+                "prompt_tokens": len(prompt_ids),
+                "completion_tokens": completion_tokens,
+                "total_tokens": len(prompt_ids) + completion_tokens,
+            },
         })
 
     if n > 1:
         return _stream_completion_fanout(
             ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
             stop_strs, want_logprobs, adapter, n, echo, chunk, tok,
+            usage_frame if include_usage else None,
         )
 
     # constructed OUTSIDE events(): parameter errors (unknown adapter,
@@ -125,6 +147,8 @@ def _stream_completion(
             else:
                 tail = ""
             yield chunk(tail, None, finish)
+            if include_usage:
+                yield usage_frame(emitted)
             yield "[DONE]"
         except Exception as exc:
             yield _json.dumps({"error": {"message": str(exc)}})
@@ -137,7 +161,7 @@ def _stream_completion(
 def _stream_completion_fanout(
     ctx: Any, body: dict, prompt_ids: list, max_tokens: int, sampler: Any,
     stop_ids: Any, stop_strs: list, want_logprobs: bool, adapter: Any,
-    n: int, echo: bool, chunk: Any, tok: Any,
+    n: int, echo: bool, chunk: Any, tok: Any, usage_frame: Any = None,
 ) -> Any:
     """Interleaved multi-index SSE: n candidates stream concurrently,
     each chunk carrying its choice ``index``. Deterministic (greedy)
@@ -150,6 +174,8 @@ def _stream_completion_fanout(
     from gofr_tpu.http.response import Stream
     from gofr_tpu.openai.fanout import (
         _drive_stream_fanout,
+        _index_feed_text,
+        _index_tail_text,
         _stream_candidates,
     )
     from gofr_tpu.openai.parse import _StopScanner
@@ -177,38 +203,30 @@ def _stream_completion_fanout(
                     yield chunk("", token=t, index=i)
 
     def feed(i, token, lp):
-        emitted[i] += 1
-        if decs[i] is None:
+        text, stopped = _index_feed_text(
+            decs[i], scans[i], finish, i, emitted, token
+        )
+        if text is None:  # id-only deployment: tokens extension
             return [chunk("", lp, token=token, index=i)]
-        text = decs[i].feed(token)
-        if scans[i] is not None:
-            text, done = scans[i].feed(text)
-            if done:
-                finish[i] = "stop"
-                return [chunk(text, None, index=i)]
+        if stopped:  # the matched token's lp is excluded with its text
+            return [chunk(text, None, index=i)]
         return [chunk(text, lp, index=i)]
 
     def tail(i):
-        t = decs[i].flush() if decs[i] is not None else ""
-        if finish[i] is None:
-            if scans[i] is not None:
-                t, done = scans[i].feed(t)
-                if done:
-                    finish[i] = "stop"
-                else:
-                    t += scans[i].flush()
-            if finish[i] is None:
-                finish[i] = "length" if emitted[i] >= max_tokens else "stop"
-        else:
-            t = ""
+        t = _index_tail_text(decs[i], scans[i], finish, i, emitted,
+                             max_tokens)
         return [chunk(t, None, finish[i], index=i)]
 
     def error_frame(exc):
         return _json.dumps({"error": {"message": str(exc)}})
 
+    usage_frames = (
+        (lambda: [usage_frame(sum(emitted))])
+        if usage_frame is not None else None
+    )
     return Stream(_drive_stream_fanout(
         iters, replicate, n, finish, want_logprobs, open_frames, feed,
-        tail, error_frame,
+        tail, error_frame, usage_frames,
     ))
 
 
@@ -235,11 +253,12 @@ def completions(ctx: Any) -> Any:
     cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
     tok = ctx.tpu.tokenizer
 
+    include_usage = _stream_usage_opt(body)  # validates even sans stream
     if body.get("stream"):
         return _stream_completion(
             ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
             stop_strs, want_logprobs, top_n, adapter, n, best_of, echo,
-            cmpl_id, created, model, tok,
+            cmpl_id, created, model, tok, include_usage,
         )
 
     prompt_lps = None

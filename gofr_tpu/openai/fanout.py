@@ -28,19 +28,29 @@ def _candidate_samplers(body: dict, count: int) -> list:
     ]
 
 
+def _fanout_workers_override(ctx: Any) -> Any:
+    """OPENAI_FANOUT_WORKERS, validated — the operator's explicit
+    fan-out concurrency bound (None when unset). Both fan-out paths
+    OBEY it in both directions: raising and lowering."""
+    raw = ctx.config.get_or_default("OPENAI_FANOUT_WORKERS", "")
+    if not raw:
+        return None
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        raise HTTPError(
+            500, "OPENAI_FANOUT_WORKERS must be an integer"
+        ) from None
+
+
 def _fanout_workers(ctx: Any, default_slots: int = 4) -> int:
     """Deployment-scaled fan-out concurrency bound, shared by both
     paths: ~3/4 of the decode pool's slots (one wide request must not
     occupy every slot, nor spawn that many solo seeded decodes);
     OPENAI_FANOUT_WORKERS overrides."""
-    raw = ctx.config.get_or_default("OPENAI_FANOUT_WORKERS", "")
-    if raw:
-        try:
-            return max(1, int(raw))
-        except ValueError:
-            raise HTTPError(
-                500, "OPENAI_FANOUT_WORKERS must be an integer"
-            ) from None
+    override = _fanout_workers_override(ctx)
+    if override is not None:
+        return override
     slots = getattr(
         getattr(ctx.tpu, "decode_pool", None), "n_slots", None
     ) or default_slots
@@ -66,10 +76,15 @@ def _stream_candidates(
             prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
             adapter=adapter, logprobs=want_logprobs,
         )]
-    slots = getattr(
-        getattr(ctx.tpu, "decode_pool", None), "n_slots", None
-    ) or 4
-    bound = max(_fanout_workers(ctx), min(n, slots))
+    override = _fanout_workers_override(ctx)
+    if override is not None:
+        bound = override  # explicit operator bound: obeyed in BOTH directions
+    else:
+        # default: streamed candidates may use up to the pool's full slot
+        # count (they cannot serialize — all indexes must progress)
+        bound = getattr(
+            getattr(ctx.tpu, "decode_pool", None), "n_slots", None
+        ) or 4
     if n > bound:
         raise HTTPError(
             400, f'"n" is capped at {bound} when streaming on this '
@@ -91,10 +106,53 @@ def _stream_candidates(
     return iters
 
 
+def _index_feed_text(
+    dec: Any, scan: Any, finish: list, i: int, emitted: list, token: int,
+) -> tuple:
+    """Decode one token for candidate ``i`` through its stop scanner —
+    the ONE copy of the per-index feed state machine both endpoints'
+    fan-outs share. Returns (text_or_None, stopped): text None means an
+    id-only deployment (no tokenizer; the caller emits the token
+    extension), stopped True means the stop matched (finish set; the
+    returned text is the pre-stop remainder)."""
+    emitted[i] += 1
+    if dec is None:
+        return None, False
+    text = dec.feed(token)
+    if scan is not None:
+        text, done = scan.feed(text)
+        if done:
+            finish[i] = "stop"
+            return text, True
+    return text, False
+
+
+def _index_tail_text(
+    dec: Any, scan: Any, finish: list, i: int, emitted: list,
+    max_tokens: int,
+) -> str:
+    """Flush candidate ``i``'s decoder through its stop scanner and
+    settle its finish reason — the ONE copy of the per-index tail state
+    machine (the subtlest stop/length logic; it must not fork per
+    endpoint). Returns the tail text ('' when already finished)."""
+    t = dec.flush() if dec is not None else ""
+    if finish[i] is not None:
+        return ""
+    if scan is not None:
+        t, done = scan.feed(t)
+        if done:
+            finish[i] = "stop"
+        else:
+            t += scan.flush()
+    if finish[i] is None:
+        finish[i] = "length" if emitted[i] >= max_tokens else "stop"
+    return t
+
+
 def _drive_stream_fanout(
     iters: list, replicate: bool, n: int, finish: list,
     want_logprobs: bool, open_frames: Any, feed: Any, tail: Any,
-    error_frame: Any,
+    error_frame: Any, usage_frames: Any = None,
 ) -> Any:
     """The ONE interleaved-SSE driver both endpoints share: replicate
     mode consumes a single iterator and fans frames across indexes;
@@ -140,6 +198,10 @@ def _drive_stream_fanout(
                 yield from feed(i, token, lp)
                 if finish[i] is not None:
                     cancels[i].set()  # stop matched: free its decode early
+        if usage_frames is not None:
+            # stream_options.include_usage: one final pre-[DONE] chunk
+            # with empty choices and the usage object
+            yield from usage_frames()
         yield "[DONE]"
     except Exception as exc:
         yield error_frame(exc)
@@ -175,8 +237,15 @@ def _multiplex(iters: list) -> tuple:
         except Exception as exc:  # surfaced as an SSE error frame
             out.put((i, ("error", exc)))
         finally:
-            it.close()  # suspended here, owned by this thread: legal
-            out.put((i, STREAM_END))
+            # STREAM_END must post even if close() raises (a cancellation
+            # tearing down the decode can error): a lost sentinel would
+            # wedge the consumer in q.get() forever, hanging the response
+            try:
+                it.close()  # suspended here, owned by this thread: legal
+            except Exception:
+                pass  # the index already ended; nothing left to deliver
+            finally:
+                out.put((i, STREAM_END))
 
     for i, it in enumerate(iters):
         threading.Thread(

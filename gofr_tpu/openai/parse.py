@@ -216,6 +216,28 @@ def _parse_request(ctx: Any, default_max: int) -> tuple:
             top_n, adapter)
 
 
+def _stream_usage_opt(body: dict) -> bool:
+    """OpenAI ``stream_options``: {"include_usage": true} asks for ONE
+    final pre-[DONE] chunk with empty choices and the usage object
+    (and "usage": null on every other chunk — typed SDK clients treat
+    the field as present-when-requested). Only legal with stream."""
+    so = body.get("stream_options")
+    if so is None:
+        return False
+    if not isinstance(so, dict):
+        raise HTTPError(400, '"stream_options" must be an object')
+    if not body.get("stream"):
+        raise HTTPError(
+            400, '"stream_options" is only allowed with "stream": true'
+        )
+    inc = so.get("include_usage", False)
+    if not isinstance(inc, bool):
+        raise HTTPError(
+            400, '"stream_options.include_usage" must be a boolean'
+        )
+    return inc
+
+
 _FANOUT_CAP = 16  # pool-slot-scale bound on n/best_of; beyond it is a 400
 
 

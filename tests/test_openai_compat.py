@@ -1011,3 +1011,43 @@ def test_chat_stream_fanout(chat_base):
     assert roles == {0: "assistant", 1: "assistant"}
     assert sorted(finishes) == [0, 1]
     assert content[0] == content[1] != ""
+
+
+def test_stream_options_include_usage(base, chat_base):
+    """stream_options.include_usage: every chunk carries "usage": null
+    and ONE final pre-[DONE] chunk has empty choices + the usage object
+    (both endpoints, single and fan-out streams); stream_options without
+    stream is a 400."""
+    # completions, single stream
+    ev = _read_sse(base, {"prompt": [1, 2, 3], "max_tokens": 4,
+                          "temperature": 0, "stream": True,
+                          "stream_options": {"include_usage": True}})
+    frames = [json.loads(e) for e in ev[:-1]]
+    assert all(f["usage"] is None for f in frames[:-1])
+    last = frames[-1]
+    assert last["choices"] == []
+    assert last["usage"] == {"prompt_tokens": 3, "completion_tokens": 4,
+                             "total_tokens": 7}
+    # completions, seeded fan-out: usage bills ALL candidates
+    ev = _read_sse(base, {"prompt": [1, 2], "max_tokens": 3,
+                          "temperature": 1.0, "seed": 3, "n": 2,
+                          "stream": True,
+                          "stream_options": {"include_usage": True}})
+    last = json.loads(ev[-2])
+    assert last["choices"] == []
+    assert last["usage"]["completion_tokens"] == 6  # 2 candidates x 3
+    # chat, single stream
+    ev = _read_sse(chat_base, {
+        "messages": [{"role": "user", "content": "go"}],
+        "max_tokens": 3, "temperature": 0, "stream": True,
+        "stream_options": {"include_usage": True},
+    }, path="/v1/chat/completions")
+    last = json.loads(ev[-2])
+    assert last["choices"] == [] and last["usage"]["completion_tokens"] == 3
+    # without stream: loud 400 (OpenAI semantics)
+    try:
+        _post(base, {"prompt": [1, 2], "max_tokens": 2,
+                     "stream_options": {"include_usage": True}})
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400 and "stream_options" in e.read(300).decode()
