@@ -67,15 +67,10 @@ def _stream_chat(
         return _json.dumps(frame)
 
     def usage_frame(completion_tokens: int) -> str:
-        return _json.dumps({
-            "id": chat_id, "object": "chat.completion.chunk",
-            "created": created, "model": model, "choices": [],
-            "usage": {
-                "prompt_tokens": len(prompt_ids),
-                "completion_tokens": completion_tokens,
-                "total_tokens": len(prompt_ids) + completion_tokens,
-            },
-        })
+        from gofr_tpu.openai.fanout import _usage_chunk
+
+        return _usage_chunk("chat.completion.chunk", chat_id, created, model,
+                            len(prompt_ids), completion_tokens)
 
     if n > 1:
         return _stream_chat_fanout(

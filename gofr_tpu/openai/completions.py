@@ -77,15 +77,10 @@ def _stream_completion(
         return _json.dumps(frame)
 
     def usage_frame(completion_tokens: int) -> str:
-        return _json.dumps({
-            "id": cmpl_id, "object": "text_completion",
-            "created": created, "model": model, "choices": [],
-            "usage": {
-                "prompt_tokens": len(prompt_ids),
-                "completion_tokens": completion_tokens,
-                "total_tokens": len(prompt_ids) + completion_tokens,
-            },
-        })
+        from gofr_tpu.openai.fanout import _usage_chunk
+
+        return _usage_chunk("text_completion", cmpl_id, created, model,
+                            len(prompt_ids), completion_tokens)
 
     if n > 1:
         return _stream_completion_fanout(

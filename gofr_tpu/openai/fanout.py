@@ -106,6 +106,26 @@ def _stream_candidates(
     return iters
 
 
+def _usage_chunk(
+    object_name: str, resp_id: str, created: int, model: str,
+    prompt_tokens: int, completion_tokens: int,
+) -> str:
+    """The ONE pre-[DONE] usage frame both endpoints emit under
+    stream_options.include_usage: empty choices + the usage object (a
+    shape change here must hit both endpoints' billing identically)."""
+    import json as _json
+
+    return _json.dumps({
+        "id": resp_id, "object": object_name, "created": created,
+        "model": model, "choices": [],
+        "usage": {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens,
+        },
+    })
+
+
 def _index_feed_text(
     dec: Any, scan: Any, finish: list, i: int, emitted: list, token: int,
 ) -> tuple:

@@ -143,6 +143,29 @@ def _parse_request(ctx: Any, default_max: int) -> tuple:
     # completions fan-out (_parse_fanout).
     if body.get("suffix") is not None:
         raise HTTPError(400, '"suffix" is not supported by this server')
+    # tool calling and modality knobs would change what the model is
+    # ASKED to do — silently ignoring them serves wrong output to a
+    # client that believes its tools were offered
+    for key in ("tools", "tool_choice", "functions", "function_call",
+                "modalities", "audio", "prediction"):
+        value = body.get(key)
+        if value is None:
+            continue
+        if key == "tool_choice" and value == "none":
+            continue  # the documented no-tools default: a semantic no-op
+        raise HTTPError(
+            400, f'"{key}" is not supported by this server'
+        )
+    rf = body.get("response_format")
+    if rf is not None:
+        # {"type": "text"} is the documented default — honoring it is a
+        # no-op; constrained JSON output is not implemented, and a
+        # client trusting json_object/json_schema would parse free text
+        if not (isinstance(rf, dict) and rf.get("type") == "text"):
+            raise HTTPError(
+                400, '"response_format" types other than "text" are not '
+                "supported by this server (no constrained decoding)"
+            )
     # nullable like the sampling knobs: explicit JSON null = the default.
     # max_tokens=0 is legal ONLY with echo (pure prompt scoring, the
     # eval-harness loglikelihood pattern) — without echo it would return
@@ -229,6 +252,13 @@ def _stream_usage_opt(body: dict) -> bool:
     if not body.get("stream"):
         raise HTTPError(
             400, '"stream_options" is only allowed with "stream": true'
+        )
+    unknown = set(so) - {"include_usage"}
+    if unknown:
+        # a misspelled include_usage must not silently stream with no
+        # usage frame (the client's accounting would wait forever)
+        raise HTTPError(
+            400, f'unknown "stream_options" keys: {sorted(unknown)}'
         )
     inc = so.get("include_usage", False)
     if not isinstance(inc, bool):

@@ -1051,3 +1051,33 @@ def test_stream_options_include_usage(base, chat_base):
         raise AssertionError("expected 400")
     except urllib.error.HTTPError as e:
         assert e.code == 400 and "stream_options" in e.read(300).decode()
+
+
+def test_tool_and_format_knobs_400_not_silent(base, chat_base):
+    """Tool-calling and modality knobs must 400 loudly — a client that
+    believes its tools were offered (or its JSON schema enforced) would
+    otherwise trust free-text output. response_format type "text" (the
+    documented default) is a no-op and passes."""
+    for key, value in (
+        ("tools", [{"type": "function", "function": {"name": "f"}}]),
+        ("tool_choice", "auto"),
+        ("functions", [{"name": "f"}]),
+        ("function_call", "auto"),
+        ("response_format", {"type": "json_object"}),
+        ("response_format", {"type": "json_schema", "json_schema": {}}),
+        ("modalities", ["text", "audio"]),
+    ):
+        try:
+            _post(chat_base, {
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 2, key: value,
+            }, path="/v1/chat/completions")
+            raise AssertionError(f"expected 400 for {key}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert key.split("_")[0] in e.read(300).decode()
+    # the default-equivalent form passes on both endpoints
+    status, _ = _post(base, {"prompt": [1, 2], "max_tokens": 2,
+                             "temperature": 0,
+                             "response_format": {"type": "text"}})
+    assert status == 200
