@@ -1710,16 +1710,30 @@ class _TransformerRunner:
             self.spec is not None and presence is None
             and not logprobs and adapter is None
         )
+        # seed the prefix cache with the finish-time conversation KV (base
+        # requests on an unsharded-batch cache): a follow-up turn then
+        # reuses the WHOLE conversation's KV. ONE predicate for the
+        # pooled, solo, AND speculative paths — they must never drift
+        seed_kv = (
+            self._prefix_cache is not None and adapter is None
+            and self._can_chunk_prefill()
+        )
         if spec_ok and sampler.greedy:
-            return self._spec_generate(
+            out, spec_cache = self._spec_generate(
                 state, ids, out, token, max_new_tokens, on_token, stop,
                 stop_tokens,
             )
+            if seed_kv:
+                self._prefix_store_generation(ids, out, spec_cache, sampler)
+            return out
         if spec_ok and not sampler.seeded and self.spec.k >= 2:
-            return self._spec_generate_sampled(
+            out, spec_cache = self._spec_generate_sampled(
                 state, ids, out, token, max_new_tokens, on_token, stop,
                 stop_tokens, sampler,
             )
+            if seed_kv:
+                self._prefix_store_generation(ids, out, spec_cache, sampler)
+            return out
 
         # continuous batching: unseeded requests decode in the shared pool
         # (seeded ones need the exact per-request key sequence — solo
@@ -1732,14 +1746,6 @@ class _TransformerRunner:
         # the pool's stacked bank (per-slot adapter selection); the pool
         # rejects them — and they solo — while the bank is off,
         # rebuilding, mesh-disabled, or a penalized slot is active.
-        # seed the prefix cache with the finish-time conversation KV (base
-        # requests on an unsharded-batch cache): a follow-up turn then
-        # reuses the WHOLE conversation's KV. ONE predicate for the
-        # pooled and solo paths — they must never drift
-        seed_kv = (
-            self._prefix_cache is not None and adapter is None
-            and self._can_chunk_prefill()
-        )
         if decode_pool is not None and not sampler.seeded:
             import queue as queue_mod
 
@@ -2219,18 +2225,20 @@ class _TransformerRunner:
         self, cache: Any, cache_len: int, max_len: int, token: int,
         out: list[int], max_new_tokens: int, emit: Any, stop: Any,
         key_fn: Any, temp: float, tk: int, tp_: float, mp: float,
-    ) -> None:
+    ) -> Any:
         """Capacity-tail fallback both spec paths share: the cache got
         too full for a verify but budget remains — finish with plain
         single-step decodes through the already-warmed n=1 chunk (the
         sampling knobs are dynamic operands, so greedy and sampled use
-        the same executable)."""
+        the same executable). Returns the FINAL cache — _set_cache_len
+        donates its input, so the caller's reference dies here and the
+        conversation-KV store needs the live one."""
         if not (
             len(out) < max_new_tokens
             and not (stop is not None and stop.is_set())
             and cache_len < max_len
         ):
-            return
+            return cache
         cache = self._set_cache_len(cache, cache_len)
         while (
             len(out) < max_new_tokens
@@ -2245,6 +2253,7 @@ class _TransformerRunner:
             cache_len += 1
             if not emit([token]):
                 break
+        return cache
 
     def _spec_generate(
         self,
@@ -2256,7 +2265,7 @@ class _TransformerRunner:
         on_token: Any,
         stop: Any,
         stop_tokens: frozenset,
-    ) -> list[int]:
+    ) -> tuple:
         """Greedy speculative decode: per cycle, ONE draft chunk proposes
         k tokens, ONE target forward verifies all of them, ONE [k+2] fetch
         returns the target's argmaxes plus the on-device accepted count —
@@ -2320,11 +2329,11 @@ class _TransformerRunner:
         else:
             # natural exhaustion only (a break above means a stop
             # condition already fired)
-            self._spec_tail(
+            cache = self._spec_tail(
                 cache, cache_len, max_len, token, out, max_new_tokens,
                 emit, stop, lambda: self._greedy_key, 0.0, 0, 1.0, 0.0,
             )
-        return out
+        return out, cache
 
     def _spec_generate_sampled(
         self,
@@ -2337,7 +2346,7 @@ class _TransformerRunner:
         stop: Any,
         stop_tokens: frozenset,
         sampler: Any,
-    ) -> list[int]:
+    ) -> tuple:
         """Speculative SAMPLING (temperature > 0): per cycle the draft
         proposes k sampled tokens with their warped distributions q, the
         target verifies k-1 of them in one forward with the canonical
@@ -2406,11 +2415,11 @@ class _TransformerRunner:
             dcache = spec.reset_len(dcache, cache_len)
             token = int(row[n_use])
         else:
-            self._spec_tail(
+            cache = self._spec_tail(
                 cache, cache_len, max_len, token, out, max_new_tokens,
                 emit, stop, sampler.take_key, temp, tk, tp_, mp,
             )
-        return out
+        return out, cache
 
     def warmup(self, progress: Any = None) -> None:
         # one compiled prefill per sequence bucket (batch fixed at

@@ -165,3 +165,30 @@ def test_unknown_draft_name_fails_fast():
             new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
     finally:
         _restore(old)
+
+
+def test_spec_generation_seeds_conversation_kv(plain):
+    """A speculative generation seeds the prefix cache with the whole
+    conversation (DRAFT deployments are the latency-mode chat shape):
+    the follow-up turn partial-hits and stays bit-identical to plain
+    greedy."""
+    dev, old = _device(
+        DRAFT_MODEL_NAME="tiny", DRAFT_TOKENS="4", DECODE_POOL="off",
+        DECODE_CHUNK="4", PREFIX_CACHE="4", PREFIX_LCP_MIN="4",
+    )
+    try:
+        turn1 = [7, 3, 9, 2, 11, 5, 61, 62]
+        reply = dev.generate(turn1, max_new_tokens=8)
+        assert reply == plain.generate(turn1, max_new_tokens=8)
+        followup = turn1 + reply + [71, 72]
+        want = plain.generate(followup, max_new_tokens=6)
+        before = dict(dev.runner.prefix_stats)
+        got = dev.generate(followup, max_new_tokens=6)
+        assert got == want
+        assert (
+            dev.runner.prefix_stats["partial_hits"]
+            == before["partial_hits"] + 1
+        )
+    finally:
+        dev.close()
+        _restore(old)
