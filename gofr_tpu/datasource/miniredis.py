@@ -154,122 +154,177 @@ def _read_command(sock: socket.socket, buf: bytes) -> tuple[list[bytes], bytes, 
     return args, buf, False
 
 
-def _dispatch(store: _Store, cmd: str, args: list[str]) -> Any:
-    if cmd == "PING":
-        return _Simple(args[0]) if args else _Simple("PONG")
-    if cmd == "ECHO":
-        return args[0]
-    if cmd == "SET":
-        ex = None
-        i = 2
-        while i < len(args):
-            opt = args[i].upper()
-            if opt == "EX" and i + 1 < len(args):
-                ex = float(args[i + 1])
-                i += 2
-            elif opt == "PX" and i + 1 < len(args):
-                ex = float(args[i + 1]) / 1000.0
-                i += 2
-            else:
-                i += 1
-        store.set(args[0], args[1], ex)
-        return OK
-    if cmd == "GET":
-        value = store.get(args[0])
-        if isinstance(value, (dict, list)):
-            return _Error("WRONGTYPE Operation against a key holding the wrong kind of value")
-        return value
-    if cmd == "DEL":
-        return sum(1 for k in args if store.delete(k))
-    if cmd == "EXISTS":
-        return sum(1 for k in args if store.get(k) is not None)
-    if cmd in ("INCR", "DECR", "INCRBY", "DECRBY"):
-        delta = int(args[1]) if len(args) > 1 else 1
-        if cmd.startswith("DECR"):
-            delta = -delta
-        with store.lock:
-            current = store.get(args[0])
-            try:
-                value = (int(current) if current is not None else 0) + delta
-            except (TypeError, ValueError):
-                return _Error("ERR value is not an integer or out of range")
-            deadline = store.expiry.get(args[0])  # INCR preserves TTL
-            store.set(args[0], str(value), None)
-            if deadline is not None:
-                store.expiry[args[0]] = deadline
-        return value
-    if cmd == "EXPIRE":
-        with store.lock:
-            if store.get(args[0]) is None:
-                return 0
-            store.expiry[args[0]] = time.monotonic() + float(args[1])
-            return 1
-    if cmd == "TTL":
-        with store.lock:
-            if store.get(args[0]) is None:
-                return -2
-            deadline = store.expiry.get(args[0])
-            if deadline is None:
-                return -1
-            return max(0, int(round(deadline - time.monotonic())))
-    if cmd == "KEYS":
-        pattern = args[0] if args else "*"
-        return [k for k in store.keys() if fnmatch.fnmatchcase(k, pattern)]
-    if cmd == "INFO":
-        return (
-            "# Server\r\nredis_version:7.0.0-mini\r\n"
-            "# Clients\r\nconnected_clients:1\r\n"
-            "# Memory\r\nused_memory:1024\r\n"
+def _cmd_ping(store: _Store, cmd: str, args: list[str]) -> Any:
+    return _Simple(args[0]) if args else _Simple("PONG")
+
+
+def _cmd_echo(store: _Store, cmd: str, args: list[str]) -> Any:
+    return args[0]
+
+
+def _cmd_set(store: _Store, cmd: str, args: list[str]) -> Any:
+    ex = None
+    i = 2
+    while i < len(args):
+        opt = args[i].upper()
+        if opt == "EX" and i + 1 < len(args):
+            ex = float(args[i + 1])
+            i += 2
+        elif opt == "PX" and i + 1 < len(args):
+            ex = float(args[i + 1]) / 1000.0
+            i += 2
+        else:
+            i += 1
+    store.set(args[0], args[1], ex)
+    return OK
+
+
+def _cmd_get(store: _Store, cmd: str, args: list[str]) -> Any:
+    value = store.get(args[0])
+    if isinstance(value, (dict, list)):
+        return _Error(
+            "WRONGTYPE Operation against a key holding the wrong kind of value"
         )
-    if cmd == "FLUSHDB":
-        with store.lock:
-            store.data.clear()
-            store.expiry.clear()
-        return OK
-    if cmd == "HSET":
-        with store.lock:
-            h = store.get(args[0])
-            if h is None:
-                h = {}
-                store.set(args[0], h, None)
-            added = 0
-            for field, value in zip(args[1::2], args[2::2]):
-                added += 0 if field in h else 1
-                h[field] = value
-            return added
-    if cmd == "HGET":
+    return value
+
+
+def _cmd_del(store: _Store, cmd: str, args: list[str]) -> Any:
+    return sum(1 for k in args if store.delete(k))
+
+
+def _cmd_exists(store: _Store, cmd: str, args: list[str]) -> Any:
+    return sum(1 for k in args if store.get(k) is not None)
+
+
+def _cmd_incr(store: _Store, cmd: str, args: list[str]) -> Any:
+    delta = int(args[1]) if len(args) > 1 else 1
+    if cmd.startswith("DECR"):
+        delta = -delta
+    with store.lock:
+        current = store.get(args[0])
+        try:
+            value = (int(current) if current is not None else 0) + delta
+        except (TypeError, ValueError):
+            return _Error("ERR value is not an integer or out of range")
+        deadline = store.expiry.get(args[0])  # INCR preserves TTL
+        store.set(args[0], str(value), None)
+        if deadline is not None:
+            store.expiry[args[0]] = deadline
+    return value
+
+
+def _cmd_expire(store: _Store, cmd: str, args: list[str]) -> Any:
+    with store.lock:
+        if store.get(args[0]) is None:
+            return 0
+        store.expiry[args[0]] = time.monotonic() + float(args[1])
+        return 1
+
+
+def _cmd_ttl(store: _Store, cmd: str, args: list[str]) -> Any:
+    with store.lock:
+        if store.get(args[0]) is None:
+            return -2
+        deadline = store.expiry.get(args[0])
+        if deadline is None:
+            return -1
+        return max(0, int(round(deadline - time.monotonic())))
+
+
+def _cmd_keys(store: _Store, cmd: str, args: list[str]) -> Any:
+    pattern = args[0] if args else "*"
+    return [k for k in store.keys() if fnmatch.fnmatchcase(k, pattern)]
+
+
+def _cmd_info(store: _Store, cmd: str, args: list[str]) -> Any:
+    return (
+        "# Server\r\nredis_version:7.0.0-mini\r\n"
+        "# Clients\r\nconnected_clients:1\r\n"
+        "# Memory\r\nused_memory:1024\r\n"
+    )
+
+
+def _cmd_flushdb(store: _Store, cmd: str, args: list[str]) -> Any:
+    with store.lock:
+        store.data.clear()
+        store.expiry.clear()
+    return OK
+
+
+def _cmd_hset(store: _Store, cmd: str, args: list[str]) -> Any:
+    with store.lock:
         h = store.get(args[0])
-        return None if not isinstance(h, dict) else h.get(args[1])
-    if cmd == "HGETALL":
-        h = store.get(args[0])
-        if not isinstance(h, dict):
-            return []
-        out: list[str] = []
-        for k, v in h.items():
-            out.extend((k, v))
-        return out
-    if cmd in ("LPUSH", "RPUSH"):
-        with store.lock:
-            lst = store.get(args[0])
-            if lst is None:
-                lst = []
-                store.set(args[0], lst, None)
-            for v in args[1:]:
-                lst.insert(0, v) if cmd == "LPUSH" else lst.append(v)
-            return len(lst)
-    if cmd in ("LPOP", "RPOP"):
-        with store.lock:
-            lst = store.get(args[0])
-            if not lst:
-                return None
-            return lst.pop(0) if cmd == "LPOP" else lst.pop()
-    if cmd == "LRANGE":
-        lst = store.get(args[0]) or []
-        start, stop = int(args[1]), int(args[2])
-        if stop == -1:
-            return lst[start:]
-        return lst[start : stop + 1]
-    return _Error(f"ERR unknown command '{cmd}'")
+        if h is None:
+            h = {}
+            store.set(args[0], h, None)
+        added = 0
+        for field, value in zip(args[1::2], args[2::2]):
+            added += 0 if field in h else 1
+            h[field] = value
+        return added
+
+
+def _cmd_hget(store: _Store, cmd: str, args: list[str]) -> Any:
+    h = store.get(args[0])
+    return None if not isinstance(h, dict) else h.get(args[1])
+
+
+def _cmd_hgetall(store: _Store, cmd: str, args: list[str]) -> Any:
+    h = store.get(args[0])
+    if not isinstance(h, dict):
+        return []
+    out: list[str] = []
+    for k, v in h.items():
+        out.extend((k, v))
+    return out
+
+
+def _cmd_push(store: _Store, cmd: str, args: list[str]) -> Any:
+    with store.lock:
+        lst = store.get(args[0])
+        if lst is None:
+            lst = []
+            store.set(args[0], lst, None)
+        for v in args[1:]:
+            lst.insert(0, v) if cmd == "LPUSH" else lst.append(v)
+        return len(lst)
+
+
+def _cmd_pop(store: _Store, cmd: str, args: list[str]) -> Any:
+    with store.lock:
+        lst = store.get(args[0])
+        if not lst:
+            return None
+        return lst.pop(0) if cmd == "LPOP" else lst.pop()
+
+
+def _cmd_lrange(store: _Store, cmd: str, args: list[str]) -> Any:
+    lst = store.get(args[0]) or []
+    start, stop = int(args[1]), int(args[2])
+    if stop == -1:
+        return lst[start:]
+    return lst[start : stop + 1]
+
+
+# command table: each handler takes (store, cmd, args) — variant commands
+# (INCR/DECR, LPUSH/RPUSH, LPOP/RPOP) share a handler and branch on cmd
+_COMMANDS: dict = {
+    "PING": _cmd_ping, "ECHO": _cmd_echo, "SET": _cmd_set,
+    "GET": _cmd_get, "DEL": _cmd_del, "EXISTS": _cmd_exists,
+    "INCR": _cmd_incr, "DECR": _cmd_incr, "INCRBY": _cmd_incr,
+    "DECRBY": _cmd_incr, "EXPIRE": _cmd_expire, "TTL": _cmd_ttl,
+    "KEYS": _cmd_keys, "INFO": _cmd_info, "FLUSHDB": _cmd_flushdb,
+    "HSET": _cmd_hset, "HGET": _cmd_hget, "HGETALL": _cmd_hgetall,
+    "LPUSH": _cmd_push, "RPUSH": _cmd_push, "LPOP": _cmd_pop,
+    "RPOP": _cmd_pop, "LRANGE": _cmd_lrange,
+}
+
+
+def _dispatch(store: _Store, cmd: str, args: list[str]) -> Any:
+    handler = _COMMANDS.get(cmd)
+    if handler is None:
+        return _Error(f"ERR unknown command '{cmd}'")
+    return handler(store, cmd, args)
 
 
 class MiniRedis:
