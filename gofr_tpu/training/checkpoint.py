@@ -53,18 +53,34 @@ def save_train_state(path: str, params: Any, opt_state: Any, step: int) -> None:
 
 
 def latest_step(path: str) -> Optional[int]:
+    """Highest completed ``state_<n>`` under ``path``. Names that are
+    not exactly state_<int> — notably orbax's 'state_3.orbax-…-tmp-…'
+    directories left by an interrupted save, the very scenario resume
+    exists for — are skipped, not crashed on."""
     try:
-        steps = [
-            int(name.split("_", 1)[1])
-            for name in os.listdir(os.path.abspath(path))
-            if name.startswith("state_")
-        ]
-        return max(steps) if steps else None
+        names = os.listdir(os.path.abspath(path))
     except OSError:
         return None
+    steps = []
+    for name in names:
+        if not name.startswith("state_"):
+            continue
+        suffix = name.split("_", 1)[1]
+        if suffix.isdigit():
+            steps.append(int(suffix))
+    return max(steps) if steps else None
 
 
-def restore_train_state(path: str, step: Optional[int] = None) -> Any:
+def restore_train_state(
+    path: str, step: Optional[int] = None, like: Any = None
+) -> Any:
+    """Restore {"params", "opt_state", "step"} for resume. ``like`` (a
+    fresh ``init_train_state`` result, or any state with the same
+    structure) is REQUIRED to actually resume: optax states are
+    namedtuple pytrees whose types are not self-describing in the
+    checkpoint — an untyped restore returns plain dicts/lists that the
+    optimizer's update() cannot consume (caught by the resume test).
+    Untyped restore (like=None) remains for params-only inspection."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
@@ -73,4 +89,11 @@ def restore_train_state(path: str, step: Optional[int] = None) -> Any:
         if step is None:
             raise FileNotFoundError(f"no training state under {path}")
     checkpointer = ocp.StandardCheckpointer()
-    return checkpointer.restore(os.path.join(path, f"state_{step}"))
+    target = os.path.join(path, f"state_{step}")
+    if like is not None:
+        return checkpointer.restore(
+            target,
+            target={"params": like["params"],
+                    "opt_state": like["opt_state"], "step": like["step"]},
+        )
+    return checkpointer.restore(target)
