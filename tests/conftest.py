@@ -36,3 +36,30 @@ def free_port():
             return s.getsockname()[1]
 
     return _get
+
+
+@pytest.fixture
+def make_plain_app(free_port, monkeypatch, tmp_path):
+    """ONE place that builds a datasource-free App for transport tests
+    (http/app/protocol suites shared this setup as drifting copies: the
+    env-scrub list must grow in ONE spot when the container gains a new
+    datasource host). Returns a builder; the caller registers routes and
+    calls start(). Teardown shuts the app down."""
+    import gofr_tpu
+
+    built = []
+
+    def _build():
+        monkeypatch.setenv("HTTP_PORT", str(free_port()))
+        monkeypatch.setenv("LOG_LEVEL", "FATAL")
+        for key in ("REDIS_HOST", "DB_NAME", "DB_HOST", "TPU_ENABLED",
+                    "MODEL_NAME"):
+            monkeypatch.delenv(key, raising=False)
+        monkeypatch.chdir(tmp_path)
+        application = gofr_tpu.new()
+        built.append(application)
+        return application
+
+    yield _build
+    for application in built:
+        application.shutdown()

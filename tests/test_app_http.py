@@ -15,18 +15,9 @@ from gofr_tpu.http.response import Raw, Stream
 
 
 @pytest.fixture
-def app(free_port, monkeypatch, tmp_path):
-    monkeypatch.setenv("HTTP_PORT", str(free_port()))
-    monkeypatch.setenv("LOG_LEVEL", "FATAL")
-    monkeypatch.delenv("REDIS_HOST", raising=False)
-    monkeypatch.delenv("DB_NAME", raising=False)
-    monkeypatch.delenv("DB_HOST", raising=False)
-    monkeypatch.delenv("TPU_ENABLED", raising=False)
-    monkeypatch.delenv("MODEL_NAME", raising=False)
-    monkeypatch.chdir(tmp_path)
-    application = gofr_tpu.new()
-    yield application
-    application.shutdown()
+def app(make_plain_app):
+    # shared conftest builder: ONE env-scrub list for every transport suite
+    return make_plain_app()
 
 
 def _get(url):
@@ -342,7 +333,14 @@ def test_run_drains_on_sigterm(app, monkeypatch):
 
     def fire():
         if not installed.wait(timeout=10):
-            return  # run() never got there; the test will fail on join
+            # run() never installed the handler: interrupt the main
+            # thread (run() handles KeyboardInterrupt and drains) so the
+            # test FAILS on the assert below instead of hanging the
+            # whole suite in stop.wait()
+            import _thread
+
+            _thread.interrupt_main()
+            return
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
             try:

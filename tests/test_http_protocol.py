@@ -1,0 +1,98 @@
+"""Wire-level HTTP/1.1 protocol tests against the real server: raw
+sockets drive the parse/limit/framing paths urllib can't reach —
+malformed heads, bad content-length, oversized headers, HTTP/1.0
+connection handling, HEAD framing, and chunked request bodies."""
+
+import json
+import socket
+
+import pytest
+
+
+@pytest.fixture
+def app(make_plain_app):
+    application = make_plain_app()
+    application.post("/echo", lambda ctx: ctx.bind())
+    application.get("/hello", lambda ctx: "hi")
+    application.start()
+    return application
+
+
+def _raw(app, payload: bytes) -> bytes:
+    with socket.create_connection(("127.0.0.1", app.http_port), timeout=10) as s:
+        s.sendall(payload)
+        s.settimeout(10)
+        out = b""
+        try:
+            while True:
+                data = s.recv(65536)
+                if not data:
+                    break
+                out += data
+        except socket.timeout:
+            pass
+        return out
+
+
+def test_malformed_request_head_400(app):
+    out = _raw(app, b"NOT A REQUEST\r\n\r\n")
+    assert out.startswith(b"HTTP/1.1 400")
+    assert b"malformed" in out
+
+
+def test_bad_content_length_400(app):
+    out = _raw(app, b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Length: banana\r\n\r\n")
+    assert out.startswith(b"HTTP/1.1 400")
+    assert b"content-length" in out
+
+
+def test_oversized_headers_431(app):
+    big = b"X-Pad: " + b"a" * (70 * 1024) + b"\r\n"
+    out = _raw(app, b"GET /hello HTTP/1.1\r\nHost: x\r\n" + big + b"\r\n")
+    assert out.startswith(b"HTTP/1.1 431")
+
+
+def test_oversized_body_413_without_upload(app):
+    # the limit must reject on the DECLARED length — before any body
+    # bytes are read (a slow client must not upload 64MB to get a 413)
+    out = _raw(app, b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Length: 999999999\r\n\r\n")
+    assert out.startswith(b"HTTP/1.1 413")
+
+
+def test_http10_connection_closes(app):
+    out = _raw(app, b"GET /hello HTTP/1.0\r\nHost: x\r\n\r\n")
+    assert out.startswith(b"HTTP/1.0 200") or out.startswith(b"HTTP/1.1 200")
+    assert b"Connection: close" in out
+    # the server closed after the response (recv drained to EOF above)
+
+
+def test_head_advertises_length_without_body(app):
+    out = _raw(app, b"HEAD /hello HTTP/1.1\r\nHost: x\r\n"
+                    b"Connection: close\r\n\r\n")
+    head, _, body = out.partition(b"\r\n\r\n")
+    assert head.startswith(b"HTTP/1.1 200")
+    # Content-Length advertises what GET would return; body itself empty
+    length = [ln for ln in head.split(b"\r\n")
+              if ln.lower().startswith(b"content-length")]
+    assert length and int(length[0].split(b":")[1]) > 0
+    assert body == b""
+
+
+def test_chunked_request_body(app):
+    payload = json.dumps({"a": 1}).encode()
+    chunked = (b"%x\r\n" % len(payload)) + payload + b"\r\n0\r\n\r\n"
+    out = _raw(app, b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                    b"Transfer-Encoding: chunked\r\n"
+                    b"Connection: close\r\n\r\n" + chunked)
+    assert out.startswith(b"HTTP/1.1 200")
+    assert b'{"a": 1}' in out or b'{"a":1}' in out
+
+
+def test_pipelined_keepalive_requests(app):
+    # two requests written back-to-back on one connection: both answered
+    two = (b"GET /hello HTTP/1.1\r\nHost: x\r\n\r\n"
+           b"GET /hello HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+    out = _raw(app, two)
+    assert out.count(b"HTTP/1.1 200") == 2
