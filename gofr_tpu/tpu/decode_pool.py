@@ -795,29 +795,9 @@ class DecodePool:
             cancelled = req.stop is not None and req.stop.is_set()
             hit_stop_token = False
             if not cancelled and req.out_queue is not None:
-                # ONE queue put per chunk (a burst list), not one per token:
-                # per-token puts wake the consuming request thread up to
-                # chunk times per dispatch, and that GIL churn is on the
-                # worker's critical path between dispatches
-                burst: list = []
-                for j, t in enumerate(emitted[:take]):
-                    if int(t) in req.stop_tokens:
-                        hit_stop_token = True  # ends stream, not emitted
-                        break
-                    if req.want_lp:
-                        # (token, lp, tops|None): tops only for requests
-                        # that asked for alternatives — building 5 tuples
-                        # per token sits on the worker's critical path
-                        tops = None
-                        if req.want_top:
-                            tops = [
-                                (int(tids[index, j, m]),
-                                 float(tvals[index, j, m]))
-                                for m in range(tids.shape[-1])
-                            ]
-                        burst.append((int(t), float(emitted_lps[j]), tops))
-                    else:
-                        burst.append(int(t))
+                burst, hit_stop_token = self._build_burst(
+                    req, index, emitted, emitted_lps, tvals, tids, take
+                )
                 if burst:
                     req.out_queue.put(burst)
                     delivered += len(burst)  # only tokens a request received
@@ -828,72 +808,7 @@ class DecodePool:
                 or req.remaining <= 0
                 or req.cache_len >= self.max_len
             ):
-                req.finished = True
-                if (
-                    req.want_kv and not cancelled
-                    and req.out_queue is not None
-                    and self._slots[index].request is req
-                ):
-                    # hand the slot's KV row back before DONE so the
-                    # device can seed its prefix cache with the WHOLE
-                    # conversation. Enqueued under the pool lock: the
-                    # copy is ordered before any later dispatch donates
-                    # the cache, and before any write_slot reuses the
-                    # row — the prefix positions it reads are final.
-                    # (Lockstep garbage decode only APPENDS past the
-                    # request's length; the device rolls the copy back.)
-                    req.out_queue.put(
-                        ("kv", self._read_slot(self.cache, index))
-                    )
-                if req.out_queue is not None:
-                    req.out_queue.put(DONE)
-                req.out_queue = None
-                req.stop = None
-                slot = self._slots[index]
-                if slot.request is req:  # not already reused
-                    slot.request = None
-                    del self._active[index]
-                    self._free.append(slot)
-                    # reset the slot's sampling knobs to greedy: one past
-                    # sampled request must not keep jnp.all(temps <= 0)
-                    # false forever and defeat the all-greedy fast path in
-                    # sample_logits_rows (a full-vocab sort per step)
-                    if (
-                        self._temps[index] != 0.0
-                        or self._top_ks[index] != 0
-                        or self._top_ps[index] != 1.0
-                        or self._min_ps[index] != 0.0
-                    ):
-                        self._temps[index] = 0.0
-                        self._top_ks[index] = 0
-                        self._top_ps[index] = 1.0
-                        self._min_ps[index] = 0.0
-                        self._sampling_dirty = True
-                    if index in self._lora_slots:
-                        # the freed slot must stop selecting the adapter:
-                        # a plain request reusing it under the adapter
-                        # executable gathers bank entry 0 (exact zero
-                        # delta = base numerics)
-                        self._lora_slots.discard(index)
-                        self._lora_ids[index] = 0
-                        self._lora_dirty = True
-                        if self._lora_pending and not self._lora_slots:
-                            # a bank rebuild waited for these slots
-                            self._install_lora(*self._lora_pending)
-                    if index in self._pen_slots:
-                        # identity knobs: a plain request reusing the slot
-                        # under the penalized executable must sample
-                        # exactly like the plain one. Presence/counts need
-                        # no reset — identity knobs neutralize them (and
-                        # lockstep garbage decode re-dirties them anyway);
-                        # the bias row is written only at submit and
-                        # applied unconditionally, so IT must be zeroed.
-                        self._pen_slots.discard(index)
-                        self._reps[index] = 1.0
-                        self._pps[index] = 0.0
-                        self._fps[index] = 0.0
-                        self._pen_dirty = True
-                        self._bias = self._zero_bias(self._bias, index)
+                self._finish_request(index, req, cancelled)
         if self._depth_gauge:
             self._depth_gauge.set(len(self._active))
         if self._mfu_gauge is not None and delivered:
@@ -920,6 +835,108 @@ class DecodePool:
                 model=self._model, op="decode",
             )
 
+
+    def _build_burst(
+        self, req: "_Request", index: int, emitted: Any, emitted_lps: Any,
+        tvals: Any, tids: Any, take: int,
+    ) -> tuple:
+        """ONE queue put per chunk (a burst list), not one per token:
+        per-token puts wake the consuming request thread up to chunk
+        times per dispatch, and that GIL churn is on the worker's
+        critical path between dispatches. Returns (burst,
+        hit_stop_token) — a stop token ends the stream and is not
+        emitted."""
+        burst: list = []
+        for j, t in enumerate(emitted[:take]):
+            if int(t) in req.stop_tokens:
+                return burst, True
+            if req.want_lp:
+                # (token, lp, tops|None): tops only for requests that
+                # asked for alternatives — building 5 tuples per token
+                # sits on the worker's critical path
+                tops = None
+                if req.want_top:
+                    tops = [
+                        (int(tids[index, j, m]), float(tvals[index, j, m]))
+                        for m in range(tids.shape[-1])
+                    ]
+                burst.append((int(t), float(emitted_lps[j]), tops))
+            else:
+                burst.append(int(t))
+        return burst, False
+
+    def _finish_request(self, index: int, req: "_Request",
+                        cancelled: bool) -> None:
+        """Terminal delivery for one request (pool lock held): optional
+        KV hand-back, DONE, and — unless the slot was already reused —
+        freeing it with every per-slot state reset (sampling knobs,
+        adapter id, penalty rows)."""
+        req.finished = True
+        if (
+            req.want_kv and not cancelled
+            and req.out_queue is not None
+            and self._slots[index].request is req
+        ):
+            # hand the slot's KV row back before DONE so the
+            # device can seed its prefix cache with the WHOLE
+            # conversation. Enqueued under the pool lock: the
+            # copy is ordered before any later dispatch donates
+            # the cache, and before any write_slot reuses the
+            # row — the prefix positions it reads are final.
+            # (Lockstep garbage decode only APPENDS past the
+            # request's length; the device rolls the copy back.)
+            req.out_queue.put(
+                ("kv", self._read_slot(self.cache, index))
+            )
+        if req.out_queue is not None:
+            req.out_queue.put(DONE)
+        req.out_queue = None
+        req.stop = None
+        slot = self._slots[index]
+        if slot.request is req:  # not already reused
+            slot.request = None
+            del self._active[index]
+            self._free.append(slot)
+            # reset the slot's sampling knobs to greedy: one past
+            # sampled request must not keep jnp.all(temps <= 0)
+            # false forever and defeat the all-greedy fast path in
+            # sample_logits_rows (a full-vocab sort per step)
+            if (
+                self._temps[index] != 0.0
+                or self._top_ks[index] != 0
+                or self._top_ps[index] != 1.0
+                or self._min_ps[index] != 0.0
+            ):
+                self._temps[index] = 0.0
+                self._top_ks[index] = 0
+                self._top_ps[index] = 1.0
+                self._min_ps[index] = 0.0
+                self._sampling_dirty = True
+            if index in self._lora_slots:
+                # the freed slot must stop selecting the adapter:
+                # a plain request reusing it under the adapter
+                # executable gathers bank entry 0 (exact zero
+                # delta = base numerics)
+                self._lora_slots.discard(index)
+                self._lora_ids[index] = 0
+                self._lora_dirty = True
+                if self._lora_pending and not self._lora_slots:
+                    # a bank rebuild waited for these slots
+                    self._install_lora(*self._lora_pending)
+            if index in self._pen_slots:
+                # identity knobs: a plain request reusing the slot
+                # under the penalized executable must sample
+                # exactly like the plain one. Presence/counts need
+                # no reset — identity knobs neutralize them (and
+                # lockstep garbage decode re-dirties them anyway);
+                # the bias row is written only at submit and
+                # applied unconditionally, so IT must be zeroed.
+                self._pen_slots.discard(index)
+                self._reps[index] = 1.0
+                self._pps[index] = 0.0
+                self._fps[index] = 0.0
+                self._pen_dirty = True
+                self._bias = self._zero_bias(self._bias, index)
     def close(self) -> None:
         with self._work:
             self._closed = True
