@@ -937,6 +937,7 @@ class DecodePool:
                 self._fps[index] = 0.0
                 self._pen_dirty = True
                 self._bias = self._zero_bias(self._bias, index)
+
     def close(self) -> None:
         with self._work:
             self._closed = True

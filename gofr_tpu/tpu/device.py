@@ -1683,6 +1683,7 @@ class _TransformerRunner:
             token = sampler.pick(state["logits"])
         if ttft_cb:
             ttft_cb()
+
         def _done():
             if top_logprobs:
                 return out, lps, tops
