@@ -20,6 +20,7 @@ from gofr_tpu.openai.template import render_chat_prompt
 
 from gofr_tpu.errors import HTTPError
 
+
 def _stream_chat(
     ctx: Any, body: dict, prompt_ids: list, max_tokens: int, sampler: Any,
     stop_ids: Any, stop_strs: list, want_logprobs: bool, top_n: int,

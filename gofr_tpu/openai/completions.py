@@ -19,6 +19,7 @@ from gofr_tpu.openai.parse import (
 
 from gofr_tpu.errors import HTTPError
 
+
 def _stream_completion(
     ctx: Any, body: dict, prompt_ids: list, max_tokens: int, sampler: Any,
     stop_ids: Any, stop_strs: list, want_logprobs: bool, top_n: int,

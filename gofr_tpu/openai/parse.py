@@ -8,6 +8,7 @@ from typing import Any
 
 from gofr_tpu.errors import HTTPError
 
+
 def _prompt_tokens(ctx: Any, prompt: Any) -> list[int]:
     if isinstance(prompt, str):
         tok = ctx.tpu.tokenizer
