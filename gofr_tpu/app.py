@@ -36,6 +36,8 @@ from gofr_tpu.handler import (
     profiler_status_handler,
     profiler_stop_handler,
     ready_handler,
+    requests_admin_handler,
+    slo_admin_handler,
 )
 from gofr_tpu.http.middleware import (
     cors_middleware,
@@ -149,6 +151,11 @@ class App:
                         make_endpoint(profiler_start_handler, self.container))
         self.router.add("POST", "/admin/profiler/stop",
                         make_endpoint(profiler_stop_handler, self.container))
+        # request flight recorder admin surface (telemetry.py)
+        self.router.add("GET", "/admin/requests",
+                        make_endpoint(requests_admin_handler, self.container))
+        self.router.add("GET", "/admin/slo",
+                        make_endpoint(slo_admin_handler, self.container))
         self.router.add("GET", "/admin/adapters",
                         make_endpoint(adapters_list_handler, self.container))
         self.router.add("POST", "/admin/adapters",

@@ -17,6 +17,7 @@ from gofr_tpu.config import Config
 from gofr_tpu.datasource.health import DOWN, UP, Health
 from gofr_tpu.logging import new_logger
 from gofr_tpu.metrics import Registry
+from gofr_tpu.telemetry import FlightRecorder
 
 
 class Container:
@@ -24,6 +25,16 @@ class Container:
         self.config = config
         self.logger = new_logger(config.get_or_default("LOG_LEVEL", "INFO"))
         self.metrics = Registry()
+        # request flight recorder: per-request inference telemetry backing
+        # /admin/requests and /admin/slo plus the wide-event request log
+        self.telemetry = FlightRecorder(
+            capacity=int(config.get_or_default("FLIGHT_RECORDER_SIZE", "512")),
+            keep=int(config.get_or_default("FLIGHT_RECORDER_KEEP", "128")),
+            slow_threshold_s=float(
+                config.get_or_default("FLIGHT_SLOW_MS", "2000")
+            ) / 1000.0,
+            logger=self.logger,
+        )
         self.services: dict[str, Any] = {}
         self.redis: Optional[Any] = None
         self.db: Optional[Any] = None

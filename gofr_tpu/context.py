@@ -67,6 +67,11 @@ class Context:
     def metrics(self) -> Any:
         return self.container.metrics
 
+    @property
+    def telemetry(self) -> Any:
+        """The request flight recorder (TPU-native addition)."""
+        return self.container.telemetry
+
     def get_http_service(self, name: str) -> Any:
         """Parity: container/container.go:93."""
         return self.container.get_http_service(name)

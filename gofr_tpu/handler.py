@@ -162,6 +162,51 @@ def adapter_unload_handler(ctx: Context) -> Any:
     return {"adapters": ctx.tpu.unload_adapter(ctx.request.path_param("name"))}
 
 
+def _query_flag(ctx: Context, name: str) -> Any:
+    """Tri-state query flag: absent -> None; present empty or truthy
+    (?slow=, ?slow=1, ?slow=true) -> True; false/0/no -> False."""
+    if name not in ctx.request.query:
+        return None
+    return ctx.param(name).strip().lower() not in ("false", "0", "no")
+
+
+def requests_admin_handler(ctx: Context) -> Any:
+    """GET /admin/requests: recent flight records, newest first.
+    ``?slow=``/``?errored=`` filter (the side buffer keeps flagged
+    requests visible after ring eviction); ``?limit=`` bounds the page."""
+    from gofr_tpu.errors import InvalidParamError
+
+    _check_admin(ctx)
+    try:
+        limit = int(ctx.param("limit") or "100")
+    except ValueError:
+        raise InvalidParamError('"limit" must be an integer') from None
+    if limit < 1:
+        raise InvalidParamError('"limit" must be >= 1')
+    records = ctx.container.telemetry.records(
+        slow=_query_flag(ctx, "slow"),
+        errored=_query_flag(ctx, "errored"),
+        limit=limit,
+    )
+    return {"requests": records, "count": len(records)}
+
+
+def slo_admin_handler(ctx: Context) -> Any:
+    """GET /admin/slo: rolling-window per-model p50/p95/p99 TTFT and
+    TPOT computed from the flight records (exact sample percentiles).
+    ``?window=`` sets the window in seconds (default 300)."""
+    from gofr_tpu.errors import InvalidParamError
+
+    _check_admin(ctx)
+    try:
+        window = float(ctx.param("window") or "300")
+    except ValueError:
+        raise InvalidParamError('"window" must be a number of seconds') from None
+    if window <= 0:
+        raise InvalidParamError('"window" must be > 0')
+    return ctx.container.telemetry.slo(window_s=window)
+
+
 def profiler_status_handler(ctx: Context) -> Any:
     from gofr_tpu.profiling import profiler
 

@@ -149,22 +149,39 @@ _CORS_HEADERS = {
 
 def metrics_middleware(registry: Any) -> Any:
     """TPU-native addition: request counters + latency histogram for every
-    route (the reference has no metrics subsystem, SURVEY.md §5)."""
+    route (the reference has no metrics subsystem, SURVEY.md §5).
+
+    The ``path`` label is the MATCHED ROUTE PATTERN the router records on
+    the request (``/greet/{name}``, bounded cardinality) — never the raw
+    URL, which would mint one series per distinct path-param value.
+    Unrouted requests (404s) share one ``unmatched`` label. Exceptions
+    escaping the inner chain count as status 500 instead of silently
+    bypassing the counters (the outer logging middleware still converts
+    them into the JSON 500)."""
 
     requests_total = registry.counter(
-        "gofr_http_requests_total", "HTTP requests", labels=("method", "status")
+        "gofr_http_requests_total", "HTTP requests",
+        labels=("method", "path", "status"),
     )
     duration = registry.histogram(
-        "gofr_http_request_duration_seconds", "HTTP request latency"
+        "gofr_http_request_duration_seconds", "HTTP request latency",
+        labels=("path",),
     )
 
     def middleware(next_ep: Endpoint) -> Endpoint:
         async def endpoint(request: Request) -> Response:
             start = time.perf_counter()
-            response = await next_ep(request)
-            duration.observe(time.perf_counter() - start)
-            requests_total.inc(method=request.method, status=str(response.status))
-            return response
+            status = "500"
+            try:
+                response = await next_ep(request)
+                status = str(response.status)
+                return response
+            finally:
+                path = getattr(request, "route_pattern", None) or "unmatched"
+                duration.observe(time.perf_counter() - start, path=path)
+                requests_total.inc(
+                    method=request.method, path=path, status=status
+                )
 
         return endpoint
 

@@ -77,6 +77,10 @@ class Router:
             params = route.match(segments)
             if params is None:
                 continue
+            # the MATCHED ROUTE PATTERN (bounded cardinality), never the
+            # raw URL: middleware (metrics path label) reads it after
+            # dispatch. Set on the 405 path too — the path existed.
+            request.route_pattern = route.pattern
             if route.method == method:
                 request.path_params = params
                 return await route.endpoint(request)

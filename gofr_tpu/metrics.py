@@ -71,7 +71,10 @@ class Counter(_Metric):
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels: str) -> float:
-        return self._values.get(self._key(labels), 0.0)
+        # same lock as the write path: exposition/readers during heavy
+        # concurrent writes must never see torn dict state
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
 
     def expose(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"

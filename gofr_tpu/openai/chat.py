@@ -231,17 +231,27 @@ def chat_completions(ctx: Any) -> Any:
         )
 
     include_usage = _stream_usage_opt(body)  # validates even sans stream
-    if body.get("stream"):
-        return _stream_chat(
-            ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
-            stop_strs, want_logprobs, top_n, adapter, n, chat_id,
-            created, model, tok, include_usage,
-        )
+    # flight record (rides a contextvar so the batcher/pool/device stamp
+    # it downstream); the Flight guard owns ok/error/drop semantics
+    from gofr_tpu.telemetry import flight
 
-    results, generated = _fanout_generate(
-        ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
-        want_logprobs, top_n, adapter, n, n,
-    )
+    with flight(
+        getattr(ctx.container, "telemetry", None),
+        model=model, endpoint="/v1/chat/completions",
+        trace_id=ctx.trace_id or "", tokens_in=len(prompt_ids),
+        stream=bool(body.get("stream")),
+    ) as fl:
+        if body.get("stream"):
+            # defer: the record completes when the stream ends
+            return fl.defer(_stream_chat(
+                ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
+                stop_strs, want_logprobs, top_n, adapter, n, chat_id,
+                created, model, tok, include_usage,
+            ))
+        results, generated = _fanout_generate(
+            ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
+            want_logprobs, top_n, adapter, n, n,
+        )
     from gofr_tpu.http.response import Raw
 
     choices = [

@@ -250,45 +250,56 @@ def completions(ctx: Any) -> Any:
     tok = ctx.tpu.tokenizer
 
     include_usage = _stream_usage_opt(body)  # validates even sans stream
-    if body.get("stream"):
-        return _stream_completion(
-            ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
-            stop_strs, want_logprobs, top_n, adapter, n, best_of, echo,
-            cmpl_id, created, model, tok, include_usage,
-        )
+    # flight record (rides a contextvar so the batcher/pool/device stamp
+    # it downstream); the Flight guard owns ok/error/drop semantics
+    from gofr_tpu.telemetry import flight
 
-    prompt_lps = None
-    if echo and want_logprobs:
-        # teacher-forcing prompt scoring: log p(t_i | t_<i), with null
-        # for the first token (no conditional) — the OpenAI convention
-        # and the eval-harness loglikelihood pattern. The request's
-        # adapter scores too (and an unknown one 400s even on the
-        # max_tokens=0 path, where no generation would catch it)
-        prompt_lps = [None] + ctx.tpu.score(prompt_ids, adapter=adapter)
-    elif max_tokens == 0 and adapter is not None:
-        # pure echo without logprobs still must validate the adapter name.
-        # list_adapters (not a direct runner read): it waits for readiness,
-        # so a request landing mid background-boot blocks like every other
-        # path instead of 500ing on a not-yet-built runner
-        loaded = ctx.tpu.list_adapters()
-        if adapter not in loaded:
-            from gofr_tpu.errors import InvalidParamError
+    with flight(
+        getattr(ctx.container, "telemetry", None),
+        model=model, endpoint="/v1/completions",
+        trace_id=ctx.trace_id or "", tokens_in=len(prompt_ids),
+        stream=bool(body.get("stream")),
+    ) as fl:
+        if body.get("stream"):
+            # defer: the record completes when the stream ends
+            return fl.defer(_stream_completion(
+                ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
+                stop_strs, want_logprobs, top_n, adapter, n, best_of, echo,
+                cmpl_id, created, model, tok, include_usage,
+            ))
 
-            raise InvalidParamError(
-                f"adapter '{adapter}' (loaded: {loaded})"
+        prompt_lps = None
+        if echo and want_logprobs:
+            # teacher-forcing prompt scoring: log p(t_i | t_<i), with null
+            # for the first token (no conditional) — the OpenAI convention
+            # and the eval-harness loglikelihood pattern. The request's
+            # adapter scores too (and an unknown one 400s even on the
+            # max_tokens=0 path, where no generation would catch it)
+            prompt_lps = [None] + ctx.tpu.score(prompt_ids, adapter=adapter)
+        elif max_tokens == 0 and adapter is not None:
+            # pure echo without logprobs still must validate the adapter name.
+            # list_adapters (not a direct runner read): it waits for readiness,
+            # so a request landing mid background-boot blocks like every other
+            # path instead of 500ing on a not-yet-built runner
+            loaded = ctx.tpu.list_adapters()
+            if adapter not in loaded:
+                from gofr_tpu.errors import InvalidParamError
+
+                raise InvalidParamError(
+                    f"adapter '{adapter}' (loaded: {loaded})"
+                )
+        if max_tokens == 0:
+            # pure scoring (echo-only, enforced at parse): no decode at all
+            results = [
+                ([], [] if want_logprobs else None, [] if top_n else None,
+                 None, "length")
+            ] * n
+            generated = 0
+        else:
+            results, generated = _fanout_generate(
+                ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
+                want_logprobs, top_n, adapter, n, best_of,
             )
-    if max_tokens == 0:
-        # pure scoring (echo-only, enforced at parse): no decode at all
-        results = [
-            ([], [] if want_logprobs else None, [] if top_n else None,
-             None, "length")
-        ] * n
-        generated = 0
-    else:
-        results, generated = _fanout_generate(
-            ctx, body, prompt_ids, max_tokens, sampler, stop_ids, stop_strs,
-            want_logprobs, top_n, adapter, n, best_of,
-        )
     choices = []
     for i, (out, logprobs, tops, text, finish) in enumerate(results):
         if text is None:

@@ -390,6 +390,7 @@ def _fanout_generate(
     if best_of == 1:
         results = [one(samplers[0])]
     else:
+        import contextvars
         from concurrent.futures import ThreadPoolExecutor
 
         # concurrency scales with the DEPLOYMENT, not the request
@@ -397,8 +398,17 @@ def _fanout_generate(
         # through pool.map; a seeded fan-out decodes solo, so the same
         # bound caps its thread count.
         workers = min(best_of, _fanout_workers(ctx))
+        # one context COPY per candidate (a single Context cannot run
+        # concurrently), snapshotted HERE in the handler thread: pool
+        # workers inherit nothing, and without this the request's span
+        # and flight record would be invisible to the generation —
+        # orphan traces, empty telemetry
+        snapshots = [contextvars.copy_context() for _ in samplers]
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            results = list(pool.map(one, samplers))
+            results = list(pool.map(
+                lambda pair: pair[0].run(one, pair[1]),
+                zip(snapshots, samplers),
+            ))
     generated = sum(len(r[0]) for r in results)
     if score:
         def mean_lp(item):

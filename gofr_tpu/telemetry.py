@@ -1,0 +1,418 @@
+"""Request flight recorder: per-request end-to-end inference telemetry.
+
+The metrics registry answers "how is the fleet doing"; it cannot answer
+"what happened to THIS request". The flight recorder keeps one
+``FlightRecord`` per inference request — enqueue/dispatch/first-token/
+last-token marks, queue wait, TTFT, TPOT, token counts, batch cohort
+size — in a bounded ring buffer, plus an always-keep side buffer for
+slow and errored requests (the interesting ones must survive ring
+eviction under traffic). At completion each record is emitted as ONE
+canonical wide-event log line (every field, one dict) through the
+container logger, so log search and the admin API see the same truth.
+
+Admin surface (app.py): ``GET /admin/requests`` returns recent records
+(``?slow=``/``?errored=`` filters), ``GET /admin/slo`` computes
+rolling-window per-model p50/p95/p99 TTFT and TPOT from the records
+themselves — exact sample percentiles, not histogram bucket upper
+bounds.
+
+The record travels with the request the same way spans do: a
+contextvar. Handlers ``start()`` it, the batcher stamps queue timing
+and cohort size on the queue item's captured record, the decode pool
+stamps pool occupancy, the device stamps token timing. Thread
+boundaries (handler pool, batcher dispatch, stream generation thread)
+propagate it via ``contextvars.copy_context()``.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+_current_record: contextvars.ContextVar[Optional["FlightRecord"]] = (
+    contextvars.ContextVar("gofr_flight_record", default=None)
+)
+
+
+def current_record() -> Optional["FlightRecord"]:
+    """The in-flight request's FlightRecord, if one is active."""
+    return _current_record.get()
+
+
+def activate_record(record: Optional["FlightRecord"]) -> Any:
+    """Bind ``record`` as the current one; returns the reset token.
+    Handlers run inside a per-request copied context (handler.py), so
+    not resetting leaks nothing past the request."""
+    return _current_record.set(record)
+
+
+class FlightRecord:
+    """One request's flight data. Marks are ``time.perf_counter`` values
+    anchored to ``wall_start`` (``time.time`` at creation) for display.
+    Single-shot marks are set-once attribute assignments (atomic under
+    the GIL); the accumulating fields (``tokens_out``, ``pool_cohort``)
+    take the record's lock — an n>1 fan-out runs candidates concurrently
+    against ONE record, and ``+=`` is a read-modify-write."""
+
+    __slots__ = (
+        "trace_id", "model", "endpoint", "status", "error", "stream",
+        "tokens_in", "tokens_out", "batch_size", "pool_cohort",
+        "wall_start", "t_start", "t_enqueue", "t_dispatch",
+        "t_first_token", "t_last_token", "t_done", "wall_done", "_lock",
+    )
+
+    def __init__(
+        self,
+        model: str,
+        endpoint: str,
+        trace_id: str = "",
+        tokens_in: int = 0,
+        stream: bool = False,
+    ):
+        self.trace_id = trace_id
+        self.model = model
+        self.endpoint = endpoint
+        self.status = "in_flight"
+        self.error = ""
+        self.stream = stream
+        self.tokens_in = tokens_in
+        self.tokens_out = 0
+        self.batch_size = 0  # prefill batch cohort (batcher dispatch)
+        self.pool_cohort = 0  # active decode-pool slots when this joined
+        self.wall_start = time.time()
+        self.t_start = time.perf_counter()
+        self.t_enqueue: Optional[float] = None
+        self.t_dispatch: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_last_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.wall_done: Optional[float] = None
+        self._lock = threading.Lock()
+
+    # -- marks (called from batcher / pool / device) -------------------------
+    def mark_enqueue(self) -> None:
+        if self.t_enqueue is None:
+            self.t_enqueue = time.perf_counter()
+
+    def mark_dispatch(self, cohort: int) -> None:
+        """First prefill dispatch: stamps the batch cohort this request
+        rode with (later dispatches — chunked prefill — keep the first)."""
+        if self.t_dispatch is None:
+            self.t_dispatch = time.perf_counter()
+            self.batch_size = cohort
+
+    def mark_first_token(self) -> None:
+        if self.t_first_token is None:
+            self.t_first_token = time.perf_counter()
+
+    def mark_pooled(self, cohort: int) -> None:
+        """Decode joined the continuous-batching pool with ``cohort``
+        active slots (keeps the max seen across fan-out candidates)."""
+        with self._lock:
+            if cohort > self.pool_cohort:
+                self.pool_cohort = cohort
+
+    def note_tokens(self, n: int = 1) -> None:
+        with self._lock:
+            self.tokens_out += n
+        self.t_last_token = time.perf_counter()
+
+    def note_error(self, exc: BaseException) -> None:
+        """Device-layer failure: remembered even if the transport still
+        manages a response (a stream that already committed its 200)."""
+        self.status = "error"
+        self.error = f"{type(exc).__name__}: {exc}"
+
+    # -- derived -------------------------------------------------------------
+    @property
+    def queue_wait(self) -> Optional[float]:
+        if self.t_enqueue is None or self.t_dispatch is None:
+            return None
+        return self.t_dispatch - self.t_enqueue
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_start
+
+    @property
+    def tpot(self) -> Optional[float]:
+        """Mean time per output token AFTER the first (decode cadence)."""
+        if (
+            self.t_first_token is None or self.t_last_token is None
+            or self.tokens_out < 2
+        ):
+            return None
+        return (self.t_last_token - self.t_first_token) / (self.tokens_out - 1)
+
+    @property
+    def duration(self) -> Optional[float]:
+        if self.t_done is None:
+            return None
+        return self.t_done - self.t_start
+
+    def to_dict(self) -> dict[str, Any]:
+        """The wide-event shape: every field, one flat dict. Durations in
+        seconds (floats); wall timestamps in unix seconds."""
+
+        def _offset(mark: Optional[float]) -> Optional[float]:
+            if mark is None:
+                return None
+            return self.wall_start + (mark - self.t_start)
+
+        return {
+            "event": "request_flight",
+            "trace_id": self.trace_id,
+            "model": self.model,
+            "endpoint": self.endpoint,
+            "status": self.status,
+            "error": self.error or None,
+            "stream": self.stream,
+            "tokens_in": self.tokens_in,
+            "tokens_out": self.tokens_out,
+            "batch_size": self.batch_size,
+            "pool_cohort": self.pool_cohort,
+            "start_ts": self.wall_start,
+            "enqueue_ts": _offset(self.t_enqueue),
+            "dispatch_ts": _offset(self.t_dispatch),
+            "first_token_ts": _offset(self.t_first_token),
+            "done_ts": self.wall_done,
+            "queue_wait_s": self.queue_wait,
+            "ttft_s": self.ttft,
+            "tpot_s": self.tpot,
+            "duration_s": self.duration,
+        }
+
+
+def _percentiles(samples: list[float]) -> dict[str, float]:
+    """Exact nearest-rank p50/p95/p99 from raw samples."""
+    import math
+
+    ordered = sorted(samples)
+    n = len(ordered)
+
+    def rank(q: float) -> float:
+        # nearest-rank: smallest value with cumulative fraction >= q
+        return ordered[max(0, min(n - 1, math.ceil(q * n) - 1))]
+
+    return {"p50": rank(0.50), "p95": rank(0.95), "p99": rank(0.99)}
+
+
+class Flight:
+    """Handler-side record lifecycle, shared by every endpoint (the
+    chat/completions copies drifted once in review). Use as a context
+    manager around the generation: a clean exit finishes the record ok;
+    an exception finishes it as errored — UNLESS it is a pre-inference
+    parameter rejection (a 4xx raised before any device work touched the
+    record), which is dropped: records describe actual inference
+    attempts, and a client retrying a malformed request must not inflate
+    the model's SLO error rate. Streaming handlers call ``defer(result)``
+    to hand completion to the stream's end instead."""
+
+    def __init__(self, recorder: Optional["FlightRecorder"],
+                 record: Optional[FlightRecord]):
+        self.recorder = recorder
+        self.record = record
+        self._deferred = False
+
+    def defer(self, result: Any) -> Any:
+        """Wrap a Stream result: the record completes when the stream
+        ends (or the client disconnects), not when the handler returns."""
+        self._deferred = True
+        if self.recorder is None:
+            return result
+        return self.recorder.finish_stream(result, self.record)
+
+    def __enter__(self) -> "Flight":
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        if self.recorder is None or self.record is None or self._deferred:
+            return False
+        if exc is None:
+            self.recorder.finish(self.record)
+            return False
+        status_code = getattr(exc, "status_code", None)
+        if (
+            self.record.status != "error"  # the device never noted a failure
+            and isinstance(status_code, int) and status_code < 500
+        ):
+            return False  # parameter rejection before inference: no record
+        self.recorder.finish(self.record, error=exc)
+        return False
+
+
+def flight(
+    recorder: Optional["FlightRecorder"],
+    model: str,
+    endpoint: str,
+    trace_id: str = "",
+    tokens_in: int = 0,
+    stream: bool = False,
+) -> Flight:
+    """Start (and contextvar-activate) a FlightRecord under a ``Flight``
+    lifecycle guard; recorder None (bare test containers) yields an
+    inert guard whose ``defer`` passes results through untouched."""
+    record = None
+    if recorder is not None:
+        record = recorder.start(
+            model=model, endpoint=endpoint, trace_id=trace_id,
+            tokens_in=tokens_in, stream=stream,
+        )
+    return Flight(recorder, record)
+
+
+class FlightRecorder:
+    """Thread-safe bounded store of completed FlightRecords.
+
+    ``capacity`` bounds the main ring (most recent completions);
+    ``keep`` bounds the side buffer that always retains slow/errored
+    requests even after the ring evicts them. ``slow_threshold_s``
+    classifies slow: total duration or TTFT past it."""
+
+    def __init__(
+        self,
+        capacity: int = 512,
+        keep: int = 128,
+        slow_threshold_s: float = 2.0,
+        logger: Any = None,
+    ):
+        self.capacity = capacity
+        self.slow_threshold_s = slow_threshold_s
+        self.logger = logger
+        self._ring: "deque[FlightRecord]" = deque(maxlen=max(1, capacity))
+        self._notable: "deque[FlightRecord]" = deque(maxlen=max(1, keep))
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(
+        self,
+        model: str,
+        endpoint: str,
+        trace_id: str = "",
+        tokens_in: int = 0,
+        stream: bool = False,
+        activate: bool = True,
+    ) -> FlightRecord:
+        record = FlightRecord(
+            model=model, endpoint=endpoint, trace_id=trace_id,
+            tokens_in=tokens_in, stream=stream,
+        )
+        if activate:
+            activate_record(record)
+        return record
+
+    def finish(
+        self,
+        record: Optional[FlightRecord],
+        status: str = "ok",
+        error: Optional[BaseException] = None,
+    ) -> None:
+        """Complete a record: stamps done, lands it in the buffers, and
+        emits the wide-event log line. Idempotent — the first finish
+        wins (a stream wrapper and an error path may both reach it)."""
+        if record is None or record.t_done is not None:
+            return
+        record.t_done = time.perf_counter()
+        record.wall_done = time.time()
+        if error is not None:
+            record.note_error(error)
+        elif record.status == "in_flight":
+            record.status = status
+        with self._lock:
+            self._ring.append(record)
+            if self.is_slow(record) or record.status != "ok":
+                self._notable.append(record)
+        if self.logger is not None:
+            try:
+                self.logger.info(record.to_dict())
+            except Exception:
+                pass  # telemetry must never take a request down
+
+    def is_slow(self, record: FlightRecord) -> bool:
+        duration = record.duration or 0.0
+        ttft = record.ttft or 0.0
+        return max(duration, ttft) >= self.slow_threshold_s
+
+    def finish_stream(self, result: Any, record: Optional[FlightRecord]) -> Any:
+        """Wrap a handler's Stream result so ``record`` completes when
+        the stream ends — normal exhaustion, an error, or the client
+        disconnecting (generator close). Non-Stream results pass
+        through untouched (the caller finishes synchronously)."""
+        from gofr_tpu.http.response import Stream
+
+        if record is None or not isinstance(result, Stream):
+            return result
+        events = result.events
+
+        def guarded() -> Any:
+            try:
+                yield from events
+            except GeneratorExit:
+                self.finish(record, status="cancelled")
+                raise
+            except BaseException as exc:
+                self.finish(record, error=exc)
+                raise
+            else:
+                self.finish(record)
+
+        result.events = guarded()
+        return result
+
+    # -- read side (admin API) ----------------------------------------------
+    def records(
+        self,
+        slow: Optional[bool] = None,
+        errored: Optional[bool] = None,
+        limit: int = 100,
+    ) -> list[dict[str, Any]]:
+        """Most-recent-first record dicts. ``slow=True``/``errored=True``
+        filter; the side buffer is merged in so flagged requests stay
+        visible after ring eviction."""
+        with self._lock:
+            merged: list[FlightRecord] = list(self._ring)
+            seen = {id(r) for r in merged}
+            merged.extend(r for r in self._notable if id(r) not in seen)
+        merged.sort(key=lambda r: r.t_done or r.t_start)
+        out = []
+        for record in reversed(merged):
+            if slow is not None and self.is_slow(record) != slow:
+                continue
+            if errored is not None and (record.status != "ok") != errored:
+                continue
+            out.append(record.to_dict())
+            if len(out) >= limit:
+                break
+        return out
+
+    def slo(self, window_s: float = 300.0) -> dict[str, Any]:
+        """Rolling-window per-model SLO view: exact p50/p95/p99 of TTFT
+        and TPOT over requests completed in the last ``window_s``
+        seconds, computed from the raw records (a cumulative histogram
+        cannot express a rolling window and only knows bucket bounds)."""
+        horizon = time.time() - window_s
+        with self._lock:
+            recent = [
+                r for r in self._ring
+                if r.wall_done is not None and r.wall_done >= horizon
+            ]
+        models: dict[str, Any] = {}
+        for model in sorted({r.model for r in recent}):
+            rows = [r for r in recent if r.model == model]
+            ttfts = [r.ttft for r in rows if r.ttft is not None]
+            tpots = [r.tpot for r in rows if r.tpot is not None]
+            entry: dict[str, Any] = {
+                "count": len(rows),
+                "errors": sum(1 for r in rows if r.status != "ok"),
+            }
+            if ttfts:
+                entry["ttft_s"] = _percentiles(ttfts)
+            if tpots:
+                entry["tpot_s"] = _percentiles(tpots)
+            models[model] = entry
+        return {"window_s": window_s, "models": models}

@@ -26,6 +26,8 @@ from typing import Any, Callable, Optional, Sequence
 import numpy as np
 
 from gofr_tpu.errors import TooManyRequestsError
+from gofr_tpu.telemetry import current_record
+from gofr_tpu.tracing import current_span, get_tracer
 
 
 def next_pow2(n: int) -> int:
@@ -36,12 +38,20 @@ def next_pow2(n: int) -> int:
 
 
 class _Item:
-    __slots__ = ("payload", "future", "arrival")
+    __slots__ = ("payload", "future", "arrival", "span", "record")
 
     def __init__(self, payload: Any):
         self.payload = payload
         self.future: Future = Future()
         self.arrival = time.perf_counter()
+        # trace continuity across the worker-thread boundary: the caller's
+        # span and flight record ride the queue item, so the dispatch-side
+        # tpu-batch span lands in the SAME trace as the HTTP server span
+        # and the request's record learns its queue wait + batch cohort
+        self.span = current_span()
+        self.record = current_record()
+        if self.record is not None:
+            self.record.mark_enqueue()
 
 
 class DynamicBatcher:
@@ -149,13 +159,29 @@ class DynamicBatcher:
             self._queue_gauge.set(self._queue.qsize(), model=self.name)
             for item in batch:
                 self._wait_hist.observe(now - item.arrival, model=self.name)
+        for item in batch:
+            if item.record is not None:
+                item.record.mark_dispatch(len(batch))
+        # one tpu-batch span per dispatch, parented to the first queued
+        # request's span (a cohort can mix traces; one wins) and ACTIVATED
+        # in this dispatch thread so run_batch's device code tags it /
+        # nests under it via current_span()
+        parent = next((item.span for item in batch if item.span is not None), None)
+        span = get_tracer().start_span("tpu-batch", parent=parent)
         try:
-            results = self.run_batch([item.payload for item in batch])
-        except Exception as exc:
-            for item in batch:
-                if not item.future.cancelled():
-                    item.future.set_exception(exc)
-            return
+            try:
+                results = self.run_batch([item.payload for item in batch])
+            except Exception as exc:
+                span.set_tag("error", exc)
+                for item in batch:
+                    if not item.future.cancelled():
+                        item.future.set_exception(exc)
+                return
+        finally:
+            # ALWAYS deactivate (BaseException included): a leaked span
+            # in this reused pool thread would become every later
+            # dispatch's bogus parent via the contextvar
+            span.__exit__(None, None, None)
         for item, result in zip(batch, results):
             if not item.future.cancelled():
                 item.future.set_result(result)

@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gofr_tpu.telemetry import current_record
+
 DONE = object()  # end-of-stream marker on a slot's token queue
 
 # Chunks in flight (DECODE_PIPELINE config): the host fetch of chunk N's
@@ -619,6 +621,11 @@ class DecodePool:
                 self._last_tokens, jnp.asarray([[first_token]], jnp.int32), slot.index
             )
             self._active[slot.index] = slot
+            record = current_record()
+            if record is not None:
+                # flight record: this request decodes pooled, alongside
+                # len(_active)-1 co-tenants
+                record.mark_pooled(len(self._active))
             if self._depth_gauge:
                 self._depth_gauge.set(len(self._active))
             self._work.notify()

@@ -73,13 +73,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from gofr_tpu.datasource.health import DOWN, UP, Health
+from gofr_tpu.telemetry import current_record as telemetry_record
 from gofr_tpu.tpu.batcher import (
     DynamicBatcher,
     next_pow2,
     pack_token_rows,
     pad_rows,
 )
-from gofr_tpu.tracing import get_tracer
+from gofr_tpu.tracing import current_span, get_tracer
 
 
 @dataclass
@@ -286,6 +287,11 @@ class TPUDevice:
         typo must fail at construction, never minutes later behind a
         background boot."""
         self._decode_chunk_cfg = int(config.get_or_default("DECODE_CHUNK", "8"))
+        # MODEL_NAME=echo only: artificial per-token decode delay so the
+        # no-JAX loopback runner mimics a real decode cadence
+        self._echo_step_ms = float(config.get_or_default("ECHO_STEP_MS", "0"))
+        if self._echo_step_ms < 0:
+            raise ValueError("ECHO_STEP_MS must be >= 0")
         raw_max_seq = config.get("MODEL_MAX_SEQ")
         self._max_seq_cfg = int(raw_max_seq) if raw_max_seq else None
         # MODEL_KV_DTYPE=f8 stores the KV cache in float8_e4m3fn — half the
@@ -478,6 +484,7 @@ class TPUDevice:
             prefix_cache=self._prefix_cache_size,
             prefix_lcp_min=self._prefix_lcp_min,
             lora_adapters=self._lora_adapters,
+            echo_step_ms=self._echo_step_ms,
         )
         self.runner.warmup(progress=self._boot_progress)
         # continuous batching: concurrent decodes share one fixed-shape
@@ -544,16 +551,17 @@ class TPUDevice:
         # out a cold boot must not double the timeout budget)
         remaining = max(0.001, timeout - (time.perf_counter() - wait_start))
         start = time.perf_counter()
-        span = get_tracer().start_span(f"tpu-{self.model_name}", activate=False)
-        try:
-            result = self.batcher.infer(self._prepare(payload), timeout=remaining)
-            self._observe("infer", "ok", start)
-            return result
-        except Exception:
-            self._observe("infer", "error", start)
-            raise
-        finally:
-            span.end()
+        # ACTIVATED device span (an activate=False span here never became
+        # anyone's parent): the batcher queue item captures it, so the
+        # dispatch-side tpu-batch span joins the caller's trace
+        with get_tracer().start_span(f"tpu-{self.model_name}"):
+            try:
+                result = self.batcher.infer(self._prepare(payload), timeout=remaining)
+                self._observe("infer", "ok", start)
+                return result
+            except Exception:
+                self._observe("infer", "error", start)
+                raise
 
     async def infer_async(self, payload: Any) -> Any:
         if not self._ready.is_set():
@@ -605,18 +613,36 @@ class TPUDevice:
         # request stops compose with it
         stop_tokens = frozenset(stop_tokens or ()) | self.default_stop_ids
         start = time.perf_counter()
-        try:
-            out = self.runner.generate(
-                tokens, max_new_tokens, on_token=on_token, stop=stop,
-                sampler=sampler, stop_tokens=stop_tokens,
-                decode_pool=self.decode_pool,
-                prefill_batcher=self.batcher, logprobs=logprobs,
-                top_logprobs=top_logprobs,
-                adapter=adapter, adapter_params=adapter_params,
-                ttft_cb=lambda: self._ttft.observe(
-                    time.perf_counter() - start, model=self.model_name, op="generate"
-                ),
+        record = telemetry_record()
+
+        def _ttft() -> None:
+            self._ttft.observe(
+                time.perf_counter() - start, model=self.model_name, op="generate"
             )
+            if record is not None:
+                record.mark_first_token()
+
+        emit = on_token
+        if record is not None:
+            def emit(item: Any, _cb: Any = on_token) -> None:
+                record.note_tokens(1)
+                if _cb is not None:
+                    _cb(item)
+        try:
+            # activated per-request device span: the prefill batcher item
+            # captures it, so tpu-batch nests under it in the same trace
+            with get_tracer().start_span(f"tpu-{self.model_name}-generate") as span:
+                out = self.runner.generate(
+                    tokens, max_new_tokens, on_token=emit, stop=stop,
+                    sampler=sampler, stop_tokens=stop_tokens,
+                    decode_pool=self.decode_pool,
+                    prefill_batcher=self.batcher, logprobs=logprobs,
+                    top_logprobs=top_logprobs,
+                    adapter=adapter, adapter_params=adapter_params,
+                    ttft_cb=_ttft,
+                )
+                emitted = out[0] if isinstance(out, tuple) else out
+                span.set_tag("tpu.tokens_out", len(emitted))
             self._requests.inc(model=self.model_name, op="generate", status="ok")
             stats = getattr(self.runner, "spec_stats", None)
             if stats and stats["drafted"]:
@@ -640,7 +666,9 @@ class TPUDevice:
                         len(cache), model=self.model_name
                     )
             return out
-        except Exception:
+        except Exception as exc:
+            if record is not None:
+                record.note_error(exc)
             self._requests.inc(model=self.model_name, op="generate", status="error")
             raise
 
@@ -682,19 +710,28 @@ class TPUDevice:
             from gofr_tpu.ops.sampling import check_bias_ids
 
             try:
-                check_bias_ids(sampler.logit_bias, self.runner.cfg.vocab_size)
+                cfg = getattr(self.runner, "cfg", None)
+                if cfg is not None:
+                    check_bias_ids(sampler.logit_bias, cfg.vocab_size)
             except ValueError as exc:
                 from gofr_tpu.errors import InvalidParamError
 
                 raise InvalidParamError(str(exc)) from None
+        import contextvars
+
+        # snapshot NOW, in the handler thread: the generator body below
+        # first runs on the SSE pull thread, where the caller's span and
+        # flight record are no longer current — the snapshot carries them
+        # into the background generation thread
+        snapshot = contextvars.copy_context()
         return self._stream_iter(
             tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs,
-            adapter_params,
+            adapter_params, snapshot,
         )
 
     def _stream_iter(
         self, tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs,
-        adapter_params=None,
+        adapter_params=None, snapshot=None,
     ) -> Any:
         import queue as queue_mod
         import threading
@@ -716,7 +753,8 @@ class TPUDevice:
             finally:
                 out.put(done)
 
-        threading.Thread(target=run, daemon=True).start()
+        target = (lambda: snapshot.run(run)) if snapshot is not None else run
+        threading.Thread(target=target, daemon=True).start()
         try:
             while True:
                 item = out.get()
@@ -753,18 +791,20 @@ class TPUDevice:
 
     def _run_batch(self, payloads: list[Any]) -> list[Any]:
         start = time.perf_counter()
-        span = get_tracer().start_span("tpu-batch", activate=False)
+        # the batcher opened (and activated) the per-dispatch tpu-batch
+        # span, parented to the enqueuing request's span — this callback
+        # only decorates it with device-side tags (SURVEY.md §5 profiling
+        # hooks — the always-on cheap signal; full XLA traces via
+        # /admin/profiler)
+        span = current_span()
         try:
             results = self.runner.run_batch(payloads)
         finally:
             elapsed = time.perf_counter() - start
-            # device time per batch as span attributes (SURVEY.md §5
-            # profiling hooks — the always-on cheap signal; full XLA traces
-            # via /admin/profiler)
-            span.set_tag("tpu.batch_size", len(payloads))
-            span.set_tag("tpu.device_time_us", int(elapsed * 1e6))
-            span.set_tag("tpu.model", self.model_name)
-            span.end()
+            if span is not None:
+                span.set_tag("tpu.batch_size", len(payloads))
+                span.set_tag("tpu.device_time_us", int(elapsed * 1e6))
+                span.set_tag("tpu.model", self.model_name)
         self.logger.debug(
             TPULog(self.model_name, "batch", len(payloads), int(elapsed * 1e6))
         )
@@ -1123,6 +1163,97 @@ def _mesh_from_topology(topology: str, devices: list) -> Optional[Any]:
 
 
 # -- model runners ------------------------------------------------------------
+
+class _EchoRunner:
+    """No-JAX loopback runner (``MODEL_NAME=echo``): "generates" by
+    cycling the prompt ids. Exists so the full serving stack — routing,
+    middleware, dynamic batcher, spans, flight records, SSE streaming —
+    can be driven end-to-end in milliseconds, with no checkpoint and no
+    XLA compiles (transport/observability tests, local protocol work,
+    load-harness smoke runs). ``ECHO_STEP_MS`` adds a per-token delay to
+    mimic a real decode cadence."""
+
+    name = "echo"
+
+    def __init__(self, max_batch: int = 8, step_ms: float = 0.0):
+        self.max_batch = max_batch
+        self.step_s = step_ms / 1000.0
+
+    def prepare(self, payload: Any) -> np.ndarray:
+        if isinstance(payload, dict):
+            payload = payload.get("tokens", [])
+        ids = np.asarray(payload, dtype=np.int32).reshape(-1)
+        if ids.size == 0:
+            from gofr_tpu.errors import InvalidParamError
+
+            raise InvalidParamError("tokens must be a non-empty list of ids")
+        return ids
+
+    def run_batch(self, payloads: list[np.ndarray]) -> list[dict]:
+        if self.step_s:
+            time.sleep(self.step_s)
+        return [
+            {"next_token": int(ids[0]), "length": int(ids.size)}
+            for ids in payloads
+        ]
+
+    def warmup(self, progress: Any = None) -> None:
+        if progress:
+            progress("echo runner ready (nothing to compile)")
+
+    def generate(
+        self,
+        tokens: Any,
+        max_new_tokens: int,
+        on_token: Any = None,
+        stop: Any = None,
+        sampler: Any = None,
+        stop_tokens: Any = None,
+        decode_pool: Any = None,
+        prefill_batcher: Any = None,
+        ttft_cb: Any = None,
+        logprobs: bool = False,
+        top_logprobs: bool = False,
+        adapter: Optional[str] = None,
+        adapter_params: Optional[Any] = None,
+    ) -> Any:
+        if adapter is not None:
+            from gofr_tpu.errors import InvalidParamError
+
+            raise InvalidParamError(
+                f"adapter '{adapter}' (the echo runner serves no adapters)"
+            )
+        ids = self.prepare(tokens)
+        stop_tokens = frozenset(stop_tokens or ())
+        # prefill rides the REAL dynamic batcher so queue wait, batch
+        # cohort, and the tpu-batch span behave exactly as on a device
+        if prefill_batcher is not None:
+            prefill_batcher.infer(ids)
+        else:
+            self.run_batch([ids])
+        if ttft_cb:
+            ttft_cb()
+        out: list[int] = []
+        lps: list[float] = []
+        tops: list = []
+        for i in range(max_new_tokens):
+            if stop is not None and stop.is_set():
+                break
+            token = int(ids[i % ids.size])
+            if token in stop_tokens:
+                break
+            out.append(token)
+            if logprobs:
+                lps.append(0.0)
+                tops.append([(token, 0.0)])
+            if on_token:
+                on_token((token, 0.0) if logprobs else token)
+            if self.step_s:
+                time.sleep(self.step_s)
+        if top_logprobs:
+            return out, lps, tops
+        return (out, lps) if logprobs else out
+
 
 class _MLPRunner:
     name = "mlp"
@@ -2795,6 +2926,7 @@ def _build_runner(
     prefix_cache: int = 0,
     prefix_lcp_min: int = 0,
     lora_adapters: Optional[dict] = None,
+    echo_step_ms: float = 0.0,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
@@ -2802,6 +2934,8 @@ def _build_runner(
         raise ValueError(
             f"LORA_ADAPTERS requires a transformer MODEL_NAME (got '{name}')"
         )
+    if name == "echo":
+        return _EchoRunner(max_batch, step_ms=echo_step_ms)
     if name in ("mlp", "tiny-mlp"):
         return _MLPRunner(quant, model_path, max_batch)
     if name.startswith("bert"):
@@ -2816,6 +2950,6 @@ def _build_runner(
             prefix_lcp_min=prefix_lcp_min, lora_adapters=lora_adapters,
         )
     raise ValueError(
-        f"unknown MODEL_NAME '{name}' — expected mlp, bert-tiny, bert-base, "
-        f"or one of {sorted(CONFIGS)}"
+        f"unknown MODEL_NAME '{name}' — expected echo, mlp, bert-tiny, "
+        f"bert-base, or one of {sorted(CONFIGS)}"
     )

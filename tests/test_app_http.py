@@ -281,6 +281,66 @@ def test_trace_context_propagation(app):
     assert seen["trace_id"] == "ab" * 16
 
 
+def test_correlation_id_generated_without_traceparent(app):
+    """Every response carries X-Correlation-ID even when the caller sent
+    no traceparent: the server's own trace id (32 hex chars) — the
+    middleware-stack propagation contract end to end."""
+    app.get("/cid", lambda ctx: "ok")
+    app.start()
+    _, _, headers = _get(f"http://127.0.0.1:{app.http_port}/cid")
+    cid = headers["X-Correlation-ID"]
+    assert len(cid) == 32
+    int(cid, 16)  # hex
+
+
+def test_metrics_path_label_is_route_pattern(app):
+    """The metrics path label must be the MATCHED ROUTE PATTERN (bounded
+    cardinality), never the raw URL; unrouted requests share one
+    'unmatched' series."""
+    import urllib.error
+
+    app.get("/greet/{name}", lambda ctx: "hi")
+    app.start()
+    base = f"http://127.0.0.1:{app.http_port}"
+    _get(base + "/greet/ada")
+    _get(base + "/greet/bob")
+    try:
+        urllib.request.urlopen(base + "/definitely/not/routed", timeout=5)
+    except urllib.error.HTTPError:
+        pass
+    _, body, _ = _get(base + "/metrics")
+    text = body.decode()
+    assert 'path="/greet/{name}"' in text
+    assert "/greet/ada" not in text and "/greet/bob" not in text
+    assert 'path="unmatched"' in text
+    # duration histogram carries the same label
+    assert ('gofr_http_request_duration_seconds_count{path="/greet/{name}"} 2'
+            in text)
+
+
+def test_metrics_middleware_counts_escaping_exceptions():
+    """An exception that escapes the inner chain must count as a 500
+    instead of silently bypassing the metrics (try/finally), and still
+    propagate to the outer recovery middleware."""
+    import asyncio
+
+    from gofr_tpu.http.middleware import metrics_middleware
+    from gofr_tpu.http.request import Request
+    from gofr_tpu.metrics import Registry
+
+    registry = Registry()
+
+    async def exploding(request):
+        raise RuntimeError("middleware-level failure")
+
+    endpoint = metrics_middleware(registry)(exploding)
+    request = Request("GET", "/boom", {})
+    with pytest.raises(RuntimeError):
+        asyncio.run(endpoint(request))
+    counter = registry.counter("gofr_http_requests_total")
+    assert counter.value(method="GET", path="unmatched", status="500") == 1
+
+
 def test_put_patch_delete_routes(app):
     """The full method-helper surface (parity: gofr.go:152-169) through
     real sockets — PUT/PATCH/DELETE were registered but never driven."""

@@ -1,0 +1,92 @@
+"""Lint-style guard on metric naming: every metric registered through
+the container's registry must follow the framework convention —
+``gofr_`` prefix, snake_case, and a recognized unit/dimension suffix —
+so dashboard and alert queries stay stable as metrics grow. Scans the
+package source for registration calls (the registry API takes literal
+names), the same way a linter would."""
+
+import pathlib
+import re
+
+import gofr_tpu
+
+PKG_DIR = pathlib.Path(gofr_tpu.__file__).parent
+
+# registry.counter("name", ...) / metrics.gauge(\n    "name", ... — the
+# name literal is the first argument, possibly on the next line
+_REGISTRATION = re.compile(
+    r'\.(counter|gauge|histogram)\(\s*\n?\s*"([^"]+)"', re.MULTILINE
+)
+
+# unit suffixes (prometheus convention) plus the framework's recognized
+# dimensionless suffixes (counts of things whose unit IS the thing)
+_COUNTER_SUFFIXES = ("_total",)
+_HISTOGRAM_SUFFIXES = ("_seconds", "_bytes", "_size")
+_GAUGE_SUFFIXES = (
+    "_seconds", "_bytes", "_total", "_depth", "_ratio", "_entries",
+    "_active", "_acceptance",
+)
+# roofline utilization gauges: the suffix IS the (well-known) metric name
+_GAUGE_ALLOWLIST = {"gofr_tpu_mfu", "gofr_tpu_mbu"}
+
+
+def _registrations():
+    found = []
+    for path in sorted(PKG_DIR.rglob("*.py")):
+        source = path.read_text(encoding="utf-8")
+        for kind, name in _REGISTRATION.findall(source):
+            found.append((str(path.relative_to(PKG_DIR)), kind, name))
+    return found
+
+
+def test_scanner_sees_the_known_registrations():
+    names = {name for _, _, name in _registrations()}
+    # sanity that the regex actually matches the codebase's idiom — a
+    # refactor that breaks the scan must fail here, not silently pass
+    assert {"gofr_http_requests_total", "gofr_tpu_ttft_seconds",
+            "gofr_tpu_batch_size", "gofr_tpu_queue_depth"} <= names
+    assert len(names) >= 12
+
+
+def test_every_metric_follows_the_naming_convention():
+    problems = []
+    for where, kind, name in _registrations():
+        if not name.startswith("gofr_"):
+            problems.append(f"{where}: {name} missing gofr_ prefix")
+            continue
+        if not re.fullmatch(r"[a-z][a-z0-9_]*", name) or "__" in name:
+            problems.append(f"{where}: {name} is not snake_case")
+            continue
+        if kind == "counter" and not name.endswith(_COUNTER_SUFFIXES):
+            problems.append(f"{where}: counter {name} must end in _total")
+        elif kind == "histogram" and not name.endswith(_HISTOGRAM_SUFFIXES):
+            problems.append(
+                f"{where}: histogram {name} needs a unit suffix "
+                f"{_HISTOGRAM_SUFFIXES}"
+            )
+        elif kind == "gauge" and name not in _GAUGE_ALLOWLIST and \
+                not name.endswith(_GAUGE_SUFFIXES):
+            problems.append(
+                f"{where}: gauge {name} needs a unit/dimension suffix "
+                f"{_GAUGE_SUFFIXES} (or an explicit allowlist entry)"
+            )
+    assert not problems, "\n".join(problems)
+
+
+def test_registered_names_at_runtime_match_convention():
+    """Belt and braces: metrics actually registered by a wired container
+    (middleware + batcher instantiation) pass the same check — catches
+    dynamically composed names the source scan cannot see."""
+    from gofr_tpu.http.middleware import metrics_middleware
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.tpu.batcher import DynamicBatcher
+
+    registry = Registry()
+    metrics_middleware(registry)
+    batcher = DynamicBatcher(lambda batch: batch, metrics=registry, name="t")
+    try:
+        for name in registry._metrics:
+            assert name.startswith("gofr_"), name
+            assert re.fullmatch(r"[a-z][a-z0-9_]*", name), name
+    finally:
+        batcher.close()

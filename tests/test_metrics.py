@@ -56,3 +56,44 @@ def test_timer():
     with Timer(h):
         pass
     assert h.percentile(0.5) == 10.0  # bucketed upper bound
+
+
+def test_reads_locked_against_concurrent_writes():
+    """value()/percentile() take the same lock as the write paths:
+    hammering reads during concurrent writes must never raise (dict
+    resize during iteration) and the final value must be exact."""
+    import threading
+
+    c = Counter("c_total", "", ("k",))
+    h = Histogram("h_seconds", "", ("k",), buckets=(0.5, 1.0))
+    stop = threading.Event()
+    failures = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                c.value(k="w0")
+                h.percentile(0.5, k="w0")
+        except Exception as exc:  # pragma: no cover - the failure mode
+            failures.append(exc)
+
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers:
+        t.start()
+    writers = []
+    for w in range(4):
+        def write(w=w):
+            for i in range(500):
+                c.inc(k=f"w{w}-{i % 50}")
+                h.observe(0.2, k=f"w{w}-{i % 50}")
+
+        writers.append(threading.Thread(target=write))
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not failures
+    assert sum(c.value(k=f"w0-{i}") for i in range(50)) == 500

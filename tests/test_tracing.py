@@ -105,6 +105,61 @@ def test_zipkin_exporter_posts_batch(free_port):
         srv.shutdown()
 
 
+def test_zipkin_exporter_drops_on_overflow(free_port):
+    """Export must NEVER block the hot path: once the bounded queue is
+    full, further spans are silently dropped, not queued unboundedly and
+    not raised into the serving thread."""
+    exp = ZipkinExporter(
+        f"http://127.0.0.1:{free_port()}/api/v2/spans",  # nothing listens
+        flush_interval=30.0, max_queue=2,
+    )
+    exp.shutdown()  # stop the draining worker; the queue bound is now hard
+    tracer = Tracer(exp)
+    for i in range(10):  # far past max_queue — must not raise
+        with tracer.start_span(f"overflow-{i}"):
+            pass
+    assert exp._queue.qsize() <= 2
+
+
+def test_tracer_shutdown_flushes_pending_spans(free_port):
+    """Spans exported just before shutdown must still reach the
+    collector even when the flush interval has not elapsed — shutdown
+    drains the queue instead of dropping it."""
+    import http.server
+
+    port = free_port()
+    received = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append(json.loads(body))
+            self.send_response(202)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        # flush_interval far past the test horizon: only the shutdown
+        # flush can deliver these
+        tracer = Tracer(ZipkinExporter(
+            f"http://127.0.0.1:{port}/api/v2/spans", flush_interval=300.0
+        ))
+        with tracer.start_span("pending-a"):
+            pass
+        with tracer.start_span("pending-b"):
+            pass
+        tracer.shutdown()
+        names = {s["name"] for batch in received for s in batch}
+        assert {"pending-a", "pending-b"} <= names
+    finally:
+        srv.shutdown()
+
+
 def test_init_tracer_without_host(monkeypatch):
     from gofr_tpu.config import EnvConfig
 
