@@ -313,7 +313,16 @@ def prefill(
     lengths: Optional[jnp.ndarray] = None,
 ) -> tuple[jnp.ndarray, dict]:
     """Process a (possibly padded) prompt bucket [B, S]; ``lengths`` [B] are
-    true prompt lengths. Returns next-token logits [B, V] + cache."""
+    true prompt lengths. Returns next-token logits [B, V] + cache.
+
+    Chunk-resume contract (chunked prefill, PREFILL_CHUNK_TOKENS): this
+    call starts at ``cache['lengths']`` and attends the full written
+    window, so feeding a prompt in bucket-sized slices through the SAME
+    executable produces the same cache contents and final logits as one
+    full-width call — each slice's keys land at their true positions and
+    its queries see every earlier slice's KV. That is what lets the
+    serving layer bound per-dispatch prefill compute without changing
+    outputs (asserted bit-exact in tests/test_tpu.py)."""
     return _forward_with_cache(params, tokens, cache, cfg, lengths)
 
 

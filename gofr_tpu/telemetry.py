@@ -60,6 +60,8 @@ class FlightRecord:
     __slots__ = (
         "trace_id", "model", "endpoint", "status", "error", "stream",
         "tokens_in", "tokens_out", "batch_size", "pool_cohort",
+        "prefill_chunks", "prefill_bucket", "sched_defer_s",
+        "pool_reject_reason",
         "wall_start", "t_start", "t_enqueue", "t_dispatch",
         "t_first_token", "t_last_token", "t_done", "wall_done", "_lock",
     )
@@ -82,6 +84,10 @@ class FlightRecord:
         self.tokens_out = 0
         self.batch_size = 0  # prefill batch cohort (batcher dispatch)
         self.pool_cohort = 0  # active decode-pool slots when this joined
+        self.prefill_chunks = 0  # bounded-compute prefill dispatches
+        self.prefill_bucket = 0  # widest compiled bucket the prefill rode
+        self.sched_defer_s = 0.0  # total interference-scheduler defer
+        self.pool_reject_reason = ""  # why the decode pool refused (solo'd)
         self.wall_start = time.time()
         self.t_start = time.perf_counter()
         self.t_enqueue: Optional[float] = None
@@ -114,6 +120,31 @@ class FlightRecord:
         with self._lock:
             if cohort > self.pool_cohort:
                 self.pool_cohort = cohort
+
+    def note_prefill_chunk(self, n: int = 1, bucket: int = 0) -> None:
+        """Prefill dispatch accounting: ``n`` bounded-compute chunks
+        landed, each through a ``bucket``-wide compiled shape (the widest
+        seen is kept — bucket vs. ``tokens_in`` shows the padding a
+        request paid)."""
+        with self._lock:
+            self.prefill_chunks += n
+            if bucket > self.prefill_bucket:
+                self.prefill_bucket = bucket
+
+    def note_sched_defer(self, seconds: float) -> None:
+        """Interference-scheduler defer: time this request's prefill
+        chunks waited for their decode-interleave turn (accumulates
+        across chunks)."""
+        if seconds and seconds > 0:
+            with self._lock:
+                self.sched_defer_s += seconds
+
+    def note_pool_reject(self, reason: str) -> None:
+        """The decode pool refused this request (it decoded solo); the
+        FIRST rejection reason is kept — later fan-out candidates may
+        see a different pool state."""
+        if not self.pool_reject_reason:
+            self.pool_reject_reason = reason
 
     def note_tokens(self, n: int = 1) -> None:
         with self._lock:
@@ -176,6 +207,10 @@ class FlightRecord:
             "tokens_out": self.tokens_out,
             "batch_size": self.batch_size,
             "pool_cohort": self.pool_cohort,
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_bucket": self.prefill_bucket or None,
+            "sched_defer_s": self.sched_defer_s or None,
+            "pool_reject_reason": self.pool_reject_reason or None,
             "start_ts": self.wall_start,
             "enqueue_ts": _offset(self.t_enqueue),
             "dispatch_ts": _offset(self.t_dispatch),
@@ -414,5 +449,13 @@ class FlightRecorder:
                 entry["ttft_s"] = _percentiles(ttfts)
             if tpots:
                 entry["tpot_s"] = _percentiles(tpots)
+            # interference-scheduler visibility: how often prefills were
+            # chunked and how much their chunks waited for decode turns
+            defers = [r.sched_defer_s for r in rows if r.sched_defer_s]
+            if defers:
+                entry["sched_defer_s"] = _percentiles(defers)
+            chunked = sum(1 for r in rows if r.prefill_chunks > 1)
+            if chunked:
+                entry["chunked_prefills"] = chunked
             models[model] = entry
         return {"window_s": window_s, "models": models}

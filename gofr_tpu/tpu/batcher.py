@@ -8,8 +8,18 @@ Design:
 - a dedicated worker thread takes the first request, then drains more until
   ``max_batch`` or ``timeout_ms`` past the FIRST request's arrival —
   the first request never waits longer than the deadline;
+- with a ``bucket_fn``, the drained batch is split into per-bucket
+  COHORTS and the fullest cohort dispatches (bucket-homogeneous batches:
+  a 48-token prompt no longer pads to a co-batched 4k prompt's bucket
+  and burns its FLOPs); the rest stay pending and dispatch on their own
+  already-running deadlines — cohort formation never blocks an item
+  beyond the deadline it was already waiting out, it only reorders which
+  dispatch an item rides;
 - batches pad the batch dimension to the next power of two (bounded set of
   compiled shapes), excess rows are masked out on split;
+- with a ``scheduler`` (tpu/scheduler.py), each dispatch first asks the
+  prefill/decode interference scheduler for its turn, so a prefill burst
+  cannot starve pooled decode chunks of the shared device;
 - works from sync handlers (Future.result) and async handlers
   (asyncio.wrap_future) alike — no event-loop coupling.
 """
@@ -20,6 +30,7 @@ import asyncio
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Optional, Sequence
 
@@ -71,10 +82,18 @@ class DynamicBatcher:
         metrics: Any = None,
         name: str = "default",
         pipeline_depth: int = 2,
+        bucket_fn: Optional[Callable[[Any], int]] = None,
+        scheduler: Any = None,
+        cohort: bool = True,
     ):
         self.run_batch = run_batch
         self.max_batch = max_batch
         self.timeout_s = timeout_ms / 1000.0
+        # bucket_fn(payload) -> the compiled sequence bucket the payload
+        # lands in; enables cohort formation AND padded-token accounting
+        self.bucket_fn = bucket_fn
+        self.scheduler = scheduler
+        self.cohort = cohort
         # pipeline_depth > 1 overlaps device execute of batch N+1 with the
         # host-transfer/completion of batch N — essential when the device
         # link has high round-trip latency (tunneled PJRT: ~65ms/sync)
@@ -85,6 +104,9 @@ class DynamicBatcher:
             max_workers=self.pipeline_depth, thread_name_prefix=f"gofr-dispatch-{name}"
         )
         self._queue: "queue.Queue[Optional[_Item]]" = queue.Queue(maxsize=max_queue)
+        # items displaced by cohort formation wait here (worker-owned;
+        # sized for the depth gauge so displaced requests stay counted)
+        self._pending: "deque[_Item]" = deque()
         self._closed = False
         if metrics is not None:
             self._batch_hist = metrics.histogram(
@@ -98,8 +120,22 @@ class DynamicBatcher:
                 "gofr_tpu_queue_wait_seconds", "time from enqueue to dispatch",
                 labels=("model",),
             )
+            # the padding a dispatch burned: bucket width minus true
+            # length, summed over the cohort — the FLOPs the compiled
+            # shape spends on pad tokens. Bucket-homogeneous cohorts
+            # exist to drive this toward zero.
+            self._padded_counter = (
+                metrics.counter(
+                    "gofr_tpu_prefill_padded_tokens_total",
+                    "pad tokens dispatched in prefill batches "
+                    "(bucket width minus true length, summed per cohort)",
+                    labels=("model",),
+                )
+                if bucket_fn is not None else None
+            )
         else:
             self._batch_hist = self._queue_gauge = self._wait_hist = None
+            self._padded_counter = None
         self.name = name
         self._thread = threading.Thread(target=self._run, daemon=True, name=f"gofr-batcher-{name}")
         self._thread.start()
@@ -114,8 +150,14 @@ class DynamicBatcher:
         except queue.Full:
             raise TooManyRequestsError("inference queue is full") from None
         if self._queue_gauge:
-            self._queue_gauge.set(self._queue.qsize(), model=self.name)
+            self._queue_gauge.set(self._depth(), model=self.name)
         return item.future
+
+    def _depth(self) -> int:
+        """Requests waiting for a batch: the queue PLUS items cohort
+        formation displaced into the worker's pending buffer (still
+        waiting, still counted)."""
+        return self._queue.qsize() + len(self._pending)
 
     def infer(self, payload: Any, timeout: float = 60.0) -> Any:
         """Blocking call for sync handlers."""
@@ -127,18 +169,29 @@ class DynamicBatcher:
 
     # -- worker --------------------------------------------------------------
     def _run(self) -> None:
+        # items displaced by cohort formation wait HERE, not in the queue:
+        # they were already dequeued, their deadlines keep running, and
+        # the next loop iteration serves them before any new arrival
+        pending = self._pending
         while True:
-            try:
-                first = self._queue.get(timeout=0.5)
-            except queue.Empty:
-                if self._closed:
+            if pending:
+                first = pending.popleft()
+            else:
+                try:
+                    first = self._queue.get(timeout=0.5)
+                except queue.Empty:
+                    if self._closed:
+                        return
+                    continue
+                if first is None:
                     return
-                continue
-            if first is None:
-                return
             batch = [first]
             deadline = first.arrival + self.timeout_s
+            closing = False
             while len(batch) < self.max_batch:
+                if pending:
+                    batch.append(pending.popleft())
+                    continue
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0:
                     break
@@ -147,21 +200,95 @@ class DynamicBatcher:
                 except queue.Empty:
                     break
                 if item is None:
-                    self._dispatch_pool.submit(self._dispatch, batch)
-                    return
+                    closing = True
+                    break
                 batch.append(item)
-            self._dispatch_pool.submit(self._dispatch, batch)
+            cohort, rest = self._form_cohort(batch)
+            pending.extend(rest)
+            self._dispatch_pool.submit(self._dispatch, cohort)
+            if closing:
+                # displaced items are invisible to close()'s queue drain —
+                # flush them as cohorts before exiting, never strand them
+                while pending:
+                    cohort, rest = self._form_cohort(list(pending))
+                    pending.clear()
+                    pending.extend(rest)
+                    self._dispatch_pool.submit(self._dispatch, cohort)
+                return
+
+    def _form_cohort(self, batch: list["_Item"]) -> tuple[list["_Item"], list["_Item"]]:
+        """Split a drained batch into per-bucket cohorts and pick ONE to
+        dispatch: the fullest (ties go to the cohort holding the oldest
+        item). Returns (cohort, displaced). A mixed FIFO batch pads every
+        row to the largest member's bucket; a bucket-homogeneous cohort
+        pads only within its own bucket. Displaced items dispatch on the
+        next loop iterations — their deadlines have typically already
+        fired, so the extra wait is the (asynchronous) dispatch handoff,
+        not another full timeout."""
+        if self.bucket_fn is None or not self.cohort or len(batch) <= 1:
+            return batch, []
+        groups: dict[int, list[_Item]] = {}
+        try:
+            for item in batch:
+                groups.setdefault(self.bucket_fn(item.payload), []).append(item)
+        except Exception:
+            return batch, []  # an unbucketable payload: dispatch as-is
+        if len(groups) <= 1:
+            return batch, []
+        chosen = max(
+            groups.values(),
+            key=lambda g: (len(g), -min(i.arrival for i in g)),
+        )
+        keep = set(map(id, chosen))
+        displaced = [i for i in batch if id(i) not in keep]
+        return chosen, displaced
 
     def _dispatch(self, batch: list[_Item]) -> None:
         now = time.perf_counter()
         if self._batch_hist:
             self._batch_hist.observe(len(batch), model=self.name)
-            self._queue_gauge.set(self._queue.qsize(), model=self.name)
+            self._queue_gauge.set(self._depth(), model=self.name)
             for item in batch:
                 self._wait_hist.observe(now - item.arrival, model=self.name)
+        # padded-FLOP accounting: the dispatch bucket is the widest
+        # member's (run_batch pads every row to it); bucket minus true
+        # length is what the compiled shape burns on pad tokens
+        bucket = 0
+        if self.bucket_fn is not None:
+            try:
+                bucket = max(self.bucket_fn(item.payload) for item in batch)
+            except Exception:
+                bucket = 0
+        if bucket and self._padded_counter is not None:
+            padded = sum(
+                max(bucket - min(int(getattr(i.payload, "size", 0) or 0), bucket), 0)
+                for i in batch
+            )
+            if padded:
+                self._padded_counter.inc(padded, model=self.name)
+        # dispatch marks BEFORE the scheduler gate: queue_wait measures
+        # enqueue -> batch formed (same instant the Prometheus wait
+        # histogram observed above); the interleave defer is its own
+        # field (sched_defer_s), never double-counted inside queue_wait
         for item in batch:
             if item.record is not None:
                 item.record.mark_dispatch(len(batch))
+        # interference scheduler: one batched prefill dispatch is one
+        # bounded-compute chunk — wait for its decode-interleave turn.
+        # Gated on bucket_fn: only runners with a prefill/bucket concept
+        # (transformer, echo) count here — an MLP/BERT classification
+        # dispatch is not a prefill chunk and has no decode pool to
+        # interleave with.
+        if self.bucket_fn is not None:
+            defer = (
+                self.scheduler.admit_prefill(bucket * len(batch))
+                if self.scheduler is not None else 0.0
+            )
+            for item in batch:
+                if item.record is not None:
+                    item.record.note_prefill_chunk(bucket=bucket)
+                    if defer:
+                        item.record.note_sched_defer(defer)
         # one tpu-batch span per dispatch, parented to the first queued
         # request's span (a cohort can mix traces; one wins) and ACTIVATED
         # in this dispatch thread so run_batch's device code tags it /

@@ -132,6 +132,7 @@ class DecodePool:
         model: str = "",
         pipeline_depth: int = PIPELINE_DEPTH,
         penalties: str = "lazy",
+        scheduler: Any = None,
     ):
         from gofr_tpu.models.transformer import decode_chunk_pool
 
@@ -142,6 +143,10 @@ class DecodePool:
                 f"penalties must be lazy|eager|off, got {penalties!r}"
             )
         self.pipeline_depth = pipeline_depth
+        # interference scheduler (tpu/scheduler.py): the pool NOTES each
+        # chunk dispatch (never throttled) so prefill chunks can
+        # interleave between decode turns instead of stalling them
+        self._sched = scheduler
         self.cfg = cfg
         self.params = params
         self.n_slots = n_slots
@@ -261,6 +266,19 @@ class DecodePool:
         self._closed = False
         self._depth_gauge = (
             metrics.gauge("gofr_tpu_decode_slots_active", "active decode slots")
+            if metrics is not None
+            else None
+        )
+        # submit rejections by reason: solo-decode fallbacks were only
+        # diagnosable via GOFR_POOL_DEBUG stderr — in production this
+        # counter (and the FlightRecord's pool_reject_reason) says WHY a
+        # stream missed the pool
+        self._reject_counter = (
+            metrics.counter(
+                "gofr_tpu_pool_reject_total",
+                "decode-pool submit rejections (the request decoded solo)",
+                labels=("reason",),
+            )
             if metrics is not None
             else None
         )
@@ -556,29 +574,46 @@ class DecodePool:
         adapter_idx = 0
         with self._work:
             if self._closed:
+                self._reject("closed", count_only=True)
                 raise RuntimeError("decode pool closed")
             if adapter is not None:
                 if penalty is not None:
-                    raise queue.Full("penalized adapter requests decode solo")
+                    self._reject(
+                        "penalized_adapter",
+                        "penalized adapter requests decode solo",
+                    )
                 if not self._lora_ready:
-                    raise queue.Full("adapter bank off or rebuilding")
+                    self._reject(
+                        "bank_rebuilding", "adapter bank off or rebuilding"
+                    )
                 if self._pen_slots:
-                    raise queue.Full("penalized slots active (one executable per chunk)")
+                    self._reject(
+                        "penalized_mix",
+                        "penalized slots active (one executable per chunk)",
+                    )
                 idx = self._lora_index.get(adapter)
                 if idx is None:
-                    raise queue.Full(f"adapter '{adapter}' not in the pool bank")
+                    self._reject(
+                        "unknown_adapter",
+                        f"adapter '{adapter}' not in the pool bank",
+                    )
                 adapter_idx = idx
             if penalty is not None and self._lora_slots:
-                raise queue.Full("adapter slots active (one executable per chunk)")
+                self._reject(
+                    "adapter_mix",
+                    "adapter slots active (one executable per chunk)",
+                )
             if penalty is not None and not self._pen_ready:
                 if self._pen_mode == "lazy":
                     self._pen_kick()
-                raise queue.Full(
+                self._reject(
+                    "penalties_off" if self._pen_mode == "off"
+                    else "penalties_warming",
                     "penalized pool path "
-                    + ("disabled" if self._pen_mode == "off" else "warming")
+                    + ("disabled" if self._pen_mode == "off" else "warming"),
                 )
             if not self._free:
-                raise queue.Full("no free decode slots")
+                self._reject("no_free_slots", "no free decode slots")
             slot = self._free.pop()
             slot.request = _Request(out, max_new, start_len, stop,
                                     frozenset(stop_tokens or ()),
@@ -631,6 +666,18 @@ class DecodePool:
             self._work.notify()
         return out
 
+    def _reject(self, reason: str, msg: str = "", count_only: bool = False):
+        """Account a submit rejection (counter + the caller's flight
+        record) and raise ``queue.Full`` unless ``count_only`` — the
+        device's fallback path then decodes the request solo."""
+        if self._reject_counter is not None:
+            self._reject_counter.inc(reason=reason)
+        record = current_record()
+        if record is not None:
+            record.note_pool_reject(reason)
+        if not count_only:
+            raise queue.Full(msg)
+
     # -- worker --------------------------------------------------------------
     def _run(self) -> None:
         try:
@@ -656,6 +703,8 @@ class DecodePool:
         self._lora_dirty = True
         if self._lora_pending:
             self._install_lora(*self._lora_pending)
+        if self._sched is not None:
+            self._sched.note_decode_idle()  # a dead pool must not gate prefill
 
     def _loop(self) -> None:
         in_flight: deque = deque()  # (records, toks_dev, lps_dev, dispatch_start)
@@ -750,6 +799,10 @@ class DecodePool:
                         (records, toks_dev, lps_dev, tvals_dev, tids_dev,
                          dispatch_start)
                     )
+                    if self._sched is not None:
+                        # decode keeps its cadence; prefill chunks take
+                        # the gaps between these notes
+                        self._sched.note_decode_chunk(len(records))
             # fetch the OLDEST chunk outside the lock: the device is
             # meanwhile executing the younger in-flight chunk(s), and new
             # submissions can take the lock to join the next dispatch
@@ -816,6 +869,8 @@ class DecodePool:
                 or req.cache_len >= self.max_len
             ):
                 self._finish_request(index, req, cancelled)
+        if self._sched is not None and not self._active:
+            self._sched.note_decode_idle()  # release any waiting prefill
         if self._depth_gauge:
             self._depth_gauge.set(len(self._active))
         if self._mfu_gauge is not None and delivered:

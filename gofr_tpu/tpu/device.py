@@ -47,6 +47,20 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
 - ``TPU_BOOT``: "background" boots the stack off-thread; the server
   accepts immediately and /.well-known/ready reports warmup progress
 - ``BATCH_MAX_SIZE`` / ``BATCH_TIMEOUT_MS``: batcher shape
+- ``PREFILL_CHUNK_TOKENS``: per-dispatch prefill compute budget — a
+  solo prefill whose bucket would exceed it runs CHUNKED through the
+  largest compiled bucket inside the budget, resuming from the partial
+  KV, so no single prefill dispatch occupies the device much longer
+  than a decode chunk (0 = off; chunks reuse warmed bucket executables)
+- ``SCHED_POLICY``: prefill/decode interference policy (tpu/scheduler.py)
+  — ``fair`` (default: one prefill chunk per decode-chunk interval
+  under load), ``decode-first`` (one per two intervals), or
+  ``prefill-first`` (never defer, the pre-scheduler behavior);
+  ``SCHED_MAX_DEFER_MS`` bounds any single chunk's wait
+- ``BATCH_COHORT``: "off" restores FIFO mixed-length prefill batches —
+  by default the batcher drains into per-bucket cohorts and dispatches
+  bucket-homogeneous batches (no cross-bucket padding waste;
+  ``gofr_tpu_prefill_padded_tokens_total`` measures what remains)
 - ``TPU_MESH``: multi-chip serving mesh, e.g. "tp=4" (llama3-8b on
   v5e-4: Megatron-sharded weights + tp-sharded KV heads) or "tp=4,dp=4"
   (llama3-70b on v5e-16: tensor-parallel replicas, batch over dp).
@@ -365,6 +379,30 @@ class TPUDevice:
         self._prefix_lcp_min = int(config.get_or_default("PREFIX_LCP_MIN", "0"))
         if self._prefix_lcp_min < -1:
             raise ValueError("PREFIX_LCP_MIN must be >= -1")
+        # prefill/decode interference scheduling (tpu/scheduler.py):
+        # chunk budget, interleave policy, per-chunk defer bound, and the
+        # batcher's cohort formation switch — all validated eagerly
+        self._prefill_chunk_cfg = int(
+            config.get_or_default("PREFILL_CHUNK_TOKENS", "0")
+        )
+        if self._prefill_chunk_cfg < 0:
+            raise ValueError("PREFILL_CHUNK_TOKENS must be >= 0 (0 = off)")
+        from gofr_tpu.tpu.scheduler import POLICIES
+
+        self._sched_policy = (
+            config.get_or_default("SCHED_POLICY", "fair").strip().lower()
+        )
+        if self._sched_policy not in POLICIES:
+            raise ValueError(
+                f"SCHED_POLICY '{self._sched_policy}' not supported — use "
+                f"one of {POLICIES}"
+            )
+        self._sched_max_defer_ms = float(
+            config.get_or_default("SCHED_MAX_DEFER_MS", "1000")
+        )
+        if self._sched_max_defer_ms <= 0:
+            raise ValueError("SCHED_MAX_DEFER_MS must be > 0")
+        self._batch_cohort = config.get_or_default("BATCH_COHORT", "on") != "off"
         self._pool_enabled = config.get_or_default("DECODE_POOL", "on") != "off"
         self._pool_slots = int(config.get_or_default("DECODE_SLOTS", str(self.max_batch)))
         from gofr_tpu.tpu.decode_pool import PIPELINE_DEPTH
@@ -473,6 +511,17 @@ class TPUDevice:
 
     def _build_stack(self) -> None:
         """Construct (or reconstruct, on reinit) runner + pool + batcher."""
+        from gofr_tpu.tpu.scheduler import InterferenceScheduler
+
+        # ONE scheduler instance shared by both dispatchers: the decode
+        # pool notes its chunk cadence, prefill dispatches (batcher
+        # cohorts and solo chunked prefills) wait for their turn
+        self.scheduler = InterferenceScheduler(
+            policy=self._sched_policy,
+            metrics=self.metrics,
+            model=self.model_name,
+            max_defer_ms=self._sched_max_defer_ms,
+        )
         self._boot_progress("building runner (model init / checkpoint load)")
         self.runner = _build_runner(
             self.model_name, self.quant, self.model_path, self.max_batch,
@@ -485,7 +534,20 @@ class TPUDevice:
             prefix_lcp_min=self._prefix_lcp_min,
             lora_adapters=self._lora_adapters,
             echo_step_ms=self._echo_step_ms,
+            prefill_chunk_tokens=self._prefill_chunk_cfg,
         )
+        if (
+            self._prefill_chunk_cfg
+            and hasattr(self.runner, "_can_chunk_prefill")
+            and getattr(self.runner, "prefill_chunk_bucket", None) is None
+        ):
+            # a silently inert knob voids the documented bound — say so
+            self.logger.warnf(
+                "PREFILL_CHUNK_TOKENS=%d is inert under a dp/fsdp serving "
+                "mesh (chunked prefill needs an unsharded cache batch "
+                "axis) — over-budget prompts prefill unbounded",
+                self._prefill_chunk_cfg,
+            )
         self.runner.warmup(progress=self._boot_progress)
         # continuous batching: concurrent decodes share one fixed-shape
         # dispatch per chunk; seeded requests bypass it (device.generate
@@ -521,6 +583,7 @@ class TPUDevice:
                 model=self.model_name,
                 pipeline_depth=self._pool_depth,
                 penalties=self._pool_penalties,
+                scheduler=self.scheduler,
             )
             if getattr(self.runner, "adapters", None):
                 self._boot_progress("warming pooled multi-LoRA bank")
@@ -531,6 +594,9 @@ class TPUDevice:
             timeout_ms=self.timeout_ms,
             metrics=self.metrics,
             name=self.model_name,
+            bucket_fn=getattr(self.runner, "bucket_for_payload", None),
+            scheduler=self.scheduler,
+            cohort=self._batch_cohort,
         )
 
     def _boot_progress(self, detail: str) -> None:
@@ -640,6 +706,7 @@ class TPUDevice:
                     top_logprobs=top_logprobs,
                     adapter=adapter, adapter_params=adapter_params,
                     ttft_cb=_ttft,
+                    scheduler=getattr(self, "scheduler", None),
                 )
                 emitted = out[0] if isinstance(out, tuple) else out
                 span.set_tag("tpu.tokens_out", len(emitted))
@@ -1174,10 +1241,25 @@ class _EchoRunner:
     mimic a real decode cadence."""
 
     name = "echo"
+    # synthetic bucket ladder: echo pads nothing itself, but exposing the
+    # transformer ladder lets the batcher form bucket cohorts and account
+    # padded tokens on the compile-free path — the scheduler/cohort
+    # machinery is then fully exercisable without XLA (tier-1 tests)
+    buckets = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
+    # bench gate: echo HAS a real generate loop (bench.py probes this
+    # attribute to decide whether a decode phase makes sense)
+    decode_chunk_size = 1
 
     def __init__(self, max_batch: int = 8, step_ms: float = 0.0):
         self.max_batch = max_batch
         self.step_s = step_ms / 1000.0
+
+    def bucket_for_payload(self, ids: np.ndarray) -> int:
+        n = int(getattr(ids, "size", 0) or 0)
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
 
     def prepare(self, payload: Any) -> np.ndarray:
         if isinstance(payload, dict):
@@ -1216,6 +1298,7 @@ class _EchoRunner:
         top_logprobs: bool = False,
         adapter: Optional[str] = None,
         adapter_params: Optional[Any] = None,
+        scheduler: Any = None,
     ) -> Any:
         if adapter is not None:
             from gofr_tpu.errors import InvalidParamError
@@ -1386,6 +1469,7 @@ class _TransformerRunner:
         prefix_cache: int = 0,
         prefix_lcp_min: int = 0,
         lora_adapters: Optional[dict] = None,
+        prefill_chunk_tokens: int = 0,
     ):
         self.max_batch = max_batch
         from gofr_tpu.models.llama import CONFIGS
@@ -1421,6 +1505,18 @@ class _TransformerRunner:
         self.n_params = transformer_param_count(cfg)
         bucket_source = buckets if buckets else self.SEQ_BUCKETS
         self.buckets = [b for b in bucket_source if b <= cfg.max_seq] or [cfg.max_seq]
+        # PREFILL_CHUNK_TOKENS: prompts whose bucket would exceed the
+        # budget prefill CHUNKED through the largest compiled bucket
+        # inside it (chunks must reuse a warmed executable, so the
+        # budget resolves to a bucket; a budget below the smallest
+        # bucket clamps to it — one bucket's compute is the floor)
+        # gated on _can_chunk_prefill: chunked prefill needs the cache's
+        # batch axis unsharded, so under a dp/fsdp mesh the budget cannot
+        # apply — the attribute stays None and the device warns at boot
+        self.prefill_chunk_bucket: Optional[int] = None
+        if prefill_chunk_tokens and self._can_chunk_prefill():
+            fitting = [b for b in self.buckets if b <= prefill_chunk_tokens]
+            self.prefill_chunk_bucket = fitting[-1] if fitting else self.buckets[0]
         # multi-LoRA serving: named adapter sets over the SHARED base
         # arrays (n adapters cost n x adapter bytes, not n x model bytes);
         # requests pick one per call — prefill runs solo with the wrapped
@@ -1628,6 +1724,11 @@ class _TransformerRunner:
                 return b
         return self.buckets[-1]
 
+    def bucket_for_payload(self, ids: Any) -> int:
+        """Compiled bucket a prepared payload lands in — the batcher's
+        cohort key and padded-token accounting basis."""
+        return self._bucket_for(max(int(getattr(ids, "size", 0) or 0), 1))
+
     def score(self, tokens: Any, adapter: Optional[str] = None) -> list[float]:
         """log p(t_i | t_<i) for every prompt position i >= 1 — the
         teacher-forcing loglikelihood primitive (completions
@@ -1745,6 +1846,7 @@ class _TransformerRunner:
         top_logprobs: bool = False,
         adapter: Optional[str] = None,
         adapter_params: Optional[Any] = None,
+        scheduler: Any = None,
     ) -> "list[int] | tuple[list[int], list[float]] | tuple":
         if top_logprobs:
             logprobs = True  # alternatives imply the chosen-token values
@@ -1772,11 +1874,14 @@ class _TransformerRunner:
                     f"adapter '{adapter}' (loaded: {sorted(self.adapters)})"
                 )
             # adapter weights differ from the batch's: prefill solo (one
-            # [1, bucket] row, bucket sized to the prompt) and skip the
-            # shared prefix cache/spec; decode joins the pool below via
-            # its per-slot adapter bank
+            # [1, bucket] row, bucket sized to the prompt but never past
+            # the chunk budget) and skip the shared prefix cache/spec;
+            # decode joins the pool below via its per-slot adapter bank
+            a_bucket = self._bucket_for(int(ids.size))
+            if self.prefill_chunk_bucket is not None:
+                a_bucket = min(a_bucket, self.prefill_chunk_bucket)
             state = self._chunked_prefill(
-                ids, prm, bucket=self._bucket_for(int(ids.size))
+                ids, prm, bucket=a_bucket, scheduler=scheduler
             )
         else:
             state = (
@@ -1789,11 +1894,23 @@ class _TransformerRunner:
                 if self._prefix_cache is not None else None
             )
             if state is None:
-                if ids.size > self.buckets[-1] and self._can_chunk_prefill():
-                    # longer than the largest compiled bucket: slice
-                    # through it instead of truncating (run_batch's
-                    # batched path keeps the recency clip)
-                    state = self._chunked_prefill(ids)
+                chunk_b = self.prefill_chunk_bucket
+                if self._can_chunk_prefill() and (
+                    ids.size > self.buckets[-1]
+                    or (chunk_b is not None and ids.size > chunk_b)
+                ):
+                    # longer than the largest compiled bucket (slice
+                    # through it instead of truncating — run_batch's
+                    # batched path keeps the recency clip), or past the
+                    # PREFILL_CHUNK_TOKENS budget: bounded-compute
+                    # chunks through one warmed bucket executable,
+                    # interleaved with decode by the scheduler
+                    width = self.buckets[-1]
+                    if chunk_b is not None:
+                        width = min(width, chunk_b)
+                    state = self._chunked_prefill(
+                        ids, bucket=width, scheduler=scheduler
+                    )
                 elif prefill_batcher is not None:
                     state = prefill_batcher.infer(ids)
                 else:
@@ -2045,17 +2162,22 @@ class _TransformerRunner:
         return self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1) == 1
 
     def _chunked_prefill(
-        self, ids: np.ndarray, params: Any = None, bucket: Optional[int] = None
+        self, ids: np.ndarray, params: Any = None,
+        bucket: Optional[int] = None, scheduler: Any = None,
     ) -> dict:
-        """Prefill a prompt LONGER than the largest compiled bucket by
-        running it through the top bucket in slices, each writing into the
-        same [1]-row cache at its ragged start offset — the exact cached
-        forward decode already uses. One compiled [1, bucket] shape serves
-        any prompt length up to max_seq, so a deployment can restrict
-        MODEL_BUCKETS (fast cold boot) without truncating long prompts.
-        ONE host fetch at the end (the last chunk's argmax). ``bucket``
-        overrides the chunk width (adapter requests size it to the
-        prompt so short prompts never pay top-bucket FLOPs)."""
+        """Prefill a prompt LONGER than the largest compiled bucket (or
+        the PREFILL_CHUNK_TOKENS budget) by running it through a bucket
+        in slices, each writing into the same [1]-row cache at its ragged
+        start offset — the exact cached forward decode already uses. One
+        compiled [1, bucket] shape serves any prompt length up to
+        max_seq, so a deployment can restrict MODEL_BUCKETS (fast cold
+        boot) without truncating long prompts, and no single dispatch
+        occupies the device longer than one bucket's compute. ONE host
+        fetch at the end (the last chunk's argmax). ``bucket`` overrides
+        the chunk width (adapter requests size it to the prompt so short
+        prompts never pay top-bucket FLOPs). ``scheduler`` interleaves
+        each chunk with pooled decode turns (tpu/scheduler.py) and the
+        chunk count/defer land on the request's FlightRecord."""
         bucket = bucket or self.buckets[-1]
         # the shared zero cache: prefill never mutates its input, so every
         # chunked request can start from the same [1]-row allocation
@@ -2063,8 +2185,23 @@ class _TransformerRunner:
         logits = next_ids = None
         total = 0
         prm = self.params if params is None else params
+        record = telemetry_record()
+        if record is not None:
+            # the chunked path has no batcher queue, but the spine marks
+            # must not go null for exactly the requests the budget
+            # targets: enqueue/dispatch are stamped here (queue_wait ~ 0;
+            # scheduler waits land in sched_defer_s, same split as the
+            # batched path)
+            record.mark_enqueue()
+            record.mark_dispatch(1)
         for tokens, lengths, size in _prompt_chunks(ids, bucket):
+            if scheduler is not None:
+                wait = scheduler.admit_prefill(bucket)
+                if record is not None and wait:
+                    record.note_sched_defer(wait)
             logits, next_ids, cache = self._prefill(prm, tokens, cache, lengths)
+            if record is not None:
+                record.note_prefill_chunk(bucket=bucket)
             total += size
         return {
             "cache": cache,
@@ -2584,6 +2721,25 @@ class _TransformerRunner:
                 np.ones((self.buckets[-1] + 1,), np.int32)
             )
             del state
+        chunk_b = self.prefill_chunk_bucket
+        if (
+            chunk_b is not None and chunk_b < self.cfg.max_seq
+            and self._can_chunk_prefill()
+            # the block above already warmed exactly this shape when the
+            # budget resolves to the top bucket — don't pay it twice
+            and not (
+                chunk_b == self.buckets[-1]
+                and self.buckets[-1] < self.cfg.max_seq
+            )
+        ):
+            # the PREFILL_CHUNK_TOKENS budget routes over-budget prompts
+            # through [1, chunk_b] slices — warm that shape too
+            if progress:
+                progress(f"compiling budgeted chunked prefill ([1, {chunk_b}])")
+            state = self._chunked_prefill(
+                np.ones((chunk_b + 1,), np.int32), bucket=chunk_b
+            )
+            del state
         if progress:
             progress("compiling decode step")
         one = _slice_cache(cache, 0)
@@ -2927,6 +3083,7 @@ def _build_runner(
     prefix_lcp_min: int = 0,
     lora_adapters: Optional[dict] = None,
     echo_step_ms: float = 0.0,
+    prefill_chunk_tokens: int = 0,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
@@ -2948,6 +3105,7 @@ def _build_runner(
             draft_tokens=draft_tokens, draft_path=draft_path,
             attn_impl=attn_impl, prefix_cache=prefix_cache,
             prefix_lcp_min=prefix_lcp_min, lora_adapters=lora_adapters,
+            prefill_chunk_tokens=prefill_chunk_tokens,
         )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected echo, mlp, bert-tiny, "

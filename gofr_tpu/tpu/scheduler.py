@@ -1,0 +1,181 @@
+"""Prefill/decode interference scheduler: the policy between the two
+dispatchers that share one device.
+
+Without it the prefill ``DynamicBatcher`` and the continuous-batching
+``DecodePool`` dispatch independently: a long-prompt prefill batch
+occupies the device for its full duration and every pooled decode chunk
+behind it waits, so one 4k-token prompt spikes TPOT for all co-tenants.
+The fix is two-sided:
+
+- **bounded prefill compute**: prefills larger than ``PREFILL_CHUNK_TOKENS``
+  are split into bucket-sized chunks (device.py ``_chunked_prefill``), so
+  no single prefill dispatch occupies the device much longer than one
+  decode chunk;
+- **an interleaver** (this module): both dispatchers consult ONE
+  ``InterferenceScheduler``. Decode is never throttled — the pool only
+  *notes* each chunk dispatch. Prefill chunks call ``admit_prefill``,
+  which under load defers until decode has taken its turn, so the device
+  stream alternates decode-chunk / prefill-chunk instead of running a
+  prefill train.
+
+Why dispatch-order interleaving is enough: a single device executes its
+stream roughly in dispatch order (JAX async dispatch keeps the host
+ahead, not the device reordered), so admitting at most one
+bounded-compute prefill chunk per decode-chunk interval bounds the gap
+between two decode chunks at ~one prefill chunk's compute — the decode
+cadence a pooled stream observes degrades by at most that bound, never
+by a whole prompt's prefill.
+
+Policies (``SCHED_POLICY``):
+
+- ``fair`` (default): at most one prefill chunk per decode-chunk
+  interval while decode is busy — prefills make steady progress, pooled
+  streams keep their cadence.
+- ``decode-first``: one prefill chunk per TWO decode-chunk intervals —
+  stronger TPOT protection for decode-heavy deployments, prefill
+  (TTFT) pays.
+- ``prefill-first``: never defer (the pre-scheduler behavior; TTFT
+  wins, co-tenant TPOT pays).
+
+Every wait is bounded by ``SCHED_MAX_DEFER_MS`` per chunk and by a
+decode-idleness horizon, so a stalled or finished pool can never starve
+prefill: the scheduler degrades to a no-op when decode goes quiet.
+
+Telemetry: ``gofr_tpu_prefill_chunks_total`` counts admitted
+bounded-compute prefill dispatches, ``gofr_tpu_sched_defer_seconds``
+observes how long each chunk waited for its turn. Callers stamp the
+per-request FlightRecord themselves (they hold it; this module stays
+request-agnostic).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+POLICIES = ("decode-first", "prefill-first", "fair")
+
+
+class InterferenceScheduler:
+    """The small shared object both dispatchers consult.
+
+    Decode side: ``note_decode_chunk(active)`` per pool dispatch (and
+    ``note_decode_idle()`` when the pool drains) — cheap, never blocks.
+    Prefill side: ``admit_prefill()`` before each bounded prefill
+    dispatch — blocks (bounded) for a decode turn under load and
+    returns the seconds deferred.
+    """
+
+    def __init__(
+        self,
+        policy: str = "fair",
+        metrics: Any = None,
+        model: str = "",
+        max_defer_ms: float = 1000.0,
+        idle_after_s: float = 0.5,
+    ):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"scheduler policy '{policy}' not supported — use one of "
+                f"{POLICIES}"
+            )
+        if max_defer_ms <= 0:
+            raise ValueError("max_defer_ms must be > 0")
+        self.policy = policy
+        self.model = model
+        self._max_defer_s = max_defer_ms / 1000.0
+        self._idle_after_s = idle_after_s
+        self._cond = threading.Condition()
+        self._decode_seq = 0  # decode chunk dispatches seen
+        self._decode_active = 0  # active pool slots at the last note
+        self._last_decode_t = 0.0
+        self._last_admit_seq = 0  # decode seq at the last admitted prefill
+        self._interval_ema = 0.0  # smoothed decode chunk cadence
+        # counters kept plain too so tests (and /admin debugging) can read
+        # scheduling behavior without scraping the registry
+        self.stats = {
+            "prefill_chunks": 0,
+            "deferred_chunks": 0,
+            "decode_chunks": 0,
+        }
+        if metrics is not None:
+            self._chunks_counter = metrics.counter(
+                "gofr_tpu_prefill_chunks_total",
+                "bounded-compute prefill dispatches admitted by the "
+                "interference scheduler",
+                labels=("model",),
+            )
+            self._defer_hist = metrics.histogram(
+                "gofr_tpu_sched_defer_seconds",
+                "time a prefill chunk waited for its decode-interleave turn",
+                labels=("model",),
+            )
+        else:
+            self._chunks_counter = self._defer_hist = None
+
+    # -- decode side (never blocks) ------------------------------------------
+    def note_decode_chunk(self, active: int) -> None:
+        """One pooled decode chunk dispatched with ``active`` live slots."""
+        now = time.perf_counter()
+        with self._cond:
+            self._decode_seq += 1
+            self.stats["decode_chunks"] += 1
+            if self._last_decode_t:
+                interval = now - self._last_decode_t
+                self._interval_ema = (
+                    interval if not self._interval_ema
+                    else 0.8 * self._interval_ema + 0.2 * interval
+                )
+            self._last_decode_t = now
+            self._decode_active = max(int(active), 0)
+            self._cond.notify_all()
+
+    def note_decode_idle(self) -> None:
+        """The pool drained (or died): release any waiting prefill now."""
+        with self._cond:
+            self._decode_active = 0
+            self._cond.notify_all()
+
+    def _decode_busy(self, now: float) -> bool:
+        """Under ``_cond``: is decode actively dispatching? Active slots
+        alone are not enough — a wedged pool must not starve prefill, so
+        a cadence older than the idleness horizon counts as quiet."""
+        if self._decode_active <= 0:
+            return False
+        horizon = max(self._idle_after_s, 8.0 * self._interval_ema)
+        return (now - self._last_decode_t) < horizon
+
+    # -- prefill side ---------------------------------------------------------
+    def admit_prefill(self, tokens: int = 0) -> float:
+        """Gate one bounded-compute prefill dispatch; returns the seconds
+        this chunk was deferred waiting for its decode-interleave turn
+        (0.0 when decode is idle or the policy never defers). ``tokens``
+        is accounting detail only (the chunk's bucket width)."""
+        start = time.perf_counter()
+        if self.policy != "prefill-first":
+            need = 2 if self.policy == "decode-first" else 1
+            deadline = start + self._max_defer_s
+            with self._cond:
+                while True:
+                    now = time.perf_counter()
+                    if not self._decode_busy(now):
+                        break
+                    if self._decode_seq >= self._last_admit_seq + need:
+                        break
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        break  # defer bound: prefill must keep progressing
+                    # short poll cap: an idle transition without a
+                    # note_decode_idle (pool wedged) must still release us
+                    self._cond.wait(min(remaining, 0.05))
+                self._last_admit_seq = self._decode_seq
+        deferred = time.perf_counter() - start
+        with self._cond:
+            self.stats["prefill_chunks"] += 1
+            if deferred > 0.0005:
+                self.stats["deferred_chunks"] += 1
+        if self._chunks_counter is not None:
+            self._chunks_counter.inc(model=self.model)
+            self._defer_hist.observe(deferred, model=self.model)
+        return deferred

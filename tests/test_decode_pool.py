@@ -60,6 +60,26 @@ def test_pool_enabled_by_default():
         _restore(old)
 
 
+def test_submit_rejection_reason_is_counted():
+    """A solo-decode fallback must be diagnosable without
+    GOFR_POOL_DEBUG: the reject reason lands on
+    gofr_tpu_pool_reject_total{reason=...}. DECODE_POOL_PENALTIES=off
+    rejects penalized submits deterministically."""
+    dev, old = _device(DECODE_POOL_PENALTIES="off")
+    try:
+        out = dev.generate(
+            [3, 1, 4, 1, 5], max_new_tokens=6, sampler=Sampler(presence_penalty=0.5)
+        )
+        assert len(out) == 6  # the solo fallback still served the request
+        counter = dev.metrics.counter(
+            "gofr_tpu_pool_reject_total", labels=("reason",)
+        )
+        assert counter.value(reason="penalties_off") >= 1
+    finally:
+        dev.close()
+        _restore(old)
+
+
 def test_pooled_greedy_matches_solo(pooled, solo):
     for prompt, n in (([1, 2, 3], 11), ([7] * 30, 6), ([42], 1), ([5, 6], 4)):
         assert pooled.generate(prompt, max_new_tokens=n) == \

@@ -219,6 +219,11 @@ def test_chat_request_produces_flight_record(echo_app):
     assert rec["tokens_in"] == body["usage"]["prompt_tokens"]
     assert rec["tokens_out"] == 6
     assert rec["batch_size"] >= 1
+    # interference-scheduler accounting rode the echo prefill dispatch:
+    # one bounded chunk through the (synthetic) echo bucket ladder
+    assert rec["prefill_chunks"] == 1
+    assert rec["prefill_bucket"] >= rec["tokens_in"]
+    assert rec["pool_reject_reason"] is None  # echo has no decode pool
     # the spine timings are real, not defaults
     assert rec["queue_wait_s"] > 0
     assert rec["ttft_s"] > 0

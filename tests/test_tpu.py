@@ -588,6 +588,104 @@ def test_chunked_prefill_matches_full_bucket():
             os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
 
 
+def test_prefill_chunk_budget_bit_identical_and_bounded():
+    """PREFILL_CHUNK_TOKENS: a prompt whose bucket exceeds the budget
+    prefills in >= 2 bounded chunks through the warmed budget bucket —
+    and the output tokens are BIT-IDENTICAL to the unbudgeted path (the
+    chunk-resume contract in models/transformer.py::prefill)."""
+    import os
+
+    base = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2", "BATCH_TIMEOUT_MS": "1",
+            "MODEL_BUCKETS": "16,32,64"}
+    old = {k: os.environ.get(k)
+           for k in {**base, "PREFILL_CHUNK_TOKENS": None}}
+    prompt = [(i % 9) + 1 for i in range(40)]  # the 64 bucket, > 2x budget
+    try:
+        os.environ.update(base)
+        os.environ.pop("PREFILL_CHUNK_TOKENS", None)
+        plain = new_device(EnvConfig(), MockLogger(Level.INFO), Registry())
+        try:
+            assert plain.runner.prefill_chunk_bucket is None
+            want = plain.generate(prompt, max_new_tokens=8)
+        finally:
+            plain.close()
+        os.environ["PREFILL_CHUNK_TOKENS"] = "16"
+        registry = Registry()
+        budget = new_device(EnvConfig(), MockLogger(Level.INFO), registry)
+        try:
+            assert budget.runner.prefill_chunk_bucket == 16
+            chunks = registry.counter(
+                "gofr_tpu_prefill_chunks_total", labels=("model",)
+            )
+            before = chunks.value(model="tiny")
+            got = budget.generate(prompt, max_new_tokens=8)
+            assert got == want, (got, want)  # bit-identical to unchunked
+            # 40 tokens through a 16-wide budget = 3 bounded dispatches
+            assert chunks.value(model="tiny") - before >= 3
+        finally:
+            budget.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_budgeted_prefill_alongside_pooled_stream():
+    """A >1-bucket prompt admitted while a pooled stream decodes: the
+    prefill lands in bounded chunks (scheduler-admitted), both requests
+    finish with their exact interference-free outputs, and the pool's
+    cadence notes flowed through the shared scheduler. (The bounded
+    inter-chunk gap itself is asserted deterministically in
+    tests/test_scheduler.py — dispatch-order interleaving.)"""
+    import os
+    import threading
+
+    env = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2", "BATCH_TIMEOUT_MS": "1",
+           "MODEL_BUCKETS": "16,32,64", "PREFILL_CHUNK_TOKENS": "16",
+           "DECODE_CHUNK": "1", "DECODE_SLOTS": "2"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    prompt = [(i % 9) + 1 for i in range(40)]
+    try:
+        registry = Registry()
+        dev = new_device(EnvConfig(), MockLogger(Level.INFO), registry)
+        try:
+            assert dev.decode_pool is not None
+            stream_prompt = [5, 6, 7]
+            stream_out: list[int] = []
+            first = threading.Event()
+
+            def on_token(t):
+                stream_out.append(t)
+                first.set()
+
+            worker = threading.Thread(
+                target=dev.generate,
+                args=(stream_prompt,),
+                kwargs={"max_new_tokens": 80, "on_token": on_token},
+            )
+            worker.start()
+            assert first.wait(60)  # the pooled stream is live
+            chunks = registry.counter(
+                "gofr_tpu_prefill_chunks_total", labels=("model",)
+            )
+            before = chunks.value(model="tiny")
+            got = dev.generate(prompt, max_new_tokens=4)
+            worker.join(timeout=120)
+            assert not worker.is_alive()
+            # the long prefill went through in bounded chunks mid-traffic
+            assert chunks.value(model="tiny") - before >= 3
+            assert dev.scheduler.stats["decode_chunks"] >= 1
+            # neither request perturbed the other: greedy outputs equal
+            # their interference-free reruns exactly
+            assert got == dev.generate(prompt, max_new_tokens=4)
+            assert stream_out == dev.generate(stream_prompt, max_new_tokens=80)
+        finally:
+            dev.close()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
 def test_attn_impl_override():
     import os
 
