@@ -28,6 +28,8 @@ from gofr_tpu.handler import (
     adapter_load_handler,
     adapter_unload_handler,
     adapters_list_handler,
+    dispatches_admin_handler,
+    engine_admin_handler,
     favicon_handler,
     health_handler,
     make_endpoint,
@@ -156,6 +158,13 @@ class App:
                         make_endpoint(requests_admin_handler, self.container))
         self.router.add("GET", "/admin/slo",
                         make_endpoint(slo_admin_handler, self.container))
+        # engine introspection (tpu/introspect.py): the layer below the
+        # flight recorder — engine state, boot/compile timeline, and the
+        # device dispatch timeline
+        self.router.add("GET", "/admin/engine",
+                        make_endpoint(engine_admin_handler, self.container))
+        self.router.add("GET", "/admin/dispatches",
+                        make_endpoint(dispatches_admin_handler, self.container))
         self.router.add("GET", "/admin/adapters",
                         make_endpoint(adapters_list_handler, self.container))
         self.router.add("POST", "/admin/adapters",

@@ -83,16 +83,26 @@ def catch_all_handler(_: Context) -> None:
 def ready_handler(ctx: Context) -> Response:
     """Readiness probe, distinct from /.well-known/health (liveness): 503
     while the TPU stack is still booting (warmup compiles), with the current
-    boot stage in the body so a slow cold boot is observable; 200 once
-    requests would be served without blocking. Apps without a TPU datasource
-    are ready as soon as the server listens."""
+    boot stage in the body so a slow cold boot is observable; 503 with the
+    engine state while the stall watchdog holds the engine degraded/wedged
+    (a wedged device tunnel is a diagnosed condition, not a silent hang);
+    200 once requests would be served without blocking. Apps without a TPU
+    datasource are ready as soon as the server listens."""
     import json
 
     tpu = ctx.container.tpu
-    if tpu is None or tpu.ready():
+    if tpu is None:
         status, state = 200, {"state": "ready"}
-    else:
+    elif not tpu.ready():
         status, state = 503, dict(tpu.boot_status)
+    else:
+        engine = getattr(tpu, "engine", None)
+        if engine is not None and engine.state in ("degraded", "wedged"):
+            snap = engine.snapshot()
+            status = 503
+            state = {"state": snap["state"], "detail": snap["detail"]}
+        else:
+            status, state = 200, {"state": "ready"}
     return Response(
         status=status,
         headers={"Content-Type": "application/json"},
@@ -207,11 +217,65 @@ def slo_admin_handler(ctx: Context) -> Any:
     return ctx.container.telemetry.slo(window_s=window)
 
 
+def engine_admin_handler(ctx: Context) -> Any:
+    """GET /admin/engine: one-call engine introspection snapshot — state
+    machine + transition history, boot timeline (per-stage compile wall
+    times), watchdog state, dispatch counts, queue depth, decode-pool
+    slot occupancy, scheduler defer state, cache hit/miss counts, HBM
+    usage. Host-side reads only: it answers even while the engine is
+    wedged (that is when it matters most)."""
+    from gofr_tpu.errors import HTTPError
+
+    _check_admin(ctx)
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    return ctx.tpu.engine_snapshot()
+
+
+def dispatches_admin_handler(ctx: Context) -> Any:
+    """GET /admin/dispatches: recent device dispatches (DispatchRecords),
+    newest first — the layer below /admin/requests. ``?kind=`` filters
+    (prefill, prefill_chunk, decode_chunk, warmup_compile, device_probe);
+    ``?limit=`` bounds the page (default 100). An in-flight (or wedged)
+    dispatch appears with status "running"."""
+    from gofr_tpu.errors import HTTPError, InvalidParamError
+    from gofr_tpu.tpu.introspect import DISPATCH_KINDS
+
+    _check_admin(ctx)
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    try:
+        limit = int(ctx.param("limit") or "100")
+    except ValueError:
+        raise InvalidParamError('"limit" must be an integer') from None
+    if limit < 1:
+        raise InvalidParamError('"limit" must be >= 1')
+    kind = ctx.param("kind") or None
+    if kind is not None and kind not in DISPATCH_KINDS:
+        raise InvalidParamError(
+            f'"kind" must be one of {", ".join(DISPATCH_KINDS)}'
+        )
+    records = ctx.tpu.timeline.records(limit=limit, kind=kind)
+    return {"dispatches": records, "count": len(records)}
+
+
+def _profiler_gauge(ctx: Context) -> Any:
+    """The profiler-activity gauge (1 while a trace is capturing) — an
+    unnoticed left-running trace degrades serving latency and fills
+    disk, so it must be alertable."""
+    return ctx.container.metrics.gauge(
+        "gofr_tpu_profiler_active",
+        "1 while an XLA profiler trace is capturing (0 otherwise)",
+    )
+
+
 def profiler_status_handler(ctx: Context) -> Any:
     from gofr_tpu.profiling import profiler
 
     _check_admin(ctx)
-    return profiler().status()
+    status = profiler().status()
+    _profiler_gauge(ctx).set(1.0 if status["state"] == "tracing" else 0.0)
+    return status
 
 
 def profiler_start_handler(ctx: Context) -> Any:
@@ -229,9 +293,13 @@ def profiler_start_handler(ctx: Context) -> Any:
 
         raise InvalidParamError('body (expected {"dir": ...} or empty)')
     try:
-        return profiler().start(body.get("dir"))
+        # an active trace REJECTS with 409 (below) instead of silently
+        # restarting: restarting would discard the in-flight capture
+        out = profiler().start(body.get("dir"))
     except RuntimeError as exc:
         raise HTTPError(409, str(exc)) from exc
+    _profiler_gauge(ctx).set(1.0)
+    return out
 
 
 def profiler_stop_handler(ctx: Context) -> Any:
@@ -240,6 +308,8 @@ def profiler_stop_handler(ctx: Context) -> Any:
 
     _check_admin(ctx)
     try:
-        return profiler().stop()
+        out = profiler().stop()
     except RuntimeError as exc:
         raise HTTPError(409, str(exc)) from exc
+    _profiler_gauge(ctx).set(0.0)
+    return out

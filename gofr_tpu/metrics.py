@@ -27,6 +27,14 @@ DEFAULT_BUCKETS = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# XLA compiles run seconds-to-minutes (an 8B prefill bucket is ~10-60s);
+# the request-latency defaults top out at 10s and would flatten every
+# compile observation into +Inf. Used by gofr_tpu_compile_seconds.
+COMPILE_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0, 600.0,
+)
+
 
 def _fmt_value(v: float) -> str:
     if v == math.inf:

@@ -61,10 +61,15 @@ class FlightRecord:
         "trace_id", "model", "endpoint", "status", "error", "stream",
         "tokens_in", "tokens_out", "batch_size", "pool_cohort",
         "prefill_chunks", "prefill_bucket", "sched_defer_s",
-        "pool_reject_reason",
+        "pool_reject_reason", "dispatch_ids",
         "wall_start", "t_start", "t_enqueue", "t_dispatch",
         "t_first_token", "t_last_token", "t_done", "wall_done", "_lock",
     )
+
+    # device dispatches linked per record: enough to cover a prefill, its
+    # chunks, and the first pooled decode chunks without letting a
+    # 10k-token generation grow the record unboundedly
+    MAX_DISPATCH_IDS = 32
 
     def __init__(
         self,
@@ -88,6 +93,7 @@ class FlightRecord:
         self.prefill_bucket = 0  # widest compiled bucket the prefill rode
         self.sched_defer_s = 0.0  # total interference-scheduler defer
         self.pool_reject_reason = ""  # why the decode pool refused (solo'd)
+        self.dispatch_ids: list[int] = []  # device dispatches this rode
         self.wall_start = time.time()
         self.t_start = time.perf_counter()
         self.t_enqueue: Optional[float] = None
@@ -138,6 +144,16 @@ class FlightRecord:
         if seconds and seconds > 0:
             with self._lock:
                 self.sched_defer_s += seconds
+
+    def note_dispatch_id(self, dispatch_id: int) -> None:
+        """Link a device dispatch (tpu/introspect.py DispatchTimeline)
+        this request rode — `/admin/requests` entries then resolve
+        directly to the `/admin/dispatches` records that carried them.
+        Bounded at MAX_DISPATCH_IDS (the decode pool stamps every chunk a
+        pooled stream shares)."""
+        with self._lock:
+            if len(self.dispatch_ids) < self.MAX_DISPATCH_IDS:
+                self.dispatch_ids.append(dispatch_id)
 
     def note_pool_reject(self, reason: str) -> None:
         """The decode pool refused this request (it decoded solo); the
@@ -211,6 +227,7 @@ class FlightRecord:
             "prefill_bucket": self.prefill_bucket or None,
             "sched_defer_s": self.sched_defer_s or None,
             "pool_reject_reason": self.pool_reject_reason or None,
+            "dispatch_ids": list(self.dispatch_ids),
             "start_ts": self.wall_start,
             "enqueue_ts": _offset(self.t_enqueue),
             "dispatch_ts": _offset(self.t_dispatch),

@@ -27,6 +27,7 @@ Design:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import queue
 import threading
 import time
@@ -38,6 +39,7 @@ import numpy as np
 
 from gofr_tpu.errors import TooManyRequestsError
 from gofr_tpu.telemetry import current_record
+from gofr_tpu.tpu.introspect import activate_dispatch
 from gofr_tpu.tracing import current_span, get_tracer
 
 
@@ -85,6 +87,8 @@ class DynamicBatcher:
         bucket_fn: Optional[Callable[[Any], int]] = None,
         scheduler: Any = None,
         cohort: bool = True,
+        timeline: Any = None,
+        watchdog: Any = None,
     ):
         self.run_batch = run_batch
         self.max_batch = max_batch
@@ -94,6 +98,11 @@ class DynamicBatcher:
         self.bucket_fn = bucket_fn
         self.scheduler = scheduler
         self.cohort = cohort
+        # engine introspection (tpu/introspect.py): every dispatch gets a
+        # DispatchRecord on the timeline and runs under the stall
+        # watchdog's deadline; both optional (bare test batchers)
+        self.timeline = timeline
+        self.watchdog = watchdog
         # pipeline_depth > 1 overlaps device execute of batch N+1 with the
         # host-transfer/completion of batch N — essential when the device
         # link has high round-trip latency (tunneled PJRT: ~65ms/sync)
@@ -250,29 +259,7 @@ class DynamicBatcher:
             self._queue_gauge.set(self._depth(), model=self.name)
             for item in batch:
                 self._wait_hist.observe(now - item.arrival, model=self.name)
-        # padded-FLOP accounting: the dispatch bucket is the widest
-        # member's (run_batch pads every row to it); bucket minus true
-        # length is what the compiled shape burns on pad tokens
-        bucket = 0
-        if self.bucket_fn is not None:
-            try:
-                bucket = max(self.bucket_fn(item.payload) for item in batch)
-            except Exception:
-                bucket = 0
-        if bucket and self._padded_counter is not None:
-            padded = sum(
-                max(bucket - min(int(getattr(i.payload, "size", 0) or 0), bucket), 0)
-                for i in batch
-            )
-            if padded:
-                self._padded_counter.inc(padded, model=self.name)
-        # dispatch marks BEFORE the scheduler gate: queue_wait measures
-        # enqueue -> batch formed (same instant the Prometheus wait
-        # histogram observed above); the interleave defer is its own
-        # field (sched_defer_s), never double-counted inside queue_wait
-        for item in batch:
-            if item.record is not None:
-                item.record.mark_dispatch(len(batch))
+        bucket, drec = self._note_dispatch(batch)
         # interference scheduler: one batched prefill dispatch is one
         # bounded-compute chunk — wait for its decode-interleave turn.
         # Gated on bucket_fn: only runners with a prefill/bucket concept
@@ -295,10 +282,22 @@ class DynamicBatcher:
         # nests under it via current_span()
         parent = next((item.span for item in batch if item.span is not None), None)
         span = get_tracer().start_span("tpu-batch", parent=parent)
+        if drec is not None:
+            # running starts AFTER the scheduler gate (the interleave
+            # defer shows as the record's queue_wait tail) and activates
+            # on this thread so device code (run_batch) can stamp
+            # per-dispatch MFU/token values only it knows
+            drec.mark_running()
+            activate_dispatch(drec)
         try:
             try:
-                results = self.run_batch([item.payload for item in batch])
+                with self._watch("prefill", drec):
+                    results = self.run_batch(
+                        [item.payload for item in batch]
+                    )
+                self._finish_record(drec)  # before the error-sweep below
             except Exception as exc:
+                self._finish_record(drec, status="error")
                 span.set_tag("error", exc)
                 for item in batch:
                     if not item.future.cancelled():
@@ -306,12 +305,69 @@ class DynamicBatcher:
                 return
         finally:
             # ALWAYS deactivate (BaseException included): a leaked span
-            # in this reused pool thread would become every later
-            # dispatch's bogus parent via the contextvar
+            # or dispatch record in this reused pool thread would become
+            # every later dispatch's bogus parent via the contextvar.
+            # finish() is idempotent, so the error-status sweep only
+            # lands on records a BaseException escape left running.
+            if drec is not None:
+                activate_dispatch(None)
+                self._finish_record(drec, status="error")
             span.__exit__(None, None, None)
         for item, result in zip(batch, results):
             if not item.future.cancelled():
                 item.future.set_result(result)
+
+    def _note_dispatch(self, batch: list["_Item"]) -> tuple[int, Any]:
+        """Per-dispatch accounting BEFORE the scheduler gate: the padded
+        token count the compiled shape burns, the dispatch-timeline
+        record (queued at the OLDEST member's arrival), and the flight-
+        record marks — every member's FlightRecord learns the dispatch
+        id, so /admin/requests entries resolve to the /admin/dispatches
+        records that carried them. queue_wait measures enqueue -> batch
+        formed; the interleave defer is its own field (sched_defer_s),
+        never double-counted inside queue_wait."""
+        bucket = 0
+        padded = 0
+        if self.bucket_fn is not None:
+            try:
+                bucket = max(self.bucket_fn(item.payload) for item in batch)
+            except Exception:
+                bucket = 0
+        if bucket:
+            # bucket minus true length, summed: the FLOPs the compiled
+            # shape spends on pad tokens (run_batch pads every row to it)
+            padded = sum(
+                max(bucket - min(int(getattr(i.payload, "size", 0) or 0), bucket), 0)
+                for i in batch
+            )
+            if padded and self._padded_counter is not None:
+                self._padded_counter.inc(padded, model=self.name)
+        drec = None
+        if self.timeline is not None:
+            drec = self.timeline.begin(
+                "prefill", bucket=bucket, batch_size=len(batch),
+                padded_tokens=padded,
+                queued_at=min(item.arrival for item in batch),
+            )
+        for item in batch:
+            if item.record is not None:
+                item.record.mark_dispatch(len(batch))
+                if drec is not None:
+                    item.record.note_dispatch_id(drec.dispatch_id)
+        return bucket, drec
+
+    def _finish_record(self, drec: Any, status: str = "ok") -> None:
+        if self.timeline is not None and drec is not None:
+            self.timeline.finish(drec, status=status)
+
+    def _watch(self, kind: str, drec: Any) -> Any:
+        """The stall watchdog's deadline over one device call (a no-op
+        context manager when no watchdog is wired)."""
+        if self.watchdog is None:
+            return contextlib.nullcontext()
+        return self.watchdog.watch(
+            kind, drec.dispatch_id if drec is not None else 0
+        )
 
     def close(self) -> None:
         self._closed = True

@@ -37,6 +37,7 @@ Mechanics:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import queue
 import threading
@@ -84,13 +85,13 @@ class _Request:
 
     __slots__ = (
         "out_queue", "remaining", "cache_len", "stop", "stop_tokens",
-        "finished", "want_lp", "want_top", "want_kv",
+        "finished", "want_lp", "want_top", "want_kv", "record",
     )
 
     def __init__(self, out_queue: "queue.Queue", remaining: int, cache_len: int,
                  stop: Optional[threading.Event], stop_tokens: frozenset,
                  want_lp: bool = False, want_top: bool = False,
-                 want_kv: bool = False):
+                 want_kv: bool = False, record: Any = None):
         self.out_queue: Optional[queue.Queue] = out_queue
         self.remaining = remaining
         self.cache_len = cache_len
@@ -106,6 +107,9 @@ class _Request:
         # DONE): the device stores it in the prefix cache so a follow-up
         # turn reuses the WHOLE conversation's KV
         self.want_kv = want_kv
+        # the caller's FlightRecord (if any): every pooled chunk dispatch
+        # stamps its dispatch id onto it (bounded by the record itself)
+        self.record = record
 
 
 class _Slot:
@@ -133,6 +137,8 @@ class DecodePool:
         pipeline_depth: int = PIPELINE_DEPTH,
         penalties: str = "lazy",
         scheduler: Any = None,
+        timeline: Any = None,
+        watchdog: Any = None,
     ):
         from gofr_tpu.models.transformer import decode_chunk_pool
 
@@ -147,6 +153,16 @@ class DecodePool:
         # chunk dispatch (never throttled) so prefill chunks can
         # interleave between decode turns instead of stalling them
         self._sched = scheduler
+        # engine introspection (tpu/introspect.py): every chunk dispatch
+        # lands on the dispatch timeline and its host fetch runs under
+        # the stall watchdog's deadline
+        self._timeline = timeline
+        self._watchdog = watchdog
+        self._in_flight_chunks: deque = deque()  # replaced by the worker
+        # the record of a chunk BETWEEN begin() and its in_flight.append
+        # (the jitted dispatch can raise in that window) — swept by
+        # _abandon_in_flight like the appended ones
+        self._pending_chunk_drec: Any = None
         self.cfg = cfg
         self.params = params
         self.n_slots = n_slots
@@ -615,11 +631,12 @@ class DecodePool:
             if not self._free:
                 self._reject("no_free_slots", "no free decode slots")
             slot = self._free.pop()
+            record = current_record()
             slot.request = _Request(out, max_new, start_len, stop,
                                     frozenset(stop_tokens or ()),
                                     want_lp=want_logprobs,
                                     want_top=want_top_logprobs,
-                                    want_kv=want_kv)
+                                    want_kv=want_kv, record=record)
             if (
                 self._temps[slot.index] != sampler.temperature
                 or self._top_ks[slot.index] != sampler.top_k
@@ -656,7 +673,6 @@ class DecodePool:
                 self._last_tokens, jnp.asarray([[first_token]], jnp.int32), slot.index
             )
             self._active[slot.index] = slot
-            record = current_record()
             if record is not None:
                 # flight record: this request decodes pooled, alongside
                 # len(_active)-1 co-tenants
@@ -683,9 +699,26 @@ class DecodePool:
         try:
             self._loop()
         except BaseException as exc:  # device/compile errors must not hang waiters
+            self._abandon_in_flight()
             with self._work:
                 self._closed = True
                 self._fail_active(exc)
+
+    def _abandon_in_flight(self) -> None:
+        """The worker died: close every dispatch record it still had in
+        flight as errored — a phantom 'running' decode chunk with
+        ever-growing duration would misdirect the exact wedged-device
+        diagnosis the timeline exists to provide."""
+        if self._timeline is None:
+            return
+        if self._pending_chunk_drec is not None:
+            # the dispatch itself raised before its chunk ever reached
+            # in_flight — same thread, read after the worker frame unwound
+            self._timeline.finish(self._pending_chunk_drec, status="error")
+            self._pending_chunk_drec = None
+        for entry in list(self._in_flight_chunks):
+            if entry[6] is not None:
+                self._timeline.finish(entry[6], status="error")
 
     def _fail_active(self, exc: BaseException) -> None:
         for slot in self._active.values():
@@ -707,7 +740,10 @@ class DecodePool:
             self._sched.note_decode_idle()  # a dead pool must not gate prefill
 
     def _loop(self) -> None:
-        in_flight: deque = deque()  # (records, toks_dev, lps_dev, dispatch_start)
+        in_flight: deque = deque()  # (records, toks_dev, ..., dispatch_start, drec)
+        # worker-owned, but exposed so the _run failure path (same
+        # thread, after this frame unwound) can close abandoned records
+        self._in_flight_chunks = in_flight
         last_fetch_done: float = 0.0
         while True:
             with self._work:
@@ -715,7 +751,10 @@ class DecodePool:
                     self._work.wait()
                 if self._closed:
                     # closing mid-stream is an ERROR for waiters, never a
-                    # silently-truncated "ok" result
+                    # silently-truncated "ok" result; un-fetched chunks'
+                    # records close too (a clean shutdown/reinit must not
+                    # leave phantom "running" dispatches on the timeline)
+                    self._abandon_in_flight()
                     self._fail_active(RuntimeError("decode pool closed mid-generation"))
                     return
                 # dispatch until the pipeline is full: chunk N+1's inputs
@@ -730,6 +769,23 @@ class DecodePool:
                         self._top_ps_dev = jnp.asarray(self._top_ps)
                         self._min_ps_dev = jnp.asarray(self._min_ps)
                         self._sampling_dirty = False
+                    drec = None
+                    if self._timeline is not None:
+                        # dispatch timeline: one record per chunk; every
+                        # active request's FlightRecord learns the id
+                        # (its own cap bounds the growth)
+                        drec = self._timeline.begin(
+                            "decode_chunk", batch_size=len(records),
+                        )
+                        drec.mark_running()
+                        for _, req in records:
+                            if req is not None and req.record is not None:
+                                req.record.note_dispatch_id(
+                                    drec.dispatch_id
+                                )
+                        # a dispatch-side raise before the append below
+                        # must not leak this record as running forever
+                        self._pending_chunk_drec = drec
                     dispatch_start = _perf_counter()
                     # ONE dispatch: RNG advance and the feed-forward token
                     # slice happen inside the jitted chunk. The penalized
@@ -797,8 +853,9 @@ class DecodePool:
                         pass  # older jax / fully-addressable-only arrays
                     in_flight.append(
                         (records, toks_dev, lps_dev, tvals_dev, tids_dev,
-                         dispatch_start)
+                         dispatch_start, drec)
                     )
+                    self._pending_chunk_drec = None  # owned by in_flight now
                     if self._sched is not None:
                         # decode keeps its cadence; prefill chunks take
                         # the gaps between these notes
@@ -807,29 +864,52 @@ class DecodePool:
             # meanwhile executing the younger in-flight chunk(s), and new
             # submissions can take the lock to join the next dispatch
             (records, toks_dev, lps_dev, tvals_dev, tids_dev,
-             dispatch_start) = in_flight.popleft()
+             dispatch_start, drec) = in_flight.popleft()
             fetch_start = _perf_counter()
-            toks = np.asarray(toks_dev)
-            lps = np.asarray(lps_dev)
-            tvals = np.asarray(tvals_dev) if tvals_dev is not None else None
-            tids = np.asarray(tids_dev) if tids_dev is not None else None
-            fetch_done = _perf_counter()
-            # throughput denominator: the interval between consecutive
-            # deliveries at steady state (dispatch->fetch spans ~2 chunk
-            # computes when the pipeline is full and would halve the MFU
-            # gauge); after an idle gap, fall back to this chunk's own
-            # span. Floor at span/depth: a host stall can make both
-            # in-flight chunks finish before the next fetch, shrinking the
-            # inter-delivery gap to ~0 and spiking the gauge past reality.
-            span = fetch_done - dispatch_start
-            dispatch_elapsed = max(
-                fetch_done - max(dispatch_start, last_fetch_done),
-                span / self.pipeline_depth,
+            # the blocking host fetch is WHERE a wedged device manifests:
+            # it runs under the stall watchdog's deadline so a hang flips
+            # the engine state instead of silently parking this worker
+            watch = (
+                self._watchdog.watch(
+                    "decode_chunk", drec.dispatch_id if drec else 0
+                )
+                if self._watchdog is not None else contextlib.nullcontext()
             )
-            last_fetch_done = fetch_done
-            with self._work:
-                self._deliver(records, toks, lps, tvals, tids,
-                              dispatch_elapsed)
+            try:
+                with watch:
+                    toks = np.asarray(toks_dev)
+                    lps = np.asarray(lps_dev)
+                    tvals = (
+                        np.asarray(tvals_dev) if tvals_dev is not None else None
+                    )
+                    tids = (
+                        np.asarray(tids_dev) if tids_dev is not None else None
+                    )
+                fetch_done = _perf_counter()
+                # throughput denominator: the interval between consecutive
+                # deliveries at steady state (dispatch->fetch spans ~2 chunk
+                # computes when the pipeline is full and would halve the MFU
+                # gauge); after an idle gap, fall back to this chunk's own
+                # span. Floor at span/depth: a host stall can make both
+                # in-flight chunks finish before the next fetch, shrinking the
+                # inter-delivery gap to ~0 and spiking the gauge past reality.
+                span = fetch_done - dispatch_start
+                dispatch_elapsed = max(
+                    fetch_done - max(dispatch_start, last_fetch_done),
+                    span / self.pipeline_depth,
+                )
+                last_fetch_done = fetch_done
+                with self._work:
+                    self._deliver(records, toks, lps, tvals, tids,
+                                  dispatch_elapsed, drec)
+            except BaseException:
+                # the chunk was already popped from in_flight: close its
+                # record here (the worker's failure path sweeps the rest)
+                if self._timeline is not None and drec is not None:
+                    self._timeline.finish(drec, status="error")
+                raise
+            if self._timeline is not None and drec is not None:
+                self._timeline.finish(drec)
             if _POOL_DEBUG:
                 import sys
 
@@ -842,7 +922,8 @@ class DecodePool:
                 )
 
     def _deliver(self, records: list, toks: np.ndarray, lps: np.ndarray,
-                 tvals: Any, tids: Any, elapsed: float) -> None:
+                 tvals: Any, tids: Any, elapsed: float,
+                 drec: Any = None) -> None:
         delivered = 0
         for index, req in records:
             if req is None or req.finished:
@@ -873,6 +954,8 @@ class DecodePool:
             self._sched.note_decode_idle()  # release any waiting prefill
         if self._depth_gauge:
             self._depth_gauge.set(len(self._active))
+        if drec is not None:
+            drec.tokens = delivered
         if self._mfu_gauge is not None and delivered:
             from gofr_tpu.tpu.flops import mfu
 
@@ -881,10 +964,10 @@ class DecodePool:
             # compute but not useful throughput). With a full pipeline the
             # per-chunk elapsed overlaps the next chunk's compute, so this
             # gauge reflects steady-state throughput, not isolated latency.
-            self._mfu_gauge.set(
-                mfu(self._n_params, delivered, elapsed, self._peak),
-                model=self._model, op="decode",
-            )
+            value = mfu(self._n_params, delivered, elapsed, self._peak)
+            self._mfu_gauge.set(value, model=self._model, op="decode")
+            if drec is not None:
+                drec.mfu = value
             self._tokens_counter.inc(delivered, model=self._model, op="decode")
         if self._mbu_gauge is not None:
             from gofr_tpu.tpu.flops import mbu
@@ -892,10 +975,12 @@ class DecodePool:
             # bandwidth view of the same interval: a full chunk of steps
             # streamed weights+KV once per step, whatever fraction of the
             # emitted tokens was useful
-            self._mbu_gauge.set(
-                mbu(self._bytes_per_step * self.chunk, elapsed, self._peak_bw),
-                model=self._model, op="decode",
+            value = mbu(
+                self._bytes_per_step * self.chunk, elapsed, self._peak_bw
             )
+            self._mbu_gauge.set(value, model=self._model, op="decode")
+            if drec is not None:
+                drec.mbu = value
 
 
     def _build_burst(
@@ -999,6 +1084,20 @@ class DecodePool:
                 self._fps[index] = 0.0
                 self._pen_dirty = True
                 self._bias = self._zero_bias(self._bias, index)
+
+    def occupancy(self) -> dict:
+        """Point-in-time slot occupancy for ``GET /admin/engine``."""
+        with self._work:
+            return {
+                "slots": self.n_slots,
+                "active": len(self._active),
+                "free": len(self._free),
+                "chunk": self.chunk,
+                "pipeline_depth": self.pipeline_depth,
+                "lora_slots": len(self._lora_slots),
+                "penalized_slots": len(self._pen_slots),
+                "closed": self._closed,
+            }
 
     def close(self) -> None:
         with self._work:

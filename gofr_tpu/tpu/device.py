@@ -77,6 +77,7 @@ device liveness + memory stats, typed TPULog entries, Prometheus metrics
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from dataclasses import dataclass
@@ -94,7 +95,29 @@ from gofr_tpu.tpu.batcher import (
     pack_token_rows,
     pad_rows,
 )
+from gofr_tpu.tpu.introspect import (
+    DispatchTimeline,
+    EngineState,
+    StallWatchdog,
+    current_dispatch,
+)
 from gofr_tpu.tracing import current_span, get_tracer
+
+# stall deadline the watchdog arms itself with when the operator set no
+# explicit WATCHDOG_DISPATCH_TIMEOUT_S and the probe found a real TPU.
+# Serving dispatches complete in <1s on a healthy chip, but a dispatch
+# may legitimately carry a LAZY compile (an opt-in executable variant or
+# remainder chunk length compiling on first use — the executable-cache
+# "miss" path), and 8B-class compiles run 10-60s: the auto deadline sits
+# ABOVE that range so a compile is never misdiagnosed as a stall, while
+# still catching the observed failure mode (jax calls hanging minutes,
+# BENCH_r01-r05). Operators who pre-warm everything can tighten it via
+# WATCHDOG_DISPATCH_TIMEOUT_S.
+WATCHDOG_AUTO_TIMEOUT_S = 120.0
+
+# nullcontext is stateless/reentrant: one shared instance serves every
+# unwatched dispatch without a per-call allocation
+_NULLCTX = contextlib.nullcontext()
 
 
 @dataclass
@@ -200,6 +223,26 @@ class TPUDevice:
         self._init_metrics(metrics)
 
         self._parse_serving_config(config)
+        # engine introspection (tpu/introspect.py): the explicit state
+        # machine, the dispatch timeline behind /admin/dispatches, and
+        # the stall watchdog — constructed BEFORE any boot work so the
+        # probe itself is already observable
+        self.engine = EngineState(metrics=metrics, logger=logger)
+        self.timeline = DispatchTimeline(
+            capacity=int(
+                config.get_or_default("DISPATCH_TIMELINE_SIZE", "512")
+            ),
+            metrics=metrics,
+        )
+        self.watchdog = StallWatchdog(
+            self.engine, metrics=metrics, logger=logger,
+            timeout_s=self._watchdog_timeout,
+        )
+        # per-stage boot wall times ({stage, kind, bucket, seconds}) —
+        # the boot timeline /admin/engine serves; compile stages also
+        # feed gofr_tpu_compile_seconds{kind,bucket}
+        self.boot_timeline: list[dict[str, Any]] = []
+        self._open_stage: Optional[tuple] = None
         self._last_reinit = 0.0
         self._reinit_lock = threading.Lock()
         # serializes adapter admin (load/unload + pool-bank rebuild):
@@ -293,6 +336,28 @@ class TPUDevice:
             "gofr_tpu_prefix_entries",
             "prefix cache: live entries (each one max_seq KV row of HBM)",
             labels=("model",),
+        )
+        from gofr_tpu.metrics import COMPILE_BUCKETS
+
+        # compile/cache observability (engine introspection layer): every
+        # warmup compile stage lands here with its bucket, so a slow cold
+        # boot decomposes into per-executable compile cost
+        self._compile_hist = metrics.histogram(
+            "gofr_tpu_compile_seconds",
+            "XLA compile stage duration by kind and sequence bucket",
+            labels=("kind", "bucket"), buckets=COMPILE_BUCKETS,
+        )
+        self._compiles = metrics.counter(
+            "gofr_tpu_compiles_total",
+            "XLA compile stages run (warmup and lazy)",
+            labels=("kind",),
+        )
+        self._cache_events = metrics.counter(
+            "gofr_tpu_cache_events_total",
+            "framework cache lookups by result: cache=prefix (prompt KV "
+            "reuse) or executable (compiled-shape reuse on the decode/"
+            "prefill paths), event=hit|partial_hit|miss",
+            labels=("cache", "event"),
         )
 
 
@@ -424,6 +489,23 @@ class TPUDevice:
             raise ValueError(
                 "DECODE_POOL_PENALTIES must be lazy, eager, or off"
             )
+        # stall watchdog deadline: unset -> auto (arms itself at
+        # WATCHDOG_AUTO_TIMEOUT_S once the probe sees a TPU platform);
+        # "off"/"0" -> disabled; a positive float -> armed from
+        # construction (the probe itself then runs under the deadline)
+        raw_wd = (
+            config.get_or_default("WATCHDOG_DISPATCH_TIMEOUT_S", "") or ""
+        ).strip().lower()
+        self._watchdog_auto = raw_wd == ""
+        if raw_wd in ("", "off"):
+            self._watchdog_timeout = 0.0
+        else:
+            self._watchdog_timeout = float(raw_wd)
+            if self._watchdog_timeout < 0:
+                raise ValueError(
+                    "WATCHDOG_DISPATCH_TIMEOUT_S must be >= 0 (0/off = "
+                    "disabled, unset = auto-arm on TPU platforms)"
+                )
 
     def _probe_devices(self) -> None:
         """First touch of the device runtime (can block/fail on a wedged
@@ -439,8 +521,23 @@ class TPUDevice:
                     "multi-host runtime joined: %s", multihost.process_info()
                 )
         self._boot_progress("probing device runtime")
-        self.devices = jax.devices()
+        # the probe is the call every wedged-tunnel bench round died
+        # inside: with an EXPLICIT watchdog deadline it runs watched (the
+        # auto-armed watchdog starts only after the platform is known)
+        probe_rec = self.timeline.begin("device_probe", detail="jax.devices()")
+        try:
+            with self.watchdog.watch("device_probe", probe_rec.dispatch_id):
+                self.devices = jax.devices()
+        except BaseException:
+            self.timeline.finish(probe_rec, status="error")
+            raise
+        self.timeline.finish(probe_rec)
         self.platform = self.devices[0].platform
+        if self._watchdog_auto and self.platform == "tpu":
+            # a real device behind a (possibly tunneled) runtime: arm the
+            # stall deadline so a mid-serving wedge becomes a diagnosed
+            # state instead of a silent hang
+            self.watchdog.arm(WATCHDOG_AUTO_TIMEOUT_S)
         self.device_kind = getattr(self.devices[0], "device_kind", self.platform)
         self.mesh = _mesh_from_topology(self._mesh_request, self.devices)
         from gofr_tpu.tpu.flops import device_peak_flops, device_peak_hbm_bw
@@ -455,28 +552,34 @@ class TPUDevice:
         self.peak_hbm_bw = device_peak_hbm_bw(str(self.device_kind), self.platform) * n_chips
 
     def _boot(self) -> None:
+        del self.boot_timeline[:]
         try:
             self._probe_devices()
             self._build_stack()
         except BaseException as exc:
+            self._close_boot_stage(status="error")
             self._boot_error = exc
             self._boot_error_permanent = isinstance(exc, ValueError)
             self.boot_status = {"state": "failed", "detail": repr(exc)}
+            self.engine.transition("failed", repr(exc))
             self._ready.set()
             if threading.current_thread().name == "gofr-tpu-boot":
                 self.logger.errorf("TPU boot failed: %r", exc)
                 return
             raise
+        self._close_boot_stage()
         if self._closed:
             # the device was closed while the background boot compiled —
             # tear down the freshly built stack instead of leaking its
             # worker threads and device buffers
             self._boot_error = RuntimeError("device closed during boot")
             self.boot_status = {"state": "closed", "detail": ""}
+            self.engine.transition("closed")
             self._teardown_stack()
             self._ready.set()
             return
         self.boot_status = {"state": "ready", "detail": ""}
+        self.engine.transition("serving")
         self._ready.set()
         if threading.current_thread().name == "gofr-tpu-boot":
             # the accurate device-topology line operators grep for — the
@@ -535,6 +638,9 @@ class TPUDevice:
             lora_adapters=self._lora_adapters,
             echo_step_ms=self._echo_step_ms,
             prefill_chunk_tokens=self._prefill_chunk_cfg,
+            timeline=self.timeline,
+            watchdog=self.watchdog,
+            cache_events=self._note_cache_event,
         )
         if (
             self._prefill_chunk_cfg
@@ -567,7 +673,8 @@ class TPUDevice:
             from gofr_tpu.tpu.decode_pool import DecodePool
 
             self._boot_progress(
-                f"warming decode pool ({self._pool_slots} slots)"
+                f"warming decode pool ({self._pool_slots} slots)",
+                kind="decode_pool",
             )
             self.decode_pool = DecodePool(
                 self.runner.params,
@@ -584,9 +691,13 @@ class TPUDevice:
                 pipeline_depth=self._pool_depth,
                 penalties=self._pool_penalties,
                 scheduler=self.scheduler,
+                timeline=self.timeline,
+                watchdog=self.watchdog,
             )
             if getattr(self.runner, "adapters", None):
-                self._boot_progress("warming pooled multi-LoRA bank")
+                self._boot_progress(
+                    "warming pooled multi-LoRA bank", kind="lora_bank"
+                )
                 self._refresh_pool_lora()
         self.batcher = DynamicBatcher(
             self._run_batch,
@@ -597,14 +708,50 @@ class TPUDevice:
             bucket_fn=getattr(self.runner, "bucket_for_payload", None),
             scheduler=self.scheduler,
             cohort=self._batch_cohort,
+            timeline=self.timeline,
+            watchdog=self.watchdog,
         )
 
-    def _boot_progress(self, detail: str) -> None:
+    def _boot_progress(
+        self, detail: str, kind: str = "", bucket: int = 0
+    ) -> None:
         """Per-stage boot progress: logged AND surfaced on the readiness
-        endpoint, so an 8B cold boot shows which compile it is on."""
+        endpoint, so an 8B cold boot shows which compile it is on.
+
+        Each call also CLOSES the previous stage's wall-time measurement
+        into the boot timeline (/admin/engine); stages that name a
+        ``kind`` are compile stages — they additionally land on the
+        dispatch timeline (kind warmup_compile) and feed the
+        ``gofr_tpu_compile_seconds{kind,bucket}`` histogram."""
+        self._close_boot_stage()
         if self.boot_status["state"] != "ready":
             self.boot_status = {"state": "warming", "detail": detail}
+            self.engine.transition("warming", detail)
+        rec = (
+            self.timeline.begin("warmup_compile", bucket=bucket, detail=detail)
+            if kind else None
+        )
+        self._open_stage = (detail, kind, bucket, time.perf_counter(), rec)
         self.logger.infof("TPU boot [%s]: %s", self.model_name, detail)
+
+    def _close_boot_stage(self, status: str = "ok") -> None:
+        if self._open_stage is None:
+            return
+        detail, kind, bucket, start, rec = self._open_stage
+        self._open_stage = None
+        seconds = time.perf_counter() - start
+        self.boot_timeline.append({
+            "stage": detail, "kind": kind or None,
+            "bucket": bucket or None, "seconds": round(seconds, 3),
+            "status": status,
+        })
+        if kind and status == "ok":
+            # a stage the boot DIED in must not pollute the compile
+            # histogram with its truncated wall time
+            self._compile_hist.observe(seconds, kind=kind, bucket=str(bucket))
+            self._compiles.inc(kind=kind)
+        if rec is not None:
+            self.timeline.finish(rec, status=status)
 
     # -- handler-facing API --------------------------------------------------
     def infer(self, payload: Any, timeout: float = 60.0) -> Any:
@@ -875,12 +1022,15 @@ class TPUDevice:
         self.logger.debug(
             TPULog(self.model_name, "batch", len(payloads), int(elapsed * 1e6))
         )
+        # real (un-padded) prompt tokens; payloads are prepared id rows
+        tokens = sum(int(getattr(p, "size", 0)) for p in payloads)
+        drec = current_dispatch()  # the batcher activated this dispatch
+        if drec is not None:
+            drec.tokens = tokens
         n_params = getattr(self.runner, "n_params", None)
         if n_params:
             from gofr_tpu.tpu.flops import mfu
 
-            # real (un-padded) prompt tokens; payloads are prepared id rows
-            tokens = sum(int(getattr(p, "size", 0)) for p in payloads)
             if tokens:
                 # steady-state denominator, same shape as the decode
                 # pool's: the batcher pipelines dispatches, so under load
@@ -909,12 +1059,75 @@ class TPUDevice:
                     mfu(n_params, tokens, steady, self.peak_flops),
                     model=self.model_name, op="prefill",
                 )
+                if drec is not None:
+                    # per-dispatch utilization: THIS dispatch's elapsed
+                    # (the steady-state window smooths the gauge; the
+                    # record describes one dispatch)
+                    drec.mfu = mfu(n_params, tokens, elapsed, self.peak_flops)
         return results
+
+    def _note_cache_event(self, cache: str, event: str) -> None:
+        """Runner callback: one prefix/executable cache lookup resolved
+        as ``event`` (hit | partial_hit | miss)."""
+        self._cache_events.inc(cache=cache, event=event)
 
     def _observe(self, op: str, status: str, start: float) -> None:
         self._requests.inc(model=self.model_name, op=op, status=status)
         if status == "ok":
             self._ttft.observe(time.perf_counter() - start, model=self.model_name, op=op)
+
+    def engine_snapshot(self) -> dict[str, Any]:
+        """One-call engine introspection snapshot (``GET /admin/engine``):
+        state machine + history, boot timeline (per-stage/per-compile
+        wall times), watchdog state, dispatch counts, queue depth,
+        decode-pool slot occupancy, scheduler defer state, cache
+        hit/miss counts, and HBM usage. Never blocks on device work —
+        every field reads host-side state, so the endpoint answers even
+        while the engine is wedged."""
+        snap: dict[str, Any] = {
+            "engine": self.engine.snapshot(),
+            "model": self.model_name,
+            "platform": self.platform,
+            "device_kind": str(self.device_kind),
+            "boot": dict(self.boot_status),
+            "boot_timeline": [dict(stage) for stage in self.boot_timeline],
+            "watchdog": self.watchdog.snapshot(),
+            "dispatches": self.timeline.stats(),
+        }
+        batcher = getattr(self, "batcher", None)
+        snap["queue_depth"] = batcher._depth() if batcher is not None else None
+        pool = getattr(self, "decode_pool", None)
+        snap["decode_pool"] = pool.occupancy() if pool is not None else None
+        sched = getattr(self, "scheduler", None)
+        snap["scheduler"] = sched.snapshot() if sched is not None else None
+        caches: dict[str, Any] = {}
+        pstats = getattr(getattr(self, "runner", None), "prefix_stats", None)
+        if pstats:
+            caches["prefix"] = dict(pstats)
+        caches["executable"] = {
+            "hits": self._cache_events.value(cache="executable", event="hit"),
+            "misses": self._cache_events.value(
+                cache="executable", event="miss"
+            ),
+        }
+        snap["caches"] = caches
+        snap["compiles"] = {
+            kind: self._compiles.value(kind=kind)
+            for kind in sorted(
+                {s["kind"] for s in snap["boot_timeline"] if s["kind"]}
+            )
+        }
+        hbm = None
+        try:
+            stats = self.devices[0].memory_stats() or {}
+            hbm = {
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            }
+        except Exception:
+            pass  # memory_stats unsupported (CPU PJRT, echo runs)
+        snap["hbm"] = hbm
+        return snap
 
     def describe(self) -> str:
         return (
@@ -946,16 +1159,23 @@ class TPUDevice:
         # gone must also hold off the next attempt (no rebuild storms)
         self._last_reinit = time.monotonic()
         self._teardown_stack()  # the old stack may be wedged; rebuild regardless
+        del self.boot_timeline[:]  # the rebuild writes a fresh timeline
         # re-probe ALWAYS: a boot that failed during the probe stage left
         # devices/mesh/peak unset, and a device-loss reinit wants fresh
         # runtime state anyway (jax caches make this cheap when healthy)
-        self._probe_devices()
-        self._build_stack()
+        try:
+            self._probe_devices()
+            self._build_stack()
+        except BaseException:
+            self._close_boot_stage(status="error")
+            raise
+        self._close_boot_stage()
         # a successful rebuild recovers a failed background boot too:
         # requests unblock and /.well-known/ready flips to 200
         self._boot_error = None
         self._boot_error_permanent = False
         self.boot_status = {"state": "ready", "detail": ""}
+        self.engine.transition("serving", "reinitialized")
         self._ready.set()
 
     def _maybe_auto_reinit(self) -> bool:
@@ -1175,6 +1395,8 @@ class TPUDevice:
 
     def close(self) -> None:
         self._closed = True  # an in-flight background boot self-tears-down
+        self.watchdog.close()
+        self.engine.transition("closed")
         self._teardown_stack()
 
 
@@ -1253,6 +1475,11 @@ class _EchoRunner:
     def __init__(self, max_batch: int = 8, step_ms: float = 0.0):
         self.max_batch = max_batch
         self.step_s = step_ms / 1000.0
+        # injectable stall hook (tests): called at the top of every
+        # run_batch, so a test can wedge a "device" dispatch on the
+        # compile-free path and drive the watchdog/engine state machine
+        # end to end (tests/test_engine_obs.py)
+        self.stall_hook: Optional[Any] = None
 
     def bucket_for_payload(self, ids: np.ndarray) -> int:
         n = int(getattr(ids, "size", 0) or 0)
@@ -1272,6 +1499,8 @@ class _EchoRunner:
         return ids
 
     def run_batch(self, payloads: list[np.ndarray]) -> list[dict]:
+        if self.stall_hook is not None:
+            self.stall_hook()
         if self.step_s:
             time.sleep(self.step_s)
         return [
@@ -1369,7 +1598,7 @@ class _MLPRunner:
         b = 1
         while b <= next_pow2(self.max_batch):
             if progress:
-                progress(f"compiling mlp forward (batch {b})")
+                progress(f"compiling mlp forward (batch {b})", kind="forward")
             self._fwd(self.params, jnp.zeros((b, self.cfg.in_dim))).block_until_ready()
             b *= 2
 
@@ -1426,7 +1655,7 @@ class _BertRunner:
         b = 1
         while b <= next_pow2(self.max_batch):
             if progress:
-                progress(f"compiling bert embed (batch {b})")
+                progress(f"compiling bert embed (batch {b})", kind="embed")
             t = jnp.zeros((b, self.bucket), jnp.int32)
             m = jnp.ones((b, self.bucket), jnp.int32)
             self._embed(self.params, t, m).block_until_ready()
@@ -1470,8 +1699,22 @@ class _TransformerRunner:
         prefix_lcp_min: int = 0,
         lora_adapters: Optional[dict] = None,
         prefill_chunk_tokens: int = 0,
+        timeline: Any = None,
+        watchdog: Any = None,
+        cache_events: Any = None,
     ):
         self.max_batch = max_batch
+        # engine introspection: the dispatch timeline + stall watchdog
+        # (chunked-prefill slices report through them) and the device's
+        # cache-event counter callback; all optional (bare test runners)
+        self.timeline = timeline
+        self.watchdog = watchdog
+        self._cache_events = cache_events or (lambda cache, event: None)
+        # compiled-shape cache accounting: keys this runner has already
+        # paid a compile for (seeded by warmup); a serving-path first-use
+        # is a miss — the compile the operator sees as a latency spike
+        self._exec_seen: set = set()
+        self._exec_lock = threading.Lock()
         from gofr_tpu.models.llama import CONFIGS
         from gofr_tpu.models.transformer import (
             decode_step,
@@ -1729,6 +1972,22 @@ class _TransformerRunner:
         cohort key and padded-token accounting basis."""
         return self._bucket_for(max(int(getattr(ids, "size", 0) or 0), 1))
 
+    def _note_exec(self, key: tuple) -> None:
+        """Executable-shape cache accounting: first use of a (shape)
+        key is a MISS (jit compiles), later uses are hits. Warmup seeds
+        the set without counting — serving-path numbers stay clean."""
+        with self._exec_lock:
+            if key in self._exec_seen:
+                hit = True
+            else:
+                self._exec_seen.add(key)
+                hit = False
+        self._cache_events("executable", "hit" if hit else "miss")
+
+    def _seed_exec(self, key: tuple) -> None:
+        with self._exec_lock:
+            self._exec_seen.add(key)
+
     def score(self, tokens: Any, adapter: Optional[str] = None) -> list[float]:
         """log p(t_i | t_<i) for every prompt position i >= 1 — the
         teacher-forcing loglikelihood primitive (completions
@@ -1809,6 +2068,7 @@ class _TransformerRunner:
         # (consistent with prepare(): recency wins for next-token prediction)
         bucket = self._bucket_for(max(int(p.size) for p in payloads))
         bsz = next_pow2(max(len(payloads), self.max_batch))
+        self._note_exec(("prefill", bucket, bsz))
         tokens, lengths = pack_token_rows(payloads, bsz, bucket)
         full_lengths = np.maximum(lengths, 1)  # padded rows need length>=1
         cache = self._zero_cache(bsz)
@@ -2097,6 +2357,11 @@ class _TransformerRunner:
                 n = min(self.decode_chunk_size, max_len - cache_len - steps_in_flight)
                 key = self._greedy_key if sampler.greedy else sampler.take_key()
                 fn = self._chunk_fns[(presence is not None, logprobs)]
+                # jit caches per (variant, scan length): a first use of
+                # an opt-in variant or remainder length compiles here
+                self._note_exec(
+                    ("decode_chunk", presence is not None, logprobs, n)
+                )
                 if presence is None:
                     result = fn(prm, token_dev, cache, key, temp,
                                 tk, tp, mp, n)
@@ -2194,19 +2459,58 @@ class _TransformerRunner:
             # batched path)
             record.mark_enqueue()
             record.mark_dispatch(1)
-        for tokens, lengths, size in _prompt_chunks(ids, bucket):
-            if scheduler is not None:
-                wait = scheduler.admit_prefill(bucket)
-                if record is not None and wait:
-                    record.note_sched_defer(wait)
-            logits, next_ids, cache = self._prefill(prm, tokens, cache, lengths)
-            if record is not None:
-                record.note_prefill_chunk(bucket=bucket)
-            total += size
+        drec = None
+        try:
+            for tokens, lengths, size in _prompt_chunks(ids, bucket):
+                if scheduler is not None:
+                    wait = scheduler.admit_prefill(bucket)
+                    if record is not None and wait:
+                        record.note_sched_defer(wait)
+                if self.timeline is not None:
+                    # dispatch timeline: one record per slice. Marks are
+                    # host/dispatch-side (jax dispatch is async): each
+                    # slice closes when the next dispatches; the LAST
+                    # stays "running" through the blocking fetch below,
+                    # so a wedge shows as that slice stuck on
+                    # /admin/dispatches.
+                    if drec is not None:
+                        self.timeline.finish(drec)
+                    drec = self.timeline.begin(
+                        "prefill_chunk", bucket=bucket, batch_size=1,
+                        tokens=size,
+                    )
+                    if record is not None:
+                        record.note_dispatch_id(drec.dispatch_id)
+                logits, next_ids, cache = self._prefill(
+                    prm, tokens, cache, lengths
+                )
+                if record is not None:
+                    record.note_prefill_chunk(bucket=bucket)
+                total += size
+            # ONE blocking fetch synchronizes every dispatched slice —
+            # the point a wedged device manifests, so it runs under the
+            # watchdog
+            watch = (
+                self.watchdog.watch(
+                    "prefill_chunk",
+                    drec.dispatch_id if drec is not None else 0,
+                )
+                if self.watchdog is not None else _NULLCTX
+            )
+            with watch:
+                next_token = int(np.asarray(next_ids)[0])
+        except BaseException:
+            # a raising slice dispatch (or fetch) must not leak the open
+            # record as a phantom "running" dispatch
+            if self.timeline is not None and drec is not None:
+                self.timeline.finish(drec, status="error")
+            raise
+        if self.timeline is not None and drec is not None:
+            self.timeline.finish(drec)
         return {
             "cache": cache,
             "length": total,
-            "next_token": int(np.asarray(next_ids)[0]),
+            "next_token": next_token,
             "logits": logits[0],
         }
 
@@ -2339,8 +2643,10 @@ class _TransformerRunner:
                 )
                 if row is None:
                     self.prefix_stats["misses"] += 1
+                    self._cache_events("prefix", "miss")
                     return None
                 self.prefix_stats["partial_hits"] += 1
+        self._cache_events("prefix", "hit" if entry is not None else "partial_hit")
         if entry is not None:  # device work outside the lock
             row, length, next_token, logits = entry
             return {
@@ -2391,15 +2697,45 @@ class _TransformerRunner:
         bucket = self._bucket_for(int(tail.size))
         logits = next_ids = None
         total = shared
-        for tokens, lengths, size in _prompt_chunks(tail, bucket):
-            logits, next_ids, cache = self._prefill(
-                self.params, tokens, cache, lengths
+        # same observability contract as _chunked_prefill: the tail
+        # prefill is a device dispatch too — one timeline record for the
+        # tail, the blocking fetch under the watchdog, so a wedge on the
+        # prefix-cache partial-hit path is diagnosed, not silent
+        drec = None
+        if self.timeline is not None:
+            drec = self.timeline.begin(
+                "prefill_chunk", bucket=bucket, batch_size=1,
+                tokens=int(tail.size),
+                detail=f"tail prefill after {shared} shared",
             )
-            total += size
+            rec = telemetry_record()
+            if rec is not None:
+                rec.note_dispatch_id(drec.dispatch_id)
+        try:
+            for tokens, lengths, size in _prompt_chunks(tail, bucket):
+                logits, next_ids, cache = self._prefill(
+                    self.params, tokens, cache, lengths
+                )
+                total += size
+            watch = (
+                self.watchdog.watch(
+                    "prefill_chunk",
+                    drec.dispatch_id if drec is not None else 0,
+                )
+                if self.watchdog is not None else _NULLCTX
+            )
+            with watch:
+                next_token = int(np.asarray(next_ids)[0])
+        except BaseException:
+            if self.timeline is not None and drec is not None:
+                self.timeline.finish(drec, status="error")
+            raise
+        if self.timeline is not None and drec is not None:
+            self.timeline.finish(drec)
         state = {
             "cache": cache,
             "length": total,
-            "next_token": int(np.asarray(next_ids)[0]),
+            "next_token": next_token,
             "logits": logits[0],
         }
         self._prefix_store(ids, state)
@@ -2699,8 +3035,10 @@ class _TransformerRunner:
             if progress:
                 progress(
                     f"compiling prefill bucket {bucket} (batch {b}, "
-                    f"{i + 1}/{len(self.buckets)})"
+                    f"{i + 1}/{len(self.buckets)})",
+                    kind="prefill", bucket=bucket,
                 )
+            self._seed_exec(("prefill", bucket, b))
             cache = self._zero_cache(b)
             tokens = jnp.zeros((b, bucket), jnp.int32)
             lengths = jnp.ones((b,), jnp.int32)
@@ -2716,7 +3054,10 @@ class _TransformerRunner:
             # prompts beyond the top bucket take the chunked-prefill path:
             # warm its [1, bucket] shape so it never compiles mid-request
             if progress:
-                progress(f"compiling chunked prefill ([1, {self.buckets[-1]}])")
+                progress(
+                    f"compiling chunked prefill ([1, {self.buckets[-1]}])",
+                    kind="prefill_chunk", bucket=self.buckets[-1],
+                )
             state = self._chunked_prefill(
                 np.ones((self.buckets[-1] + 1,), np.int32)
             )
@@ -2735,13 +3076,16 @@ class _TransformerRunner:
             # the PREFILL_CHUNK_TOKENS budget routes over-budget prompts
             # through [1, chunk_b] slices — warm that shape too
             if progress:
-                progress(f"compiling budgeted chunked prefill ([1, {chunk_b}])")
+                progress(
+                    f"compiling budgeted chunked prefill ([1, {chunk_b}])",
+                    kind="prefill_chunk", bucket=chunk_b,
+                )
             state = self._chunked_prefill(
                 np.ones((chunk_b + 1,), np.int32), bucket=chunk_b
             )
             del state
         if progress:
-            progress("compiling decode step")
+            progress("compiling decode step", kind="decode_step")
         one = _slice_cache(cache, 0)
         self._warmup_prefix(progress, one)
         self._warmup_adapters(progress)
@@ -2749,7 +3093,13 @@ class _TransformerRunner:
         step.block_until_ready()
         # warm the full decode chunk (remainder sizes compile on demand)
         if progress:
-            progress(f"compiling decode chunk ({self.decode_chunk_size} steps)")
+            progress(
+                f"compiling decode chunk ({self.decode_chunk_size} steps)",
+                kind="decode_chunk",
+            )
+        self._seed_exec(
+            ("decode_chunk", False, False, self.decode_chunk_size)
+        )
         toks, _ = self._decode_chunk(
             self.params, jnp.zeros((1, 1), jnp.int32), one,
             jax.random.key(0), 0.0, 0, 1.0, 0.0, self.decode_chunk_size,
@@ -2773,7 +3123,8 @@ class _TransformerRunner:
                     if progress:
                         progress(
                             f"compiling tail prefill bucket {b_} "
-                            f"({i + 1}/{len(self.buckets)})"
+                            f"({i + 1}/{len(self.buckets)})",
+                            kind="tail_prefill", bucket=b_,
                         )
                     # tail of b_-1 tokens lands in bucket b_ (> previous
                     # bucket); total stays within max_seq
@@ -2797,13 +3148,14 @@ class _TransformerRunner:
                 if progress:
                     progress(
                         f"compiling adapter prefill bucket {b_} "
-                        f"({i + 1}/{len(self.buckets)})"
+                        f"({i + 1}/{len(self.buckets)})",
+                        kind="adapter_prefill", bucket=b_,
                     )
                 st = self._chunked_prefill(
                     np.ones((4,), np.int32), any_tree, bucket=b_
                 )
             if progress:
-                progress("compiling adapter decode chunk")
+                progress("compiling adapter decode chunk", kind="adapter_decode")
             a_toks = self._decode_chunk(
                 any_tree, jnp.zeros((1, 1), jnp.int32), st["cache"],
                 self._greedy_key, 0.0, 0, 1.0, 0.0, self.decode_chunk_size,
@@ -2822,11 +3174,15 @@ class _TransformerRunner:
                 if progress:
                     progress(
                         f"compiling draft prefill bucket {bucket} "
-                        f"({i + 1}/{len(self.buckets)})"
+                        f"({i + 1}/{len(self.buckets)})",
+                        kind="draft_prefill", bucket=bucket,
                     )
                 dcache = spec.prefill_prompt(np.ones((4,), np.int32), bucket, False)
             if progress:
-                progress(f"compiling draft chunk + verify (k={spec.k})")
+                progress(
+                    f"compiling draft chunk + verify (k={spec.k})",
+                    kind="spec_verify",
+                )
             dtoks, dcache = spec.propose(jnp.zeros((1, 1), jnp.int32), dcache)
             verify_in = jnp.concatenate([jnp.zeros((1, 1), jnp.int32), dtoks], axis=1)
             vids, vcache = self._verify(self.params, verify_in, one)
@@ -2834,6 +3190,7 @@ class _TransformerRunner:
             spec.reset_len(dcache, 1)
             # the capacity-tail fallback decodes single steps: warm the
             # n=1 chunk shape so it never compiles on the serving path
+            self._seed_exec(("decode_chunk", False, False, 1))
             t1, vcache = self._decode_chunk(
                 self.params, jnp.zeros((1, 1), jnp.int32), vcache,
                 self._greedy_key, 0.0, 0, 1.0, 0.0, 1,
@@ -2849,7 +3206,10 @@ class _TransformerRunner:
                 # reset_len DONATES its input — rebuild the throwaway
                 # draft cache rather than reuse a deleted array
                 if progress:
-                    progress("compiling sampled draft chunk + verify")
+                    progress(
+                        "compiling sampled draft chunk + verify",
+                        kind="spec_verify_sampled",
+                    )
                 dcache = spec.prefill_prompt(
                     np.ones((4,), np.int32), self.buckets[0], False
                 )
@@ -3084,6 +3444,9 @@ def _build_runner(
     lora_adapters: Optional[dict] = None,
     echo_step_ms: float = 0.0,
     prefill_chunk_tokens: int = 0,
+    timeline: Any = None,
+    watchdog: Any = None,
+    cache_events: Any = None,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
@@ -3106,6 +3469,7 @@ def _build_runner(
             attn_impl=attn_impl, prefix_cache=prefix_cache,
             prefix_lcp_min=prefix_lcp_min, lora_adapters=lora_adapters,
             prefill_chunk_tokens=prefill_chunk_tokens,
+            timeline=timeline, watchdog=watchdog, cache_events=cache_events,
         )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected echo, mlp, bert-tiny, "

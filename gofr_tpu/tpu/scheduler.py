@@ -114,6 +114,18 @@ class InterferenceScheduler:
         else:
             self._chunks_counter = self._defer_hist = None
 
+    def snapshot(self) -> dict:
+        """Point-in-time defer state for ``GET /admin/engine``: policy,
+        bound, decode cadence, and the plain counters."""
+        with self._cond:
+            return {
+                "policy": self.policy,
+                "max_defer_ms": self._max_defer_s * 1000.0,
+                "decode_active": self._decode_active,
+                "decode_interval_ema_s": round(self._interval_ema, 6),
+                **dict(self.stats),
+            }
+
     # -- decode side (never blocks) ------------------------------------------
     def note_decode_chunk(self, active: int) -> None:
         """One pooled decode chunk dispatched with ``active`` live slots."""

@@ -1,0 +1,348 @@
+"""Engine introspection (gofr_tpu/tpu/introspect.py): dispatch timeline,
+engine state machine, and the device stall watchdog — unit semantics plus
+the end-to-end spine over the in-process server on the no-JAX ``echo``
+model (no XLA compiles; the fast tier covers the whole layer): an
+injected device stall must flip the state machine to degraded/wedged,
+turn ``/.well-known/ready`` into a diagnosed 503, increment the stall
+counter, and recover when the dispatch completes."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gofr_tpu.metrics import Registry
+from gofr_tpu.tpu.introspect import (
+    DISPATCH_KINDS,
+    DispatchTimeline,
+    EngineState,
+    StallWatchdog,
+)
+
+
+# -- unit: dispatch timeline --------------------------------------------------
+
+def test_timeline_ids_monotonic_and_ring_bounded():
+    timeline = DispatchTimeline(capacity=3)
+    records = [timeline.begin("prefill", bucket=64) for _ in range(5)]
+    assert [r.dispatch_id for r in records] == [1, 2, 3, 4, 5]
+    for r in records:
+        timeline.finish(r)
+    page = timeline.records()
+    assert [r["dispatch_id"] for r in page] == [5, 4, 3]  # newest first
+    stats = timeline.stats()
+    assert stats["total"] == 5
+    assert stats["by_kind"] == {"prefill": 5}
+    assert stats["in_flight"] == 0
+
+
+def test_timeline_in_flight_visible_and_finish_idempotent():
+    timeline = DispatchTimeline(capacity=8)
+    rec = timeline.begin("decode_chunk", batch_size=4)
+    assert timeline.stats()["in_flight"] == 1
+    # a running (possibly wedged) dispatch is already on the ring
+    assert timeline.records()[0]["status"] == "running"
+    assert timeline.records()[0]["duration_s"] is None
+    timeline.finish(rec, status="error")
+    timeline.finish(rec)  # idempotent: first finish wins
+    assert timeline.records()[0]["status"] == "error"
+    assert timeline.stats()["in_flight"] == 0
+
+
+def test_timeline_kind_filter_and_limit():
+    timeline = DispatchTimeline(capacity=16)
+    for _ in range(3):
+        timeline.finish(timeline.begin("prefill"))
+    timeline.finish(timeline.begin("warmup_compile", detail="bucket 64"))
+    assert all(
+        r["kind"] == "prefill" for r in timeline.records(kind="prefill")
+    )
+    assert len(timeline.records(kind="prefill")) == 3
+    assert len(timeline.records(limit=2)) == 2
+    assert timeline.records(kind="warmup_compile")[0]["detail"] == "bucket 64"
+
+
+def test_dispatch_record_queue_vs_running_split():
+    timeline = DispatchTimeline(capacity=4)
+    queued = time.perf_counter()
+    time.sleep(0.02)
+    rec = timeline.begin("prefill", queued_at=queued)
+    rec.mark_running()
+    timeline.finish(rec)
+    out = rec.to_dict()
+    assert out["queue_wait_s"] >= 0.02
+    assert out["duration_s"] < 0.02
+
+
+# -- unit: engine state machine ----------------------------------------------
+
+def test_engine_state_transitions_history_and_gauge():
+    registry = Registry()
+    engine = EngineState(metrics=registry)
+    assert engine.state == "booting"
+    engine.transition("warming", "compiling")
+    engine.transition("serving")
+    engine.transition("serving")  # same-state: no duplicate history entry
+    snap = engine.snapshot()
+    assert snap["state"] == "serving"
+    assert [h["state"] for h in snap["history"]] == [
+        "booting", "warming", "serving",
+    ]
+    gauge = registry.gauge("gofr_tpu_engine_state", labels=("state",))
+    assert gauge.value(state="serving") == 1.0
+    assert gauge.value(state="booting") == 0.0
+
+
+def test_engine_state_rejects_unknown_state():
+    engine = EngineState()
+    with pytest.raises(ValueError, match="unknown"):
+        engine.transition("confused")
+
+
+# -- unit: stall watchdog -----------------------------------------------------
+
+def test_watchdog_flags_stall_wedges_and_recovers():
+    registry = Registry()
+    engine = EngineState(metrics=registry)
+    engine.transition("serving")
+    watchdog = StallWatchdog(
+        engine, metrics=registry, timeout_s=0.05, wedge_factor=3.0
+    )
+    seen = set()
+
+    def stalled_dispatch():
+        with watchdog.watch("prefill", 7):
+            time.sleep(0.4)
+
+    worker = threading.Thread(target=stalled_dispatch)
+    worker.start()
+    deadline = time.time() + 2.0
+    while worker.is_alive() and time.time() < deadline:
+        seen.add(engine.state)
+        time.sleep(0.01)
+    worker.join()
+    watchdog.close()
+    assert "degraded" in seen
+    assert "wedged" in seen  # 0.4s stall > 3x the 0.05s deadline
+    assert watchdog.stall_counts == {"prefill": 1}
+    counter = registry.counter("gofr_tpu_device_stalls_total", labels=("kind",))
+    assert counter.value(kind="prefill") == 1
+    # the dispatch completing flips the engine back to pre-stall state
+    assert engine.state == "serving"
+    assert "recovered" in (engine.snapshot()["detail"] or "")
+
+
+def test_watchdog_disabled_is_noop():
+    engine = EngineState()
+    watchdog = StallWatchdog(engine, timeout_s=0.0)
+    assert not watchdog.enabled
+    with watchdog.watch("prefill", 1):
+        time.sleep(0.05)
+    assert watchdog.stall_counts == {}
+    assert watchdog.snapshot()["enabled"] is False
+
+
+def test_watchdog_fast_dispatches_never_flag():
+    engine = EngineState()
+    engine.transition("serving")
+    watchdog = StallWatchdog(engine, timeout_s=0.2)
+    for _ in range(5):
+        with watchdog.watch("decode_chunk", 1):
+            time.sleep(0.005)
+    time.sleep(0.1)
+    watchdog.close()
+    assert watchdog.stall_counts == {}
+    assert engine.state == "serving"
+
+
+# -- end-to-end: the echo app ------------------------------------------------
+
+@pytest.fixture(scope="module")
+def echo_app(tmp_path_factory):
+    """Echo-model app with the OpenAI routes and an ARMED watchdog —
+    the full engine-introspection spine, no XLA compiles."""
+    import os
+
+    import gofr_tpu
+    from gofr_tpu.openai_compat import register_openai_routes
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {"HTTP_PORT": str(port), "LOG_LEVEL": "FATAL",
+           "MODEL_NAME": "echo", "TOKENIZER": "byte",
+           "BATCH_MAX_SIZE": "4", "BATCH_TIMEOUT_MS": "1",
+           "FLIGHT_SLOW_MS": "60000",
+           # armed deadline small enough that an injected 0.7s stall
+           # walks the whole machine: degraded at 0.15s, wedged at 0.45s
+           "WATCHDOG_DISPATCH_TIMEOUT_S": "0.15"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    cwd = os.getcwd()
+    os.chdir(tmp_path_factory.mktemp("engine_obs"))
+    try:
+        app = gofr_tpu.new()
+    finally:
+        os.chdir(cwd)
+        for k, v in saved.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+    register_openai_routes(app)
+    app.start()
+    yield app, f"http://127.0.0.1:{port}"
+    app.shutdown()
+
+
+def _post(base, payload, path="/v1/chat/completions"):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read()), dict(resp.headers.items())
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read())["data"]
+
+
+def test_admin_engine_snapshot_is_populated(echo_app):
+    app, base = echo_app
+    _post(base, {"messages": [{"role": "user", "content": "warm"}],
+                 "max_tokens": 2, "temperature": 0})
+    snap = _get(base, "/admin/engine")
+    assert snap["engine"]["state"] == "serving"
+    assert [h["state"] for h in snap["engine"]["history"]][0] == "booting"
+    assert snap["model"] == "echo"
+    # the boot timeline captured real stages (probe + runner build)
+    stages = [s["stage"] for s in snap["boot_timeline"]]
+    assert any("probing device runtime" in s for s in stages)
+    assert all(s["seconds"] >= 0 for s in snap["boot_timeline"])
+    assert snap["watchdog"]["enabled"] is True
+    assert snap["watchdog"]["timeout_s"] == pytest.approx(0.15)
+    assert snap["dispatches"]["total"] >= 1
+    assert "prefill" in snap["dispatches"]["by_kind"]
+    assert snap["queue_depth"] == 0
+    assert snap["scheduler"]["policy"] == "fair"
+    assert "executable" in snap["caches"]
+
+
+def test_admin_dispatches_schema_filter_and_400(echo_app):
+    app, base = echo_app
+    _post(base, {"messages": [{"role": "user", "content": "dispatch me"}],
+                 "max_tokens": 2, "temperature": 0})
+    page = _get(base, "/admin/dispatches")
+    assert page["count"] == len(page["dispatches"]) >= 1
+    newest = page["dispatches"][0]
+    for field in ("dispatch_id", "kind", "status", "batch_size",
+                  "padded_tokens", "tokens", "queue_wait_s", "duration_s"):
+        assert field in newest
+    assert newest["kind"] in DISPATCH_KINDS
+    prefills = _get(base, "/admin/dispatches?kind=prefill")["dispatches"]
+    assert prefills and all(r["kind"] == "prefill" for r in prefills)
+    assert prefills[0]["status"] == "ok"
+    assert prefills[0]["bucket"] >= prefills[0]["tokens"]
+    assert prefills[0]["duration_s"] > 0
+    assert len(_get(base, "/admin/dispatches?limit=1")["dispatches"]) == 1
+    # the boot-time device probe rode the timeline too
+    assert _get(base, "/admin/dispatches?kind=device_probe")["dispatches"]
+    try:
+        _get(base, "/admin/dispatches?kind=warp")
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_flight_record_dispatch_ids_resolve(echo_app):
+    """The request->dispatch join: a FlightRecord's dispatch_ids must
+    resolve to matching DispatchRecords on /admin/dispatches."""
+    app, base = echo_app
+    _, headers = _post(base, {
+        "messages": [{"role": "user", "content": "link me up"}],
+        "max_tokens": 3, "temperature": 0,
+    })
+    corr = headers["X-Correlation-ID"]
+    mine = [r for r in _get(base, "/admin/requests")["requests"]
+            if r["trace_id"] == corr]
+    assert len(mine) == 1
+    ids = mine[0]["dispatch_ids"]
+    assert ids, "request carried no dispatch ids"
+    dispatches = {
+        r["dispatch_id"]: r
+        for r in _get(base, "/admin/dispatches?limit=500")["dispatches"]
+    }
+    for did in ids:
+        assert did in dispatches, (did, sorted(dispatches))
+        assert dispatches[did]["kind"] == "prefill"
+        assert dispatches[did]["status"] == "ok"
+
+
+def test_injected_stall_walks_the_state_machine(echo_app):
+    """The acceptance spine: injected stall -> degraded -> wedged ->
+    ready 503 with the state -> stall counter -> recovery -> ready 200."""
+    app, base = echo_app
+    tpu = app.container.tpu
+    counter_before = tpu.metrics.counter(
+        "gofr_tpu_device_stalls_total", labels=("kind",)
+    ).value(kind="prefill")
+    tpu.runner.stall_hook = lambda: time.sleep(0.7)
+    try:
+        worker = threading.Thread(
+            target=lambda: _post(
+                base,
+                {"messages": [{"role": "user", "content": "stall"}],
+                 "max_tokens": 1, "temperature": 0},
+            ),
+        )
+        worker.start()
+        states = set()
+        ready_bodies = []
+        deadline = time.time() + 5.0
+        while worker.is_alive() and time.time() < deadline:
+            states.add(tpu.engine.state)
+            try:
+                urllib.request.urlopen(
+                    base + "/.well-known/ready", timeout=5
+                ).close()
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    ready_bodies.append(json.loads(e.read() or b"{}"))
+            time.sleep(0.02)
+        worker.join()
+    finally:
+        tpu.runner.stall_hook = None
+    assert "degraded" in states
+    assert "wedged" in states  # 0.7s stall > 3x the 0.15s deadline
+    # ready told the truth while stalled: 503 with the engine state
+    assert ready_bodies, "ready never returned 503 during the stall"
+    assert {b["state"] for b in ready_bodies} <= {"degraded", "wedged"}
+    assert any("stalled" in (b.get("detail") or "") for b in ready_bodies)
+    counter_after = tpu.metrics.counter(
+        "gofr_tpu_device_stalls_total", labels=("kind",)
+    ).value(kind="prefill")
+    assert counter_after >= counter_before + 1
+    # recovery: the dispatch completed, the engine serves again
+    deadline = time.time() + 2.0
+    while tpu.engine.state != "serving" and time.time() < deadline:
+        time.sleep(0.02)
+    assert tpu.engine.state == "serving"
+    with urllib.request.urlopen(base + "/.well-known/ready", timeout=5) as r:
+        assert r.status == 200
+    # and the stalled dispatch shows on the timeline as completed
+    snap = _get(base, "/admin/engine")
+    assert snap["watchdog"]["stalls"].get("prefill", 0) >= 1
+    assert snap["engine"]["state"] == "serving"
+
+
+def test_stall_metrics_visible_on_metrics_endpoint(echo_app):
+    """The Prometheus view: engine state gauge + stall counter exposed."""
+    app, base = echo_app
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert 'gofr_tpu_engine_state{state="serving"} 1' in text
+    assert "gofr_tpu_dispatches_total" in text
+    assert "gofr_tpu_dispatch_seconds" in text

@@ -24,7 +24,7 @@ _COUNTER_SUFFIXES = ("_total",)
 _HISTOGRAM_SUFFIXES = ("_seconds", "_bytes", "_size")
 _GAUGE_SUFFIXES = (
     "_seconds", "_bytes", "_total", "_depth", "_ratio", "_entries",
-    "_active", "_acceptance",
+    "_active", "_acceptance", "_state",
 )
 # roofline utilization gauges: the suffix IS the (well-known) metric name
 _GAUGE_ALLOWLIST = {"gofr_tpu_mfu", "gofr_tpu_mbu"}
@@ -50,7 +50,14 @@ def test_scanner_sees_the_known_registrations():
     assert {"gofr_tpu_prefill_chunks_total", "gofr_tpu_sched_defer_seconds",
             "gofr_tpu_prefill_padded_tokens_total",
             "gofr_tpu_pool_reject_total"} <= names
-    assert len(names) >= 16
+    # the engine-introspection suite (tpu/introspect.py + device compile/
+    # cache observability + the profiler-activity gauge) stays visible too
+    assert {"gofr_tpu_engine_state", "gofr_tpu_device_stalls_total",
+            "gofr_tpu_dispatches_total", "gofr_tpu_dispatch_seconds",
+            "gofr_tpu_compile_seconds", "gofr_tpu_compiles_total",
+            "gofr_tpu_cache_events_total",
+            "gofr_tpu_profiler_active"} <= names
+    assert len(names) >= 24
 
 
 def test_every_metric_follows_the_naming_convention():

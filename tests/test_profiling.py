@@ -68,22 +68,30 @@ def test_admin_profiler_endpoints(app, tmp_path):
         with urllib.request.urlopen(req, timeout=10) as r:
             return json.loads(r.read())["data"]
 
+    def active_gauge():
+        return app.container.metrics.gauge("gofr_tpu_profiler_active").value()
+
     assert call("GET", "/admin/profiler") == {"state": "idle"}
+    assert active_gauge() == 0.0
     trace_dir = str(tmp_path / "prof")
     started = call("POST", "/admin/profiler/start", {"dir": trace_dir})
     assert started["state"] == "tracing" and started["dir"] == trace_dir
-    # duplicate start -> 409, not a crash
+    assert active_gauge() == 1.0  # the left-running-trace alert signal
+    # duplicate start -> 409 (rejecting beats silently restarting the
+    # trace: a restart would discard the in-flight capture)
     try:
         call("POST", "/admin/profiler/start")
         raise AssertionError("expected 409")
     except urllib.error.HTTPError as e:
         assert e.code == 409
+    assert active_gauge() == 1.0  # the rejected start did not clear it
     import jax.numpy as jnp
 
     jnp.ones((4, 4)).sum().block_until_ready()
     stopped = call("POST", "/admin/profiler/stop")
     assert stopped["state"] == "stopped"
     assert stopped["artifacts"]
+    assert active_gauge() == 0.0
     assert call("GET", "/admin/profiler") == {"state": "idle"}
 
 
