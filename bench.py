@@ -59,6 +59,12 @@ def next_pow2(n: int) -> int:
 
 def main() -> int:
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gofr_jax_cache")
+    # postmortem black box: bundles written during THIS run (wedge,
+    # crash, or the forced end-of-run capture below) are harvested into
+    # the JSON artifact — and copied to BENCH_POSTMORTEM_OUT (e.g.
+    # hw/rNN/) so the evidence survives the process
+    pm_dir = os.environ.setdefault("POSTMORTEM_DIR", "/tmp/gofr_postmortems")
+    run_start = time.time()
     model = os.environ.get("BENCH_MODEL", "llama3-8b")
     clients = int(os.environ.get("BENCH_CLIENTS", "8"))
     n_requests = int(os.environ.get("BENCH_REQUESTS", "64"))
@@ -165,12 +171,42 @@ def main() -> int:
         errors.append(f"{type(exc).__name__}: {exc}")
         traceback.print_exc(file=sys.stderr)
     finally:
+        _harvest_postmortems(result, pm_dir, run_start)
         if errors:
             result["errors"] = errors
         # ALWAYS one JSON line, even on phase failure — partial numbers
         # beat an empty artifact
         print(json.dumps(result), flush=True)
     return rc
+
+
+def _harvest_postmortems(result: dict, pm_dir: str, run_start: float) -> None:
+    """Collect the black-box bundles this run produced: list them in the
+    artifact, copy them to BENCH_POSTMORTEM_OUT when set (the round's
+    hw/rNN/ evidence directory)."""
+    import glob
+    import shutil
+
+    try:
+        bundles = sorted(
+            p for p in glob.glob(os.path.join(pm_dir, "postmortem-*.json"))
+            if os.path.getmtime(p) >= run_start - 1.0
+        )
+    except OSError:
+        return
+    if not bundles:
+        return
+    result["postmortem_bundles"] = bundles
+    out_dir = os.environ.get("BENCH_POSTMORTEM_OUT")
+    if not out_dir:
+        return
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        for path in bundles:
+            shutil.copy2(path, out_dir)
+        log(f"harvested {len(bundles)} postmortem bundle(s) into {out_dir}")
+    except OSError as exc:
+        log(f"postmortem harvest failed: {exc}")
 
 
 def _enter_cpu_fallback(result: dict) -> str:
@@ -486,6 +522,22 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
         state = _scrape_engine_state(base)
         if state is not None:
             result["engine_state"] = state
+        if state in ("degraded", "wedged"):
+            # force a black-box bundle BEFORE shutdown: the wedge's own
+            # bundle may be rate-limited or mid-write, and the driver is
+            # about to kill this process — main()'s harvest then carries
+            # it into the artifact
+            try:
+                req = urllib.request.Request(
+                    base + "/admin/postmortem", data=b"{}",
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    path = json.loads(r.read())["data"]["path"]
+                log(f"engine {state}: postmortem bundle forced at {path}")
+            except Exception as exc:
+                log(f"postmortem trigger failed: {exc}")
         try:
             app.shutdown()
         except Exception:

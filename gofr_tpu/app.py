@@ -34,12 +34,16 @@ from gofr_tpu.handler import (
     health_handler,
     make_endpoint,
     metrics_handler,
+    overview_admin_handler,
+    postmortem_list_handler,
+    postmortem_trigger_handler,
     profiler_start_handler,
     profiler_status_handler,
     profiler_stop_handler,
     ready_handler,
     requests_admin_handler,
     slo_admin_handler,
+    timeseries_admin_handler,
 )
 from gofr_tpu.http.middleware import (
     cors_middleware,
@@ -165,6 +169,16 @@ class App:
                         make_endpoint(engine_admin_handler, self.container))
         self.router.add("GET", "/admin/dispatches",
                         make_endpoint(dispatches_admin_handler, self.container))
+        # telemetry timebase (timebase.py): retained metric history +
+        # the one-page ops rollup; postmortem black box (postmortem.py)
+        self.router.add("GET", "/admin/timeseries",
+                        make_endpoint(timeseries_admin_handler, self.container))
+        self.router.add("GET", "/admin/overview",
+                        make_endpoint(overview_admin_handler, self.container))
+        self.router.add("GET", "/admin/postmortem",
+                        make_endpoint(postmortem_list_handler, self.container))
+        self.router.add("POST", "/admin/postmortem",
+                        make_endpoint(postmortem_trigger_handler, self.container))
         self.router.add("GET", "/admin/adapters",
                         make_endpoint(adapters_list_handler, self.container))
         self.router.add("POST", "/admin/adapters",

@@ -11,6 +11,17 @@ TPU-native keys added on top of the reference set (SURVEY.md §2 #22):
 ``TPU_ENABLED``, ``TPU_MESH`` (serving mesh, e.g. "tp=4,dp=4"),
 ``MODEL_NAME``, ``MODEL_PATH``, ``MODEL_QUANT``, ``BATCH_MAX_SIZE``,
 ``BATCH_TIMEOUT_MS``, ``METRICS_ENABLED``.
+
+Observability keys (timebase + postmortem layer, see
+docs/advanced-guide/observability.md for semantics):
+``TIMEBASE_INTERVAL_S`` (default 5) / ``TIMEBASE_WINDOW_S`` (default
+900) / ``TIMEBASE_ENABLED`` size and arm the metric-snapshot ring;
+``POSTMORTEM_DIR`` (default ./postmortems — setting it EXPLICITLY also
+arms the crash/fatal-signal hooks), ``POSTMORTEM_KEEP``,
+``POSTMORTEM_MIN_INTERVAL_S``, ``POSTMORTEM_SNAPSHOTS`` govern the
+black-box bundles; ``METRICS_MAX_SERIES`` (default 1000) caps
+per-metric label cardinality; ``METRICS_EXEMPLARS=off`` disables
+OpenMetrics histogram exemplars.
 """
 
 from __future__ import annotations

@@ -17,14 +17,30 @@ from gofr_tpu.config import Config
 from gofr_tpu.datasource.health import DOWN, UP, Health
 from gofr_tpu.logging import new_logger
 from gofr_tpu.metrics import Registry
-from gofr_tpu.telemetry import FlightRecorder
+from gofr_tpu.postmortem import PostmortemStore
+from gofr_tpu.telemetry import FlightRecorder, exemplar_provider
+from gofr_tpu.timebase import TimebaseSampler
 
 
 class Container:
     def __init__(self, config: Config, wire: bool = True):
         self.config = config
         self.logger = new_logger(config.get_or_default("LOG_LEVEL", "INFO"))
-        self.metrics = Registry()
+        self.metrics = Registry(
+            # cardinality guard: overflow increments
+            # gofr_tpu_metrics_dropped_series_total{metric} instead of
+            # growing the scrape unboundedly under scanner traffic
+            max_series=int(
+                config.get_or_default("METRICS_MAX_SERIES", "1000")
+            ),
+            # histogram observations self-correlate: OpenMetrics bucket
+            # exemplars carry the active trace_id/dispatch_id
+            exemplar_provider=(
+                exemplar_provider
+                if config.get_or_default("METRICS_EXEMPLARS", "on") != "off"
+                else None
+            ),
+        )
         # request flight recorder: per-request inference telemetry backing
         # /admin/requests and /admin/slo plus the wide-event request log
         self.telemetry = FlightRecorder(
@@ -35,6 +51,39 @@ class Container:
             ) / 1000.0,
             logger=self.logger,
         )
+        # telemetry timebase: the metric history ring behind
+        # /admin/timeseries and /admin/overview (and the trend data every
+        # postmortem bundle carries)
+        self.timebase = TimebaseSampler(
+            self.metrics,
+            interval_s=float(
+                config.get_or_default("TIMEBASE_INTERVAL_S", "5")
+            ),
+            window_s=float(
+                config.get_or_default("TIMEBASE_WINDOW_S", "900")
+            ),
+            logger=self.logger,
+            start=config.get_or_default("TIMEBASE_ENABLED", "on") != "off",
+        )
+        # postmortem black box: wedge/crash/manual flight-data bundles
+        # (the engine listener attaches in _wire_tpu once a device exists)
+        self.postmortem = PostmortemStore(
+            self,
+            directory=config.get_or_default("POSTMORTEM_DIR", "./postmortems"),
+            keep=int(config.get_or_default("POSTMORTEM_KEEP", "20")),
+            min_interval_s=float(
+                config.get_or_default("POSTMORTEM_MIN_INTERVAL_S", "30")
+            ),
+            snapshots=int(
+                config.get_or_default("POSTMORTEM_SNAPSHOTS", "60")
+            ),
+            logger=self.logger,
+        )
+        if config.get("POSTMORTEM_DIR"):
+            # crash + fatal-signal hooks are process-global: armed only on
+            # the operator's explicit POSTMORTEM_DIR opt-in (wedge and
+            # manual bundles work either way)
+            self.postmortem.install_crash_hooks()
         self.services: dict[str, Any] = {}
         self.redis: Optional[Any] = None
         self.db: Optional[Any] = None
@@ -88,6 +137,9 @@ class Container:
             # the server before it listens — the exact failure
             # TPU_BOOT=background exists to avoid
             self.tpu = new_device(self.config, self.logger, self.metrics)
+            # a wedged or boot-failed engine writes its own black-box
+            # bundle the moment the state machine says so
+            self.postmortem.watch_engine(self.tpu.engine)
             if self.config.get_or_default("TPU_BOOT", "") == "background":
                 # the device logs its describe() line once probe+warmup end
                 self.logger.infof(
@@ -151,6 +203,8 @@ class Container:
                     closer()
                 except Exception:
                     pass
+        self.timebase.close()
+        self.postmortem.detach()
         if self._handler_pool is not None:
             self._handler_pool.shutdown(wait=False)
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import inspect
+import time
 from typing import Any, Callable
 
 from gofr_tpu import static
@@ -111,10 +112,23 @@ def ready_handler(ctx: Context) -> Response:
 
 
 def metrics_handler(ctx: Context) -> Response:
+    """Prometheus text exposition, content-negotiated: an
+    ``Accept: application/openmetrics-text`` header gets the OpenMetrics
+    1.0 body — same series, plus histogram bucket exemplars
+    (trace_id/dispatch_id) and the mandatory ``# EOF`` — so dashboards
+    that speak exemplars resolve a latency bucket straight to its
+    flight record. Everyone else keeps classic text 0.0.4."""
+    accept = ctx.request.header("Accept") or ""
+    openmetrics = "application/openmetrics-text" in accept
+    content_type = (
+        "application/openmetrics-text; version=1.0.0; charset=utf-8"
+        if openmetrics
+        else "text/plain; version=0.0.4; charset=utf-8"
+    )
     return Response(
         status=200,
-        headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
-        body=ctx.container.metrics.expose().encode("utf-8"),
+        headers={"Content-Type": content_type},
+        body=ctx.container.metrics.expose(openmetrics=openmetrics).encode("utf-8"),
     )
 
 
@@ -257,6 +271,145 @@ def dispatches_admin_handler(ctx: Context) -> Any:
         )
     records = ctx.tpu.timeline.records(limit=limit, kind=kind)
     return {"dispatches": records, "count": len(records)}
+
+
+def timeseries_admin_handler(ctx: Context) -> Any:
+    """GET /admin/timeseries: retained metric history from the timebase
+    ring. ``?metric=`` (required) names a registered metric;
+    ``?labels=k:v,k2:v2`` filters label-sets by subset match;
+    ``?window=`` bounds the lookback in seconds (default: the whole
+    ring). Counters and histograms carry a derived per-second ``rate``
+    series next to the raw cumulative points."""
+    from gofr_tpu.errors import InvalidParamError
+
+    _check_admin(ctx)
+    metric = ctx.param("metric")
+    if not metric:
+        raise InvalidParamError('"metric" is required (a registered metric name)')
+    labels: dict[str, str] = {}
+    raw_labels = ctx.param("labels") or ""
+    for part in raw_labels.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        sep = ":" if ":" in part else "="
+        name, found, value = part.partition(sep)
+        if not found or not name:
+            raise InvalidParamError(
+                '"labels" must be comma-separated name:value pairs'
+            )
+        labels[name.strip()] = value.strip()
+    window = None
+    raw_window = ctx.param("window")
+    if raw_window:
+        try:
+            window = float(raw_window)
+        except ValueError:
+            raise InvalidParamError(
+                '"window" must be a number of seconds'
+            ) from None
+        if window <= 0:
+            raise InvalidParamError('"window" must be > 0')
+    result = ctx.container.timebase.series(
+        metric, labels=labels or None, window=window
+    )
+    if result is None:
+        raise InvalidParamError(
+            f'metric "{metric}" unknown to the timebase (not registered, '
+            "or no snapshot taken yet)"
+        )
+    result["timebase"] = ctx.container.timebase.stats()
+    return result
+
+
+def overview_admin_handler(ctx: Context) -> Any:
+    """GET /admin/overview: the one-page ops rollup — engine state,
+    req/s and TTFT p95 TRENDS from the timebase ring, stall/cache/
+    compile counters, the SLO snapshot, in-flight requests, and the
+    postmortem inventory. One request instead of six; every field is a
+    host-side read, so it answers while wedged."""
+    _check_admin(ctx)
+    container = ctx.container
+    timebase = container.timebase
+    out: dict[str, Any] = {
+        "ts": time.time(),
+        "timebase": timebase.stats(),
+        "requests_in_flight": container.telemetry.active_count(),
+        "slo": container.telemetry.slo(window_s=300.0),
+        "req_per_sec": _trend(timebase.rate_total("gofr_http_requests_total")),
+        "ttft_p95_s": _trend(
+            timebase.hist_quantile_trend("gofr_tpu_ttft_seconds", 0.95)
+        ),
+        "postmortems": container.postmortem.list()[-5:],
+    }
+    tpu = container.tpu
+    if tpu is None:
+        out["engine"] = None
+        return out
+    engine = tpu.engine.snapshot()
+    out["engine"] = {
+        "state": engine["state"],
+        "detail": engine["detail"],
+        "since": engine["since"],
+    }
+    out["model"] = tpu.model_name
+    out["platform"] = tpu.platform
+    out["watchdog"] = tpu.watchdog.snapshot()
+    out["dispatches"] = tpu.timeline.stats()
+    batcher = getattr(tpu, "batcher", None)
+    out["queue_depth"] = batcher._depth() if batcher is not None else None
+    pool = getattr(tpu, "decode_pool", None)
+    out["decode_pool"] = pool.occupancy() if pool is not None else None
+    registry = container.metrics
+    out["compiles_total"] = sum(
+        registry.counter(
+            "gofr_tpu_compiles_total", labels=("kind",)
+        ).data().values()
+    )
+    cache_counter = registry.counter(
+        "gofr_tpu_cache_events_total", labels=("cache", "event")
+    )
+    out["cache_events"] = {
+        "/".join(key): value for key, value in cache_counter.data().items()
+    }
+    return out
+
+
+def _trend(points: list) -> dict[str, Any]:
+    """A trend series plus its latest value (the rollup's headline)."""
+    return {
+        "now": points[-1][1] if points else None,
+        "trend": points,
+    }
+
+
+def postmortem_list_handler(ctx: Context) -> Any:
+    """GET /admin/postmortem: the on-disk bundle inventory."""
+    _check_admin(ctx)
+    store = ctx.container.postmortem
+    return {"dir": store.directory, "bundles": store.list()}
+
+
+def postmortem_trigger_handler(ctx: Context) -> Any:
+    """POST /admin/postmortem: write a bundle NOW (operator trigger —
+    bypasses the automatic-trigger rate limit). Body is optional:
+    ``{"detail": "..."}`` annotates the bundle."""
+    from gofr_tpu.errors import HTTPError
+
+    _check_admin(ctx)
+    detail = ""
+    try:
+        body = ctx.bind() if ctx.request.body else {}
+        if isinstance(body, dict):
+            detail = str(body.get("detail") or "")
+    except Exception:
+        pass  # empty/garbage body: an unannotated bundle still helps
+    path = ctx.container.postmortem.write(
+        reason="manual", detail=detail, force=True
+    )
+    if path is None:
+        raise HTTPError(500, "postmortem write failed (see server log)")
+    return {"path": path, "reason": "manual"}
 
 
 def _profiler_gauge(ctx: Context) -> Any:

@@ -29,6 +29,7 @@ from __future__ import annotations
 import contextvars
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Optional
 
@@ -40,6 +41,35 @@ _current_record: contextvars.ContextVar[Optional["FlightRecord"]] = (
 def current_record() -> Optional["FlightRecord"]:
     """The in-flight request's FlightRecord, if one is active."""
     return _current_record.get()
+
+
+def exemplar_provider() -> Optional[dict]:
+    """Default metrics exemplar provider (metrics.py Histogram): the
+    correlating ids of the CURRENT observation — the active request's
+    trace_id (flight record first, else the live span) and, below the
+    dispatch layer, the executing dispatch_id. Contextvar reads only:
+    O(1), no locks, safe on the hot path. Returns None outside any
+    request/dispatch context (boot-time observations stay exemplar-free)."""
+    labels: dict[str, str] = {}
+    record = _current_record.get()
+    trace_id = record.trace_id if record is not None else ""
+    if not trace_id:
+        from gofr_tpu.tracing import current_trace_id
+
+        trace_id = current_trace_id() or ""
+    if trace_id:
+        labels["trace_id"] = trace_id
+    # sys.modules, not an import: gofr_tpu.tpu's package init pulls in
+    # jax, and an app serving no TPU must never pay that import because
+    # a latency histogram fired
+    import sys
+
+    introspect = sys.modules.get("gofr_tpu.tpu.introspect")
+    if introspect is not None:
+        dispatch = introspect.current_dispatch()
+        if dispatch is not None:
+            labels["dispatch_id"] = str(dispatch.dispatch_id)
+    return labels or None
 
 
 def activate_record(record: Optional["FlightRecord"]) -> Any:
@@ -64,6 +94,9 @@ class FlightRecord:
         "pool_reject_reason", "dispatch_ids",
         "wall_start", "t_start", "t_enqueue", "t_dispatch",
         "t_first_token", "t_last_token", "t_done", "wall_done", "_lock",
+        # the recorder's in-flight index holds records WEAKLY (an
+        # abandoned record must vanish with its request, not leak)
+        "__weakref__",
     )
 
     # device dispatches linked per record: enough to cover a prefill, its
@@ -338,6 +371,14 @@ class FlightRecorder:
         self.logger = logger
         self._ring: "deque[FlightRecord]" = deque(maxlen=max(1, capacity))
         self._notable: "deque[FlightRecord]" = deque(maxlen=max(1, keep))
+        # records started but not yet finished — the postmortem bundle
+        # needs the requests riding a WEDGED dispatch, and those never
+        # reach the ring. Weak values: a record abandoned without finish
+        # (pre-inference parameter rejection) vanishes with its request
+        # instead of leaking here forever.
+        self._active: "weakref.WeakValueDictionary[int, FlightRecord]" = (
+            weakref.WeakValueDictionary()
+        )
         self._lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
@@ -354,6 +395,8 @@ class FlightRecorder:
             model=model, endpoint=endpoint, trace_id=trace_id,
             tokens_in=tokens_in, stream=stream,
         )
+        with self._lock:
+            self._active[id(record)] = record
         if activate:
             activate_record(record)
         return record
@@ -376,6 +419,7 @@ class FlightRecorder:
         elif record.status == "in_flight":
             record.status = status
         with self._lock:
+            self._active.pop(id(record), None)
             self._ring.append(record)
             if self.is_slow(record) or record.status != "ok":
                 self._notable.append(record)
@@ -416,7 +460,21 @@ class FlightRecorder:
         result.events = guarded()
         return result
 
-    # -- read side (admin API) ----------------------------------------------
+    # -- read side (admin API / postmortem) ----------------------------------
+    def active_count(self) -> int:
+        """In-flight request count — the cheap read for rollups that
+        only need the number, not the serialized records."""
+        with self._lock:
+            return len(self._active)
+
+    def active_records(self) -> list[dict[str, Any]]:
+        """Records started but not finished — the requests in flight RIGHT
+        NOW, oldest first. This is what a postmortem bundle needs most:
+        the requests riding a wedged dispatch never reach the ring."""
+        with self._lock:
+            active = sorted(self._active.values(), key=lambda r: r.t_start)
+        return [r.to_dict() for r in active]
+
     def records(
         self,
         slow: Optional[bool] = None,

@@ -829,8 +829,18 @@ class TPUDevice:
         record = telemetry_record()
 
         def _ttft() -> None:
+            # explicit exemplar: this callback fires on batcher/pool
+            # threads whose context may lack the request's contextvars —
+            # the captured record carries the trace_id regardless, so the
+            # TTFT histogram's OpenMetrics buckets still resolve to the
+            # flight record that produced them
+            exemplar = (
+                {"trace_id": record.trace_id}
+                if record is not None and record.trace_id else None
+            )
             self._ttft.observe(
-                time.perf_counter() - start, model=self.model_name, op="generate"
+                time.perf_counter() - start, exemplar=exemplar,
+                model=self.model_name, op="generate",
             )
             if record is not None:
                 record.mark_first_token()
@@ -1084,11 +1094,17 @@ class TPUDevice:
         hit/miss counts, and HBM usage. Never blocks on device work —
         every field reads host-side state, so the endpoint answers even
         while the engine is wedged."""
+        from gofr_tpu.postmortem import runtime_versions
+
         snap: dict[str, Any] = {
             "engine": self.engine.snapshot(),
             "model": self.model_name,
             "platform": self.platform,
             "device_kind": str(self.device_kind),
+            # versions ride the snapshot (and every postmortem bundle
+            # embedding it): "which jax was this wedge on" is the first
+            # question a tunnel-failure triage asks
+            "versions": runtime_versions(),
             "boot": dict(self.boot_status),
             "boot_timeline": [dict(stage) for stage in self.boot_timeline],
             "watchdog": self.watchdog.snapshot(),

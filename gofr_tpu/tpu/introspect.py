@@ -259,6 +259,7 @@ class EngineState:
         self._since = time.time()
         self._history: "deque[dict[str, Any]]" = deque(maxlen=64)
         self._logger = logger
+        self._listeners: list[Any] = []
         self._gauge = (
             metrics.gauge(
                 "gofr_tpu_engine_state",
@@ -279,6 +280,15 @@ class EngineState:
         for s in ENGINE_STATES:
             self._gauge.set(1.0 if s == state else 0.0, state=s)
 
+    def add_listener(self, fn: Any) -> None:
+        """Register ``fn(state, detail)``, called AFTER every completed
+        transition, outside the engine lock. Listeners must be quick and
+        non-blocking — the postmortem trigger, for example, hands the
+        actual bundle write to its own thread. A raising listener is
+        swallowed (observers must never wedge the state machine)."""
+        with self._lock:
+            self._listeners.append(fn)
+
     def transition(self, state: str, detail: str = "") -> None:
         if state not in ENGINE_STATES:
             raise ValueError(
@@ -297,6 +307,12 @@ class EngineState:
             # inside the lock: two racing transitions must not interleave
             # their per-state gauge writes (the metric lock is a leaf)
             self._set_gauge(state)
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(state, detail)
+            except Exception:
+                pass  # observers must never wedge the state machine
         if self._logger is not None:
             log = (
                 self._logger.warnf if state in ("degraded", "wedged", "failed")

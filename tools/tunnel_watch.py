@@ -18,6 +18,12 @@ timeout so later stages always get their chance):
 
 After every stage the log + any emitted JSON metric lines are committed
 under hw/r05/ (git retry loop: the builder may be committing too).
+Every stage also runs with POSTMORTEM_DIR pointed at hw/r05/, so a
+server that wedges mid-stage writes its black-box bundle (engine state
+history, dispatch timeline, flight records, timebase snapshots, thread
+stacks — see gofr_tpu/postmortem.py) straight into the committed
+evidence tree: the wedge explains ITSELF even when the stage is
+SIGKILLed moments later.
 """
 
 from __future__ import annotations
@@ -171,6 +177,10 @@ def main() -> int:
         return 1
 
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gofr_jax_cache")
+    # black-box bundles land directly in the committed evidence tree:
+    # every stage subprocess inherits this, and the post-stage commit
+    # sweeps hw/ — a wedged stage leaves its own forensics behind
+    os.environ.setdefault("POSTMORTEM_DIR", OUT)
 
     # hard stop for the whole agenda (epoch seconds): the driver's own
     # end-of-round bench needs the chip — a watcher still holding it past
