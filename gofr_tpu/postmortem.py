@@ -104,7 +104,10 @@ class PostmortemStore:
         logger: Any = None,
     ):
         self.container = container
-        self.directory = directory
+        # anchor NOW: bundles must land relative to where the app was
+        # constructed, not wherever the process has chdir'd to by the
+        # time a wedge (much later) triggers the write
+        self.directory = os.path.abspath(directory)
         self.keep = max(1, keep)
         self.min_interval_s = float(min_interval_s)
         self.snapshots = max(1, snapshots)
