@@ -64,6 +64,7 @@ def main() -> int:
     # the JSON artifact — and copied to BENCH_POSTMORTEM_OUT (e.g.
     # hw/rNN/) so the evidence survives the process
     pm_dir = os.environ.setdefault("POSTMORTEM_DIR", "/tmp/gofr_postmortems")
+    # gofrlint: wall-clock — compared against bundle file mtimes in _harvest_postmortems
     run_start = time.time()
     model = os.environ.get("BENCH_MODEL", "llama3-8b")
     clients = int(os.environ.get("BENCH_CLIENTS", "8"))
@@ -563,7 +564,10 @@ def _ttft_pass(fire, clients: int, n_requests: int, errors: list[str]):
             latencies.extend(local)
             failures.extend(bad)
 
-    threads = [threading.Thread(target=worker) for _ in range(clients)]
+    threads = [
+        threading.Thread(target=worker, name=f"bench-client-{i}")
+        for i in range(clients)
+    ]
     for t in threads:
         t.start()
     for t in threads:
@@ -657,7 +661,10 @@ def _warmup(fire, errors: list[str], attempts: int = 5, clients: int = 1) -> Non
             except Exception as exc:
                 failures.append(_describe_http_error(exc))
 
-        workers = [threading.Thread(target=one) for _ in range(clients)]
+        workers = [
+            threading.Thread(target=one, name=f"bench-warmup-{i}")
+            for i in range(clients)
+        ]
         for t in workers:
             t.start()
         for t in workers:
@@ -750,7 +757,10 @@ def _measure_decode(post, n_streams: int, prompt_len: int, n_tokens: int) -> flo
         except Exception as exc:
             failures.append(f"stream {i}: {_describe_http_error(exc)}")
 
-    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_streams)]
+    threads = [
+        threading.Thread(target=worker, args=(i,), name=f"bench-stream-{i}")
+        for i in range(n_streams)
+    ]
     start = time.perf_counter()
     for t in threads:
         t.start()

@@ -22,6 +22,18 @@ arms the crash/fatal-signal hooks), ``POSTMORTEM_KEEP``,
 black-box bundles; ``METRICS_MAX_SERIES`` (default 1000) caps
 per-metric label cardinality; ``METRICS_EXEMPLARS=off`` disables
 OpenMetrics histogram exemplars.
+
+Correctness-tooling keys (devtools/sanitizer.py + tests/conftest.py,
+see docs/advanced-guide/static-analysis.md): ``GOFR_SANITIZE=1`` arms
+the runtime concurrency sanitizer under tests;
+``GOFR_SANITIZE_HOLD_MS`` (default 150) is the lock hold-time warning
+threshold; ``GOFR_SANITIZE_ALL=1`` widens lock-order tracking beyond
+project-created locks; ``GOFR_SANITIZE_REPORT`` names the findings
+file.
+
+Module-level accessors :func:`get_env`, :func:`env_flag`, and
+:func:`environ_snapshot` are the ONLY sanctioned raw environment reads
+in package code (gofrlint rule GFL001).
 """
 
 from __future__ import annotations
@@ -72,6 +84,27 @@ def parse_env_file(path: str) -> dict[str, str]:
             value = value.split(" #", 1)[0].rstrip()
         out[key] = value
     return out
+
+
+def get_env(key: str, default: Optional[str] = None) -> Optional[str]:
+    """THE sanctioned raw environment read (gofrlint GFL001): package
+    code routes every env lookup through here (or a Config instance) so
+    the config surface stays auditable in one module. Entry-point
+    scripts may read the environment directly."""
+    return os.environ.get(key, default)
+
+
+def env_flag(key: str) -> bool:
+    """True when ``key`` is set to ``1`` — the framework's debug-toggle
+    idiom (``GOFR_POOL_DEBUG``, ``GOFR_SANITIZE``, ...)."""
+    return os.environ.get(key, "") == "1"
+
+
+def environ_snapshot() -> dict[str, str]:
+    """A point-in-time copy of the whole environment — for consumers
+    that must iterate it (postmortem config fingerprints, test
+    save/restore scaffolding) without scattering raw reads."""
+    return dict(os.environ)
 
 
 class EnvConfig:

@@ -102,7 +102,9 @@ class MiniMySQL:
         self._anchor = sqlite3.connect(self._uri, uri=True)  # keeps db alive
         self._closed = False
         self._threads: list[threading.Thread] = []
-        self._accept_thread = threading.Thread(target=self._accept, daemon=True)
+        self._accept_thread = threading.Thread(
+            target=self._accept, daemon=True, name="gofr-minimysql-accept"
+        )
         self._accept_thread.start()
 
     # -- lifecycle -----------------------------------------------------------
@@ -129,7 +131,10 @@ class MiniMySQL:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t = threading.Thread(
+                target=self._serve, args=(conn,), daemon=True,
+                name="gofr-minimysql-conn",
+            )
             t.start()
             self._threads.append(t)
 

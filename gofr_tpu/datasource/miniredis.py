@@ -342,7 +342,10 @@ class MiniRedis:
         self._server.store = self.store  # type: ignore[attr-defined]
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
-        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="gofr-miniredis",
+        ).start()
         return self
 
     def close(self) -> None:

@@ -1,0 +1,3 @@
+"""Developer-facing correctness tooling (never imported by the serving
+path): the runtime concurrency sanitizer lives here, the static half is
+``tools/gofrlint.py``. See docs/advanced-guide/static-analysis.md."""

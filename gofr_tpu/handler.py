@@ -332,7 +332,7 @@ def overview_admin_handler(ctx: Context) -> Any:
     container = ctx.container
     timebase = container.timebase
     out: dict[str, Any] = {
-        "ts": time.time(),
+        "ts": time.time(),  # gofrlint: wall-clock — /admin/overview response timestamp (display)
         "timebase": timebase.stats(),
         "requests_in_flight": container.telemetry.active_count(),
         "slo": container.telemetry.slo(window_s=300.0),

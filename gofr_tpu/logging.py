@@ -167,7 +167,7 @@ class Logger:
     def _write(self, level: Level, message: Any) -> None:
         stream = self._stream(level)
         terminal = self._terminal if self._terminal is not None else _is_terminal(stream)
-        now = time.time()
+        now = time.time()  # gofrlint: wall-clock — rendered log-line timestamp (presentation)
         try:
             if terminal:
                 stream.write(self._render_pretty(level, message, now))

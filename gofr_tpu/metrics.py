@@ -178,7 +178,9 @@ class _Metric:
             try:
                 self._on_drop(self.name)
             except Exception:
-                pass  # accounting must never take a request down
+                # gofrlint: disable=GFL006 — overflow-drop callback:
+                # accounting must never take a request down
+                pass
 
 
 class Counter(_Metric):
@@ -294,6 +296,7 @@ class Histogram(_Metric):
         if exemplar:
             clamped = _clamp_exemplar_labels(exemplar)
             if clamped:
+                # gofrlint: wall-clock — OpenMetrics exemplar timestamps are epoch seconds by spec
                 ex = Exemplar(clamped, float(value), time.time())
         with self._lock:
             if not self._admit(self._totals, key):

@@ -19,6 +19,8 @@ import subprocess
 import tempfile
 from typing import Optional
 
+from gofr_tpu.config import env_flag, get_env
+
 _SOURCES = ("tokenizer.cpp",)
 _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
@@ -34,8 +36,8 @@ def _source_dir() -> Optional[pathlib.Path]:
 
 
 def _cache_dir() -> pathlib.Path:
-    base = os.environ.get("GOFR_NATIVE_CACHE") or os.path.join(
-        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "gofr_tpu"
+    base = get_env("GOFR_NATIVE_CACHE") or os.path.join(
+        get_env("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "gofr_tpu"
     )
     path = pathlib.Path(base)
     path.mkdir(parents=True, exist_ok=True)
@@ -75,14 +77,14 @@ def load() -> Optional[ctypes.CDLL]:
     if _lib_tried:
         return _lib
     _lib_tried = True
-    explicit = os.environ.get("GOFR_NATIVE_LIB")
+    explicit = get_env("GOFR_NATIVE_LIB")
     if explicit:
         try:
             _lib = _bind(ctypes.CDLL(explicit))
         except OSError:
             _lib = None
         return _lib
-    if os.environ.get("GOFR_NATIVE_DISABLE"):
+    if get_env("GOFR_NATIVE_DISABLE"):
         return None
     src = _source_dir()
     if src is None:

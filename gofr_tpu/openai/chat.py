@@ -220,6 +220,7 @@ def chat_completions(ctx: Any) -> Any:
     if not prompt_ids:
         raise HTTPError(400, "messages encoded to zero tokens")
     model = adapter or ctx.tpu.model_name  # adapters serve under their name
+    # gofrlint: wall-clock — OpenAI API `created` is epoch seconds by contract
     created = int(time.time())
     chat_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
 

@@ -245,6 +245,7 @@ def completions(ctx: Any) -> Any:
         raise HTTPError(400, 'missing "prompt"')
     prompt_ids = _prompt_tokens(ctx, body["prompt"])
     model = adapter or ctx.tpu.model_name  # adapters serve under their name
+    # gofrlint: wall-clock — OpenAI API `created` is epoch seconds by contract
     created = int(time.time())
     cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
     tok = ctx.tpu.tokenizer

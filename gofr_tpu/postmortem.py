@@ -61,6 +61,7 @@ import time
 import traceback
 from typing import Any, Optional
 
+from gofr_tpu.config import environ_snapshot
 from gofr_tpu.version import __version__
 
 SCHEMA = "gofr-postmortem/1"
@@ -240,6 +241,7 @@ class PostmortemStore:
             "schema": SCHEMA,
             "reason": reason,
             "detail": detail,
+            # gofrlint: wall-clock — bundle ts (filename + correlation)
             "ts": time.time(),
             "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "pid": os.getpid(),
@@ -319,10 +321,14 @@ class PostmortemStore:
                 self.logger.errorf(fmt, *args)
                 return
             except Exception:
+                # gofrlint: disable=GFL006 — crash-path reporter: the
+                # logger itself failed, fall through to stderr
                 pass
         try:
             print("[postmortem] " + (fmt % args), file=sys.stderr)
         except Exception:
+            # gofrlint: disable=GFL006 — last-resort reporter on the
+            # crash path; nothing left to report to
             pass
 
 
@@ -343,6 +349,8 @@ def runtime_versions() -> dict[str, Any]:
 
         out["platform"] = platform.platform()
     except Exception:
+        # gofrlint: disable=GFL006 — crash-path version probe: a
+        # failure must not block the bundle
         pass
     return out
 
@@ -351,14 +359,15 @@ def _config_fingerprint() -> dict[str, Any]:
     """Framework config keys present in the environment, secrets
     redacted, plus a stable hash of the redacted view — enough to say
     "these two wedges ran the same config" without leaking credentials."""
+    environ = environ_snapshot()
     keys: dict[str, str] = {}
-    for key in sorted(os.environ):
+    for key in sorted(environ):
         if not key.startswith(CONFIG_PREFIXES):
             continue
         if key.upper().endswith(SECRET_SUFFIXES):
             keys[key] = "<redacted>"
         else:
-            keys[key] = os.environ[key]
+            keys[key] = environ[key]
     digest = hashlib.sha256(
         "\n".join(f"{k}={v}" for k, v in keys.items()).encode()
     ).hexdigest()[:16]

@@ -33,16 +33,18 @@ class Profiler:
     def start(self, log_dir: Optional[str] = None) -> dict[str, Any]:
         import jax
 
+        from gofr_tpu.config import get_env
+
         with self._lock:
             if self._dir is not None:
                 raise RuntimeError(f"profiler already tracing into {self._dir}")
-            log_dir = log_dir or os.environ.get("PROFILE_DIR") or tempfile.mkdtemp(
+            log_dir = log_dir or get_env("PROFILE_DIR") or tempfile.mkdtemp(
                 prefix="gofr-profile-"
             )
             os.makedirs(log_dir, exist_ok=True)
             jax.profiler.start_trace(log_dir)
             self._dir = log_dir
-            self._started_at = time.time()
+            self._started_at = time.monotonic()
             return {"state": "tracing", "dir": log_dir}
 
     def stop(self) -> dict[str, Any]:
@@ -55,7 +57,7 @@ class Profiler:
             # profiler must not wedge in "tracing" forever (the endpoint
             # exists to debug live processes; restarting defeats it)
             log_dir, self._dir = self._dir, None
-            elapsed = time.time() - (self._started_at or time.time())
+            elapsed = time.monotonic() - (self._started_at or time.monotonic())
             self._started_at = None
             jax.profiler.stop_trace()
         files = []
@@ -72,7 +74,7 @@ class Profiler:
                 return {"state": "idle"}
             return {
                 "state": "tracing", "dir": self._dir,
-                "seconds": round(time.time() - (self._started_at or 0), 2),
+                "seconds": round(time.monotonic() - (self._started_at or 0), 2),
             }
 
 

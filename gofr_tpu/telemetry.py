@@ -127,6 +127,7 @@ class FlightRecord:
         self.sched_defer_s = 0.0  # total interference-scheduler defer
         self.pool_reject_reason = ""  # why the decode pool refused (solo'd)
         self.dispatch_ids: list[int] = []  # device dispatches this rode
+        # gofrlint: wall-clock — /admin/requests display ts (durations use t_*)
         self.wall_start = time.time()
         self.t_start = time.perf_counter()
         self.t_enqueue: Optional[float] = None
@@ -413,7 +414,7 @@ class FlightRecorder:
         if record is None or record.t_done is not None:
             return
         record.t_done = time.perf_counter()
-        record.wall_done = time.time()
+        record.wall_done = time.time()  # gofrlint: wall-clock — /admin/requests display timestamp
         if error is not None:
             record.note_error(error)
         elif record.status == "in_flight":
@@ -427,7 +428,9 @@ class FlightRecorder:
             try:
                 self.logger.info(record.to_dict())
             except Exception:
-                pass  # telemetry must never take a request down
+                # gofrlint: disable=GFL006 — wide-event log emission:
+                # telemetry must never take a request down
+                pass
 
     def is_slow(self, record: FlightRecord) -> bool:
         duration = record.duration or 0.0
@@ -505,11 +508,13 @@ class FlightRecorder:
         and TPOT over requests completed in the last ``window_s``
         seconds, computed from the raw records (a cumulative histogram
         cannot express a rolling window and only knows bucket bounds)."""
-        horizon = time.time() - window_s
+        # monotonic window: wall-clock steps (NTP, suspend) must never
+        # grow or shrink the SLO window
+        horizon = time.perf_counter() - window_s
         with self._lock:
             recent = [
                 r for r in self._ring
-                if r.wall_done is not None and r.wall_done >= horizon
+                if r.t_done is not None and r.t_done >= horizon
             ]
         models: dict[str, Any] = {}
         for model in sorted({r.model for r in recent}):

@@ -51,6 +51,7 @@ class MockLogger(Logger):
         self.lines: list[str] = []
 
     def _write(self, level: Level, message: Any) -> None:  # type: ignore[override]
+        # gofrlint: wall-clock — rendered log-line timestamp (presentation)
         self.lines.append(self._render_json(level, message, time.time()))
 
     @property
@@ -71,14 +72,14 @@ def serving_device(**env: str):
     snapshot pairs repeatedly got wrong."""
     import os
 
-    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.config import EnvConfig, get_env
     from gofr_tpu.metrics import Registry
     from gofr_tpu.tpu.device import new_device
 
     defaults = {"MODEL_NAME": "tiny", "BATCH_MAX_SIZE": "2",
                 "BATCH_TIMEOUT_MS": "1"}
     defaults.update(env)
-    old = {k: os.environ.get(k) for k in defaults}
+    old = {k: get_env(k) for k in defaults}
     os.environ.update(defaults)
     dev = None
     try:

@@ -95,12 +95,21 @@ class TimebaseSampler:
         """Take one snapshot (and append it to the ring). Also the test
         seam: drive the ring deterministically without the thread."""
         try:
-            snapshot = {"ts": time.time(), "metrics": self.registry.collect()}
+            snapshot = {
+                # display timestamp for /admin/timeseries points and
+                # postmortem bundles; every duration/window/rate
+                # computation uses the monotonic mark next to it
+                "ts": time.time(),  # gofrlint: wall-clock — snapshot display timestamp
+                "mono": time.monotonic(),
+                "metrics": self.registry.collect(),
+            }
         except Exception as exc:  # sampling must never kill the thread
             if self.logger is not None:
                 try:
                     self.logger.errorf("timebase sample failed: %r", exc)
                 except Exception:
+                    # gofrlint: disable=GFL006 — the logger itself
+                    # failed; nothing left to report to
                     pass
             return {}
         with self._lock:
@@ -116,8 +125,10 @@ class TimebaseSampler:
         with self._lock:
             snaps = list(self._ring)
         if window is not None:
-            horizon = time.time() - window
-            snaps = [s for s in snaps if s["ts"] >= horizon]
+            # monotonic horizon: a wall-clock step (NTP, suspend) must
+            # never silently widen or empty the window
+            horizon = time.monotonic() - window
+            snaps = [s for s in snaps if s["mono"] >= horizon]
         if last is not None and last > 0:
             snaps = snaps[-last:]
         return snaps
@@ -126,7 +137,8 @@ class TimebaseSampler:
         with self._lock:
             snaps = len(self._ring)
             span = (
-                self._ring[-1]["ts"] - self._ring[0]["ts"] if snaps >= 2 else 0.0
+                self._ring[-1]["mono"] - self._ring[0]["mono"]
+                if snaps >= 2 else 0.0
             )
         return {
             "interval_s": self.interval_s,
@@ -168,7 +180,7 @@ class TimebaseSampler:
         snaps = self.snapshots(window=window)
         kind = None
         label_names: tuple = ()
-        per_key: dict[tuple, list[list[float]]] = {}
+        per_key: dict[tuple, list[tuple[float, float, float]]] = {}
         for snap in snaps:
             entry = snap["metrics"].get(metric)
             if entry is None:
@@ -179,19 +191,19 @@ class TimebaseSampler:
                 if not self._match(label_names, key, labels):
                     continue
                 per_key.setdefault(key, []).append(
-                    [snap["ts"], self._scalar(kind, value)]
+                    (snap["ts"], snap["mono"], self._scalar(kind, value))
                 )
         if kind is None:
             return None
         cumulative = kind in ("counter", "histogram")
         out = []
-        for key, points in sorted(per_key.items()):
+        for key, triples in sorted(per_key.items()):
             entry: dict[str, Any] = {
                 "labels": dict(zip(label_names, key)),
-                "points": points,
+                "points": [[ts, v] for ts, _, v in triples],
             }
             if cumulative:
-                entry["rate"] = _rate_of(points)
+                entry["rate"] = _rate_of(triples)
             out.append(entry)
         return {
             "metric": metric,
@@ -206,7 +218,7 @@ class TimebaseSampler:
         """Counter rate summed across every label-set — the "req/s"
         shape of a labeled counter. Empty list when unknown."""
         snaps = self.snapshots(window=window)
-        points: list[list[float]] = []
+        points: list[tuple[float, float, float]] = []
         for snap in snaps:
             entry = snap["metrics"].get(metric)
             if entry is None:
@@ -214,7 +226,7 @@ class TimebaseSampler:
             total = sum(
                 self._scalar(entry["kind"], v) for v in entry["series"].values()
             )
-            points.append([snap["ts"], total])
+            points.append((snap["ts"], snap["mono"], total))
         return _rate_of(points)
 
     def hist_quantile_trend(
@@ -296,13 +308,16 @@ def jsonable_snapshots(snaps: list[dict[str, Any]]) -> list[dict[str, Any]]:
     return out
 
 
-def _rate_of(points: list[list[float]]) -> list[list[float]]:
-    """Per-second rate between consecutive cumulative points. A value
-    going DOWN means the process (or a label-set) reset — clamp the
-    delta to 0 rather than emitting a giant negative spike."""
+def _rate_of(points: list[tuple[float, float, float]]) -> list[list[float]]:
+    """Per-second rate between consecutive cumulative ``(ts, mono,
+    value)`` points: dt comes from the MONOTONIC marks (a wall-clock
+    step must never inflate or negate a rate), the emitted timestamp is
+    the wall-clock one (display). A value going DOWN means the process
+    (or a label-set) reset — clamp the delta to 0 rather than emitting
+    a giant negative spike."""
     out: list[list[float]] = []
-    for (t0, v0), (t1, v1) in zip(points, points[1:]):
-        dt = t1 - t0
+    for (_, m0, v0), (t1, m1, v1) in zip(points, points[1:]):
+        dt = m1 - m0
         if dt <= 0:
             continue
         out.append([t1, max(0.0, v1 - v0) / dt])

@@ -38,7 +38,6 @@ Mechanics:
 from __future__ import annotations
 
 import contextlib
-import os
 import queue
 import threading
 from collections import deque
@@ -49,6 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gofr_tpu.config import env_flag
 from gofr_tpu.telemetry import current_record
 
 DONE = object()  # end-of-stream marker on a slot's token queue
@@ -66,7 +66,7 @@ PIPELINE_DEPTH = 3
 # GOFR_POOL_DEBUG=1: per-chunk dispatch/fetch/deliver timings on stderr —
 # the first tool to reach for when pooled tok/s diverges from the raw
 # decode-chunk capability
-_POOL_DEBUG = os.environ.get("GOFR_POOL_DEBUG", "") == "1"
+_POOL_DEBUG = env_flag("GOFR_POOL_DEBUG")
 
 
 class PoolFailure:
@@ -280,6 +280,33 @@ class DecodePool:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._closed = False
+        self._peak_bw = peak_hbm_bw
+        self._init_metrics(metrics, params, n_params, peak_flops, peak_hbm_bw)
+        # warm the [n_slots]-shaped executable NOW: the first pooled request
+        # must not compile under the pool lock on the serving path
+        toks, _, _, _, _, self._key, self.cache = self._decode(
+            self.params, self._last_tokens, self.cache,
+            self._key, jnp.asarray(self._temps),
+            jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
+            jnp.asarray(self._min_ps),
+        )
+        toks.block_until_ready()
+        # warm the finish-time row read too (prefix-cache hand-back): it
+        # must never compile on the serving path
+        self._read_slot(self.cache, 0)["lengths"].block_until_ready()
+        self.cache = self._place(init_cache(cfg, n_slots))  # reset the warmup writes
+        self._last_tokens = jnp.zeros((n_slots, 1), jnp.int32)
+        if penalties == "eager":
+            self._enable_penalties()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="gofr-decode-pool"
+        )
+        self._thread.start()
+
+    def _init_metrics(self, metrics: Any, params: Any, n_params: Any,
+                      peak_flops: Any, peak_hbm_bw: Any) -> None:
+        """Register the pool's metric instruments (None registry = all
+        instruments None; callers already guard on that)."""
         self._depth_gauge = (
             metrics.gauge("gofr_tpu_decode_slots_active", "active decode slots")
             if metrics is not None
@@ -308,7 +335,6 @@ class DecodePool:
             self._tokens_counter = metrics.counter(
                 "gofr_tpu_tokens_total", "tokens processed", labels=("model", "op")
             )
-        self._peak_bw = peak_hbm_bw
         if metrics is not None and peak_hbm_bw:
             from gofr_tpu.tpu.flops import tree_bytes
 
@@ -325,24 +351,6 @@ class DecodePool:
                 "(weights+KV bytes per step / time / peak bandwidth)",
                 labels=("model", "op"),
             )
-        # warm the [n_slots]-shaped executable NOW: the first pooled request
-        # must not compile under the pool lock on the serving path
-        toks, _, _, _, _, self._key, self.cache = self._decode(
-            self.params, self._last_tokens, self.cache,
-            self._key, jnp.asarray(self._temps),
-            jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
-            jnp.asarray(self._min_ps),
-        )
-        toks.block_until_ready()
-        # warm the finish-time row read too (prefix-cache hand-back): it
-        # must never compile on the serving path
-        self._read_slot(self.cache, 0)["lengths"].block_until_ready()
-        self.cache = self._place(init_cache(cfg, n_slots))  # reset the warmup writes
-        self._last_tokens = jnp.zeros((n_slots, 1), jnp.int32)
-        if penalties == "eager":
-            self._enable_penalties()
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
 
     # -- per-slot penalties ---------------------------------------------------
     def _enable_penalties(self) -> None:
@@ -482,7 +490,9 @@ class DecodePool:
                 self._pen_starting = False
                 raise
 
-        threading.Thread(target=build, daemon=True).start()
+        threading.Thread(
+            target=build, daemon=True, name="gofr-pool-pen-build"
+        ).start()
 
     # -- pooled multi-LoRA ----------------------------------------------------
     def enable_lora(self, stacked: dict, index: "dict[str, int]") -> None:
@@ -587,47 +597,11 @@ class DecodePool:
         off/rebuilding, the name is unknown to the bank, or a penalized
         slot is active (the chunk runs ONE executable; the mix solos)."""
         out: "queue.Queue" = queue.Queue()
-        adapter_idx = 0
         with self._work:
             if self._closed:
                 self._reject("closed", count_only=True)
                 raise RuntimeError("decode pool closed")
-            if adapter is not None:
-                if penalty is not None:
-                    self._reject(
-                        "penalized_adapter",
-                        "penalized adapter requests decode solo",
-                    )
-                if not self._lora_ready:
-                    self._reject(
-                        "bank_rebuilding", "adapter bank off or rebuilding"
-                    )
-                if self._pen_slots:
-                    self._reject(
-                        "penalized_mix",
-                        "penalized slots active (one executable per chunk)",
-                    )
-                idx = self._lora_index.get(adapter)
-                if idx is None:
-                    self._reject(
-                        "unknown_adapter",
-                        f"adapter '{adapter}' not in the pool bank",
-                    )
-                adapter_idx = idx
-            if penalty is not None and self._lora_slots:
-                self._reject(
-                    "adapter_mix",
-                    "adapter slots active (one executable per chunk)",
-                )
-            if penalty is not None and not self._pen_ready:
-                if self._pen_mode == "lazy":
-                    self._pen_kick()
-                self._reject(
-                    "penalties_off" if self._pen_mode == "off"
-                    else "penalties_warming",
-                    "penalized pool path "
-                    + ("disabled" if self._pen_mode == "off" else "warming"),
-                )
+            adapter_idx = self._admit(adapter, penalty)
             if not self._free:
                 self._reject("no_free_slots", "no free decode slots")
             slot = self._free.pop()
@@ -637,33 +611,13 @@ class DecodePool:
                                     want_lp=want_logprobs,
                                     want_top=want_top_logprobs,
                                     want_kv=want_kv, record=record)
-            if (
-                self._temps[slot.index] != sampler.temperature
-                or self._top_ks[slot.index] != sampler.top_k
-                or self._top_ps[slot.index] != sampler.top_p
-                or self._min_ps[slot.index] != sampler.min_p
-            ):
-                self._temps[slot.index] = sampler.temperature
-                self._top_ks[slot.index] = sampler.top_k
-                self._top_ps[slot.index] = sampler.top_p
-                self._min_ps[slot.index] = sampler.min_p
-                self._sampling_dirty = True
+            self._apply_sampling(slot.index, sampler)
             if adapter_idx:
                 self._lora_ids[slot.index] = adapter_idx
                 self._lora_dirty = True
                 self._lora_slots.add(slot.index)
             if penalty is not None:
-                pres_row, cnt_row, bias_row, rep, pp, fp = penalty
-                self._pres, self._cnts, self._bias = self._write_rows(
-                    self._pres, self._cnts, self._bias,
-                    pres_row, cnt_row.astype(jnp.float32),
-                    bias_row.astype(jnp.float32), slot.index,
-                )
-                self._reps[slot.index] = rep
-                self._pps[slot.index] = pp
-                self._fps[slot.index] = fp
-                self._pen_dirty = True
-                self._pen_slots.add(slot.index)
+                self._apply_penalty(slot.index, penalty)
             # cache/token writes happen under the lock: jax sequences them
             # after any in-flight chunk (their inputs are its outputs), so
             # the new request's first real decode lands in the next
@@ -681,6 +635,79 @@ class DecodePool:
                 self._depth_gauge.set(len(self._active))
             self._work.notify()
         return out
+
+    def _admit(self, adapter: Optional[str], penalty: Optional[tuple]) -> int:
+        """The submit reject gates (pool lock held): raises queue.Full
+        via ``_reject`` on any executable-mix or readiness conflict.
+        Returns the adapter's bank index (0 = base weights)."""
+        adapter_idx = 0
+        if adapter is not None:
+            if penalty is not None:
+                self._reject(
+                    "penalized_adapter",
+                    "penalized adapter requests decode solo",
+                )
+            if not self._lora_ready:
+                self._reject(
+                    "bank_rebuilding", "adapter bank off or rebuilding"
+                )
+            if self._pen_slots:
+                self._reject(
+                    "penalized_mix",
+                    "penalized slots active (one executable per chunk)",
+                )
+            idx = self._lora_index.get(adapter)
+            if idx is None:
+                self._reject(
+                    "unknown_adapter",
+                    f"adapter '{adapter}' not in the pool bank",
+                )
+            adapter_idx = idx
+        if penalty is not None and self._lora_slots:
+            self._reject(
+                "adapter_mix",
+                "adapter slots active (one executable per chunk)",
+            )
+        if penalty is not None and not self._pen_ready:
+            if self._pen_mode == "lazy":
+                self._pen_kick()
+            self._reject(
+                "penalties_off" if self._pen_mode == "off"
+                else "penalties_warming",
+                "penalized pool path "
+                + ("disabled" if self._pen_mode == "off" else "warming"),
+            )
+        return adapter_idx
+
+    def _apply_sampling(self, index: int, sampler: Any) -> None:
+        """Write the slot's sampling knobs (pool lock held); dirties the
+        device copies only when something actually changed."""
+        if (
+            self._temps[index] != sampler.temperature
+            or self._top_ks[index] != sampler.top_k
+            or self._top_ps[index] != sampler.top_p
+            or self._min_ps[index] != sampler.min_p
+        ):
+            self._temps[index] = sampler.temperature
+            self._top_ks[index] = sampler.top_k
+            self._top_ps[index] = sampler.top_p
+            self._min_ps[index] = sampler.min_p
+            self._sampling_dirty = True
+
+    def _apply_penalty(self, index: int, penalty: tuple) -> None:
+        """Write a penalized request's rows/knobs into slot state (pool
+        lock held)."""
+        pres_row, cnt_row, bias_row, rep, pp, fp = penalty
+        self._pres, self._cnts, self._bias = self._write_rows(
+            self._pres, self._cnts, self._bias,
+            pres_row, cnt_row.astype(jnp.float32),
+            bias_row.astype(jnp.float32), index,
+        )
+        self._reps[index] = rep
+        self._pps[index] = pp
+        self._fps[index] = fp
+        self._pen_dirty = True
+        self._pen_slots.add(index)
 
     def _reject(self, reason: str, msg: str = "", count_only: bool = False):
         """Account a submit rejection (counter + the caller's flight
@@ -760,166 +787,181 @@ class DecodePool:
                 # dispatch until the pipeline is full: chunk N+1's inputs
                 # are chunk N's output futures, so this never blocks
                 while self._active and len(in_flight) < self.pipeline_depth:
-                    records = [
-                        (slot.index, slot.request) for slot in self._active.values()
-                    ]
-                    if self._sampling_dirty:
-                        self._temps_dev = jnp.asarray(self._temps)
-                        self._top_ks_dev = jnp.asarray(self._top_ks)
-                        self._top_ps_dev = jnp.asarray(self._top_ps)
-                        self._min_ps_dev = jnp.asarray(self._min_ps)
-                        self._sampling_dirty = False
-                    drec = None
-                    if self._timeline is not None:
-                        # dispatch timeline: one record per chunk; every
-                        # active request's FlightRecord learns the id
-                        # (its own cap bounds the growth)
-                        drec = self._timeline.begin(
-                            "decode_chunk", batch_size=len(records),
-                        )
-                        drec.mark_running()
-                        for _, req in records:
-                            if req is not None and req.record is not None:
-                                req.record.note_dispatch_id(
-                                    drec.dispatch_id
-                                )
-                        # a dispatch-side raise before the append below
-                        # must not leak this record as running forever
-                        self._pending_chunk_drec = drec
-                    dispatch_start = _perf_counter()
-                    # ONE dispatch: RNG advance and the feed-forward token
-                    # slice happen inside the jitted chunk. The penalized
-                    # executable runs only while a penalized slot is
-                    # active — penalty-free traffic keeps the plain one
-                    if self._lora_slots:
-                        if self._lora_dirty:
-                            self._lora_ids_dev = jnp.asarray(self._lora_ids)
-                            self._lora_dirty = False
-                        self.lora_chunks += 1
-                        (toks_dev, lps_dev, tvals_dev, tids_dev,
-                         self._last_tokens, self._key,
-                         self.cache) = self._decode_lora(
-                            self._lora_params, self._lora_ids_dev,
-                            self._last_tokens, self.cache, self._key,
-                            self._temps_dev, self._top_ks_dev,
-                            self._top_ps_dev, self._min_ps_dev,
-                        )
-                    elif self._pen_slots:
-                        if self._pen_dirty:
-                            self._reps_dev = jnp.asarray(self._reps)
-                            self._pps_dev = jnp.asarray(self._pps)
-                            self._fps_dev = jnp.asarray(self._fps)
-                            self._pen_dirty = False
-                        (toks_dev, lps_dev, tvals_dev, tids_dev,
-                         self._last_tokens, self._key, self.cache,
-                         self._pres, self._cnts) = self._decode_pen(
-                            self.params, self._last_tokens, self.cache,
-                            self._key, self._temps_dev, self._top_ks_dev,
-                            self._top_ps_dev, self._min_ps_dev, self._pres,
-                            self._reps_dev, self._cnts, self._pps_dev,
-                            self._fps_dev, self._bias,
-                        )
-                    else:
-                        (toks_dev, lps_dev, tvals_dev, tids_dev,
-                         self._last_tokens, self._key,
-                         self.cache) = self._decode(
-                            self.params, self._last_tokens, self.cache, self._key,
-                            self._temps_dev, self._top_ks_dev, self._top_ps_dev,
-                            self._min_ps_dev,
-                        )
-                    # start the D2H copy NOW: the transfer begins the moment
-                    # the chunk's compute finishes, so the blocking fetch
-                    # below waits on an already-in-flight copy and the
-                    # per-chunk link round trips OVERLAP across the pipeline
-                    # instead of serializing (on a tunneled link the
-                    # serialized fetch — not compute — was the cap).
-                    # top-k alternatives cross the link only when some
-                    # active request asked for ALTERNATIVES (the
-                    # executables always compute them; fetching is the
-                    # opt-in part — plain logprobs requests stay at the
-                    # scalar-per-token fetch)
-                    want_top = any(
-                        req is not None and req.want_top for _, req in records
-                    )
-                    if not want_top:
-                        tvals_dev = tids_dev = None
-                    try:
-                        toks_dev.copy_to_host_async()
-                        lps_dev.copy_to_host_async()
-                        if want_top:
-                            tvals_dev.copy_to_host_async()
-                            tids_dev.copy_to_host_async()
-                    except (AttributeError, RuntimeError):
-                        pass  # older jax / fully-addressable-only arrays
-                    in_flight.append(
-                        (records, toks_dev, lps_dev, tvals_dev, tids_dev,
-                         dispatch_start, drec)
-                    )
-                    self._pending_chunk_drec = None  # owned by in_flight now
-                    if self._sched is not None:
-                        # decode keeps its cadence; prefill chunks take
-                        # the gaps between these notes
-                        self._sched.note_decode_chunk(len(records))
-            # fetch the OLDEST chunk outside the lock: the device is
-            # meanwhile executing the younger in-flight chunk(s), and new
-            # submissions can take the lock to join the next dispatch
-            (records, toks_dev, lps_dev, tvals_dev, tids_dev,
-             dispatch_start, drec) = in_flight.popleft()
-            fetch_start = _perf_counter()
-            # the blocking host fetch is WHERE a wedged device manifests:
-            # it runs under the stall watchdog's deadline so a hang flips
-            # the engine state instead of silently parking this worker
-            watch = (
-                self._watchdog.watch(
-                    "decode_chunk", drec.dispatch_id if drec else 0
-                )
-                if self._watchdog is not None else contextlib.nullcontext()
+                    self._dispatch_chunk(in_flight)
+            last_fetch_done = self._fetch_and_deliver(
+                in_flight, last_fetch_done
             )
-            try:
-                with watch:
-                    toks = np.asarray(toks_dev)
-                    lps = np.asarray(lps_dev)
-                    tvals = (
-                        np.asarray(tvals_dev) if tvals_dev is not None else None
-                    )
-                    tids = (
-                        np.asarray(tids_dev) if tids_dev is not None else None
-                    )
-                fetch_done = _perf_counter()
-                # throughput denominator: the interval between consecutive
-                # deliveries at steady state (dispatch->fetch spans ~2 chunk
-                # computes when the pipeline is full and would halve the MFU
-                # gauge); after an idle gap, fall back to this chunk's own
-                # span. Floor at span/depth: a host stall can make both
-                # in-flight chunks finish before the next fetch, shrinking the
-                # inter-delivery gap to ~0 and spiking the gauge past reality.
-                span = fetch_done - dispatch_start
-                dispatch_elapsed = max(
-                    fetch_done - max(dispatch_start, last_fetch_done),
-                    span / self.pipeline_depth,
-                )
-                last_fetch_done = fetch_done
-                with self._work:
-                    self._deliver(records, toks, lps, tvals, tids,
-                                  dispatch_elapsed, drec)
-            except BaseException:
-                # the chunk was already popped from in_flight: close its
-                # record here (the worker's failure path sweeps the rest)
-                if self._timeline is not None and drec is not None:
-                    self._timeline.finish(drec, status="error")
-                raise
-            if self._timeline is not None and drec is not None:
-                self._timeline.finish(drec)
-            if _POOL_DEBUG:
-                import sys
 
-                print(
-                    f"[pool] chunk active={len(records)} "
-                    f"dispatch->fetch {dispatch_elapsed*1e3:.0f}ms "
-                    f"fetch-wait {(fetch_done-fetch_start)*1e3:.0f}ms "
-                    f"deliver {(_perf_counter()-fetch_done)*1e3:.0f}ms",
-                    file=sys.stderr, flush=True,
+    def _dispatch_chunk(self, in_flight: deque) -> None:
+        """Dispatch ONE pipelined chunk (pool lock held): timeline
+        record, device dispatch through whichever executable the active
+        slot mix selects, early D2H copy kickoff, in-flight append."""
+        records = [
+            (slot.index, slot.request) for slot in self._active.values()
+        ]
+        if self._sampling_dirty:
+            self._temps_dev = jnp.asarray(self._temps)
+            self._top_ks_dev = jnp.asarray(self._top_ks)
+            self._top_ps_dev = jnp.asarray(self._top_ps)
+            self._min_ps_dev = jnp.asarray(self._min_ps)
+            self._sampling_dirty = False
+        drec = None
+        if self._timeline is not None:
+            # dispatch timeline: one record per chunk; every active
+            # request's FlightRecord learns the id (its own cap bounds
+            # the growth)
+            drec = self._timeline.begin(
+                "decode_chunk", batch_size=len(records),
+            )
+            drec.mark_running()
+            for _, req in records:
+                if req is not None and req.record is not None:
+                    req.record.note_dispatch_id(drec.dispatch_id)
+            # a dispatch-side raise before the append below must not
+            # leak this record as running forever
+            self._pending_chunk_drec = drec
+        dispatch_start = _perf_counter()
+        toks_dev, lps_dev, tvals_dev, tids_dev = self._run_executable(records)
+        # start the D2H copy NOW: the transfer begins the moment the
+        # chunk's compute finishes, so the blocking fetch later waits on
+        # an already-in-flight copy and the per-chunk link round trips
+        # OVERLAP across the pipeline instead of serializing (on a
+        # tunneled link the serialized fetch — not compute — was the
+        # cap). top-k alternatives cross the link only when some active
+        # request asked for ALTERNATIVES (the executables always compute
+        # them; fetching is the opt-in part — plain logprobs requests
+        # stay at the scalar-per-token fetch)
+        want_top = any(
+            req is not None and req.want_top for _, req in records
+        )
+        if not want_top:
+            tvals_dev = tids_dev = None
+        try:
+            toks_dev.copy_to_host_async()
+            lps_dev.copy_to_host_async()
+            if want_top:
+                tvals_dev.copy_to_host_async()
+                tids_dev.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass  # older jax / fully-addressable-only arrays
+        in_flight.append(
+            (records, toks_dev, lps_dev, tvals_dev, tids_dev,
+             dispatch_start, drec)
+        )
+        self._pending_chunk_drec = None  # owned by in_flight now
+        if self._sched is not None:
+            # decode keeps its cadence; prefill chunks take the gaps
+            # between these notes
+            self._sched.note_decode_chunk(len(records))
+
+    def _run_executable(self, records: list) -> tuple:
+        """ONE device dispatch (pool lock held): RNG advance and the
+        feed-forward token slice happen inside the jitted chunk. The
+        penalized executable runs only while a penalized slot is active
+        — penalty-free traffic keeps the plain one."""
+        if self._lora_slots:
+            if self._lora_dirty:
+                self._lora_ids_dev = jnp.asarray(self._lora_ids)
+                self._lora_dirty = False
+            self.lora_chunks += 1
+            (toks_dev, lps_dev, tvals_dev, tids_dev,
+             self._last_tokens, self._key,
+             self.cache) = self._decode_lora(
+                self._lora_params, self._lora_ids_dev,
+                self._last_tokens, self.cache, self._key,
+                self._temps_dev, self._top_ks_dev,
+                self._top_ps_dev, self._min_ps_dev,
+            )
+        elif self._pen_slots:
+            if self._pen_dirty:
+                self._reps_dev = jnp.asarray(self._reps)
+                self._pps_dev = jnp.asarray(self._pps)
+                self._fps_dev = jnp.asarray(self._fps)
+                self._pen_dirty = False
+            (toks_dev, lps_dev, tvals_dev, tids_dev,
+             self._last_tokens, self._key, self.cache,
+             self._pres, self._cnts) = self._decode_pen(
+                self.params, self._last_tokens, self.cache,
+                self._key, self._temps_dev, self._top_ks_dev,
+                self._top_ps_dev, self._min_ps_dev, self._pres,
+                self._reps_dev, self._cnts, self._pps_dev,
+                self._fps_dev, self._bias,
+            )
+        else:
+            (toks_dev, lps_dev, tvals_dev, tids_dev,
+             self._last_tokens, self._key,
+             self.cache) = self._decode(
+                self.params, self._last_tokens, self.cache, self._key,
+                self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                self._min_ps_dev,
+            )
+        return toks_dev, lps_dev, tvals_dev, tids_dev
+
+    def _fetch_and_deliver(
+        self, in_flight: deque, last_fetch_done: float
+    ) -> float:
+        """Fetch the OLDEST chunk outside the lock (the device is
+        meanwhile executing the younger in-flight chunk(s), and new
+        submissions can take the lock to join the next dispatch), then
+        deliver its tokens. Returns the fetch-completion mark the next
+        call uses as its throughput-denominator anchor."""
+        (records, toks_dev, lps_dev, tvals_dev, tids_dev,
+         dispatch_start, drec) = in_flight.popleft()
+        fetch_start = _perf_counter()
+        # the blocking host fetch is WHERE a wedged device manifests:
+        # it runs under the stall watchdog's deadline so a hang flips
+        # the engine state instead of silently parking this worker
+        watch = (
+            self._watchdog.watch(
+                "decode_chunk", drec.dispatch_id if drec else 0
+            )
+            if self._watchdog is not None else contextlib.nullcontext()
+        )
+        try:
+            with watch:
+                toks = np.asarray(toks_dev)
+                lps = np.asarray(lps_dev)
+                tvals = (
+                    np.asarray(tvals_dev) if tvals_dev is not None else None
                 )
+                tids = (
+                    np.asarray(tids_dev) if tids_dev is not None else None
+                )
+            fetch_done = _perf_counter()
+            # throughput denominator: the interval between consecutive
+            # deliveries at steady state (dispatch->fetch spans ~2 chunk
+            # computes when the pipeline is full and would halve the MFU
+            # gauge); after an idle gap, fall back to this chunk's own
+            # span. Floor at span/depth: a host stall can make both
+            # in-flight chunks finish before the next fetch, shrinking the
+            # inter-delivery gap to ~0 and spiking the gauge past reality.
+            span = fetch_done - dispatch_start
+            dispatch_elapsed = max(
+                fetch_done - max(dispatch_start, last_fetch_done),
+                span / self.pipeline_depth,
+            )
+            with self._work:
+                self._deliver(records, toks, lps, tvals, tids,
+                              dispatch_elapsed, drec)
+        except BaseException:
+            # the chunk was already popped from in_flight: close its
+            # record here (the worker's failure path sweeps the rest)
+            if self._timeline is not None and drec is not None:
+                self._timeline.finish(drec, status="error")
+            raise
+        if self._timeline is not None and drec is not None:
+            self._timeline.finish(drec)
+        if _POOL_DEBUG:
+            import sys
+
+            print(
+                f"[pool] chunk active={len(records)} "
+                f"dispatch->fetch {dispatch_elapsed*1e3:.0f}ms "
+                f"fetch-wait {(fetch_done-fetch_start)*1e3:.0f}ms "
+                f"deliver {(_perf_counter()-fetch_done)*1e3:.0f}ms",
+                file=sys.stderr, flush=True,
+            )
+        return fetch_done
 
     def _deliver(self, records: list, toks: np.ndarray, lps: np.ndarray,
                  tvals: Any, tids: Any, elapsed: float,
@@ -928,34 +970,48 @@ class DecodePool:
         for index, req in records:
             if req is None or req.finished:
                 continue  # freed mid-pipeline; this chunk's row is garbage
-            emitted = toks[index]
-            emitted_lps = lps[index]
-            room = self.max_len - req.cache_len  # valid steps this chunk
-            req.cache_len += self.chunk
-            take = min(self.chunk, req.remaining, max(room, 0))
-            cancelled = req.stop is not None and req.stop.is_set()
-            hit_stop_token = False
-            if not cancelled and req.out_queue is not None:
-                burst, hit_stop_token = self._build_burst(
-                    req, index, emitted, emitted_lps, tvals, tids, take
-                )
-                if burst:
-                    req.out_queue.put(burst)
-                    delivered += len(burst)  # only tokens a request received
-            req.remaining -= take
-            if (
-                cancelled
-                or hit_stop_token
-                or req.remaining <= 0
-                or req.cache_len >= self.max_len
-            ):
-                self._finish_request(index, req, cancelled)
+            delivered += self._deliver_one(index, req, toks, lps, tvals, tids)
         if self._sched is not None and not self._active:
             self._sched.note_decode_idle()  # release any waiting prefill
         if self._depth_gauge:
             self._depth_gauge.set(len(self._active))
         if drec is not None:
             drec.tokens = delivered
+        self._account_chunk(delivered, elapsed, drec)
+
+    def _deliver_one(self, index: int, req: "_Request", toks: np.ndarray,
+                     lps: np.ndarray, tvals: Any, tids: Any) -> int:
+        """Deliver one request's share of a fetched chunk (pool lock
+        held): burst put, bookkeeping, terminal finish when the request
+        cancelled, hit a stop token, or ran out of budget/cache.
+        Returns the tokens actually put on the request's queue."""
+        room = self.max_len - req.cache_len  # valid steps this chunk
+        req.cache_len += self.chunk
+        take = min(self.chunk, req.remaining, max(room, 0))
+        cancelled = req.stop is not None and req.stop.is_set()
+        hit_stop_token = False
+        delivered = 0
+        if not cancelled and req.out_queue is not None:
+            burst, hit_stop_token = self._build_burst(
+                req, index, toks[index], lps[index], tvals, tids, take
+            )
+            if burst:
+                req.out_queue.put(burst)
+                delivered = len(burst)  # only tokens a request received
+        req.remaining -= take
+        if (
+            cancelled
+            or hit_stop_token
+            or req.remaining <= 0
+            or req.cache_len >= self.max_len
+        ):
+            self._finish_request(index, req, cancelled)
+        return delivered
+
+    def _account_chunk(self, delivered: int, elapsed: float,
+                       drec: Any) -> None:
+        """Roofline accounting for one delivered chunk (pool lock
+        held): MFU/MBU gauges, token counter, dispatch-record stamps."""
         if self._mfu_gauge is not None and delivered:
             from gofr_tpu.tpu.flops import mfu
 
@@ -981,7 +1037,6 @@ class DecodePool:
             self._mbu_gauge.set(value, model=self._model, op="decode")
             if drec is not None:
                 drec.mbu = value
-
 
     def _build_burst(
         self, req: "_Request", index: int, emitted: Any, emitted_lps: Any,
@@ -1044,46 +1099,51 @@ class DecodePool:
             slot.request = None
             del self._active[index]
             self._free.append(slot)
-            # reset the slot's sampling knobs to greedy: one past
-            # sampled request must not keep jnp.all(temps <= 0)
-            # false forever and defeat the all-greedy fast path in
-            # sample_logits_rows (a full-vocab sort per step)
-            if (
-                self._temps[index] != 0.0
-                or self._top_ks[index] != 0
-                or self._top_ps[index] != 1.0
-                or self._min_ps[index] != 0.0
-            ):
-                self._temps[index] = 0.0
-                self._top_ks[index] = 0
-                self._top_ps[index] = 1.0
-                self._min_ps[index] = 0.0
-                self._sampling_dirty = True
-            if index in self._lora_slots:
-                # the freed slot must stop selecting the adapter:
-                # a plain request reusing it under the adapter
-                # executable gathers bank entry 0 (exact zero
-                # delta = base numerics)
-                self._lora_slots.discard(index)
-                self._lora_ids[index] = 0
-                self._lora_dirty = True
-                if self._lora_pending and not self._lora_slots:
-                    # a bank rebuild waited for these slots
-                    self._install_lora(*self._lora_pending)
-            if index in self._pen_slots:
-                # identity knobs: a plain request reusing the slot
-                # under the penalized executable must sample
-                # exactly like the plain one. Presence/counts need
-                # no reset — identity knobs neutralize them (and
-                # lockstep garbage decode re-dirties them anyway);
-                # the bias row is written only at submit and
-                # applied unconditionally, so IT must be zeroed.
-                self._pen_slots.discard(index)
-                self._reps[index] = 1.0
-                self._pps[index] = 0.0
-                self._fps[index] = 0.0
-                self._pen_dirty = True
-                self._bias = self._zero_bias(self._bias, index)
+            self._reset_slot(index)
+
+    def _reset_slot(self, index: int) -> None:
+        """Reset a freed slot's per-slot state (pool lock held):
+        sampling knobs, adapter id, penalty knobs + bias row."""
+        # reset the slot's sampling knobs to greedy: one past
+        # sampled request must not keep jnp.all(temps <= 0)
+        # false forever and defeat the all-greedy fast path in
+        # sample_logits_rows (a full-vocab sort per step)
+        if (
+            self._temps[index] != 0.0
+            or self._top_ks[index] != 0
+            or self._top_ps[index] != 1.0
+            or self._min_ps[index] != 0.0
+        ):
+            self._temps[index] = 0.0
+            self._top_ks[index] = 0
+            self._top_ps[index] = 1.0
+            self._min_ps[index] = 0.0
+            self._sampling_dirty = True
+        if index in self._lora_slots:
+            # the freed slot must stop selecting the adapter:
+            # a plain request reusing it under the adapter
+            # executable gathers bank entry 0 (exact zero
+            # delta = base numerics)
+            self._lora_slots.discard(index)
+            self._lora_ids[index] = 0
+            self._lora_dirty = True
+            if self._lora_pending and not self._lora_slots:
+                # a bank rebuild waited for these slots
+                self._install_lora(*self._lora_pending)
+        if index in self._pen_slots:
+            # identity knobs: a plain request reusing the slot
+            # under the penalized executable must sample
+            # exactly like the plain one. Presence/counts need
+            # no reset — identity knobs neutralize them (and
+            # lockstep garbage decode re-dirties them anyway);
+            # the bias row is written only at submit and
+            # applied unconditionally, so IT must be zeroed.
+            self._pen_slots.discard(index)
+            self._reps[index] = 1.0
+            self._pps[index] = 0.0
+            self._fps[index] = 0.0
+            self._pen_dirty = True
+            self._bias = self._zero_bias(self._bias, index)
 
     def occupancy(self) -> dict:
         """Point-in-time slot occupancy for ``GET /admin/engine``."""

@@ -113,6 +113,7 @@ class DispatchRecord:
         self.tokens = tokens
         self.detail = detail
         self.status = "running"
+        # gofrlint: wall-clock — /admin/dispatches display ts (durations use t_*)
         self.wall_start = time.time()
         now = time.perf_counter()
         self.t_queued = queued_at if queued_at is not None else now
@@ -256,6 +257,7 @@ class EngineState:
         self._lock = threading.Lock()
         self.state = "booting"
         self._detail = ""
+        # gofrlint: wall-clock — /admin/engine "since"/history ts (display)
         self._since = time.time()
         self._history: "deque[dict[str, Any]]" = deque(maxlen=64)
         self._logger = logger
@@ -300,6 +302,7 @@ class EngineState:
                 return
             self.state = state
             self._detail = detail
+            # gofrlint: wall-clock — /admin/engine "since"/history ts (display)
             self._since = time.time()
             self._history.append(
                 {"state": state, "ts": self._since, "detail": detail}
@@ -311,8 +314,14 @@ class EngineState:
         for fn in listeners:
             try:
                 fn(state, detail)
-            except Exception:
-                pass  # observers must never wedge the state machine
+            except Exception as exc:
+                # observers must never wedge the state machine — but a
+                # broken one must be visible, not silently dropped
+                if self._logger is not None:
+                    self._logger.warnf(
+                        "engine state listener failed on -> %s: %r",
+                        state, exc,
+                    )
         if self._logger is not None:
             log = (
                 self._logger.warnf if state in ("degraded", "wedged", "failed")

@@ -128,6 +128,7 @@ class ZipkinExporter:
         self.max_batch = max_batch
         self.flush_interval = flush_interval
         self._queue: "queue.Queue[Optional[Span]]" = queue.Queue(maxsize=max_queue)
+        self.post_failures = 0  # rejected/unreachable collector posts
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="gofr-zipkin", daemon=True)
         self._thread.start()
@@ -186,7 +187,9 @@ class ZipkinExporter:
         try:
             urllib.request.urlopen(req, timeout=2.0).close()
         except Exception:
-            pass  # tracing must never take the app down
+            # tracing must never take the app down — but a dead
+            # collector should be diagnosable, so count the failures
+            self.post_failures += 1
 
 
 class Tracer:

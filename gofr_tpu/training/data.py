@@ -154,7 +154,9 @@ def prefetch_to_device(
                 except queue.Full:
                     continue
 
-    thread = threading.Thread(target=run, daemon=True)
+    thread = threading.Thread(
+        target=run, daemon=True, name="gofr-data-prefetch"
+    )
     thread.start()
     try:
         while True:

@@ -23,9 +23,78 @@ jax.config.update("jax_platforms", "cpu")
 # convention); numeric tests need exact f32 accumulation
 jax.config.update("jax_default_matmul_precision", "highest")
 
+import json
 import socket
+import threading
 
 import pytest
+
+from gofr_tpu.devtools import sanitizer as _sanitizer
+
+# GOFR_SANITIZE=1: rebind threading.Lock/RLock to the instrumented
+# wrappers BEFORE any engine object builds its locks — the whole suite
+# then runs under lock-order cycle detection, hold-time tracking, and
+# the per-test thread-leak check below (CI runs this as the `sanitize`
+# tier-1 variant, serial so the graph sees real interleavings).
+if _sanitizer.enabled():
+    _sanitizer.install()
+    # fresh report per session: the per-test writes below append, so a
+    # leftover file would misattribute a previous run's findings
+    try:
+        os.unlink(os.environ.get("GOFR_SANITIZE_REPORT",
+                                 "sanitizer-report.jsonl"))
+    except OSError:
+        pass
+
+
+def _format_finding(v: dict) -> str:
+    lines = [v.get("summary") or v.get("kind", "finding")]
+    for key in ("this_edge", "reverse_edge"):
+        edge = v.get(key)
+        if edge:
+            lines.append(f"  {key}: {edge['from']} -> {edge['to']} "
+                         f"on thread {edge['thread']}")
+            lines.extend(f"    {frame}" for frame in edge["acquire_stack"][:6])
+    return "\n".join(lines)
+
+
+@pytest.fixture(autouse=True)
+def gofr_sanitize(request):
+    """Per-test concurrency verdict under GOFR_SANITIZE=1: fail the
+    test that recorded a lock-order cycle or leaked an unjoined
+    non-daemon thread (allowlisted singletons exempt). Findings also
+    land in GOFR_SANITIZE_REPORT (default sanitizer-report.jsonl) so CI
+    can upload them as an artifact."""
+    if not _sanitizer.enabled():
+        yield
+        return
+    before = set(threading.enumerate())
+    yield
+    leaked = _sanitizer.leaked_threads(before)
+    report = _sanitizer.drain()
+    problems = [_format_finding(v) for v in report["violations"]]
+    if leaked:
+        problems.append(
+            "leaked non-daemon thread(s): "
+            + ", ".join(sorted(t.name for t in leaked))
+            + " — join them in close()/shutdown() or daemonize"
+        )
+    if problems or report["hold_warnings"]:
+        path = os.environ.get("GOFR_SANITIZE_REPORT", "sanitizer-report.jsonl")
+        try:
+            with open(path, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps({
+                    "test": request.node.nodeid,
+                    "violations": report["violations"],
+                    "hold_warnings": report["hold_warnings"],
+                    "leaked_threads": sorted(t.name for t in leaked),
+                }) + "\n")
+        except OSError:
+            pass
+    if problems:
+        pytest.fail(
+            "concurrency sanitizer:\n" + "\n".join(problems), pytrace=False
+        )
 
 
 @pytest.fixture

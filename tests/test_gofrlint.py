@@ -1,0 +1,298 @@
+"""gofrlint's own test suite: positive/negative fixture snippets per
+rule, suppression comments, the JSON output schema — and the tree gate
+itself (the whole package + tools must lint clean, same contract as
+``ruff check .``)."""
+
+import importlib.util
+import io
+import json
+import pathlib
+import sys
+from contextlib import redirect_stdout
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+_spec = importlib.util.spec_from_file_location(
+    "gofrlint", REPO / "tools" / "gofrlint.py"
+)
+gofrlint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(gofrlint)
+
+
+def lint(source: str, rel: str = "gofr_tpu/somemod.py") -> list:
+    """Lint a snippet as though it lived at ``rel`` (path scoping —
+    package vs script vs engine module — is part of the rules)."""
+    return gofrlint.FileLinter(pathlib.Path(rel), rel, source).run()
+
+
+def rules_of(violations) -> list:
+    return [v.rule for v in violations]
+
+
+# -- GFL001: env discipline ---------------------------------------------------
+
+def test_gfl001_flags_raw_reads_in_package_code():
+    assert rules_of(lint('import os\nx = os.environ.get("K")\n')) == ["GFL001"]
+    assert rules_of(lint('import os\nx = os.getenv("K")\n')) == ["GFL001"]
+    assert rules_of(lint('import os\nx = os.environ["K"]\n')) == ["GFL001"]
+    assert rules_of(lint(
+        "import os\nfor k in sorted(os.environ):\n    pass\n"
+    )) == ["GFL001"]
+
+
+def test_gfl001_allows_writes_scripts_and_config():
+    assert lint('import os\nos.environ["K"] = "1"\n') == []
+    assert lint('import os\nos.environ.setdefault("K", "1")\n') == []
+    assert lint('import os\nos.environ.pop("K", None)\n') == []
+    assert lint('import os\nos.environ.update({"K": "1"})\n') == []
+    # entry-point scripts configure the process env before boot
+    assert lint('import os\nx = os.environ.get("K")\n', rel="tools/x.py") == []
+    assert lint('import os\nx = os.getenv("K")\n', rel="bench.py") == []
+    # config.py IS the sanctioned reader
+    assert lint(
+        'import os\nx = os.environ.get("K")\n', rel="gofr_tpu/config.py"
+    ) == []
+
+
+def test_gfl001_suppression_comment():
+    src = 'import os\nx = os.environ.get("K")  # gofrlint: disable=GFL001 — bootstrap\n'
+    assert lint(src) == []
+
+
+# -- GFL002: timestamp discipline ---------------------------------------------
+
+def test_gfl002_flags_unannotated_time_time():
+    assert rules_of(lint("import time\nt = time.time()\n")) == ["GFL002"]
+    # scripts are not exempt — durations there drift the same way
+    assert rules_of(
+        lint("import time\nt = time.time()\n", rel="tools/x.py")
+    ) == ["GFL002"]
+
+
+def test_gfl002_monotonic_and_annotated_sites_pass():
+    assert lint("import time\nt = time.monotonic()\n") == []
+    assert lint("import time\nt = time.perf_counter()\n") == []
+    assert lint(
+        "import time\nt = time.time()  # gofrlint: wall-clock — log ts\n"
+    ) == []
+    # the annotation may ride a comment-only line directly above
+    assert lint(
+        "import time\n# gofrlint: wall-clock — api field\nt = time.time()\n"
+    ) == []
+
+
+# -- GFL003: thread hygiene ---------------------------------------------------
+
+def test_gfl003_unnamed_or_unjoined_threads():
+    src = "import threading\nthreading.Thread(target=print).start()\n"
+    assert rules_of(lint(src)) == ["GFL003", "GFL003"]  # unnamed AND unjoined
+    named_daemon = (
+        "import threading\n"
+        'threading.Thread(target=print, name="t", daemon=True).start()\n'
+    )
+    assert lint(named_daemon) == []
+    named_joined = (
+        "import threading\n"
+        't = threading.Thread(target=print, name="t")\n'
+        "t.start()\nt.join()\n"
+    )
+    assert lint(named_joined) == []
+
+
+def test_gfl003_str_and_path_join_do_not_count_as_thread_joins():
+    src = (
+        "import threading, os\n"
+        't = threading.Thread(target=print, name="t")\n'
+        'x = ",".join(["a"])\ny = os.path.join("a", "b")\n'
+    )
+    assert rules_of(lint(src)) == ["GFL003"]  # still unjoined
+
+
+# -- GFL004: no blocking under a lock -----------------------------------------
+
+def test_gfl004_sleep_and_timeoutless_queue_get_under_lock():
+    src = (
+        "import threading, time\n"
+        "class C:\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(1)\n"
+    )
+    assert rules_of(lint(src)) == ["GFL004"]
+    src_q = (
+        "class C:\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            item = self.queue.get()\n"
+    )
+    assert rules_of(lint(src_q)) == ["GFL004"]
+
+
+def test_gfl004_allows_timeouts_condition_wait_and_unlocked_calls():
+    ok = (
+        "import time\n"
+        "class C:\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            x = self.queue.get(timeout=1)\n"
+        "            self._work.wait()\n"  # Condition releases its lock
+        "        time.sleep(1)\n"  # outside the critical section
+    )
+    assert lint(ok) == []
+
+
+def test_gfl004_acquire_release_tracking():
+    src = (
+        "import time\n"
+        "def f(lock):\n"
+        "    lock.acquire()\n"
+        "    time.sleep(1)\n"
+        "    lock.release()\n"
+        "    time.sleep(1)\n"
+    )
+    assert rules_of(lint(src)) == ["GFL004"]  # only the held sleep
+
+
+def test_gfl004_thread_join_under_lock():
+    src = (
+        "class C:\n"
+        "    def close(self):\n"
+        "        with self._lock:\n"
+        "            self._thread.join()\n"
+    )
+    assert rules_of(lint(src)) == ["GFL004"]
+
+
+# -- GFL005: metric naming ----------------------------------------------------
+
+def test_gfl005_convention_enforced_statically():
+    bad = 'm.counter("gofr_tpu_requests", "r")\n'
+    assert rules_of(lint(bad)) == ["GFL005"]
+    assert rules_of(lint('m.histogram("gofr_tpu_latency", "l")\n')) == ["GFL005"]
+    assert rules_of(lint('m.gauge("gofr_tpu_stuff", "s")\n')) == ["GFL005"]
+    assert rules_of(lint('m.counter("tpu_x_total", "x")\n')) == ["GFL005"]
+    assert lint('m.counter("gofr_tpu_requests_total", "r")\n') == []
+    assert lint('m.histogram("gofr_tpu_latency_seconds", "l")\n') == []
+    assert lint('m.gauge("gofr_tpu_mfu", "roofline")\n') == []  # allowlist
+    # dynamically composed names are the runtime test's job, not ours
+    assert lint("m.counter(name, 'x')\n") == []
+
+
+# -- GFL006: swallowed exceptions ---------------------------------------------
+
+def test_gfl006_bare_except_everywhere():
+    src = "try:\n    x = 1\nexcept:\n    pass\n"
+    assert rules_of(lint(src, rel="tools/x.py")) == ["GFL006"]
+
+
+def test_gfl006_broad_swallow_only_in_engine_paths():
+    src = "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    assert rules_of(lint(src, rel="gofr_tpu/tpu/x.py")) == ["GFL006"]
+    assert rules_of(lint(src, rel="gofr_tpu/timebase.py")) == ["GFL006"]
+    assert lint(src, rel="gofr_tpu/handler.py") == []  # request path
+    narrow = "try:\n    x = 1\nexcept ValueError:\n    pass\n"
+    assert lint(narrow, rel="gofr_tpu/tpu/x.py") == []
+    handled = (
+        "try:\n    x = 1\nexcept Exception as exc:\n    log(exc)\n"
+    )
+    assert lint(handled, rel="gofr_tpu/tpu/x.py") == []
+
+
+def test_gfl006_suppression_sits_on_the_pass_line():
+    src = (
+        "try:\n    x = 1\nexcept Exception:\n"
+        "    pass  # gofrlint: disable=GFL006 — last-resort guard\n"
+    )
+    assert lint(src, rel="gofr_tpu/tpu/x.py") == []
+
+
+# -- suppression / annotation robustness --------------------------------------
+
+def test_directives_inside_strings_are_ignored():
+    src = 'x = "# gofrlint: disable=GFL002"\nimport time\nt = time.time()\n'
+    assert rules_of(lint(src)) == ["GFL002"]
+
+
+def test_directive_cascades_through_comment_blocks():
+    src = (
+        "try:\n    x = 1\nexcept Exception:\n"
+        "    # gofrlint: disable=GFL006 — reason line one\n"
+        "    # ...reason continued on a second line\n"
+        "    pass\n"
+    )
+    assert lint(src, rel="gofr_tpu/tpu/x.py") == []
+
+
+def test_multi_rule_suppression():
+    src = (
+        "import os, time\n"
+        't = time.time(); x = os.getenv("K")'
+        "  # gofrlint: disable=GFL001,GFL002 — fixture\n"
+    )
+    assert lint(src) == []
+
+
+# -- output formats / CLI -----------------------------------------------------
+
+def test_json_output_schema(tmp_path):
+    bad = tmp_path / "gofr_tpu" / "mod.py"
+    bad.parent.mkdir()
+    bad.write_text('import os\nx = os.getenv("K")\nimport time\nt = time.time()\n')
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = gofrlint.main(["--format=json", str(tmp_path)])
+    assert rc == 1
+    out = json.loads(buf.getvalue())
+    assert out["version"] == 1
+    assert out["files_scanned"] == 1
+    assert out["counts_by_rule"] == {"GFL001": 1, "GFL002": 1}
+    for v in out["violations"]:
+        assert set(v) == {"file", "line", "col", "rule", "message"}
+        assert v["rule"] in gofrlint.RULES
+
+
+def test_clean_tree_exits_zero(tmp_path):
+    good = tmp_path / "ok.py"
+    good.write_text("import time\nt = time.monotonic()\n")
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = gofrlint.main([str(tmp_path)])
+    assert rc == 0
+    assert "clean" in buf.getvalue()
+
+
+def test_syntax_error_is_reported_not_crashed(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    violations, scanned = gofrlint.lint_paths([str(tmp_path)])
+    assert scanned == 1
+    assert [v.rule for v in violations] == ["GFL000"]
+
+
+# -- the tree gate ------------------------------------------------------------
+
+def test_the_real_tree_is_clean():
+    """The acceptance contract, runnable as a test: the package, tools,
+    and bench.py carry zero unsuppressed violations. Same "only
+    shrinks" policy as the ruff debt ledger — fix new violations or
+    suppress them IN-FILE with a reason."""
+    violations, scanned = gofrlint.lint_paths([
+        str(REPO / "gofr_tpu"), str(REPO / "tools"), str(REPO / "bench.py")
+    ])
+    assert scanned > 50
+    assert violations == [], "\n".join(
+        f"{v.path}:{v.line}: {v.rule} {v.message}" for v in violations
+    )
+
+
+def test_cli_entrypoint_runs(tmp_path):
+    """``python tools/gofrlint.py`` stays invocable as a script (the CI
+    lint job calls it exactly that way)."""
+    import subprocess
+
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "gofrlint.py"), str(ok)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -20,7 +20,18 @@ def _req(method="GET", target="/", headers=None, body=b""):
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    # full teardown, not just run_until_complete: responder streams pull
+    # sync iterators through the loop's default executor, and a loop
+    # abandoned without shutdown_default_executor() leaks its non-daemon
+    # "asyncio_N" worker until interpreter exit (found by the
+    # GOFR_SANITIZE=1 thread-leak check)
+    loop = asyncio.new_event_loop()
+    try:
+        result = loop.run_until_complete(coro)
+        loop.run_until_complete(loop.shutdown_default_executor())
+        return result
+    finally:
+        loop.close()
 
 
 def test_path_params_and_methods():

@@ -1,0 +1,245 @@
+"""Unit tests for the runtime concurrency sanitizer
+(gofr_tpu/devtools/sanitizer.py): lock-order cycle detection with both
+stacks, reentrancy, Condition compatibility, hold-time warnings,
+install/uninstall, and the thread-leak detector + allowlist.
+
+These run in the PLAIN tier-1 suite (no GOFR_SANITIZE needed): the
+wrappers are constructed directly. The conftest fixture wires the same
+machinery across the whole suite when GOFR_SANITIZE=1."""
+
+import threading
+import time
+
+import pytest
+
+from gofr_tpu.devtools import sanitizer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sanitizer_state():
+    """Deliberate violations below must never leak into the suite-wide
+    GOFR_SANITIZE verdict (this teardown runs before the conftest
+    fixture's drain)."""
+    sanitizer.reset()
+    yield
+    sanitizer.reset()
+
+
+# -- lock-order graph ---------------------------------------------------------
+
+def test_opposite_order_acquisition_is_a_potential_deadlock():
+    a = sanitizer.sanitized_lock("lockA")
+    b = sanitizer.sanitized_lock("lockB")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    report = sanitizer.drain()
+    assert len(report["violations"]) == 1
+    v = report["violations"][0]
+    assert v["kind"] == "lock-order-cycle"
+    assert "lockA" in v["summary"] and "lockB" in v["summary"]
+    # both acquisition stacks ride the report
+    assert v["this_edge"]["acquire_stack"]
+    assert v["reverse_edge"]["acquire_stack"]
+    assert any("test_sanitizer" in f for f in v["this_edge"]["acquire_stack"])
+
+
+def test_consistent_order_is_clean():
+    a = sanitizer.sanitized_lock("A")
+    b = sanitizer.sanitized_lock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert sanitizer.drain()["violations"] == []
+
+
+def test_indirect_cycle_through_a_third_lock():
+    a = sanitizer.sanitized_lock("A")
+    b = sanitizer.sanitized_lock("B")
+    c = sanitizer.sanitized_lock("C")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:
+            pass  # closes A -> B -> C -> A
+    report = sanitizer.drain()
+    assert len(report["violations"]) == 1
+    assert report["violations"][0]["reverse_edge"] is None  # indirect
+
+
+def test_cross_thread_opposite_order_is_detected():
+    a = sanitizer.sanitized_lock("A")
+    b = sanitizer.sanitized_lock("B")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=forward, name="san-forward")
+    t.start()
+    t.join()
+    with b:
+        with a:
+            pass
+    assert sanitizer.drain()["violations"], (
+        "edge recorded on one thread must trip the cycle check on another"
+    )
+
+
+def test_rlock_reentrancy_adds_no_edges():
+    r = sanitizer.sanitized_rlock("R")
+    with r:
+        with r:
+            with r:
+                pass
+    report = sanitizer.drain()
+    assert report["violations"] == []
+    assert report["edges"] == 0
+
+
+def test_drain_clears_violations_but_keeps_the_graph():
+    a = sanitizer.sanitized_lock("A")
+    b = sanitizer.sanitized_lock("B")
+    with a:
+        with b:
+            pass
+    assert sanitizer.drain()["edges"] == 1
+    with b:
+        with a:
+            pass  # the edge from before drain still closes the cycle
+    report = sanitizer.drain()
+    assert len(report["violations"]) == 1
+
+
+# -- Condition compatibility --------------------------------------------------
+
+@pytest.mark.parametrize("factory", [
+    sanitizer.sanitized_lock, sanitizer.sanitized_rlock,
+])
+def test_condition_wait_notify_on_sanitized_locks(factory):
+    cond = threading.Condition(factory("condlock"))
+    hits = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter, name="san-cond-wait")
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        cond.notify()
+    t.join(timeout=5)
+    assert hits == [1]
+    assert sanitizer.drain()["violations"] == []
+
+
+# -- hold-time tracking -------------------------------------------------------
+
+def test_long_hold_records_a_warning(monkeypatch):
+    monkeypatch.setenv("GOFR_SANITIZE_HOLD_MS", "20")
+    lock = sanitizer.sanitized_lock("slow-lock")
+    with lock:
+        time.sleep(0.05)
+    warnings = sanitizer.drain()["hold_warnings"]
+    assert any(w["lock"] == "slow-lock" for w in warnings)
+    w = next(w for w in warnings if w["lock"] == "slow-lock")
+    assert w["seconds"] >= 0.02 and w["stack"]
+
+
+def test_fast_hold_is_silent(monkeypatch):
+    monkeypatch.setenv("GOFR_SANITIZE_HOLD_MS", "500")
+    lock = sanitizer.sanitized_lock("fast-lock")
+    with lock:
+        pass
+    assert sanitizer.drain()["hold_warnings"] == []
+
+
+# -- install / uninstall ------------------------------------------------------
+
+def test_install_rebinds_threading_lock_factories():
+    was_installed = sanitizer.installed()
+    try:
+        sanitizer.install()
+        lk = threading.Lock()
+        rlk = threading.RLock()
+        assert isinstance(lk, sanitizer.SanitizedLock)
+        assert isinstance(rlk, sanitizer.SanitizedRLock)
+        with lk:
+            pass
+        with rlk:
+            with rlk:
+                pass
+        # creation label points at THIS file (project-scoped tracking)
+        assert "test_sanitizer" in lk._label
+        sanitizer.uninstall()
+        assert not isinstance(threading.Lock(), sanitizer.SanitizedLock)
+    finally:
+        # the suite may be running under GOFR_SANITIZE=1: leave the
+        # patch state exactly as found
+        if was_installed:
+            sanitizer.install()
+        else:
+            sanitizer.uninstall()
+    sanitizer.drain()
+
+
+# -- thread-leak detection ----------------------------------------------------
+
+def test_leaked_nondaemon_thread_is_reported():
+    before = set(threading.enumerate())
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="san-leaky")
+    t.start()
+    try:
+        leaked = sanitizer.leaked_threads(before, grace_s=0.1)
+        assert t in leaked
+    finally:
+        release.set()
+        t.join(timeout=5)
+
+
+def test_joined_and_daemon_threads_are_not_leaks():
+    before = set(threading.enumerate())
+    t = threading.Thread(target=lambda: None, name="san-quick")
+    t.start()
+    t.join()
+    d = threading.Thread(target=time.sleep, args=(0.5,), name="san-d",
+                         daemon=True)
+    d.start()
+    assert sanitizer.leaked_threads(before, grace_s=0.1) == []
+
+
+def test_allowlisted_singletons_pass():
+    before = set(threading.enumerate())
+    release = threading.Event()
+    t = threading.Thread(
+        target=release.wait, name="gofr-timebase-sampler"
+    )
+    t.start()
+    try:
+        assert sanitizer.leaked_threads(before, grace_s=0.0) == []
+        assert sanitizer.is_allowlisted(t)
+    finally:
+        release.set()
+        t.join(timeout=5)
+
+
+def test_grace_period_tolerates_winding_down_threads():
+    before = set(threading.enumerate())
+    t = threading.Thread(target=time.sleep, args=(0.2,), name="san-slowstop")
+    t.start()
+    # alive at check time, but exits within the grace window
+    assert sanitizer.leaked_threads(before, grace_s=2.0) == []
+    t.join()
+

@@ -76,7 +76,7 @@ def test_slo_percentiles_are_exact_samples():
 def test_slo_window_excludes_old_requests():
     recorder = FlightRecorder(capacity=8)
     rec = _finished(recorder, model="m", ttft=0.01)
-    rec.wall_done -= 3600  # finished an hour ago
+    rec.t_done -= 3600  # finished an hour ago (monotonic mark drives the window)
     assert recorder.slo(window_s=60.0)["models"] == {}
 
 

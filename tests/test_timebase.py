@@ -45,7 +45,8 @@ def test_sampler_series_and_rate_derivation():
     a = by_labels[(("k", "a"),)]
     assert [p[1] for p in a["points"]] == [10.0, 40.0]
     assert len(a["rate"]) == 1
-    dt = a["points"][1][0] - a["points"][0][0]
+    snaps = sampler.snapshots()
+    dt = snaps[1]["mono"] - snaps[0]["mono"]  # rate dt is monotonic
     assert a["rate"][0][1] == pytest.approx(30.0 / dt)
     # label-set b only exists in the second snapshot: one point, no rate
     b = by_labels[(("k", "b"),)]
@@ -126,7 +127,8 @@ def test_rate_total_sums_across_label_sets():
     counter.inc(2, k="b")
     sampler.sample_now()
     rate = sampler.rate_total("gofr_s_total")
-    dt = rate[0][0] - sampler.snapshots()[0]["ts"]
+    snaps = sampler.snapshots()
+    dt = snaps[1]["mono"] - snaps[0]["mono"]  # rate dt is monotonic
     assert rate[0][1] == pytest.approx(3.0 / dt)
 
 

@@ -63,7 +63,10 @@ def main() -> int:
                 latencies.extend(local)
                 failures.extend(bad)
 
-        threads = [threading.Thread(target=worker) for _ in range(clients)]
+        threads = [
+            threading.Thread(target=worker, name=f"bench-hello-{i}")
+            for i in range(clients)
+        ]
         wall_start = time.perf_counter()
         for t in threads:
             t.start()

@@ -73,12 +73,12 @@ def main() -> int:
             )
             urllib.request.urlopen(req, timeout=30).read()
 
-        worker = threading.Thread(target=fire)
+        worker = threading.Thread(target=fire, name="pm-smoke-fire")
         worker.start()
 
         bundle_path = None
-        deadline = time.time() + 15.0
-        while time.time() < deadline and bundle_path is None:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and bundle_path is None:
             bundles = sorted(
                 n for n in os.listdir(pm_dir)
                 if n.startswith("postmortem-") and n.endswith(".json")

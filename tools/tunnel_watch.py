@@ -190,9 +190,11 @@ def main() -> int:
     except ValueError:
         log("WATCH_ABS_DEADLINE is not epoch seconds — using now+6h")
         abs_deadline = 0.0
+    # gofrlint: wall-clock — WATCH_ABS_DEADLINE's contract is epoch seconds
     abs_deadline = abs_deadline or (time.time() + 6 * 3600)
 
     def remaining() -> float:
+        # gofrlint: wall-clock — epoch-seconds deadline contract
         return abs_deadline - time.time()
 
     # 0. real-TPU pallas kernel validation — cheap, and gates nothing:
