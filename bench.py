@@ -516,6 +516,21 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
         except Exception as exc:
             errors.append(f"decode phase: {_describe_http_error(exc)}")
             traceback.print_exc(file=sys.stderr)
+
+        # -- phase: paged-KV microbench (echo/CPU rounds) ---------------------
+        # the copied-bytes and admission-latency deltas of block aliasing
+        # vs the slot/copy model, measured host-side in the SAME harness —
+        # plus the server's live block accounting off /admin/engine
+        if model == "echo":
+            try:
+                result["kv_microbench"] = _measure_paged_kv()
+                log(f"paged KV: {result['kv_microbench']}")
+            except Exception as exc:
+                errors.append(f"paged-kv phase: {exc}")
+                traceback.print_exc(file=sys.stderr)
+            kv_live = _scrape_kv_blocks(base)
+            if kv_live is not None:
+                result["kv_blocks"] = kv_live
         return 0 if result["value"] is not None else 1
     finally:
         # the engine state machine's verdict on the run (serving vs
@@ -704,6 +719,66 @@ def _describe_http_error(exc: Exception) -> str:
             body = "<unreadable>"
         return f"HTTP {exc.code}: {body}"
     return f"{type(exc).__name__}: {exc}"
+
+
+def _measure_paged_kv() -> dict:
+    """Copied-KV-bytes per prefix hit + admission latency: the paged
+    engine (copy-free block aliasing) against the slot/copy model
+    (``copy_mode=True`` — every hit materializes a private copy, the
+    row-cache behavior), same allocator, same arena, same prompts.
+    Host-side and compile-free, so the number exists even on rounds
+    where the device tunnel is wedged."""
+    import numpy as np
+
+    from gofr_tpu.tpu.kv_blocks import (
+        BlockPool,
+        HostPagedKV,
+        HostTokenArena,
+    )
+
+    prompt = (np.arange(512, dtype=np.int32) * 7) % 251 + 1
+    follow = np.concatenate(  # LCP case: shared prefix, new tail
+        [prompt[:384], (np.arange(64, dtype=np.int32) % 97) + 1]
+    ).astype(np.int32)
+    n = int(os.environ.get("BENCH_KV_ITERS", "200"))
+    out: dict = {}
+    for label, copy_mode in (("paged", False), ("slot_copy", True)):
+        arena = HostTokenArena(2048, 16)
+        pool = BlockPool(2048, 16, arena=arena, cache_entries=64)
+        eng = HostPagedKV(pool, arena, lcp_min=16, copy_mode=copy_mode)
+        seed = eng.admit(prompt, 0)
+        eng.finish(seed)  # the cached conversation every hit aliases
+        base_bytes = pool.stats()["copied_kv_bytes"]
+        start = time.perf_counter()
+        for i in range(n):
+            seq = eng.admit(prompt if i % 2 == 0 else follow, 8)
+            eng.finish(seq, store=False)
+        elapsed = time.perf_counter() - start
+        st = pool.stats()
+        out[label] = {
+            "copied_kv_bytes_per_hit": round(
+                (st["copied_kv_bytes"] - base_bytes) / n, 1
+            ),
+            "admission_ms": round(elapsed / n * 1000, 4),
+            "hits": eng.prefix_stats["hits"],
+            "partial_hits": eng.prefix_stats["partial_hits"],
+        }
+    slot_b = out["slot_copy"]["copied_kv_bytes_per_hit"]
+    paged_b = out["paged"]["copied_kv_bytes_per_hit"]
+    out["copied_bytes_reduction"] = (
+        round(1.0 - paged_b / slot_b, 4) if slot_b else None
+    )
+    return out
+
+
+def _scrape_kv_blocks(base: str) -> "dict | None":
+    """The serving process's live block accounting off GET /admin/engine."""
+    try:
+        with urllib.request.urlopen(base + "/admin/engine", timeout=10) as r:
+            data = json.loads(r.read()).get("data") or {}
+        return data.get("kv_blocks")
+    except Exception:
+        return None
 
 
 def _scrape_engine_state(base: str) -> "str | None":

@@ -12,6 +12,12 @@ TPU-native keys added on top of the reference set (SURVEY.md §2 #22):
 ``MODEL_NAME``, ``MODEL_PATH``, ``MODEL_QUANT``, ``BATCH_MAX_SIZE``,
 ``BATCH_TIMEOUT_MS``, ``METRICS_ENABLED``.
 
+Paged-KV keys (tpu/kv_blocks.py, see docs/advanced-guide/performance):
+``KV_PAGED`` (default on) switches KV storage/admission to
+block-granular paged mode; ``KV_BLOCK_TOKENS`` (default 64) is the
+block size; ``KV_BLOCKS`` / ``KV_HBM_BUDGET_MB`` size the shared
+block budget (0 = auto, non-binding).
+
 Observability keys (timebase + postmortem layer, see
 docs/advanced-guide/observability.md for semantics):
 ``TIMEBASE_INTERVAL_S`` (default 5) / ``TIMEBASE_WINDOW_S`` (default

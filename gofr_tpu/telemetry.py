@@ -92,6 +92,7 @@ class FlightRecord:
         "tokens_in", "tokens_out", "batch_size", "pool_cohort",
         "prefill_chunks", "prefill_bucket", "sched_defer_s",
         "pool_reject_reason", "dispatch_ids",
+        "kv_blocks", "kv_aliased_blocks",
         "wall_start", "t_start", "t_enqueue", "t_dispatch",
         "t_first_token", "t_last_token", "t_done", "wall_done", "_lock",
         # the recorder's in-flight index holds records WEAKLY (an
@@ -127,6 +128,8 @@ class FlightRecord:
         self.sched_defer_s = 0.0  # total interference-scheduler defer
         self.pool_reject_reason = ""  # why the decode pool refused (solo'd)
         self.dispatch_ids: list[int] = []  # device dispatches this rode
+        self.kv_blocks = 0  # paged-KV blocks reserved for this request
+        self.kv_aliased_blocks = 0  # of those, admitted copy-free (prefix share)
         # gofrlint: wall-clock — /admin/requests display ts (durations use t_*)
         self.wall_start = time.time()
         self.t_start = time.perf_counter()
@@ -196,6 +199,16 @@ class FlightRecord:
         if not self.pool_reject_reason:
             self.pool_reject_reason = reason
 
+    def note_kv(self, blocks: int, aliased: int = 0) -> None:
+        """Paged-KV admission accounting: ``blocks`` reserved for this
+        request, ``aliased`` of them shared copy-free with the prefix
+        cache. Keeps the max seen (fan-out candidates admit separately)."""
+        with self._lock:
+            if blocks > self.kv_blocks:
+                self.kv_blocks = blocks
+            if aliased > self.kv_aliased_blocks:
+                self.kv_aliased_blocks = aliased
+
     def note_tokens(self, n: int = 1) -> None:
         with self._lock:
             self.tokens_out += n
@@ -262,6 +275,8 @@ class FlightRecord:
             "sched_defer_s": self.sched_defer_s or None,
             "pool_reject_reason": self.pool_reject_reason or None,
             "dispatch_ids": list(self.dispatch_ids),
+            "kv_blocks": self.kv_blocks or None,
+            "kv_aliased_blocks": self.kv_aliased_blocks or None,
             "start_ts": self.wall_start,
             "enqueue_ts": _offset(self.t_enqueue),
             "dispatch_ts": _offset(self.t_dispatch),

@@ -86,12 +86,14 @@ class _Request:
     __slots__ = (
         "out_queue", "remaining", "cache_len", "stop", "stop_tokens",
         "finished", "want_lp", "want_top", "want_kv", "record",
+        "kv_reserved",
     )
 
     def __init__(self, out_queue: "queue.Queue", remaining: int, cache_len: int,
                  stop: Optional[threading.Event], stop_tokens: frozenset,
                  want_lp: bool = False, want_top: bool = False,
-                 want_kv: bool = False, record: Any = None):
+                 want_kv: bool = False, record: Any = None,
+                 kv_reserved: int = 0):
         self.out_queue: Optional[queue.Queue] = out_queue
         self.remaining = remaining
         self.cache_len = cache_len
@@ -110,6 +112,11 @@ class _Request:
         # the caller's FlightRecord (if any): every pooled chunk dispatch
         # stamps its dispatch id onto it (bounded by the record itself)
         self.record = record
+        # paged-KV ledger reservation (block count): the request's
+        # whole KV budget, claimed at admission and released THE MOMENT
+        # the request finishes — freed budget admits the next request
+        # mid-flight instead of waiting for any drain
+        self.kv_reserved = kv_reserved
 
 
 class _Slot:
@@ -139,6 +146,7 @@ class DecodePool:
         scheduler: Any = None,
         timeline: Any = None,
         watchdog: Any = None,
+        kv: Any = None,
     ):
         from gofr_tpu.models.transformer import decode_chunk_pool
 
@@ -153,6 +161,12 @@ class DecodePool:
         # chunk dispatch (never throttled) so prefill chunks can
         # interleave between decode turns instead of stalling them
         self._sched = scheduler
+        # paged-KV admission (tpu/kv_blocks.py BlockPool, shared with
+        # the prefix cache): submit reserves a request's block budget —
+        # admission is block-granular against ONE HBM ledger, so cached
+        # prefixes are evicted to admit live traffic and a finished
+        # request's blocks admit the next one immediately
+        self._kv = kv
         # engine introspection (tpu/introspect.py): every chunk dispatch
         # lands on the dispatch timeline and its host fetch runs under
         # the stall watchdog's deadline
@@ -604,13 +618,17 @@ class DecodePool:
             adapter_idx = self._admit(adapter, penalty)
             if not self._free:
                 self._reject("no_free_slots", "no free decode slots")
+            kv_reserved = self._reserve_kv(start_len, max_new)
             slot = self._free.pop()
             record = current_record()
             slot.request = _Request(out, max_new, start_len, stop,
                                     frozenset(stop_tokens or ()),
                                     want_lp=want_logprobs,
                                     want_top=want_top_logprobs,
-                                    want_kv=want_kv, record=record)
+                                    want_kv=want_kv, record=record,
+                                    kv_reserved=kv_reserved)
+            if record is not None and kv_reserved:
+                record.note_kv(kv_reserved)
             self._apply_sampling(slot.index, sampler)
             if adapter_idx:
                 self._lora_ids[slot.index] = adapter_idx
@@ -635,6 +653,26 @@ class DecodePool:
                 self._depth_gauge.set(len(self._active))
             self._work.notify()
         return out
+
+    def _reserve_kv(self, start_len: int, max_new: int) -> int:
+        """Reserve the request's whole KV block budget (pool lock held):
+        prompt + first token + every decode step it may take, capped at
+        the cache bound — a LEDGER claim on the shared BlockPool (the
+        bytes themselves live in this pool's slot cache; cached prefix
+        blocks count as reclaimable against the same budget).
+        Exhaustion rejects with the ``kv_exhausted`` reason (distinct
+        from slot/executable-mix rejects), and the caller's solo
+        fallback serves the request."""
+        if self._kv is None:
+            return 0
+        from gofr_tpu.tpu.kv_blocks import KVExhausted
+
+        try:
+            return self._kv.reserve_ledger(
+                min(start_len + 1 + max_new, self.max_len)
+            )
+        except KVExhausted as exc:
+            self._reject("kv_exhausted", f"KV block budget exhausted: {exc}")
 
     def _admit(self, adapter: Optional[str], penalty: Optional[tuple]) -> int:
         """The submit reject gates (pool lock held): raises queue.Full
@@ -754,6 +792,11 @@ class DecodePool:
                 req.out_queue.put(PoolFailure(exc))
                 req.out_queue.put(DONE)
                 req.finished = True
+            if req is not None and req.kv_reserved:
+                # a dead pool must not pin KV budget against the prefix
+                # cache and any future reinit
+                self._kv.release_ledger(req.kv_reserved)
+                req.kv_reserved = 0
             slot.request = None
         self._active.clear()
         self._free = list(reversed(self._slots))
@@ -1094,6 +1137,13 @@ class DecodePool:
             req.out_queue.put(DONE)
         req.out_queue = None
         req.stop = None
+        if req.kv_reserved:
+            # free the KV reservation NOW (not at slot reuse): the
+            # budget is back on the shared ledger before this delivery
+            # even returns, so a request waiting on kv_exhausted admits
+            # mid-flight — continuous batching at block granularity
+            self._kv.release_ledger(req.kv_reserved)
+            req.kv_reserved = 0
         slot = self._slots[index]
         if slot.request is req:  # not already reused
             slot.request = None
@@ -1157,6 +1207,7 @@ class DecodePool:
                 "lora_slots": len(self._lora_slots),
                 "penalized_slots": len(self._pen_slots),
                 "closed": self._closed,
+                "kv": self._kv.stats() if self._kv is not None else None,
             }
 
     def close(self) -> None:

@@ -44,6 +44,20 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
 - ``PREFIX_LCP_MIN``: minimum shared-prefix tokens for a partial hit
   (default 0 = the smallest compiled bucket; -1 = exact-only matching,
   restoring the pre-LCP behavior and skipping its warmup compiles)
+- ``KV_PAGED`` (default on): block-granular paged KV (tpu/kv_blocks.py)
+  — the prefix cache stores refcounted token BLOCKS instead of whole
+  ``max_seq`` rows (exact/LCP hits alias blocks copy-free, conversation
+  stores alias the prefix they extend, LRU eviction under the arena
+  budget yields cached blocks to live admission), and the decode pool
+  reserves a request's block budget at submit (``kv_exhausted`` reject
+  when even eviction cannot cover it) and frees it the instant the
+  request finishes. ``off`` restores the whole-row slot model
+- ``KV_BLOCK_TOKENS`` (default 64): tokens per KV block; must divide
+  the model's ``max_seq``
+- ``KV_BLOCKS`` / ``KV_HBM_BUDGET_MB``: arena size, in blocks or HBM
+  megabytes (0 = auto: decode slots + prefix entries worth of blocks,
+  which makes the budget non-binding; set one to make eviction and
+  block-granular admission real)
 - ``TPU_BOOT``: "background" boots the stack off-thread; the server
   accepts immediately and /.well-known/ready reports warmup progress
 - ``BATCH_MAX_SIZE`` / ``BATCH_TIMEOUT_MS``: batcher shape
@@ -468,6 +482,27 @@ class TPUDevice:
         if self._sched_max_defer_ms <= 0:
             raise ValueError("SCHED_MAX_DEFER_MS must be > 0")
         self._batch_cohort = config.get_or_default("BATCH_COHORT", "on") != "off"
+        # paged KV (tpu/kv_blocks.py): block-granular KV storage for the
+        # prefix cache (copy-free aliasing, LRU eviction under budget)
+        # and block-granular decode-pool admission. KV_PAGED=off restores
+        # the whole-row slot model; KV_BLOCK_TOKENS sets the block size
+        # (must divide max_seq on transformer models); KV_BLOCKS pins the
+        # arena size in blocks (0 = auto: slots + prefix entries worth);
+        # KV_HBM_BUDGET_MB sizes the arena by HBM bytes instead
+        self._kv_paged = config.get_or_default("KV_PAGED", "on") != "off"
+        self._kv_block_tokens = int(
+            config.get_or_default("KV_BLOCK_TOKENS", "64")
+        )
+        if self._kv_block_tokens < 1:
+            raise ValueError("KV_BLOCK_TOKENS must be >= 1")
+        self._kv_blocks_cfg = int(config.get_or_default("KV_BLOCKS", "0"))
+        if self._kv_blocks_cfg < 0:
+            raise ValueError("KV_BLOCKS must be >= 0 (0 = auto-size)")
+        self._kv_budget_mb = float(
+            config.get_or_default("KV_HBM_BUDGET_MB", "0")
+        )
+        if self._kv_budget_mb < 0:
+            raise ValueError("KV_HBM_BUDGET_MB must be >= 0 (0 = auto)")
         self._pool_enabled = config.get_or_default("DECODE_POOL", "on") != "off"
         self._pool_slots = int(config.get_or_default("DECODE_SLOTS", str(self.max_batch)))
         from gofr_tpu.tpu.decode_pool import PIPELINE_DEPTH
@@ -643,7 +678,14 @@ class TPUDevice:
             timeline=self.timeline,
             watchdog=self.watchdog,
             cache_events=self._note_cache_event,
+            kv_paged=self._kv_paged,
+            kv_block_tokens=self._kv_block_tokens,
+            kv_blocks=self._kv_blocks_cfg,
+            kv_budget_bytes=int(self._kv_budget_mb * 1024 * 1024),
+            kv_reserve_seqs=self._pool_slots,
+            metrics=self.metrics,
         )
+        self._wire_paged_kv()
         if (
             self._prefill_chunk_cfg
             and hasattr(self.runner, "_can_chunk_prefill")
@@ -660,6 +702,9 @@ class TPUDevice:
         # continuous batching: concurrent decodes share one fixed-shape
         # dispatch per chunk; seeded requests bypass it (device.generate
         # routes them solo — the per-request key sequence must reproduce).
+        # With KV_PAGED the pool additionally reserves each request's KV
+        # block budget from the SAME BlockPool the prefix cache stores
+        # into — one HBM ledger, cached prefixes evicted for admission.
         self.decode_pool = None
         pool_ok = self._pool_enabled
         if pool_ok and self.mesh is not None:
@@ -695,6 +740,7 @@ class TPUDevice:
                 scheduler=self.scheduler,
                 timeline=self.timeline,
                 watchdog=self.watchdog,
+                kv=self.kv_pool,
             )
             if getattr(self.runner, "adapters", None):
                 self._boot_progress(
@@ -713,6 +759,66 @@ class TPUDevice:
             timeline=self.timeline,
             watchdog=self.watchdog,
         )
+
+    def _wire_paged_kv(self) -> None:
+        """Attach the paged-KV layer to the freshly built runner.
+
+        Transformer runners build their own device-arena BlockPool
+        (``_init_paged_kv``) — this only lifts it onto the device for
+        the decode pool and ``/admin/engine``. The echo runner gets a
+        HOST arena engine here (the device owns config + metrics), so
+        the whole allocator/aliasing/admission path runs compile-free
+        in tier-1."""
+        self.kv_pool = getattr(self.runner, "kv_pool", None)
+        reason = getattr(self.runner, "kv_paged_disabled", "")
+        if reason:
+            self.logger.warnf("paged KV disabled: %s", reason)
+        if not (
+            self._kv_paged
+            and self.kv_pool is None
+            and hasattr(self.runner, "enable_paged_kv")
+        ):
+            return
+        from gofr_tpu.tpu.kv_blocks import (
+            BlockPool,
+            HostPagedKV,
+            HostTokenArena,
+        )
+
+        bt = self._kv_block_tokens
+        if self._kv_blocks_cfg:
+            n_blocks = self._kv_blocks_cfg
+        elif self._kv_budget_mb:
+            n_blocks = max(
+                int(self._kv_budget_mb * 1024 * 1024)
+                // (bt * HostTokenArena.TOKEN_BYTES),
+                2,
+            )
+        else:
+            n_blocks = 1024  # ~64k tokens of host "KV" — ample for echo
+        arena = HostTokenArena(n_blocks, bt)
+        pool = BlockPool(
+            n_blocks, bt, arena=arena,
+            hbm_budget_bytes=n_blocks * arena.block_bytes,
+            # echo has no PREFIX_CACHE knob of its own: reuse it when
+            # set, else a default bound that keeps tier-1 aliasing real
+            cache_entries=self._prefix_cache_size or 32,
+            metrics=self.metrics,
+        )
+        lcp_min = self._prefix_lcp_min
+        if lcp_min == 0:
+            lcp_min = 8  # echo has no compiled buckets to anchor on
+        elif lcp_min < 0:
+            lcp_min = 1 << 30  # -1 = exact-only, same as the row store
+        self.runner.enable_paged_kv(
+            HostPagedKV(pool, arena, lcp_min=lcp_min),
+            reject_counter=self.metrics.counter(
+                "gofr_tpu_pool_reject_total",
+                "decode-pool submit rejections (the request decoded solo)",
+                labels=("reason",),
+            ),
+        )
+        self.kv_pool = pool
 
     def _boot_progress(
         self, detail: str, kind: str = "", bucket: int = 0
@@ -1118,6 +1224,11 @@ class TPUDevice:
         snap["queue_depth"] = batcher._depth() if batcher is not None else None
         pool = getattr(self, "decode_pool", None)
         snap["decode_pool"] = pool.occupancy() if pool is not None else None
+        # paged-KV block accounting (free-list/refcount/eviction state,
+        # budget utilization) — host-side reads off the BlockPool, so
+        # block starvation is diagnosable even while the engine is wedged
+        kv = getattr(self, "kv_pool", None)
+        snap["kv_blocks"] = kv.stats() if kv is not None else None
         sched = getattr(self, "scheduler", None)
         snap["scheduler"] = sched.snapshot() if sched is not None else None
         caches: dict[str, Any] = {}
@@ -1506,6 +1617,26 @@ class _EchoRunner:
         # compile-free path and drive the watchdog/engine state machine
         # end to end (tests/test_engine_obs.py)
         self.stall_hook: Optional[Any] = None
+        # host-side paged KV (tpu/kv_blocks.py HostPagedKV, attached by
+        # the device when KV_PAGED=on): echo "KV" is the token ids
+        # themselves, so block reservation, prefix aliasing, COW, LRU
+        # eviction, and kv_exhausted admission all run compile-free —
+        # the tier-1 proof of the paged path
+        self.paged: Optional[Any] = None
+        self.kv_pool: Optional[Any] = None
+        self._kv_reject: Optional[Any] = None
+
+    def enable_paged_kv(self, engine: Any, reject_counter: Any = None) -> None:
+        """Attach a host paged-KV engine; the runner then decodes off
+        block tables (reading the prompt back THROUGH the arena) and
+        the device's prefix-cache gauges read this engine's stats."""
+        self.paged = engine
+        self.kv_pool = engine.pool
+        self._kv_reject = reject_counter
+        # same attribute surface as the transformer runner, so the
+        # device's hit-ratio/entries gauges work unchanged
+        self.prefix_stats = engine.prefix_stats
+        self._prefix_cache = engine.pool  # len() = live cached entries
 
     def bucket_for_payload(self, ids: np.ndarray) -> int:
         n = int(getattr(ids, "size", 0) or 0)
@@ -1571,23 +1702,63 @@ class _EchoRunner:
             self.run_batch([ids])
         if ttft_cb:
             ttft_cb()
+        # paged-KV admission (decode side, mirroring the real pool's
+        # submit timing): reserve the request's block budget, aliasing
+        # cached prefix blocks copy-free; exhaustion falls back to the
+        # block-free path with the kv_exhausted reject accounted —
+        # exactly the solo-fallback contract of DecodePool.submit
+        seq = None
+        src = ids
+        if self.paged is not None:
+            from gofr_tpu.tpu.kv_blocks import KVExhausted
+
+            record = telemetry_record()
+            try:
+                seq = self.paged.admit(ids, max_new_tokens)
+            except KVExhausted:
+                if self._kv_reject is not None:
+                    self._kv_reject.inc(reason="kv_exhausted")
+                if record is not None:
+                    record.note_pool_reject("kv_exhausted")
+            if seq is not None:
+                # decode off the BLOCK TABLES, not the request buffer:
+                # aliasing/COW fidelity is load-bearing for the output
+                src = self.paged.prompt_tokens(seq)
+                if record is not None:
+                    record.note_kv(
+                        len(seq.table.blocks), seq.aliased_blocks
+                    )
         out: list[int] = []
         lps: list[float] = []
         tops: list = []
-        for i in range(max_new_tokens):
-            if stop is not None and stop.is_set():
-                break
-            token = int(ids[i % ids.size])
-            if token in stop_tokens:
-                break
-            out.append(token)
-            if logprobs:
-                lps.append(0.0)
-                tops.append([(token, 0.0)])
-            if on_token:
-                on_token((token, 0.0) if logprobs else token)
-            if self.step_s:
-                time.sleep(self.step_s)
+        try:
+            for i in range(max_new_tokens):
+                if stop is not None and stop.is_set():
+                    break
+                token = int(src[i % src.size])
+                if token in stop_tokens:
+                    break
+                out.append(token)
+                if seq is not None:
+                    # each decoded token lands in the sequence's KV
+                    # (COW first if the boundary block is shared)
+                    self.paged.append(seq, token)
+                if logprobs:
+                    lps.append(0.0)
+                    tops.append([(token, 0.0)])
+                if on_token:
+                    on_token((token, 0.0) if logprobs else token)
+                if self.step_s:
+                    time.sleep(self.step_s)
+        except BaseException:
+            if seq is not None:
+                self.paged.abort(seq)
+            raise
+        if seq is not None:
+            # trim the unused reservation (freed blocks admit the next
+            # request immediately) and store the conversation copy-free
+            # — the request's table BECOMES the cache entry
+            self.paged.finish(seq)
         if top_logprobs:
             return out, lps, tops
         return (out, lps) if logprobs else out
@@ -1728,6 +1899,12 @@ class _TransformerRunner:
         timeline: Any = None,
         watchdog: Any = None,
         cache_events: Any = None,
+        kv_paged: bool = False,
+        kv_block_tokens: int = 64,
+        kv_blocks: int = 0,
+        kv_budget_bytes: int = 0,
+        kv_reserve_seqs: int = 8,
+        metrics: Any = None,
     ):
         self.max_batch = max_batch
         # engine introspection: the dispatch timeline + stall watchdog
@@ -1843,6 +2020,10 @@ class _TransformerRunner:
         )
         self._prefix_lock = threading.Lock()
         self.prefix_stats = {"hits": 0, "partial_hits": 0, "misses": 0}
+        self._init_paged_kv(
+            kv_paged, kv_block_tokens, kv_blocks, kv_budget_bytes,
+            kv_reserve_seqs, prefix_cache, metrics,
+        )
         if self.spec is not None:
             from gofr_tpu.models.transformer import (
                 verify_chunk,
@@ -1877,6 +2058,90 @@ class _TransformerRunner:
 
         self._score_fn = jax.jit(lambda p, t: _score_tokens(p, t, cfg))
 
+
+    def _init_paged_kv(
+        self, kv_paged: bool, block_tokens: int, kv_blocks: int,
+        kv_budget_bytes: int, reserve_seqs: int, prefix_cache: int,
+        metrics: Any,
+    ) -> None:
+        """Build the paged-KV layer (tpu/kv_blocks.py) when enabled: one
+        shared :class:`BlockPool` over a device arena backs BOTH the
+        prefix cache (block-aliased entries, LRU-evicted under the
+        budget) and the decode pool's admission ledger — one HBM ledger,
+        so cached prefixes yield to live traffic block by block.
+
+        Disabled (with the reason recorded for the boot log) under a
+        serving mesh (the arena and gather/scatter ops are unsharded) or
+        when ``block_tokens`` does not tile ``max_seq``. With neither a
+        prefix cache nor an explicit arena size there is nothing to
+        page — the slot model is already exact."""
+        self.kv_pool = None
+        self._paged_prefix = None
+        self.kv_paged_disabled = ""
+        if not kv_paged or not (prefix_cache > 0 or kv_blocks or kv_budget_bytes):
+            return
+        if self.mesh is not None:
+            self.kv_paged_disabled = (
+                "KV_PAGED is inert under a serving mesh (unsharded arena)"
+            )
+            return
+        cfg = self.cfg
+        if cfg.max_seq % block_tokens:
+            self.kv_paged_disabled = (
+                f"KV_BLOCK_TOKENS={block_tokens} does not divide "
+                f"max_seq={cfg.max_seq}"
+            )
+            return
+        from gofr_tpu.tpu.kv_blocks import BlockPool, JaxKVArena
+
+        blocks_per_seq = cfg.max_seq // block_tokens
+        block_bytes = (
+            2 * cfg.n_layers * block_tokens * cfg.n_kv_heads
+            * cfg.head_dim * np.dtype(cfg.cache_dtype).itemsize
+        )
+        # the physical arena backs the PREFIX CACHE's blocks (entries
+        # share blocks, so this is a ceiling: +1 seq of headroom for the
+        # transient store-side table); in-flight decode KV lives in the
+        # pool's slot cache and claims the LEDGER only
+        data_blocks = (max(prefix_cache, 0) + 1) * blocks_per_seq
+        if kv_blocks:
+            ledger = kv_blocks
+        elif kv_budget_bytes:
+            ledger = int(kv_budget_bytes // block_bytes)
+        else:
+            # auto: every decode slot + the whole arena fit the ledger —
+            # non-binding by default (no admission behavior change
+            # without explicit sizing); the at-rest layout is still
+            # paged, so entries share blocks and stores shrink
+            ledger = data_blocks + reserve_seqs * blocks_per_seq
+        if ledger < blocks_per_seq:
+            self.kv_paged_disabled = (
+                f"KV budget of {ledger} blocks cannot hold one "
+                f"{cfg.max_seq}-token sequence ({blocks_per_seq} blocks)"
+            )
+            return
+        data_blocks = min(data_blocks, ledger)
+        self.kv_pool = BlockPool(
+            data_blocks + 1, block_tokens,  # +1 scratch
+            block_bytes=block_bytes,
+            hbm_budget_bytes=kv_budget_bytes or ledger * block_bytes,
+            cache_entries=prefix_cache,
+            metrics=metrics, scratch=True,
+            ledger_blocks=ledger,
+        )
+        if prefix_cache > 0:
+            # the physical arena (device buffers + scatter/gather
+            # compiles) exists only for the prefix cache's blocks —
+            # ledger-only mode (PREFIX_CACHE=0 + an explicit budget) is
+            # pure admission accounting and must not pay HBM for it
+            arena = JaxKVArena(cfg, data_blocks + 1, block_tokens)
+            self._paged_prefix = _PagedPrefixStore(
+                self.kv_pool, arena, self._prefix_lcp_min
+            )
+            # the paged store answers for the legacy attributes the
+            # device's gauges (and tests) read: stats dict + len()
+            self.prefix_stats = self._paged_prefix.stats
+            self._prefix_cache = self._paged_prefix
 
     def _load_params(self, model_path: Optional[str], quant: Any) -> None:
         """Load/initialize serving weights (HF safetensors, orbax, or
@@ -2650,6 +2915,8 @@ class _TransformerRunner:
         or scores from the final-position logits — stored GENERATION
         entries carry none, so they divert to the LCP tail-prefill (which
         re-derives the logits) instead of exact-hitting."""
+        if self._paged_prefix is not None:
+            return self._paged_lookup(ids, need_logits)
         key = ids.tobytes()
         with self._prefix_lock:
             entry = self._prefix_cache.get(key)
@@ -2681,7 +2948,31 @@ class _TransformerRunner:
                 "next_token": next_token,
                 "logits": logits,
             }
-        return self._tail_prefill(ids, row, shared)
+        return self._tail_prefill(
+            ids,
+            _cache_with_len(self._copy_row(row), jnp.asarray(shared, jnp.int32)),
+            shared,
+        )
+
+    def _paged_lookup(
+        self, ids: np.ndarray, need_logits: bool
+    ) -> Optional[dict]:
+        """Block-table prefix lookup (KV_PAGED): exact hits GATHER the
+        entry's blocks into a fresh compute row (the blocks stay shared
+        — no stored-row duplicate exists to copy); LCP partial hits
+        gather only the shared prefix and resume with the same tail
+        prefill as the row path. Divert rules (need_logits, untrusted
+        next_token) are identical to the row store's."""
+        hit = self._paged_prefix.lookup(ids, need_logits)
+        if hit is None:
+            self._cache_events("prefix", "miss")
+            return None
+        kind, payload, shared = hit
+        if kind == "hit":
+            self._cache_events("prefix", "hit")
+            return payload
+        self._cache_events("prefix", "partial_hit")
+        return self._tail_prefill(ids, payload, shared)
 
     def _lcp_scan(self, ids: np.ndarray) -> tuple:
         """Under ``_prefix_lock``: find the entry with the longest common
@@ -2691,34 +2982,27 @@ class _TransformerRunner:
         (whose continuation belongs to a DIFFERENT prompt). Linear scan:
         the cache holds PREFIX_CACHE (tens of) entries and one numpy
         compare per entry is nanoseconds against the prefill it saves."""
-        best_shared, best_key, best_row = 0, None, None
-        limit = int(ids.size) - 1
-        for key, entry in self._prefix_cache.items():
-            cand = np.frombuffer(key, dtype=np.int32)
-            n = min(cand.size, limit)
-            if n <= best_shared:
-                continue
-            neq = np.nonzero(cand[:n] != ids[:n])[0]
-            shared = int(neq[0]) if neq.size else n
-            if shared > best_shared:
-                best_shared, best_key, best_row = shared, key, entry[0]
-        if best_row is None or best_shared < self._prefix_lcp_min:
-            return 0, None
-        self._prefix_cache.move_to_end(best_key)
-        return best_shared, best_row
+        from gofr_tpu.tpu.kv_blocks import lcp_scan
 
-    def _tail_prefill(self, ids: np.ndarray, row: Any, shared: int) -> dict:
-        """Resume prefill from a cached shared-prefix row: copy the row
-        (stored rows are shared read-only), roll its write head back to
-        ``shared`` (the donated copy, never the stored row), and run only
-        the tail through the bucketed prefill at its ragged offset — the
-        same mechanics as chunked prefill. Stale KV past ``shared`` is
+        shared, key, entry = lcp_scan(
+            list(self._prefix_cache.items()), ids, int(ids.size) - 1,
+            self._prefix_lcp_min,
+        )
+        if entry is None:
+            return 0, None
+        self._prefix_cache.move_to_end(key)
+        return shared, entry[0]
+
+    def _tail_prefill(self, ids: np.ndarray, cache: Any, shared: int) -> dict:
+        """Resume prefill from a shared-prefix cache: ``cache`` is a
+        PRIVATE [1]-row cache whose write head sits at ``shared`` (the
+        row path passes a rolled-back copy of the stored row; the paged
+        path passes a gathered block-table row), and only the tail runs
+        through the bucketed prefill at its ragged offset — the same
+        mechanics as chunked prefill. Stale KV past ``shared`` is
         masked by attention (lengths bounds the valid prefix) and
         overwritten as the tail lands. The completed full-prompt state is
         stored for future exact hits."""
-        cache = _cache_with_len(
-            self._copy_row(row), jnp.asarray(shared, jnp.int32)
-        )
         tail = ids[shared:]
         bucket = self._bucket_for(int(tail.size))
         logits = next_ids = None
@@ -2793,10 +3077,18 @@ class _TransformerRunner:
         )
         if full.size > self.cfg.max_seq:
             return
+        exactable = sampler.greedy and not sampler.penalized
+        if self._paged_prefix is not None:
+            # block-table store: alias the whole blocks of the longest
+            # cached prefix this conversation extends (typically the
+            # prompt's own prefill entry) and scatter only the new tail
+            # — the at-rest copy collapses from a max_seq row to the
+            # reply's blocks
+            self._paged_prefix.store_generation(full, row, exactable, out)
+            return
         entry_row = _cache_with_len(
             row, jnp.asarray(int(full.size), jnp.int32)
         )
-        exactable = sampler.greedy and not sampler.penalized
         entry = (
             entry_row, int(full.size),
             int(out[-1]) if exactable else None, None,
@@ -2810,6 +3102,12 @@ class _TransformerRunner:
         """Store this prompt's prefill result (copied row — the live row
         continues into decode); evict least-recently-used beyond the
         configured size."""
+        if self._paged_prefix is not None:
+            # scatter only the prompt's blocks into the arena — the
+            # ~max_seq-row copy (and residency) of the row store is the
+            # exact cost this path deletes
+            self._paged_prefix.store(ids, state)
+            return
         entry = (
             self._copy_row(state["cache"]),
             state["length"],
@@ -3154,7 +3452,13 @@ class _TransformerRunner:
                         )
                     # tail of b_-1 tokens lands in bucket b_ (> previous
                     # bucket); total stays within max_seq
-                    st = self._tail_prefill(np.ones((b_,), np.int32), one, 1)
+                    st = self._tail_prefill(
+                        np.ones((b_,), np.int32),
+                        _cache_with_len(
+                            self._copy_row(one), jnp.asarray(1, jnp.int32)
+                        ),
+                        1,
+                    )
                     del st
                 # the warmup probes above polluted the cache with fake
                 # prompt entries — serving must start empty
@@ -3400,6 +3704,177 @@ class _SpecEngine:
         return _cache_with_len(cache, jnp.asarray(n, jnp.int32))
 
 
+class _PagedPrefixStore:
+    """Block-table prefix cache for the transformer runner (KV_PAGED).
+
+    Entries live as refcounted BLOCK TABLES in a shared
+    :class:`~gofr_tpu.tpu.kv_blocks.BlockPool` arena instead of private
+    ``max_seq`` rows: a stored conversation occupies only the blocks its
+    tokens fill, a conversation store ALIASES the whole blocks of the
+    prefix entry it extends (no duplicate residency, no copy), and the
+    LRU yields blocks to decode-pool admission the moment live traffic
+    needs them. Lookups still hand the executables the contiguous row
+    they were compiled for (``JaxKVArena.gather_row``) — bit-identity
+    with the slot model is the contract, block-native attention the
+    roadmap item — so the paged win here is at-rest HBM residency and
+    store-path copy volume, not hit-time gather bytes.
+
+    Entry meta mirrors the row store's tuple: ``length``,
+    ``next_token`` (None = divert to tail-prefill, the sampled-source
+    rule), ``logits`` (None for generation entries — logits-needing
+    lookups divert the same way). ``_lock`` serializes arena
+    scatter/gather dispatch order; the pool's own lock guards block
+    accounting and must nest INSIDE it."""
+
+    def __init__(self, pool: Any, arena: Any, lcp_min: int):
+        self.pool = pool
+        self.arena = arena
+        self.lcp_min = lcp_min  # resolved by the runner; -1 = exact-only
+        self.stats = {"hits": 0, "partial_hits": 0, "misses": 0}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.pool)
+
+    def lookup(self, ids: np.ndarray, need_logits: bool) -> Optional[tuple]:
+        """-> ("hit", state, 0) | ("partial", gathered_cache, shared) |
+        None. Blocks are PINNED (increfed) across the gather so a
+        concurrent admission evicting the entry cannot free them
+        mid-copy."""
+        from gofr_tpu.tpu.kv_blocks import BlockTable, blocks_for
+
+        key = ids.tobytes()
+        with self._lock:
+            with self.pool.lock:
+                entry = self.pool.cache_lookup(key)
+                if entry is not None and (
+                    (entry.meta["logits"] is None and need_logits)
+                    or entry.meta["next_token"] is None
+                ):
+                    entry = None  # divert rules, identical to the row store
+                if entry is not None:
+                    meta = dict(entry.meta)
+                    pinned = list(entry.table.blocks)
+                    self.pool.incref(pinned)
+                    self.stats["hits"] += 1
+                    shared = 0
+                else:
+                    shared, donor = (
+                        self._lcp_scan(ids, int(ids.size) - 1, self.lcp_min)
+                        if self.lcp_min >= 0 else (0, None)
+                    )
+                    if donor is None:
+                        self.stats["misses"] += 1
+                        return None
+                    pinned = list(
+                        donor.table.blocks[
+                            : blocks_for(shared, self.pool.block_tokens)
+                        ]
+                    )
+                    self.pool.incref(pinned)
+                    self.stats["partial_hits"] += 1
+            # gather outside the pool lock (arena dispatch order still
+            # serialized by _lock); the pin keeps the blocks alive
+            try:
+                if shared:
+                    cache = self.arena.gather_row(
+                        BlockTable(pinned, shared), shared
+                    )
+                    return ("partial", cache, shared)
+                cache = self.arena.gather_row(
+                    BlockTable(pinned, meta["length"]), meta["length"]
+                )
+            finally:
+                self.pool.release_blocks(pinned)
+        return ("hit", {
+            "cache": cache,
+            "length": meta["length"],
+            "next_token": meta["next_token"],
+            "logits": meta["logits"],
+        }, 0)
+
+    def _lcp_scan(self, ids: np.ndarray, limit: int, min_shared: int) -> tuple:
+        """Longest-common-token-prefix donor entry (pool lock held) —
+        the shared :func:`~gofr_tpu.tpu.kv_blocks.lcp_scan` loop."""
+        from gofr_tpu.tpu.kv_blocks import lcp_scan
+
+        shared, key, entry = lcp_scan(
+            self.pool.cache_items(), ids, limit, min_shared
+        )
+        if entry is None:
+            return 0, None
+        self.pool.cache_touch(key)
+        return shared, entry
+
+    def store(self, ids: np.ndarray, state: Any) -> None:
+        """Prompt prefill result -> blocks: scatter only
+        ``ceil(length/block_tokens)`` blocks (the row store copied the
+        whole max_seq row). Exhaustion skips the store — the cache must
+        never fail a request."""
+        from gofr_tpu.tpu.kv_blocks import KVExhausted
+
+        length = int(state["length"])
+        with self._lock:
+            try:
+                table = self.pool.reserve(length)
+            except KVExhausted:
+                return  # all blocks held by live requests: nothing to evict
+            table.length = length
+            self.pool.note_copied(
+                self.arena.scatter_row(state["cache"], table)
+            )
+            self.pool.cache_put(ids.tobytes(), table, {
+                "length": length,
+                "next_token": state["next_token"],
+                "logits": state["logits"],
+            })
+
+    def clear(self) -> None:
+        """Purge every entry (blocks released) — the warmup's fake
+        probe entries must not greet live traffic."""
+        with self._lock:
+            self.pool.cache_clear()
+
+    def store_generation(
+        self, full: np.ndarray, row: Any, exactable: bool, out: list
+    ) -> None:
+        """Conversation store (prompt + reply): alias the WHOLE blocks
+        of the longest cached prefix this conversation extends —
+        typically the prompt's own prefill entry, whose blocks then
+        serve both entries — and scatter only the tail. The boundary
+        block stays the donor's (scatter skips aliased blocks): writing
+        "equal" KV from a different executable's row would fork the
+        bit-lineage shared readers see."""
+        from gofr_tpu.tpu.kv_blocks import BlockTable, KVExhausted
+
+        bt = self.pool.block_tokens
+        with self._lock:
+            with self.pool.lock:
+                shared, donor = self._lcp_scan(full, int(full.size), bt)
+                if donor is not None:
+                    table, shared_tokens = self.pool.alias_full_blocks(
+                        donor.table, shared
+                    )
+                else:
+                    table, shared_tokens = BlockTable(), 0
+                try:
+                    self.pool.ensure(table, int(full.size))
+                except KVExhausted:
+                    self.pool.release(table)
+                    return
+                table.length = int(full.size)
+            self.pool.note_copied(
+                self.arena.scatter_row(
+                    row, table, skip_blocks=shared_tokens // bt
+                )
+            )
+            self.pool.cache_put(full.tobytes(), table, {
+                "length": int(full.size),
+                "next_token": int(out[-1]) if exactable else None,
+                "logits": None,
+            })
+
+
 class _PrefillState(dict):
     """Per-request prefill result with lazy fields: ``cache`` (row slice,
     computed only when generate() continues the request) and ``logits``
@@ -3473,6 +3948,12 @@ def _build_runner(
     timeline: Any = None,
     watchdog: Any = None,
     cache_events: Any = None,
+    kv_paged: bool = False,
+    kv_block_tokens: int = 64,
+    kv_blocks: int = 0,
+    kv_budget_bytes: int = 0,
+    kv_reserve_seqs: int = 8,
+    metrics: Any = None,
 ) -> Any:
     from gofr_tpu.models.llama import CONFIGS
 
@@ -3496,6 +3977,9 @@ def _build_runner(
             prefix_lcp_min=prefix_lcp_min, lora_adapters=lora_adapters,
             prefill_chunk_tokens=prefill_chunk_tokens,
             timeline=timeline, watchdog=watchdog, cache_events=cache_events,
+            kv_paged=kv_paged, kv_block_tokens=kv_block_tokens,
+            kv_blocks=kv_blocks, kv_budget_bytes=kv_budget_bytes,
+            kv_reserve_seqs=kv_reserve_seqs, metrics=metrics,
         )
     raise ValueError(
         f"unknown MODEL_NAME '{name}' — expected echo, mlp, bert-tiny, "

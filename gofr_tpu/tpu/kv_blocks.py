@@ -1,0 +1,897 @@
+"""Paged KV: a refcounted block allocator over a preallocated KV arena.
+
+The serving path historically stored KV per request/cache-entry as one
+CONTIGUOUS ``max_seq`` row. That shape is what the compiled executables
+want, but it is brutal at rest: every prefix-cache entry pins a full
+``max_seq`` row of HBM (~1 GB for llama3-8b bf16 at 8k — see the sizing
+note in ``tpu/device.py``) even when the cached conversation is 300
+tokens, an exact/LCP hit duplicates the whole row again, and admission
+is all-or-nothing (a free "slot" implicitly owns ``max_seq`` worth of
+cache).
+
+This module replaces the at-rest unit with fixed-size TOKEN BLOCKS
+carved from one preallocated arena (vLLM's PagedAttention storage
+model, scoped to this engine's executables):
+
+- a :class:`BlockPool` hands out block ids with REFCOUNTS, so the
+  prefix cache becomes copy-free block aliasing — exact and LCP partial
+  hits share blocks instead of copying rows, and a stored conversation
+  aliases the prefix blocks it extends;
+- COPY-ON-WRITE: extending a sequence whose boundary block is shared
+  first copies that one block, never the row;
+- cached entries are LRU-EVICTED under the arena budget the moment live
+  traffic needs blocks — the cache yields to admission, block by block,
+  instead of a whole-row all-or-nothing;
+- free-list/refcount accounting is exposed to introspection
+  (``GET /admin/engine`` ``kv_blocks``) and metrics
+  (``gofr_tpu_kv_blocks{state}``, ``gofr_tpu_kv_evictions_total``).
+
+Two arenas implement the storage side:
+
+- :class:`HostTokenArena` — the echo runner's "KV" is the token ids
+  themselves, so the whole allocator/aliasing/admission path runs
+  compile-free in tier-1 (and :class:`HostPagedKV` is the engine the
+  echo runner drives it through);
+- :class:`JaxKVArena` — device-side block storage
+  ``[layers, n_blocks, block_tokens, kv_heads, head_dim]`` with jitted
+  scatter/gather between block tables and the contiguous rows the
+  compiled prefill/decode executables consume. Compute still runs on
+  gathered contiguous rows (bit-identity with the slot model is a hard
+  requirement; block-native attention is a roadmap item), so the paged
+  win on device is at-rest residency, store-path copy volume, and
+  block-granular admission — not hit-time gather bytes.
+
+``jax`` is imported lazily (inside :class:`JaxKVArena` only): the host
+side must stay importable in no-JAX contexts.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Optional
+
+import numpy as np
+
+
+class KVExhausted(RuntimeError):
+    """No free KV blocks (and nothing evictable): the caller's request
+    cannot be admitted — decode falls back to the solo path and the
+    rejection is accounted as ``pool_reject{reason="kv_exhausted"}``."""
+
+
+def blocks_for(tokens: int, block_tokens: int) -> int:
+    """Blocks needed to hold ``tokens`` tokens (ceil division)."""
+    return (max(int(tokens), 0) + block_tokens - 1) // block_tokens
+
+
+def lcp_scan(items: list, ids: np.ndarray, limit: int,
+             min_shared: int) -> tuple:
+    """Longest-common-token-prefix donor among cached sequences — the
+    ONE scan both paged engines use (host echo and the device prefix
+    store; thresholds differ, the loop must not). ``items`` is
+    ``BlockPool.cache_items()`` output; keys are int32 token bytes.
+    Returns ``(shared_tokens, key, entry)`` or ``(0, None, None)`` when
+    nothing clears ``max(min_shared, 1)``. Linear scan: the cache holds
+    tens of entries and one vector compare per entry is nanoseconds
+    against the prefill a hit saves."""
+    best_shared, best_key, best_entry = 0, None, None
+    for key, entry in items:
+        cand = np.frombuffer(key, dtype=np.int32)
+        n = min(cand.size, limit)
+        if n <= best_shared:
+            continue
+        neq = np.nonzero(cand[:n] != ids[:n])[0]
+        shared = int(neq[0]) if neq.size else n
+        if shared > best_shared:
+            best_shared, best_key, best_entry = shared, key, entry
+    if best_entry is None or best_shared < max(min_shared, 1):
+        return 0, None, None
+    return best_shared, best_key, best_entry
+
+
+class BlockTable:
+    """One sequence's ordered block list + its valid token length.
+
+    ``blocks[i]`` holds tokens ``[i*block_tokens, (i+1)*block_tokens)``;
+    content in the boundary block past ``length`` belongs to whoever
+    the block is shared with (readers must respect ``length`` — the
+    same contract attention's per-request ``lengths`` already enforces
+    for stale row positions)."""
+
+    __slots__ = ("blocks", "length")
+
+    def __init__(self, blocks: Optional[list] = None, length: int = 0):
+        self.blocks: list[int] = blocks if blocks is not None else []
+        self.length = length
+
+    def __repr__(self) -> str:  # debugging/postmortem friendliness
+        return f"BlockTable(n={len(self.blocks)}, length={self.length})"
+
+
+class _CacheEntry:
+    """A cached sequence: its block table plus caller metadata (length,
+    next_token, logits... — opaque to the pool)."""
+
+    __slots__ = ("table", "meta")
+
+    def __init__(self, table: BlockTable, meta: dict):
+        self.table = table
+        self.meta = meta
+
+
+class BlockPool:
+    """Refcounted block allocator + LRU registry of cached sequences.
+
+    Thread-safe; ``lock`` is a public RLock so engines can make
+    compound operations (LCP scan then alias) atomic against concurrent
+    admission/eviction by wrapping them in ``with pool.lock:``.
+
+    Block states (the ``gofr_tpu_kv_blocks{state}`` gauge):
+
+    - ``free``: on the free list;
+    - ``cached``: referenced by at least one cache entry (may ALSO be
+      shared with live requests — cache wins the label);
+    - ``active``: referenced only by live requests/reservations.
+
+    ``scratch=True`` reserves block id 0 permanently (never allocated):
+    the device arena's padded scatter/gather ops need a harmless target
+    for table positions past a sequence's end.
+
+    Two admission surfaces share one budget:
+
+    - DATA blocks (``alloc``/``reserve``/``alias``...): physically
+      backed by the arena — cache entries and host-path sequences;
+    - the LEDGER (``reserve_ledger``/``release_ledger``): accounting
+      for in-flight KV that physically lives elsewhere (the device
+      decode pool's slot cache). ``ledger_blocks`` (default
+      ``n_blocks``) is the combined budget; a ledger reservation
+      treats cached blocks as reclaimable (they evict on demand when
+      data is actually needed), so admission is gated on
+      ``ledger - reserved - active``, and a finished request's
+      ``release_ledger`` admits the next one immediately.
+    """
+
+    def __init__(
+        self,
+        n_blocks: int,
+        block_tokens: int,
+        arena: Any = None,
+        block_bytes: int = 0,
+        hbm_budget_bytes: int = 0,
+        cache_entries: int = 0,
+        metrics: Any = None,
+        scratch: bool = False,
+        ledger_blocks: Optional[int] = None,
+    ):
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+        if block_tokens < 1:
+            raise ValueError(
+                f"block_tokens must be >= 1, got {block_tokens}"
+            )
+        self.n_blocks = n_blocks
+        self.block_tokens = block_tokens
+        self.arena = arena
+        self.block_bytes = block_bytes or getattr(arena, "block_bytes", 0)
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.cache_entries = cache_entries  # 0 = unbounded (budget still caps)
+        self.lock = threading.RLock()
+        self._ref = [0] * n_blocks
+        self._cache_ref = [0] * n_blocks  # refs held by cache entries
+        first = 1 if scratch else 0
+        self._scratch = scratch
+        if scratch and n_blocks < 2:
+            raise ValueError("scratch pool needs n_blocks >= 2")
+        if scratch:
+            self._ref[0] = 1  # permanently held, never freed
+        # LIFO free list: recently freed blocks are re-handed first
+        # (their arena pages are the warmest)
+        self._free = list(range(n_blocks - 1, first - 1, -1))
+        self._cache: "OrderedDict[bytes, _CacheEntry]" = OrderedDict()
+        self._cached_unique = 0  # blocks with _cache_ref > 0
+        self.ledger_blocks = (
+            ledger_blocks if ledger_blocks is not None else self.total_blocks
+        )
+        self.reserved = 0  # ledger blocks claimed by in-flight requests
+        # counters surfaced by stats() and the bench delta report
+        self.evictions = 0
+        self.cow_copies = 0
+        self.copied_kv_bytes = 0
+        self.exhausted_rejects = 0
+        self._blocks_gauge = self._evict_counter = None
+        if metrics is not None:
+            self._blocks_gauge = metrics.gauge(
+                "gofr_tpu_kv_blocks",
+                "paged KV arena blocks by state "
+                "(total/free/active/cached/reserved)",
+                labels=("state",),
+            )
+            self._evict_counter = metrics.counter(
+                "gofr_tpu_kv_evictions_total",
+                "prefix-cache entries LRU-evicted to free KV blocks",
+            )
+            self._publish()
+
+    # -- accounting helpers (lock held) --------------------------------------
+    @property
+    def total_blocks(self) -> int:
+        """Allocatable blocks (the scratch block is bookkeeping)."""
+        return self.n_blocks - (1 if self._scratch else 0)
+
+    def _publish(self) -> None:
+        if self._blocks_gauge is None:
+            return
+        free = len(self._free)
+        self._blocks_gauge.set(self.total_blocks, state="total")
+        self._blocks_gauge.set(free, state="free")
+        self._blocks_gauge.set(self._cached_unique, state="cached")
+        self._blocks_gauge.set(
+            self.total_blocks - free - self._cached_unique, state="active"
+        )
+        self._blocks_gauge.set(self.reserved, state="reserved")
+
+    def note_copied(self, nbytes: int) -> None:
+        """Engines report bytes they physically copied moving KV between
+        blocks and rows — the number the bench's paged-vs-slot delta is
+        built on."""
+        with self.lock:
+            self.copied_kv_bytes += int(nbytes)
+
+    # -- raw block ops -------------------------------------------------------
+    def alloc(self, n: int) -> list[int]:
+        """Take ``n`` free blocks (refcount 1 each), LRU-evicting cached
+        entries as needed; raises :class:`KVExhausted` when live
+        references alone exceed the arena."""
+        if n <= 0:
+            return []
+        with self.lock:
+            if len(self._free) < n:
+                # satisfiability FIRST: a doomed request must not wipe
+                # the whole cache as collateral before failing anyway.
+                # Reclaimable = blocks whose only refs are the cache's
+                # (evicting everything frees exactly these).
+                reclaimable = sum(
+                    1 for b in range(self.n_blocks)
+                    if self._ref[b] > 0 and self._ref[b] == self._cache_ref[b]
+                )
+                if len(self._free) + reclaimable < n:
+                    self.exhausted_rejects += 1
+                    raise KVExhausted(
+                        f"need {n} KV blocks, {len(self._free)} free + "
+                        f"{reclaimable} reclaimable of {self.total_blocks} "
+                        "(the rest held by live requests)"
+                    )
+            while len(self._free) < n and self._cache:
+                self._evict_lru()
+            if len(self._free) < n:
+                self.exhausted_rejects += 1
+                raise KVExhausted(
+                    f"need {n} KV blocks, {len(self._free)} free of "
+                    f"{self.total_blocks} (cache empty — all blocks held "
+                    "by live requests)"
+                )
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+            self._publish()
+            return out
+
+    def incref(self, blocks: list) -> None:
+        with self.lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise RuntimeError(
+                        f"incref of free block {b} (use-after-free)"
+                    )
+                self._ref[b] += 1
+
+    def release_blocks(self, blocks: list) -> None:
+        """Drop one reference per block; blocks reaching zero return to
+        the free list immediately (continuous admission feeds on this)."""
+        with self.lock:
+            for b in blocks:
+                r = self._ref[b] - 1
+                if r < 0:
+                    raise RuntimeError(f"double free of block {b}")
+                self._ref[b] = r
+                if r == 0:
+                    self._free.append(b)
+            self._publish()
+
+    # -- ledger reservations (device decode-pool admission) ------------------
+    def reserve_ledger(self, n_tokens: int) -> int:
+        """Claim admission budget for ``n_tokens`` of in-flight KV that
+        physically lives OUTSIDE the arena (the pool's slot cache).
+        Cached blocks count as reclaimable (data allocation evicts them
+        on demand), so the gate is
+        ``ledger - reserved - active >= needed``. Returns the block
+        count to hand back via :meth:`release_ledger`; raises
+        :class:`KVExhausted` when live KV alone exceeds the budget."""
+        n = blocks_for(n_tokens, self.block_tokens)
+        with self.lock:
+            active = (
+                self.total_blocks - len(self._free) - self._cached_unique
+            )
+            if self.ledger_blocks - self.reserved - active < n:
+                self.exhausted_rejects += 1
+                raise KVExhausted(
+                    f"need {n} KV blocks, "
+                    f"{self.ledger_blocks - self.reserved - active} of "
+                    f"{self.ledger_blocks} unclaimed (reserved="
+                    f"{self.reserved}, active={active})"
+                )
+            self.reserved += n
+            self._publish()
+            return n
+
+    def release_ledger(self, n: int) -> None:
+        """Return admission budget — called the moment a request
+        finishes, so the freed capacity admits the next request
+        mid-flight."""
+        with self.lock:
+            self.reserved = max(self.reserved - int(n), 0)
+            self._publish()
+
+    # -- table ops -----------------------------------------------------------
+    def reserve(self, n_tokens: int) -> BlockTable:
+        """A fresh table with capacity for ``n_tokens`` (length 0): the
+        admission primitive — DecodePool reserves a request's whole KV
+        budget here so it can never OOM mid-generation."""
+        return BlockTable(self.alloc(blocks_for(n_tokens, self.block_tokens)))
+
+    def ensure(self, table: BlockTable, n_tokens: int) -> None:
+        """Grow ``table``'s capacity to ``n_tokens`` tokens."""
+        need = blocks_for(n_tokens, self.block_tokens) - len(table.blocks)
+        if need > 0:
+            table.blocks.extend(self.alloc(need))
+
+    def release(self, table: BlockTable) -> None:
+        with self.lock:
+            blocks, table.blocks, table.length = table.blocks, [], 0
+            self.release_blocks(blocks)
+
+    def trim(self, table: BlockTable) -> int:
+        """Free capacity beyond ``length`` (reserved-but-unused tail —
+        a finished request hands these back instantly). Returns the
+        number of blocks released."""
+        with self.lock:
+            keep = blocks_for(table.length, self.block_tokens)
+            tail = table.blocks[keep:]
+            del table.blocks[keep:]
+            if tail:
+                self.release_blocks(tail)
+            return len(tail)
+
+    def alias(self, donor: BlockTable, n_tokens: int) -> BlockTable:
+        """Copy-free sharing: a new table referencing the donor's blocks
+        covering the first ``n_tokens`` tokens. The boundary block may
+        be shared mid-block — extending through it later triggers
+        :meth:`cow_boundary`."""
+        if n_tokens > donor.length:
+            raise ValueError(
+                f"alias of {n_tokens} tokens from a {donor.length}-token table"
+            )
+        with self.lock:
+            shared = donor.blocks[: blocks_for(n_tokens, self.block_tokens)]
+            self.incref(shared)
+            return BlockTable(list(shared), n_tokens)
+
+    def alias_full_blocks(self, donor: BlockTable, n_tokens: int) -> tuple:
+        """Share only WHOLE blocks within ``n_tokens`` — the store-path
+        variant (the boundary block must stay private to the donor, the
+        extender writes its own). Returns ``(table, shared_tokens)``."""
+        full = (min(n_tokens, donor.length) // self.block_tokens)
+        shared_tokens = full * self.block_tokens
+        with self.lock:
+            shared = donor.blocks[:full]
+            self.incref(shared)
+            return BlockTable(list(shared), shared_tokens), shared_tokens
+
+    def cow_boundary(self, table: BlockTable) -> Optional[tuple]:
+        """Copy-on-write before appending: if the boundary block (the
+        partially filled last block) is shared, replace it with a
+        private copy. Returns ``(old, new)`` block ids when a copy
+        happened, else None."""
+        frac = table.length % self.block_tokens
+        if frac == 0 or not table.blocks:
+            return None  # boundary is block-aligned: next append opens fresh
+        with self.lock:
+            i = table.length // self.block_tokens
+            old = table.blocks[i]
+            if self._ref[old] <= 1:
+                return None  # private already
+            new = self.alloc(1)[0]
+            copied = 0
+            if self.arena is not None:
+                copied = self.arena.copy_partial(new, old, frac)
+            table.blocks[i] = new
+            self.release_blocks([old])
+            self.cow_copies += 1
+            self.copied_kv_bytes += copied
+            return old, new
+
+    # -- cached sequences (the prefix cache's storage half) ------------------
+    def cache_put(self, key: bytes, table: BlockTable, meta: dict) -> None:
+        """Insert/replace a cached sequence. OWNERSHIP TRANSFER: the
+        caller's block references become the cache's (copy-free store —
+        a finished request's table IS the entry); the caller must not
+        release the table afterwards."""
+        with self.lock:
+            old = self._cache.pop(key, None)
+            if old is not None:
+                self._cache_release(old)
+            self._cache[key] = _CacheEntry(table, meta)
+            for b in table.blocks:
+                if self._cache_ref[b] == 0:
+                    self._cached_unique += 1
+                self._cache_ref[b] += 1
+            while self.cache_entries and len(self._cache) > self.cache_entries:
+                self._evict_lru()
+            self._publish()
+
+    def cache_lookup(self, key: bytes) -> Optional[_CacheEntry]:
+        """Exact-key entry (LRU order refreshed) or None. Callers doing
+        device work against the entry must pin its blocks (``incref``)
+        under ``pool.lock`` before leaving it — eviction can otherwise
+        free them mid-gather."""
+        with self.lock:
+            entry = self._cache.get(key)
+            if entry is not None:
+                self._cache.move_to_end(key)
+            return entry
+
+    def cache_items(self) -> list:
+        """Snapshot of (key, entry) pairs, LRU-first — the LCP scan's
+        iteration surface. Take ``pool.lock`` around scan+alias to keep
+        the chosen donor alive."""
+        with self.lock:
+            return list(self._cache.items())
+
+    def cache_touch(self, key: bytes) -> None:
+        with self.lock:
+            if key in self._cache:
+                self._cache.move_to_end(key)
+
+    def cache_clear(self) -> None:
+        """Release every cached sequence (live aliases keep their own
+        refs); eviction counters are NOT incremented — this is an
+        administrative purge, not budget pressure."""
+        with self.lock:
+            while self._cache:
+                _, entry = self._cache.popitem(last=False)
+                self._cache_release(entry)
+            self._publish()
+
+    def cache_discard(self, key: bytes) -> None:
+        with self.lock:
+            entry = self._cache.pop(key, None)
+            if entry is not None:
+                self._cache_release(entry)
+                self._publish()
+
+    def _cache_release(self, entry: _CacheEntry) -> None:
+        for b in entry.table.blocks:
+            self._cache_ref[b] -= 1
+            if self._cache_ref[b] == 0:
+                self._cached_unique -= 1
+        self.release_blocks(entry.table.blocks)
+        entry.table.blocks = []
+
+    def _evict_lru(self) -> None:
+        """Drop the least-recently-used cached sequence (lock held).
+        Blocks shared with live requests survive via their remaining
+        refs — eviction only removes the CACHE's claim."""
+        _, entry = self._cache.popitem(last=False)
+        self._cache_release(entry)
+        self.evictions += 1
+        if self._evict_counter is not None:
+            self._evict_counter.inc()
+
+    def __len__(self) -> int:
+        with self.lock:
+            return len(self._cache)
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> dict:
+        """Point-in-time accounting for ``GET /admin/engine`` and the
+        bench artifact — all host-side reads."""
+        with self.lock:
+            free = len(self._free)
+            used = self.total_blocks - free
+            out = {
+                "total": self.total_blocks,
+                "ledger": self.ledger_blocks,
+                "block_tokens": self.block_tokens,
+                "block_bytes": self.block_bytes,
+                "free": free,
+                "cached": self._cached_unique,
+                "active": used - self._cached_unique,
+                "reserved": self.reserved,
+                "cached_entries": len(self._cache),
+                "evictions": self.evictions,
+                "cow_copies": self.cow_copies,
+                "copied_kv_bytes": self.copied_kv_bytes,
+                "kv_exhausted_rejects": self.exhausted_rejects,
+                "hbm_budget_bytes": self.hbm_budget_bytes or None,
+                "budget_utilization": (
+                    round(
+                        (used + self.reserved) * self.block_bytes
+                        / self.hbm_budget_bytes, 4,
+                    )
+                    if self.hbm_budget_bytes and self.block_bytes else None
+                ),
+            }
+        return out
+
+
+class HostTokenArena:
+    """Host block storage for the echo runner: a block's "KV" is the
+    token ids it covers, so aliasing/COW fidelity is directly checkable
+    (read the sequence back, compare to the prompt) with zero compiles."""
+
+    TOKEN_BYTES = 4  # int32 ids
+
+    def __init__(self, n_blocks: int, block_tokens: int):
+        self.block_tokens = block_tokens
+        self.block_bytes = block_tokens * self.TOKEN_BYTES
+        self._data = np.zeros((n_blocks, block_tokens), np.int32)
+
+    def write(self, table: BlockTable, start: int, ids: np.ndarray) -> int:
+        """Write ``ids`` at token offset ``start`` of ``table``;
+        capacity must already exist. Returns bytes copied."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        bt = self.block_tokens
+        pos = start
+        off = 0
+        while off < ids.size:
+            blk = table.blocks[pos // bt]
+            at = pos % bt
+            n = min(bt - at, ids.size - off)
+            self._data[blk, at : at + n] = ids[off : off + n]
+            pos += n
+            off += n
+        return ids.size * self.TOKEN_BYTES
+
+    def read(self, table: BlockTable) -> np.ndarray:
+        """The sequence's tokens (exactly ``length`` of them)."""
+        bt = self.block_tokens
+        if not table.blocks or table.length == 0:
+            return np.zeros(0, np.int32)
+        nb = blocks_for(table.length, bt)
+        flat = self._data[table.blocks[:nb]].reshape(-1)
+        return flat[: table.length].copy()
+
+    def copy_partial(self, dst_block: int, src_block: int, n_tokens: int) -> int:
+        """COW copy of the boundary block's first ``n_tokens``."""
+        self._data[dst_block, :n_tokens] = self._data[src_block, :n_tokens]
+        return n_tokens * self.TOKEN_BYTES
+
+
+class PagedSequence:
+    """One live request's handle on the host engine: its table, how it
+    was admitted (for flight records), and the prompt length."""
+
+    __slots__ = ("table", "prompt_len", "aliased_blocks", "kind")
+
+    def __init__(self, table: BlockTable, prompt_len: int,
+                 aliased_blocks: int, kind: str):
+        self.table = table
+        self.prompt_len = prompt_len
+        self.aliased_blocks = aliased_blocks  # admitted copy-free
+        self.kind = kind  # hit | partial_hit | miss
+
+
+class HostPagedKV:
+    """The echo runner's paged KV engine: block-table prompt storage,
+    copy-free prefix aliasing (exact + LCP), COW on extension,
+    reserve-at-admission (continuous batching's accounting half) — the
+    whole paged path, compile-free for tier-1.
+
+    ``copy_mode=True`` disables aliasing and deep-copies hit entries
+    into fresh blocks — the slot-model behavior, kept as the bench's
+    within-harness baseline for the copied-bytes/admission deltas."""
+
+    def __init__(
+        self,
+        pool: BlockPool,
+        arena: HostTokenArena,
+        lcp_min: int = 8,
+        copy_mode: bool = False,
+    ):
+        self.pool = pool
+        self.arena = arena
+        self.lcp_min = lcp_min
+        self.copy_mode = copy_mode
+        # same dict shape as the transformer runner's prefix_stats so
+        # the device's hit-ratio gauges work unchanged
+        self.prefix_stats = {"hits": 0, "partial_hits": 0, "misses": 0}
+        self._stats_lock = threading.Lock()
+
+    # -- admission -----------------------------------------------------------
+    def admit(self, ids: np.ndarray, max_new: int) -> PagedSequence:
+        """Admit a prompt: alias cached blocks where possible, write the
+        rest, and reserve decode capacity up front. Raises
+        :class:`KVExhausted` (rolled back) when the arena cannot cover
+        it even after evicting the cache."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        table = None
+        try:
+            with self.pool.lock:  # scan + alias must be atomic vs eviction
+                table, aliased, kind = self._admit_table(ids)
+                # capacity for the whole generation NOW: a request that
+                # admits can never die to block starvation mid-decode,
+                # and trim() hands the unused tail back at finish
+                self.pool.ensure(table, ids.size + max_new)
+                if kind != "hit":
+                    # store the PROMPT entry as an alias of the live
+                    # table (the transformer stores its prefill result
+                    # the same way) — zero copies, and an exact repeat
+                    # of this prompt now hits
+                    self.pool.cache_put(
+                        ids.tobytes(), self.pool.alias(table, ids.size),
+                        {"length": int(ids.size)},
+                    )
+                if max_new > 0:
+                    # pre-COW the (now shared) boundary block HERE, while
+                    # exhaustion still rolls back to a clean reject: an
+                    # ADMITTED request must never die to block starvation
+                    # mid-decode, and after this no append can allocate
+                    # (capacity is reserved, the boundary is private)
+                    self.pool.cow_boundary(table)
+        except KVExhausted:
+            if table is not None:
+                self.pool.release(table)
+            raise
+        with self._stats_lock:
+            self.prefix_stats[
+                "hits" if kind == "hit"
+                else "partial_hits" if kind == "partial_hit" else "misses"
+            ] += 1
+        return PagedSequence(table, ids.size, aliased, kind)
+
+    def _admit_table(self, ids: np.ndarray) -> tuple:
+        """Build the admitted table (pool lock held): exact alias, LCP
+        partial alias + tail write, or full write."""
+        key = ids.tobytes()
+        entry = self.pool.cache_lookup(key)
+        if entry is not None:
+            if self.copy_mode:
+                return self._copy_entry(entry, ids.size), 0, "hit"
+            table = self.pool.alias(entry.table, ids.size)
+            return table, len(table.blocks), "hit"
+        shared, donor = self._lcp_scan(ids)
+        if donor is not None:
+            if self.copy_mode:
+                table = self._copy_entry(donor, shared)
+                try:
+                    # exception safety: _copy_entry already holds refs —
+                    # a failed grow must release them, not strand them
+                    # (the caller's rollback never sees this table)
+                    self.pool.ensure(table, ids.size)
+                except KVExhausted:
+                    self.pool.release(table)
+                    raise
+                self.pool.note_copied(
+                    self.arena.write(table, shared, ids[shared:])
+                )
+                table.length = ids.size
+                return table, 0, "partial_hit"
+            # share whole blocks copy-free; the boundary + tail are this
+            # request's own writes
+            table, shared_tokens = self.pool.alias_full_blocks(
+                donor.table, shared
+            )
+            n_aliased = len(table.blocks)
+            try:
+                # same exception-safety contract: the alias increfed the
+                # donor's blocks and this table is not yet the caller's
+                self.pool.ensure(table, ids.size)
+            except KVExhausted:
+                self.pool.release(table)
+                raise
+            self.pool.note_copied(
+                self.arena.write(table, shared_tokens, ids[shared_tokens:])
+            )
+            table.length = ids.size
+            return table, n_aliased, "partial_hit"
+        table = self.pool.reserve(ids.size)
+        self.pool.note_copied(self.arena.write(table, 0, ids))
+        table.length = ids.size
+        return table, 0, "miss"
+
+    def _copy_entry(self, entry: Any, n_tokens: int) -> BlockTable:
+        """Slot-model baseline: materialize a PRIVATE copy of the entry
+        (what the row cache did per hit), counting the copied bytes."""
+        src = self.arena.read(entry.table)[:n_tokens]
+        table = self.pool.reserve(n_tokens)
+        self.pool.note_copied(self.arena.write(table, 0, src))
+        table.length = n_tokens
+        return table
+
+    def _lcp_scan(self, ids: np.ndarray) -> tuple:
+        """Longest-common-prefix donor among cached sequences (pool lock
+        held) — the shared :func:`lcp_scan` at this engine's threshold."""
+        shared, key, entry = lcp_scan(
+            self.pool.cache_items(), ids, int(ids.size) - 1, self.lcp_min
+        )
+        if entry is None:
+            return 0, None
+        self.pool.cache_touch(key)
+        return shared, entry
+
+    # -- decode-time ---------------------------------------------------------
+    def prompt_tokens(self, seq: PagedSequence) -> np.ndarray:
+        """The prompt read back THROUGH the block tables — the echo
+        decode loop cycles these, so aliasing fidelity is load-bearing,
+        not decorative."""
+        return self.arena.read(seq.table)[: seq.prompt_len]
+
+    def append(self, seq: PagedSequence, token: int) -> None:
+        """One decoded token lands in the sequence's KV: COW if the
+        boundary block is shared, then write (capacity was reserved at
+        admission)."""
+        with self.pool.lock:
+            self.pool.cow_boundary(seq.table)
+            self.pool.ensure(seq.table, seq.table.length + 1)
+            self.arena.write(
+                seq.table, seq.table.length, np.asarray([token], np.int32)
+            )
+            seq.table.length += 1
+
+    # -- completion ----------------------------------------------------------
+    def finish(self, seq: PagedSequence, store: bool = True) -> None:
+        """Request done: trim the unused reservation (those blocks admit
+        the NEXT request immediately), then either transfer the table to
+        the cache (copy-free store, keyed by the full conversation) or
+        release it."""
+        self.pool.trim(seq.table)
+        if store and seq.table.length > 0:
+            key = self.arena.read(seq.table).tobytes()
+            self.pool.cache_put(
+                key, seq.table, {"length": seq.table.length}
+            )
+        else:
+            self.pool.release(seq.table)
+        seq.table = BlockTable()
+
+    def abort(self, seq: PagedSequence) -> None:
+        self.finish(seq, store=False)
+
+    def stats(self) -> dict:
+        out = self.pool.stats()
+        with self._stats_lock:
+            out["prefix"] = dict(self.prefix_stats)
+        return out
+
+
+class JaxKVArena:
+    """Device-side block storage + the jitted block<->row bridge.
+
+    Layout ``[n_layers, n_blocks, block_tokens, n_kv_heads, head_dim]``
+    for k and v. Block id 0 is the SCRATCH block (pair with
+    ``BlockPool(scratch=True)``): the fixed-shape scatter/scan and
+    gather/take ops pad every table to ``blocks_per_seq`` entries, and
+    the padding must land somewhere harmless.
+
+    - ``scatter_row(row, table, skip_blocks)``: write a contiguous
+      ``[L, 1, max_seq, H, D]`` row's first ``table.length`` tokens into
+      the table's blocks, skipping the first ``skip_blocks`` (aliased
+      blocks keep their donor's content — writing "equal" KV from a
+      different executable's row would break bit-lineage);
+    - ``gather_row(table, length)``: materialize the contiguous row the
+      compiled executables consume (``lengths=[length]``); positions
+      past ``length`` are scratch garbage, masked by attention exactly
+      like the slot model's stale rows.
+
+    Both are ONE dispatch each (a scan / a take), compiled once at
+    construction — no lazy compile on the serving path.
+    """
+
+    def __init__(self, cfg: Any, n_blocks: int, block_tokens: int,
+                 max_seq: Optional[int] = None):
+        import jax
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        max_seq = max_seq or cfg.max_seq
+        if max_seq % block_tokens:
+            raise ValueError(
+                f"KV_BLOCK_TOKENS={block_tokens} must divide max_seq="
+                f"{max_seq} (block boundaries must tile the row)"
+            )
+        self.block_tokens = block_tokens
+        self.max_seq = max_seq
+        self.blocks_per_seq = max_seq // block_tokens
+        shape = (
+            cfg.n_layers, n_blocks, block_tokens, cfg.n_kv_heads,
+            cfg.head_dim,
+        )
+        self.k = jnp.zeros(shape, cfg.cache_dtype)
+        self.v = jnp.zeros(shape, cfg.cache_dtype)
+        itemsize = jnp.zeros((), cfg.cache_dtype).dtype.itemsize
+        self.block_bytes = (
+            2 * cfg.n_layers * block_tokens * cfg.n_kv_heads
+            * cfg.head_dim * itemsize
+        )
+        bt = block_tokens
+        nps = self.blocks_per_seq
+        n_layers = cfg.n_layers
+
+        def scatter(ak, av, rk, rv, ids):
+            # one scan over the table: block j <- row[j*bt:(j+1)*bt]
+            # (padded/skipped entries carry id 0 = scratch)
+            def body(carry, x):
+                ak, av = carry
+                bid, start = x
+                blk_k = jax.lax.dynamic_slice_in_dim(
+                    rk[:, 0], start, bt, axis=1
+                )
+                blk_v = jax.lax.dynamic_slice_in_dim(
+                    rv[:, 0], start, bt, axis=1
+                )
+                ak = jax.lax.dynamic_update_slice(
+                    ak, blk_k[:, None], (0, bid, 0, 0, 0)
+                )
+                av = jax.lax.dynamic_update_slice(
+                    av, blk_v[:, None], (0, bid, 0, 0, 0)
+                )
+                return (ak, av), None
+
+            starts = jnp.arange(nps, dtype=jnp.int32) * bt
+            (ak, av), _ = jax.lax.scan(body, (ak, av), (ids, starts))
+            return ak, av
+
+        def gather(ak, av, ids, length):
+            gk = jnp.take(ak, ids, axis=1).reshape(
+                n_layers, nps * bt, -1, cfg.head_dim
+            )[:, None]
+            gv = jnp.take(av, ids, axis=1).reshape(
+                n_layers, nps * bt, -1, cfg.head_dim
+            )[:, None]
+            return {
+                "k": gk, "v": gv,
+                "lengths": jnp.reshape(length, (1,)).astype(jnp.int32),
+            }
+
+        # the arena is donated through scatter (updated in place — it is
+        # the second-largest live buffer after the pool cache)
+        self._scatter = jax.jit(scatter, donate_argnums=(0, 1))
+        self._gather = jax.jit(gather)
+        # warm both NOW: serving-path calls must reuse, never compile
+        zero_row_k = jnp.zeros(
+            (n_layers, 1, max_seq, cfg.n_kv_heads, cfg.head_dim),
+            cfg.cache_dtype,
+        )
+        ids0 = jnp.zeros((nps,), jnp.int32)
+        self.k, self.v = self._scatter(
+            self.k, self.v, zero_row_k, zero_row_k, ids0
+        )
+        self._gather(self.k, self.v, ids0, 0)["lengths"].block_until_ready()
+
+    def _padded_ids(self, table: BlockTable, skip_blocks: int = 0) -> Any:
+        ids = np.zeros(self.blocks_per_seq, np.int32)  # 0 = scratch
+        nb = min(
+            blocks_for(table.length, self.block_tokens), len(table.blocks)
+        )
+        for j in range(skip_blocks, nb):
+            ids[j] = table.blocks[j]
+        return ids, nb
+
+    def scatter_row(self, row: dict, table: BlockTable,
+                    skip_blocks: int = 0) -> int:
+        """Write ``row``'s tokens into the table's (non-aliased) blocks;
+        returns the bytes physically copied into the arena."""
+        ids, nb = self._padded_ids(table, skip_blocks)
+        self.k, self.v = self._scatter(
+            self.k, self.v, row["k"], row["v"], self._jnp.asarray(ids)
+        )
+        return max(nb - skip_blocks, 0) * self.block_bytes
+
+    def gather_row(self, table: BlockTable, length: int) -> dict:
+        """The contiguous compute row for a cached table (a fresh copy —
+        the caller owns it; the arena blocks stay shared)."""
+        ids, _ = self._padded_ids(table)
+        return self._gather(
+            self.k, self.v, self._jnp.asarray(ids), length
+        )

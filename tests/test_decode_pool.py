@@ -352,3 +352,86 @@ def test_top_logprobs_pooled_and_solo(pooled, solo):
     assert p[0] == s[0]
     assert [[i for i, _ in alts] for alts in p[2]] == \
         [[i for i, _ in alts] for alts in s[2]]
+
+
+# -- paged KV (KV_PAGED, tpu/kv_blocks.py) ------------------------------------
+
+
+def _deactivate():
+    """Drop the contextvar a recorder.start() activated — a leaked
+    active record would bleed into unrelated tests in the same worker."""
+    from gofr_tpu.telemetry import activate_record
+
+    activate_record(None)
+
+
+def test_kv_exhausted_reject_reason_and_solo_fallback():
+    """Block starvation is observable at the flight-record level like
+    every other reject: with the shared KV ledger pre-claimed, submit
+    rejects with reason=kv_exhausted (distinct from slot rejects), the
+    request decodes solo and still completes, and releasing the budget
+    re-admits pooled requests — continuous admission, no drain wait."""
+    from gofr_tpu.telemetry import FlightRecorder
+
+    # tiny max_seq=128, 16-token blocks -> 8 blocks per full sequence
+    dev, old = _device(DECODE_POOL="on", DECODE_SLOTS="2", DECODE_CHUNK="2",
+                       KV_BLOCKS="8", KV_BLOCK_TOKENS="16")
+    try:
+        assert dev.kv_pool is not None
+        claimed = dev.kv_pool.reserve_ledger(128)  # the whole ledger
+        recorder = FlightRecorder()
+        rec = recorder.start(model="tiny", endpoint="/t")
+        try:
+            out = dev.generate([1, 2, 3], max_new_tokens=6)
+        finally:
+            recorder.finish(rec)
+            _deactivate()
+        assert len(out) == 6  # solo fallback served it
+        assert rec.pool_reject_reason == "kv_exhausted"
+        counter = dev.metrics.counter(
+            "gofr_tpu_pool_reject_total", labels=("reason",)
+        )
+        assert counter.value(reason="kv_exhausted") >= 1
+        # freed budget admits the next request immediately
+        dev.kv_pool.release_ledger(claimed)
+        rec2 = recorder.start(model="tiny", endpoint="/t")
+        try:
+            out2 = dev.generate([1, 2, 3], max_new_tokens=6)
+        finally:
+            recorder.finish(rec2)
+            _deactivate()
+        assert out2 == out  # pooled and solo agree (bit-identity)
+        assert rec2.pool_reject_reason == ""
+        assert rec2.kv_blocks > 0  # pooled admission reserved blocks
+        assert dev.kv_pool.stats()["reserved"] == 0  # released at finish
+    finally:
+        dev.close()
+        _restore(old)
+
+
+def test_paged_pooled_outputs_match_unpaged(pooled, solo):
+    """The paged device (block-table prefix cache + ledger admission)
+    produces bit-identical pooled output to the unpaged slot model —
+    across prefix hits, partial hits, and conversation stores."""
+    dev, old = _device(DECODE_POOL="on", DECODE_SLOTS="4", DECODE_CHUNK="4",
+                       PREFIX_CACHE="3", PREFIX_LCP_MIN="4",
+                       KV_BLOCK_TOKENS="16")
+    try:
+        assert dev.kv_pool is not None  # paging actually on
+        system = [7, 3, 9, 2, 11, 5]
+        prompts = [[1, 2, 3], [1, 2, 3], system + [21, 22],
+                   system + [31, 32, 33], [5, 6]]
+        for p in prompts:
+            assert dev.generate(p, max_new_tokens=8) == \
+                solo.generate(p, max_new_tokens=8), p
+        # multi-turn conversation reuse through the paged store
+        reply = dev.generate(system + [41], max_new_tokens=6)
+        follow = system + [41] + reply + [42]
+        assert dev.generate(follow, max_new_tokens=5) == \
+            solo.generate(follow, max_new_tokens=5)
+        st = dev.kv_pool.stats()
+        assert st["reserved"] == 0  # every reservation released
+        assert dev.decode_pool.occupancy()["kv"]["total"] == st["total"]
+    finally:
+        dev.close()
+        _restore(old)

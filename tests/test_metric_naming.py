@@ -24,7 +24,7 @@ _COUNTER_SUFFIXES = ("_total",)
 _HISTOGRAM_SUFFIXES = ("_seconds", "_bytes", "_size")
 _GAUGE_SUFFIXES = (
     "_seconds", "_bytes", "_total", "_depth", "_ratio", "_entries",
-    "_active", "_acceptance", "_state",
+    "_active", "_acceptance", "_state", "_blocks",
 )
 # roofline utilization gauges: the suffix IS the (well-known) metric name
 _GAUGE_ALLOWLIST = {"gofr_tpu_mfu", "gofr_tpu_mbu"}
@@ -57,6 +57,8 @@ def test_scanner_sees_the_known_registrations():
             "gofr_tpu_compile_seconds", "gofr_tpu_compiles_total",
             "gofr_tpu_cache_events_total",
             "gofr_tpu_profiler_active"} <= names
+    # the paged-KV block accounting (tpu/kv_blocks.py BlockPool)
+    assert {"gofr_tpu_kv_blocks", "gofr_tpu_kv_evictions_total"} <= names
     # the cardinality guard's overflow ledger (metrics.py Registry)
     assert "gofr_tpu_metrics_dropped_series_total" in names
     assert len(names) >= 24

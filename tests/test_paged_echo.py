@@ -1,0 +1,264 @@
+"""Paged KV end-to-end on the echo runner (compile-free, tier-1): the
+whole allocator/aliasing/admission path driven through the real device —
+exact/LCP prefix hits produce bit-identical output to the unpaged
+runner, kv_exhausted rejections are observable (counter + FlightRecord)
+while the request still completes, freed blocks admit a waiting request
+mid-flight (continuous batching), and the block accounting surfaces on
+``engine_snapshot()`` and /metrics."""
+
+import os
+import threading
+
+import pytest
+
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.logging import Level
+from gofr_tpu.metrics import Registry
+from gofr_tpu.telemetry import FlightRecorder
+from gofr_tpu.testutil import MockLogger
+from gofr_tpu.tpu.device import new_device
+
+
+def _device(**env):
+    defaults = {"MODEL_NAME": "echo", "BATCH_MAX_SIZE": "4",
+                "BATCH_TIMEOUT_MS": "1"}
+    defaults.update(env)
+    old = {k: os.environ.get(k) for k in defaults}
+    os.environ.update(defaults)
+    try:
+        return new_device(EnvConfig(), MockLogger(Level.INFO), Registry()), old
+    except BaseException:
+        _restore(old)
+        raise
+
+
+def _restore(old):
+    for k, v in old.items():
+        os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def _deactivate():
+    """Drop the contextvar a recorder.start() activated — a leaked
+    active record would bleed into unrelated tests in the same worker."""
+    from gofr_tpu.telemetry import activate_record
+
+    activate_record(None)
+
+
+@pytest.fixture()
+def paged():
+    dev, old = _device(KV_BLOCKS="64", KV_BLOCK_TOKENS="4",
+                       PREFIX_LCP_MIN="4")
+    yield dev
+    dev.close()
+    _restore(old)
+
+
+def test_echo_paged_enabled_by_default():
+    dev, old = _device()
+    try:
+        assert dev.kv_pool is not None
+        assert dev.runner.paged is not None
+    finally:
+        dev.close()
+        _restore(old)
+
+
+def test_kv_paged_off_restores_plain_echo():
+    dev, old = _device(KV_PAGED="off")
+    try:
+        assert dev.kv_pool is None
+        assert dev.generate([1, 2, 3], max_new_tokens=4) == [1, 2, 3, 1]
+    finally:
+        dev.close()
+        _restore(old)
+
+
+def test_paged_output_bit_identical_to_unpaged(paged):
+    plain, old = _device(KV_PAGED="off")
+    try:
+        prompts = [[1, 2, 3, 4, 5], [1, 2, 3, 4, 5],
+                   [1, 2, 3, 4, 5, 9, 8], [7, 7, 7]]
+        for p in prompts:
+            assert paged.generate(p, max_new_tokens=7) == \
+                plain.generate(p, max_new_tokens=7), p
+    finally:
+        plain.close()
+        _restore(old)
+
+
+def test_exact_and_lcp_hits_count_and_alias(paged):
+    p = [11, 12, 13, 14, 15, 16]
+    paged.generate(p, max_new_tokens=4)          # miss: stores prompt entry
+    before = dict(paged.runner.prefix_stats)
+    copied_before = paged.kv_pool.stats()["copied_kv_bytes"]
+    paged.generate(p, max_new_tokens=4)          # exact hit: block alias
+    after = paged.runner.prefix_stats
+    assert after["hits"] == before["hits"] + 1
+    # the hit wrote only its own decode tokens + one COW boundary block
+    # — never a row copy (4 new tokens + <=1 block of 4 tokens, 4B each)
+    assert paged.kv_pool.stats()["copied_kv_bytes"] - copied_before <= 8 * 4
+    before = dict(paged.runner.prefix_stats)
+    paged.generate([11, 12, 13, 14, 99, 98], max_new_tokens=2)  # LCP 4
+    assert paged.runner.prefix_stats["partial_hits"] == \
+        before["partial_hits"] + 1
+    # hit-ratio gauges maintained off the paged stats
+    text = paged.metrics.expose()
+    assert any(
+        ln.startswith('gofr_tpu_prefix_hit_ratio{model="echo"}')
+        for ln in text.splitlines()
+    ), text
+
+
+def test_kv_exhausted_rejects_but_request_completes():
+    # 8 blocks x 2 tokens: a 5-token prompt + 16 new tokens cannot admit
+    dev, old = _device(KV_BLOCKS="8", KV_BLOCK_TOKENS="2")
+    try:
+        recorder = FlightRecorder()
+        rec = recorder.start(model="echo", endpoint="/t")
+        try:
+            out = dev.generate([1, 2, 3, 4, 5], max_new_tokens=16)
+        finally:
+            recorder.finish(rec)
+            _deactivate()
+        assert len(out) == 16  # the block-free fallback served it fully
+        assert rec.pool_reject_reason == "kv_exhausted"
+        counter = dev.metrics.counter(
+            "gofr_tpu_pool_reject_total", labels=("reason",)
+        )
+        assert counter.value(reason="kv_exhausted") >= 1
+        assert dev.kv_pool.stats()["kv_exhausted_rejects"] >= 1
+    finally:
+        dev.close()
+        _restore(old)
+
+
+def test_freed_blocks_admit_new_request_mid_flight():
+    """Continuous batching e2e: A holds most of the arena; B cannot
+    admit (kv_exhausted, solo fallback); A finishes and frees its
+    blocks; C then admits INTO THEM while B is still mid-decode."""
+    dev, old = _device(KV_BLOCKS="16", KV_BLOCK_TOKENS="2",
+                       ECHO_STEP_MS="10")
+    try:
+        recorder = FlightRecorder()
+        release_a = threading.Event()
+        results = {}
+        reject_counter = dev.metrics.counter(
+            "gofr_tpu_pool_reject_total", labels=("reason",)
+        )
+
+        def run_a():
+            # 4-token prompt + 20 new = 12 blocks of 16
+            stop = threading.Event()
+
+            def tick(_):
+                if release_a.is_set():
+                    stop.set()
+
+            results["a"] = dev.generate(
+                [1, 2, 3, 4], max_new_tokens=20, on_token=tick, stop=stop
+            )
+
+        def run_b():
+            rec = recorder.start(model="echo", endpoint="/b")
+            try:
+                # needs 15 blocks: fits the 16-block arena alone, but NOT
+                # while A holds 12 — rejected (solo fallback), and long
+                # enough (28 step-delayed tokens) to still be mid-decode
+                # when C admits below
+                results["b"] = dev.generate([5, 6], max_new_tokens=28)
+            finally:
+                recorder.finish(rec)
+            _deactivate()
+            results["b_rec"] = rec
+
+        ta = threading.Thread(target=run_a)
+        ta.start()
+        # wait until A actually holds its blocks
+        for _ in range(500):
+            if dev.kv_pool.stats()["free"] < 7:
+                break
+            threading.Event().wait(0.01)
+        tb = threading.Thread(target=run_b)
+        tb.start()
+        # DETERMINISTIC ordering: release A only after B's rejection is
+        # observable — the counter increments at reject time, before B's
+        # solo decode starts emitting
+        for _ in range(500):
+            if reject_counter.value(reason="kv_exhausted") >= 1:
+                break
+            threading.Event().wait(0.01)
+        assert reject_counter.value(reason="kv_exhausted") >= 1
+        release_a.set()  # A finishes -> blocks free immediately
+        ta.join(10)
+        # C admits into A's freed blocks while B (28 step-delayed
+        # tokens) is still mid-decode
+        rec_c = recorder.start(model="echo", endpoint="/c")
+        try:
+            results["c"] = dev.generate([9, 9, 9], max_new_tokens=4)
+        finally:
+            recorder.finish(rec_c)
+            _deactivate()
+        assert "b" not in results  # B genuinely mid-decode at C's admit
+        tb.join(10)
+        assert results["a"] and results["b"] == [5, 6] * 14
+        assert results["c"] == [9, 9, 9, 9]
+        assert rec_c.kv_blocks > 0  # C was ADMITTED (paged), not solo
+        # B hit the exhausted arena (reject observable on its record)
+        assert results["b_rec"].pool_reject_reason == "kv_exhausted"
+    finally:
+        dev.close()
+        _restore(old)
+
+
+def test_engine_snapshot_and_metrics_expose_block_accounting(paged):
+    paged.generate([1, 2, 3, 4, 5], max_new_tokens=4)
+    snap = paged.engine_snapshot()
+    kv = snap["kv_blocks"]
+    assert kv is not None
+    for key in ("total", "ledger", "free", "cached", "active", "reserved",
+                "evictions", "cow_copies", "copied_kv_bytes",
+                "kv_exhausted_rejects", "budget_utilization"):
+        assert key in kv, key
+    assert kv["total"] == 64
+    assert kv["free"] + kv["cached"] + kv["active"] == kv["total"]
+    assert snap["caches"]["prefix"] == paged.runner.prefix_stats
+    text = paged.metrics.expose()
+    for state in ("total", "free", "cached", "active", "reserved"):
+        assert f'gofr_tpu_kv_blocks{{state="{state}"}}' in text, state
+    assert "gofr_tpu_kv_evictions_total" in text
+
+
+def test_eviction_under_pressure_is_counted():
+    dev, old = _device(KV_BLOCKS="12", KV_BLOCK_TOKENS="2")
+    try:
+        # each round caches entries; later admissions must evict them
+        for i in range(6):
+            dev.generate([i + 1, i + 2, i + 3], max_new_tokens=6)
+        assert dev.kv_pool.stats()["evictions"] > 0
+        text = dev.metrics.expose()
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("gofr_tpu_kv_evictions_total")
+        )
+        assert float(line.rsplit(" ", 1)[1]) > 0
+    finally:
+        dev.close()
+        _restore(old)
+
+
+def test_flight_record_carries_kv_block_fields(paged):
+    recorder = FlightRecorder()
+    p = [21, 22, 23, 24]
+    paged.generate(p, max_new_tokens=4)  # seed the prompt entry
+    rec = recorder.start(model="echo", endpoint="/t")
+    try:
+        paged.generate(p, max_new_tokens=4)  # exact hit: aliased blocks
+    finally:
+        recorder.finish(rec)
+        _deactivate()
+    assert rec.kv_blocks > 0
+    assert rec.kv_aliased_blocks > 0  # admitted copy-free
+    d = rec.to_dict()
+    assert d["kv_blocks"] == rec.kv_blocks
+    assert d["kv_aliased_blocks"] == rec.kv_aliased_blocks

@@ -1,0 +1,129 @@
+"""CI perf-regression gate: compare a bench artifact against the
+committed ``bench_baseline.json``.
+
+The hardware bench rounds kept going dark (r03-r05 died to a wedged
+device tunnel), so the HOST-SIDE echo/CPU bench is the perf signal that
+must never disappear: this gate runs it in CI (see the perf-gate job),
+always uploads the artifact, and FAILS the build when the serving
+stack's host-side overheads regress beyond tolerance vs the committed
+baseline:
+
+- ``req_per_sec`` (TTFT-path throughput through the real HTTP
+  transport/batcher/scheduler stack) must stay above
+  ``baseline * BENCH_GATE_RPS_FACTOR`` (default 0.40 — CI runners are
+  noisy; the gate catches structural regressions, not jitter);
+- ``value`` (p50 TTFT ms) must stay below
+  ``baseline * BENCH_GATE_TTFT_FACTOR`` (default 2.5);
+- the paged-KV microbench must still show copied-bytes SAVINGS:
+  paged copied-KV-bytes per prefix hit strictly below the slot/copy
+  model's, and the admission path must not blow up
+  (``paged admission_ms <= slot_copy admission_ms *
+  BENCH_GATE_KV_FACTOR``, default 3.0 — aliasing bookkeeping may cost
+  a little CPU; it must never cost an order of magnitude).
+
+Usage::
+
+    python tools/bench_gate.py BENCH.json [BASELINE.json]
+
+Exit 0 = within tolerance, 1 = regression (each failure printed).
+Refreshing the baseline is an explicit act: run the bench locally with
+the same env as the CI job and commit the new ``bench_baseline.json``
+next to the change that moved it — the file is the perf contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _num(d: dict, key: str):
+    v = d.get(key)
+    return v if isinstance(v, (int, float)) else None
+
+
+def gate(bench: dict, baseline: dict) -> list[str]:
+    failures: list[str] = []
+    rps_factor = float(os.environ.get("BENCH_GATE_RPS_FACTOR", "0.40"))
+    ttft_factor = float(os.environ.get("BENCH_GATE_TTFT_FACTOR", "2.5"))
+    kv_factor = float(os.environ.get("BENCH_GATE_KV_FACTOR", "3.0"))
+
+    if bench.get("backend") != baseline.get("backend"):
+        failures.append(
+            f"backend mismatch: bench ran on {bench.get('backend')!r}, "
+            f"baseline is {baseline.get('backend')!r} — not comparable"
+        )
+        return failures
+
+    rps, base_rps = _num(bench, "req_per_sec"), _num(baseline, "req_per_sec")
+    if base_rps:
+        if rps is None:
+            failures.append("req_per_sec missing from the bench artifact")
+        elif rps < base_rps * rps_factor:
+            failures.append(
+                f"req/s regression: {rps} < {base_rps} * {rps_factor} "
+                f"(= {base_rps * rps_factor:.2f})"
+            )
+    ttft, base_ttft = _num(bench, "value"), _num(baseline, "value")
+    if base_ttft:
+        if ttft is None:
+            failures.append("p50 TTFT missing from the bench artifact")
+        elif ttft > base_ttft * ttft_factor:
+            failures.append(
+                f"p50 TTFT regression: {ttft}ms > {base_ttft}ms * "
+                f"{ttft_factor} (= {base_ttft * ttft_factor:.2f}ms)"
+            )
+
+    kv = bench.get("kv_microbench") or {}
+    if baseline.get("kv_microbench"):
+        paged, slot = kv.get("paged"), kv.get("slot_copy")
+        if not (paged and slot):
+            failures.append("kv_microbench missing from the bench artifact")
+        else:
+            if paged["copied_kv_bytes_per_hit"] >= slot["copied_kv_bytes_per_hit"]:
+                failures.append(
+                    "paged KV no longer saves copies: "
+                    f"{paged['copied_kv_bytes_per_hit']} bytes/hit paged vs "
+                    f"{slot['copied_kv_bytes_per_hit']} slot-copy"
+                )
+            if paged["admission_ms"] > slot["admission_ms"] * kv_factor:
+                failures.append(
+                    f"paged admission latency blew up: "
+                    f"{paged['admission_ms']}ms > "
+                    f"{slot['admission_ms']}ms * {kv_factor}"
+                )
+    return failures
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) < 2:
+        print(__doc__)
+        return 2
+    bench_path = argv[1]
+    base_path = argv[2] if len(argv) > 2 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_baseline.json",
+    )
+    with open(bench_path) as f:
+        bench = json.load(f)
+    with open(base_path) as f:
+        baseline = json.load(f)
+    failures = gate(bench, baseline)
+    print(
+        f"bench gate: backend={bench.get('backend')} "
+        f"req/s={bench.get('req_per_sec')} (baseline "
+        f"{baseline.get('req_per_sec')}) p50={bench.get('value')}ms "
+        f"(baseline {baseline.get('value')}ms) "
+        f"kv={json.dumps(bench.get('kv_microbench'))}"
+    )
+    if failures:
+        for f_ in failures:
+            print(f"FAIL: {f_}")
+        return 1
+    print("bench gate: OK (within tolerance of bench_baseline.json)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
