@@ -237,6 +237,15 @@ class App:
         return self
 
     def shutdown(self) -> None:
+        fleet = getattr(self.container, "fleet", None)
+        if fleet is not None:
+            # graceful drain BEFORE the listener stops: admission closes
+            # (new requests shed 503, readiness flips) while in-flight
+            # requests finish through the still-running server
+            timeout = float(
+                self.config.get_or_default("FLEET_DRAIN_TIMEOUT_S", "10")
+            )
+            fleet.drain(timeout_s=timeout)
         if self.http_server:
             self.http_server.shutdown()
         if self._grpc_server:

@@ -29,6 +29,23 @@ black-box bundles; ``METRICS_MAX_SERIES`` (default 1000) caps
 per-metric label cardinality; ``METRICS_EXEMPLARS=off`` disables
 OpenMetrics histogram exemplars.
 
+Fleet-router keys (gofr_tpu/fleet, see docs/advanced-guide/fleet.md):
+``FLEET_REPLICAS`` (comma list of replica base URLs, optionally
+``name=url``) turns a process into the fleet front door via
+``tools/router.py``; routing: ``FLEET_RETRIES`` (2),
+``FLEET_DEADLINE_S`` (30), ``FLEET_CONNECT_TIMEOUT_S`` (2),
+``FLEET_READ_TIMEOUT_S`` (30), ``FLEET_AFFINITY`` (on),
+``FLEET_AFFINITY_MAX_SKEW`` (4), ``FLEET_ROUTES``; health:
+``FLEET_PROBE_INTERVAL_S`` (1),
+``FLEET_PROBE_TIMEOUT_S`` (1), ``FLEET_PROBE_HEDGE_MS`` (0 = off),
+``FLEET_OUT_AFTER`` (2), ``FLEET_PROBATION_PROBES`` (3); breaker:
+``FLEET_BREAKER_THRESHOLD`` (5), ``FLEET_BREAKER_COOLDOWN_S`` (5);
+admission: ``FLEET_QUOTA_RPS`` (0 = off), ``FLEET_QUOTA_BURST``,
+``FLEET_TRUST_TENANT_HEADER`` (off — only behind a gateway that stamps
+``X-Tenant``), ``FLEET_MAX_INFLIGHT`` (256),
+``FLEET_SATURATION_QUEUE`` (64), ``FLEET_RETRY_AFTER_S`` (1); drain:
+``FLEET_DRAIN_TIMEOUT_S`` (10).
+
 Correctness-tooling keys (devtools/sanitizer.py + tests/conftest.py,
 see docs/advanced-guide/static-analysis.md): ``GOFR_SANITIZE=1`` arms
 the runtime concurrency sanitizer under tests;

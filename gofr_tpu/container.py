@@ -88,6 +88,10 @@ class Container:
         self.redis: Optional[Any] = None
         self.db: Optional[Any] = None
         self.tpu: Optional[Any] = None
+        # the fleet front door, when this process is a router
+        # (gofr_tpu.fleet.wire_fleet sets it): readiness reads its
+        # draining flag, App.shutdown drains it before stopping servers
+        self.fleet: Optional[Any] = None
         self._handler_pool: Optional[Any] = None
         if wire:
             self._wire_redis()
@@ -196,6 +200,11 @@ class Container:
         return self._handler_pool
 
     def close(self) -> None:
+        if self.fleet is not None:
+            try:
+                self.fleet.close()  # stops the health-prober thread
+            except Exception:
+                pass
         for source in (self.redis, self.db, self.tpu):
             closer = getattr(source, "close", None)
             if closer:

@@ -1,0 +1,352 @@
+"""Fault-injection harness: multi-replica echo fleets in one process,
+with deterministic failure modes, so every routing / retry / breaker /
+shed decision in ``gofr_tpu/fleet`` is provoked in tier-1 tests without
+hardware, sleep-and-hope, or a second process.
+
+A :class:`ChaosReplica` is a full serving app (echo runner: the real
+batcher → scheduler → decode pool → paged KV path, compile-free) with a
+:class:`ChaosController` consulted by an injected middleware. Failure
+modes, all armable and clearable at runtime:
+
+- ``error_burst(n, status)`` — the next ``n`` matching requests answer
+  ``status`` (5xx bursts; also 429 storms).
+- ``stall(seconds)`` — matching requests hang before reaching the
+  handler (a wedged replica that still ACCEPTS connections: provokes
+  the router's read-timeout retry — the "force-wedged mid-stream"
+  acceptance case).
+- ``slow_loris(delay_s)`` — streamed responses crawl one chunk per
+  ``delay_s`` (client-side read-timeout handling).
+- ``disconnect_after(chunks)`` — streamed responses abort mid-body
+  after ``chunks`` chunks (truncated SSE: the router must NOT replay a
+  stream that already produced client-visible bytes).
+- :meth:`ChaosReplica.stop_listener` — the socket goes away entirely
+  (connection refused: the fastest failure, and the one that historically
+  leaked client connections).
+- :meth:`ChaosReplica.wedge` — an injected DEVICE stall via the echo
+  runner's ``stall_hook``: the watchdog walks degraded → wedged, the
+  replica's own readiness 503s, and the fleet prober takes it out of
+  rotation (the r03–r05 tunnel-wedge failure, reproduced on demand).
+
+``chaos_fleet(n)`` builds N replicas + teardown; ``chaos_router``
+fronts them with a wired fleet app. Both swap env vars only around app
+CONSTRUCTION (config keys are read at wiring time), so parallel test
+workers never see each other's ports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import socket
+import threading
+from typing import Any, Iterator, Optional
+
+from gofr_tpu.http.response import Response
+
+# paths chaos applies to by default: the serving surface, never the
+# health/admin plane (the prober must keep seeing the truth unless a
+# test explicitly widens the blast radius)
+DEFAULT_CHAOS_PATHS = ("/v1/", "/generate", "/infer")
+
+
+class ChaosController:
+    """Thread-safe switchboard of armed failure modes."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._modes: dict[str, dict[str, Any]] = {}
+        self.injected: dict[str, int] = {}  # mode -> times fired
+
+    # -- arming ----------------------------------------------------------------
+    def arm(self, mode: str, **params: Any) -> None:
+        with self._lock:
+            self._modes[mode] = params
+
+    def error_burst(self, n: int, status: int = 500,
+                    paths: tuple = DEFAULT_CHAOS_PATHS) -> None:
+        self.arm("error_burst", remaining=n, status=status, paths=paths)
+
+    def stall(self, seconds: float,
+              paths: tuple = DEFAULT_CHAOS_PATHS) -> None:
+        self.arm("stall", seconds=seconds, paths=paths)
+
+    def slow_loris(self, delay_s: float,
+                   paths: tuple = DEFAULT_CHAOS_PATHS) -> None:
+        self.arm("slow_loris", delay_s=delay_s, paths=paths)
+
+    def disconnect_after(self, chunks: int,
+                         paths: tuple = DEFAULT_CHAOS_PATHS) -> None:
+        self.arm("disconnect_after", chunks=chunks, paths=paths)
+
+    def clear(self, mode: Optional[str] = None) -> None:
+        with self._lock:
+            if mode is None:
+                self._modes.clear()
+            else:
+                self._modes.pop(mode, None)
+
+    # -- middleware-side reads -------------------------------------------------
+    def _matches(self, params: dict[str, Any], path: str) -> bool:
+        return any(path.startswith(p) for p in params.get("paths", ("/",)))
+
+    def take(self, mode: str, path: str) -> Optional[dict[str, Any]]:
+        """Fetch ``mode``'s params when armed for ``path`` (consuming
+        one shot from counted modes); None otherwise."""
+        with self._lock:
+            params = self._modes.get(mode)
+            if params is None or not self._matches(params, path):
+                return None
+            if "remaining" in params:
+                if params["remaining"] <= 0:
+                    return None
+                params["remaining"] -= 1
+                if params["remaining"] == 0:
+                    self._modes.pop(mode, None)
+            self.injected[mode] = self.injected.get(mode, 0) + 1
+            return dict(params)
+
+    def peek(self, mode: str, path: str) -> Optional[dict[str, Any]]:
+        with self._lock:
+            params = self._modes.get(mode)
+            if params is None or not self._matches(params, path):
+                return None
+            return dict(params)
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {"armed": {k: dict(v) for k, v in self._modes.items()},
+                    "injected": dict(self.injected)}
+
+
+def chaos_middleware(controller: ChaosController):
+    """Router middleware consulting the controller per request — the
+    injection point sits where a real failure would: between transport
+    and handler (bursts, stalls) or inside the response body stream
+    (slow-loris, mid-stream disconnects)."""
+
+    def middleware(next_ep: Any) -> Any:
+        async def endpoint(request: Any) -> Response:
+            path = request.path
+            burst = controller.take("error_burst", path)
+            if burst is not None:
+                return Response(
+                    status=burst["status"],
+                    headers={"Content-Type": "application/json",
+                             "Retry-After": "1"},
+                    body=b'{"error":{"message":"chaos: injected burst"}}',
+                )
+            stall = controller.take("stall", path)
+            if stall is not None:
+                # hang while ACCEPTING the connection, re-checking so a
+                # cleared stall releases parked requests quickly
+                deadline = (asyncio.get_running_loop().time()
+                            + float(stall["seconds"]))
+                while asyncio.get_running_loop().time() < deadline:
+                    if controller.peek("stall", path) is None:
+                        break  # cleared: release parked requests
+                    await asyncio.sleep(0.02)
+            response = await next_ep(request)
+            if response.stream is not None:
+                loris = controller.take("slow_loris", path)
+                cut = controller.take("disconnect_after", path)
+                if loris is not None or cut is not None:
+                    response.stream = _mangle_stream(
+                        response.stream,
+                        delay_s=float(loris["delay_s"]) if loris else 0.0,
+                        cut_after=int(cut["chunks"]) if cut else -1,
+                    )
+            return response
+
+        return endpoint
+
+    return middleware
+
+
+async def _mangle_stream(stream: Any, delay_s: float,
+                         cut_after: int) -> Any:
+    """Slow-loris and/or mid-body disconnect over an async chunk
+    iterator. Raising inside the iterator makes the server abort the
+    transport WITHOUT the terminal chunk — exactly what a yanked
+    network cable produces on the wire."""
+    sent = 0
+    async for chunk in stream:
+        if cut_after >= 0 and sent >= cut_after:
+            raise ConnectionResetError("chaos: injected mid-stream disconnect")
+        if delay_s:
+            await asyncio.sleep(delay_s)
+        yield chunk
+        sent += 1
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@contextlib.contextmanager
+def _env_overrides(overrides: dict[str, str]) -> Iterator[None]:
+    """Apply env overrides for the duration (app construction reads
+    config then); ``None`` values unset keys. Restores on exit."""
+    from gofr_tpu.config import get_env
+
+    old = {k: get_env(k) for k in overrides}
+    for key, value in overrides.items():
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = value
+    try:
+        yield
+    finally:
+        for key, value in old.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+class ChaosReplica:
+    """One in-process echo serving replica with its chaos switchboard."""
+
+    def __init__(self, name: str, app: Any, chaos: ChaosController,
+                 port: int):
+        self.name = name
+        self.app = app
+        self.chaos = chaos
+        self.port = port
+
+    @property
+    def address(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # -- listener-level chaos --------------------------------------------------
+    def stop_listener(self) -> None:
+        """Connection refused: the socket goes away, the app object (and
+        its engine) stays alive for a later :meth:`start_listener`."""
+        if self.app.http_server is not None:
+            self.app.http_server.shutdown()
+            self.app.http_server = None
+
+    def start_listener(self) -> None:
+        from gofr_tpu.http.server import HTTPServer
+
+        if self.app.http_server is None:
+            self.app.http_server = HTTPServer(
+                self.app.router, self.port, self.app.logger
+            )
+            self.app.http_server.run_in_thread()
+
+    # -- device-level chaos ----------------------------------------------------
+    def wedge(self, seconds: float) -> None:
+        """Inject a device stall: the NEXT dispatch blocks ``seconds``
+        on the echo runner's ``stall_hook``; with the watchdog armed the
+        replica walks degraded → wedged and its readiness 503s."""
+        import time as _time
+
+        tpu = self.app.container.tpu
+        tpu.runner.stall_hook = lambda: _time.sleep(seconds)
+
+    def unwedge(self) -> None:
+        self.app.container.tpu.runner.stall_hook = None
+
+    def close(self) -> None:
+        self.app.shutdown()
+
+
+def build_replica(name: str, env: Optional[dict[str, str]] = None,
+                  port: Optional[int] = None) -> ChaosReplica:
+    """One echo replica app: real serving surface (OpenAI routes +
+    ``/generate``), chaos middleware armed, watchdog on a short leash so
+    injected device stalls flip the state machine within test budgets."""
+    import gofr_tpu
+    from gofr_tpu.openai_compat import register_openai_routes
+
+    port = port or _free_port()
+    overrides: dict[str, Any] = {
+        "HTTP_PORT": str(port),
+        "MODEL_NAME": "echo",
+        "LOG_LEVEL": "FATAL",
+        "BATCH_MAX_SIZE": "4",
+        "BATCH_TIMEOUT_MS": "1",
+        "WATCHDOG_DISPATCH_TIMEOUT_S": "0.2",
+        "TIMEBASE_ENABLED": "off",
+        "GRPC_PORT": str(_free_port()),
+    }
+    overrides.update(env or {})
+    chaos = ChaosController()
+    with _env_overrides(overrides):
+        app = gofr_tpu.new()
+        app.router.use(chaos_middleware(chaos))
+        register_openai_routes(app)
+        app.post("/generate", _generate_handler)
+        app.start()
+    return ChaosReplica(name, app, chaos, port)
+
+
+def _generate_handler(ctx: Any) -> Any:
+    """Minimal token-in/token-out surface for fleet tests: reserves real
+    paged-KV blocks for the full generation like any decode."""
+    body = ctx.bind() if ctx.request.body else {}
+    tokens = body.get("tokens") or [1, 2, 3]
+    max_new = int(body.get("max_new_tokens") or 8)
+    out = ctx.tpu.generate(tokens, max_new_tokens=max_new)
+    return {"tokens": out, "count": len(out)}
+
+
+@contextlib.contextmanager
+def chaos_fleet(n: int = 3, env: Optional[dict[str, str]] = None,
+                per_replica_env: Optional[list[dict[str, str]]] = None
+                ) -> Iterator[list[ChaosReplica]]:
+    """N echo replicas, torn down in reverse on exit."""
+    replicas: list[ChaosReplica] = []
+    try:
+        for i in range(n):
+            merged = dict(env or {})
+            if per_replica_env and i < len(per_replica_env):
+                merged.update(per_replica_env[i])
+            replicas.append(build_replica(f"r{i}", env=merged))
+        yield replicas
+    finally:
+        for replica in reversed(replicas):
+            try:
+                replica.close()
+            except Exception:
+                pass
+
+
+@contextlib.contextmanager
+def chaos_router(replicas: list[ChaosReplica],
+                 env: Optional[dict[str, str]] = None) -> Iterator[Any]:
+    """A fleet router app fronting ``replicas`` (names preserved, so
+    ``/admin/fleet`` talks about r0/r1/r2). Yields the started app;
+    ``app.container.fleet`` is the FleetRouter."""
+    import gofr_tpu
+    from gofr_tpu.fleet import wire_fleet
+
+    spec = ",".join(f"{r.name}={r.address}" for r in replicas)
+    overrides: dict[str, Any] = {
+        "HTTP_PORT": str(_free_port()),
+        "GRPC_PORT": str(_free_port()),
+        "LOG_LEVEL": "FATAL",
+        "TIMEBASE_ENABLED": "off",
+        "MODEL_NAME": None,  # the router serves no model of its own
+        "TPU_ENABLED": None,
+        "FLEET_REPLICAS": spec,
+        "FLEET_PROBE_INTERVAL_S": "0.05",
+        "FLEET_PROBE_TIMEOUT_S": "1",
+        "FLEET_RETRIES": "2",
+        "FLEET_DEADLINE_S": "10",
+        "FLEET_CONNECT_TIMEOUT_S": "1",
+        "FLEET_READ_TIMEOUT_S": "5",
+    }
+    overrides.update(env or {})
+    with _env_overrides(overrides):
+        app = gofr_tpu.new()
+        wire_fleet(app)
+        app.start()
+    try:
+        yield app
+    finally:
+        app.shutdown()

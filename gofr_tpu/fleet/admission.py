@@ -1,0 +1,205 @@
+"""Admission control for the fleet front door: per-tenant token-bucket
+quotas (redis-backed when the container has redis, in-memory otherwise)
+and the shed decisions that keep the router's queue bounded.
+
+Every deny carries a ``Retry-After`` hint so well-behaved clients back
+off instead of hammering: for quota denials it is the exact refill time
+of the next token; for saturation/in-flight sheds it is the configured
+``retry_after_s`` coarse hint.
+
+The redis backing makes quotas FLEET-WIDE: N router processes fronting
+the same replicas share one bucket per tenant (key
+``fleet:quota:<tenant>``, a hash of ``tokens`` + ``ts``). The
+read-modify-write is not atomic across routers — a race can admit one
+extra request per colliding pair — which is the right trade for a
+quota (a rate hint, not a ledger); redis failures fail OPEN to the
+in-memory bucket so a cache outage never takes admission down with it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+# bounded tenant map: beyond this, new tenants share one overflow bucket
+# (same rationale as METRICS_MAX_SERIES — scanner traffic must not grow
+# resident memory unboundedly)
+MAX_TENANTS = 10_000
+OVERFLOW_TENANT = "_overflow"
+
+
+class TokenBucket:
+    """Classic token bucket on the monotonic clock: ``rate`` tokens/s
+    refill toward ``capacity``; :meth:`take` is lock-guarded arithmetic
+    only (admission sits on the hot path)."""
+
+    def __init__(self, rate: float, capacity: float):
+        self.rate = rate
+        self.capacity = capacity
+        self._tokens = capacity
+        self._updated = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self, n: float = 1.0) -> tuple[bool, float]:
+        """(admitted, retry_after_s). ``retry_after_s`` is 0 when
+        admitted, else the time until ``n`` tokens will exist."""
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.capacity, self._tokens + (now - self._updated) * self.rate
+            )
+            self._updated = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True, 0.0
+            needed = n - self._tokens
+            return False, needed / self.rate if self.rate > 0 else 60.0
+
+    def peek(self) -> float:
+        with self._lock:
+            now = time.monotonic()
+            return min(
+                self.capacity, self._tokens + (now - self._updated) * self.rate
+            )
+
+
+class QuotaTable:
+    """Per-tenant buckets. ``rate_rps`` <= 0 disables quotas entirely
+    (every take admits)."""
+
+    def __init__(self, rate_rps: float, burst: float,
+                 redis: Optional[Any] = None, logger: Optional[Any] = None):
+        self.rate_rps = rate_rps
+        self.burst = burst if burst > 0 else max(1.0, 2 * rate_rps)
+        self._redis = redis
+        self._logger = logger
+        self._redis_down_logged = False
+        self._buckets: dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+        self._denied = 0
+        self._admitted = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.rate_rps > 0
+
+    def take(self, tenant: str) -> tuple[bool, float]:
+        if not self.enabled:
+            return True, 0.0
+        if self._redis is not None:
+            verdict = self._take_redis(tenant)
+            if verdict is not None:
+                self._count(verdict[0])
+                return verdict
+        ok, retry_after = self._bucket(tenant).take()
+        self._count(ok)
+        return ok, retry_after
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "rate_rps": self.rate_rps,
+                "burst": self.burst,
+                "backend": "redis" if self._redis is not None else "memory",
+                "tenants": len(self._buckets),
+                "admitted": self._admitted,
+                "denied": self._denied,
+            }
+
+    # -- internals ------------------------------------------------------------
+    def _count(self, admitted: bool) -> None:
+        with self._lock:
+            if admitted:
+                self._admitted += 1
+            else:
+                self._denied += 1
+
+    def _bucket(self, tenant: str) -> TokenBucket:
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                if len(self._buckets) >= MAX_TENANTS:
+                    tenant = OVERFLOW_TENANT
+                    bucket = self._buckets.get(tenant)
+                if bucket is None:
+                    bucket = TokenBucket(self.rate_rps, self.burst)
+                    self._buckets[tenant] = bucket
+            return bucket
+
+    def _take_redis(self, tenant: str) -> Optional[tuple[bool, float]]:
+        """Fleet-wide bucket in redis; ``None`` = backend unavailable
+        (caller falls back to the in-memory bucket: fail open). Two
+        pipelined round trips per take (read both fields, write both +
+        TTL) — this sits on the admission hot path, so five sequential
+        RTTs would tax every admitted request. One RTT would need
+        server-side scripting (EVAL), which the in-tree miniredis does
+        not speak."""
+        key = f"fleet:quota:{tenant}"
+        try:
+            # wall clock ON PURPOSE: the timestamp is shared across
+            # router processes, whose monotonic clocks are unrelated
+            now = time.time()  # gofrlint: wall-clock — cross-process bucket refill timestamp
+            raw_tokens, raw_ts = self._redis.pipeline().hget(
+                key, "tokens"
+            ).hget(key, "ts").execute()
+            tokens = _as_float(raw_tokens, self.burst)
+            ts = _as_float(raw_ts, now)
+            tokens = min(self.burst, tokens + max(0.0, now - ts) * self.rate_rps)
+            if tokens >= 1.0:
+                admitted, tokens, retry_after = True, tokens - 1.0, 0.0
+            else:
+                admitted = False
+                retry_after = (1.0 - tokens) / self.rate_rps
+            ttl = max(60, int(self.burst / max(self.rate_rps, 0.001)) + 60)
+            # idle tenants expire instead of accreting forever
+            self._redis.pipeline().hset(key, "tokens", repr(tokens)).hset(
+                key, "ts", repr(now)
+            ).expire(key, ttl).execute()
+            return admitted, retry_after
+        except Exception as exc:
+            if not self._redis_down_logged and self._logger is not None:
+                self._redis_down_logged = True
+                self._logger.errorf(
+                    "fleet quota redis backend failed (%r); failing open "
+                    "to per-process buckets", exc
+                )
+            return None
+
+
+def _as_float(value: Any, default: float) -> float:
+    """Redis replies arrive as str/bytes/None depending on the client
+    path; the bucket math wants a float either way."""
+    if value is None:
+        return default
+    if isinstance(value, bytes):
+        value = value.decode("utf-8", "replace")
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return default
+
+
+def tenant_of(request: Any, trust_tenant_header: bool = False) -> str:
+    """The quota subject of a request: the API key (``Authorization``
+    value, HASHED — the tenant string lands in route records,
+    ``/admin/fleet``, and redis keys, none of which may carry secret
+    material), else a shared anonymous bucket.
+
+    The client-supplied ``X-Tenant`` header is honored only when the
+    operator opted in (``FLEET_TRUST_TENANT_HEADER=on``, for routers
+    behind an authenticating gateway that STAMPS the header): trusted
+    by default it would let any rate-limited client mint a fresh full
+    bucket per request by randomizing the header."""
+    if trust_tenant_header:
+        tenant = request.header("X-Tenant")
+        if tenant:
+            return tenant
+    auth = request.header("Authorization")
+    if auth:
+        import hashlib
+
+        digest = hashlib.sha256(auth.encode("utf-8")).hexdigest()
+        return "key-" + digest[:16]
+    return "anonymous"

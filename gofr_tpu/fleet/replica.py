@@ -1,0 +1,399 @@
+"""Replica bookkeeping + the health prober that decides rotation
+membership.
+
+Each engine replica is wrapped in a :class:`Replica`: its resilient
+HTTP client (``service.py``), an outstanding-request counter (the
+least-outstanding selection signal), a per-replica circuit breaker
+(``fleet/breaker.py``), and the prober-maintained rotation state:
+
+- ``healthy`` — in rotation, receives traffic.
+- ``probation`` — recovering: the replica answered ready again after
+  being out, but must string together ``probation_probes`` consecutive
+  OK probes before traffic returns (a flapping replica — wedge,
+  recover, wedge — never oscillates back into rotation on one good
+  poll).
+- ``out`` — readiness failed (connect error, 503 while booting,
+  watchdog degraded/wedged); receives no traffic.
+
+The prober (one named daemon thread per :class:`ReplicaSet`, joined on
+``close()``) polls ``/.well-known/ready`` every ``probe_interval_s``
+and — piggybacked on the same round — scrapes ``GET /admin/engine`` for
+the saturation signals the admission layer sheds on: paged-KV free
+blocks and batcher queue depth. Probes can optionally HEDGE: when
+``hedge_ms`` > 0 a second probe fires if the first hasn't answered in
+that window and the first reply wins — the p99 of a health check on a
+busy replica stops deciding rotation membership.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import queue
+import threading
+import time
+from typing import Any, Optional
+
+from gofr_tpu.fleet.breaker import CircuitBreaker
+from gofr_tpu.service import HTTPService
+
+HEALTHY = "healthy"
+PROBATION = "probation"
+OUT = "out"
+
+# numeric gauge encoding for gofr_tpu_router_replica_state{replica}
+STATE_VALUES = {OUT: 0, PROBATION: 1, HEALTHY: 2}
+
+
+def affinity_order(key: str, names: list[str]) -> list[str]:
+    """Rendezvous (highest-random-weight) order of ``names`` for
+    ``key``: stable under membership churn — removing one replica only
+    remaps the conversations that lived on it, never the whole fleet."""
+    def score(name: str) -> int:
+        digest = hashlib.md5(f"{key}|{name}".encode("utf-8")).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    return sorted(names, key=score, reverse=True)
+
+
+class Replica:
+    def __init__(
+        self,
+        name: str,
+        address: str,
+        logger: Any,
+        connect_timeout: float = 2.0,
+        read_timeout: float = 30.0,
+        breaker: Optional[CircuitBreaker] = None,
+    ):
+        self.name = name
+        self.address = address
+        self.client = HTTPService(
+            address, logger, name=name,
+            connect_timeout=connect_timeout, read_timeout=read_timeout,
+        )
+        self.breaker = breaker or CircuitBreaker()
+        self._lock = threading.Lock()
+        self._outstanding = 0
+        self.state = HEALTHY  # optimistic: the prober corrects within a round
+        self.ok_streak = 0
+        self.fail_streak = 0
+        self.probes = 0
+        self.last_probe_error = ""
+        self.saturated = False
+        self.engine: Optional[dict[str, Any]] = None
+        self.last_kv_rejects: Optional[int] = None  # prober-only state
+        self.kv_starved = False  # KV-only component of `saturated`
+
+    # -- outstanding-request accounting (selection signal) -------------------
+    def mark_dispatch(self) -> int:
+        with self._lock:
+            self._outstanding += 1
+            return self._outstanding
+
+    def mark_done(self) -> int:
+        with self._lock:
+            self._outstanding = max(0, self._outstanding - 1)
+            return self._outstanding
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return self._outstanding
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "address": self.address,
+            "state": self.state,
+            "outstanding": self.outstanding,
+            "saturated": self.saturated,
+            "probes": self.probes,
+            "ok_streak": self.ok_streak,
+            "fail_streak": self.fail_streak,
+            "last_probe_error": self.last_probe_error or None,
+            "breaker": self.breaker.snapshot(),
+            "engine": self.engine,
+        }
+
+
+class ReplicaSet:
+    """The fleet membership + its prober thread."""
+
+    def __init__(
+        self,
+        replicas: list[Replica],
+        logger: Any,
+        probe_interval_s: float = 1.0,
+        probe_timeout_s: float = 1.0,
+        hedge_ms: float = 0.0,
+        out_after: int = 2,
+        probation_probes: int = 3,
+        saturation_queue: int = 64,
+        affinity_max_skew: int = 4,
+        on_state_change: Optional[Any] = None,
+    ):
+        self.replicas = replicas
+        self.logger = logger
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.hedge_ms = hedge_ms
+        self.out_after = max(1, out_after)
+        self.probation_probes = max(1, probation_probes)
+        self.saturation_queue = saturation_queue
+        self.affinity_max_skew = max(0, affinity_max_skew)
+        self._on_state_change = on_state_change
+        self._stop = threading.Event()
+        self._rr = 0  # round-robin tie-break for equal-outstanding picks
+        self._rr_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "ReplicaSet":
+        self._thread = threading.Thread(
+            target=self._probe_loop, name="gofr-fleet-probe", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- selection ------------------------------------------------------------
+    def by_name(self, name: str) -> Optional[Replica]:
+        for replica in self.replicas:
+            if replica.name == name:
+                return replica
+        return None
+
+    def candidates(self, affinity_key: str = "",
+                   exclude: Optional[set[str]] = None) -> list[Replica]:
+        """Dispatch order for one attempt round: in-rotation replicas,
+        affinity target first (rendezvous on the conversation key —
+        that replica holds the paged-KV blocks of the prefix), the rest
+        by least-outstanding with a rotating tie-break. Affinity yields
+        to load once the preferred replica runs ``affinity_max_skew``
+        more outstanding requests than the least-loaded sibling — a
+        popular shared prefix must not funnel the whole fleet onto one
+        replica. ``exclude`` drops replicas already tried this
+        request."""
+        eligible = [
+            r for r in self.replicas
+            if r.state == HEALTHY and (exclude is None or r.name not in exclude)
+        ]
+        if not eligible:
+            return []
+        with self._rr_lock:
+            self._rr += 1
+            rotate = self._rr
+        order = {r.name: i for i, r in enumerate(eligible)}
+        eligible.sort(
+            key=lambda r: (r.outstanding,
+                           (order[r.name] + rotate) % len(order))
+        )
+        if affinity_key:
+            ranked = affinity_order(affinity_key, [r.name for r in eligible])
+            preferred = next(
+                r for r in eligible if r.name == ranked[0]
+            )
+            least_loaded = eligible[0].outstanding
+            if preferred.outstanding <= least_loaded + self.affinity_max_skew:
+                eligible.sort(key=lambda r: 0 if r.name == preferred.name else 1)
+        return eligible
+
+    def in_rotation(self) -> list[Replica]:
+        return [r for r in self.replicas if r.state == HEALTHY]
+
+    def all_saturated(self) -> bool:
+        """True when every in-rotation replica reports KV/queue
+        saturation — the admission layer sheds instead of queueing."""
+        rotation = self.in_rotation()
+        return bool(rotation) and all(r.saturated for r in rotation)
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "probe_interval_s": self.probe_interval_s,
+            "out_after": self.out_after,
+            "probation_probes": self.probation_probes,
+            "replicas": [r.snapshot() for r in self.replicas],
+        }
+
+    # -- probing --------------------------------------------------------------
+    def _probe_loop(self) -> None:
+        """One probe thread PER REPLICA per round: a serial sweep would
+        make failure-detection latency O(n_replicas × probe_timeout) —
+        two hard-down replicas must not delay taking a third, newly
+        wedged one out of rotation. A replica whose previous probe is
+        still running (stuck in its connect timeout) is skipped, never
+        double-probed; each replica's state machine thus stays
+        single-threaded."""
+        pending: dict[str, threading.Thread] = {}
+        while not self._stop.is_set():
+            for replica in self.replicas:
+                previous = pending.get(replica.name)
+                if previous is not None and previous.is_alive():
+                    continue
+                thread = threading.Thread(
+                    target=self._probe_guarded, args=(replica,),
+                    name=f"gofr-fleet-probe-{replica.name}", daemon=True,
+                )
+                pending[replica.name] = thread
+                thread.start()
+            self._stop.wait(self.probe_interval_s)
+        for thread in pending.values():
+            thread.join(timeout=self.probe_timeout_s * 2 + 1.0)
+
+    def _probe_guarded(self, replica: Replica) -> None:
+        try:
+            self.probe_once(replica)
+        except Exception as exc:
+            # a prober crash would silently freeze rotation state
+            self.logger.errorf(
+                "fleet probe of %s failed: %r", replica.name, exc
+            )
+
+    def probe_once(self, replica: Replica) -> bool:
+        """One probe round for ``replica``: readiness decides rotation,
+        the piggybacked engine scrape updates saturation. Returns the
+        readiness verdict (also applied to the state machine)."""
+        ok, detail = self._ready_probe(replica)
+        replica.probes += 1
+        replica.last_probe_error = "" if ok else detail
+        self._apply_probe(replica, ok)
+        if ok:
+            self._scrape_engine(replica)
+        else:
+            replica.saturated = False
+            replica.engine = None
+        return ok
+
+    def _ready_probe(self, replica: Replica) -> tuple[bool, str]:
+        if self.hedge_ms and self.hedge_ms > 0:
+            return self._hedged_ready(replica)
+        return self._ready_once(replica)
+
+    def _ready_once(self, replica: Replica) -> tuple[bool, str]:
+        try:
+            resp = replica.client.request(
+                "GET", "/.well-known/ready",
+                connect_timeout=self.probe_timeout_s,
+                read_timeout=self.probe_timeout_s,
+                retries=0,
+            )
+        except Exception as exc:
+            return False, str(exc)
+        if resp.status_code == 200:
+            return True, ""
+        detail = resp.body.decode("utf-8", "replace")[:200]
+        return False, f"ready {resp.status_code}: {detail}"
+
+    def _hedged_ready(self, replica: Replica) -> tuple[bool, str]:
+        """Hedged readiness read: fire a second probe if the first is
+        slower than ``hedge_ms``; first answer wins. The loser's reply
+        is discarded (its connection closes with its thread)."""
+        results: "queue.Queue[tuple[bool, str]]" = queue.Queue()
+
+        def attempt() -> None:
+            results.put(self._ready_once(replica))
+
+        first = threading.Thread(
+            target=attempt, name="gofr-fleet-hedge", daemon=True
+        )
+        first.start()
+        try:
+            return results.get(timeout=self.hedge_ms / 1000.0)
+        except queue.Empty:
+            pass
+        second = threading.Thread(
+            target=attempt, name="gofr-fleet-hedge", daemon=True
+        )
+        second.start()
+        try:
+            return results.get(timeout=self.probe_timeout_s * 2 + 1.0)
+        except queue.Empty:
+            return False, "hedged probe timed out"
+
+    def _scrape_engine(self, replica: Replica) -> None:
+        """Saturation signals off ``GET /admin/engine``: paged-KV free
+        blocks and batcher queue depth. A router fronting replicas
+        without an engine (or with admin auth) keeps saturated=False —
+        shedding then falls back to the router's own in-flight cap."""
+        try:
+            resp = replica.client.request(
+                "GET", "/admin/engine",
+                connect_timeout=self.probe_timeout_s,
+                read_timeout=self.probe_timeout_s,
+                retries=0,
+            )
+            if resp.status_code != 200:
+                replica.saturated = False
+                return
+            data = json.loads(resp.body.decode("utf-8"))
+        except Exception:
+            replica.saturated = False
+            return
+        if isinstance(data, dict) and isinstance(data.get("data"), dict):
+            data = data["data"]  # the framework envelope
+        engine: dict[str, Any] = {
+            "state": (data.get("engine") or {}).get("state"),
+            "queue_depth": data.get("queue_depth"),
+        }
+        kv = data.get("kv_blocks") or {}
+        engine["kv_free"] = kv.get("free")
+        engine["kv_cached"] = kv.get("cached")
+        engine["kv_total"] = kv.get("total")
+        engine["kv_exhausted_rejects"] = kv.get("kv_exhausted_rejects")
+        replica.engine = engine
+        # KV starvation keys on the replica's OWN verdicts: a rising
+        # kv_exhausted_rejects counter means admissions are being
+        # rejected RIGHT NOW (the pool's authoritative signal — free/
+        # cached counts can't tell pinned-shared cache blocks from
+        # evictable ones). Starvation then sustains while blocks stay
+        # visibly scarce (free == 0 with live decodes) and clears when
+        # free blocks appear or every decode has finished (an idle
+        # cache is wholly evictable).
+        rejects = int(kv.get("kv_exhausted_rejects") or 0)
+        delta = (rejects - replica.last_kv_rejects
+                 if replica.last_kv_rejects is not None else 0)
+        replica.last_kv_rejects = rejects
+        free = int(kv.get("free") or 0)
+        active = int(kv.get("active") or 0)
+        if delta > 0:
+            replica.kv_starved = True
+        elif free > 0 or active == 0:
+            replica.kv_starved = False
+        # else: sticky on the KV-ONLY flag while blocks stay scarce —
+        # never on the composite `saturated`, or a one-time queue spike
+        # would latch as KV starvation for as long as the warm cache
+        # keeps the free list empty (its routine steady state)
+        depth = engine["queue_depth"] or 0
+        queue_full = self.saturation_queue > 0 and depth >= self.saturation_queue
+        replica.saturated = replica.kv_starved or queue_full
+
+    def _apply_probe(self, replica: Replica, ok: bool) -> None:
+        """The probation state machine. Runs on the prober thread only
+        (plus tests), so plain attribute writes are safe."""
+        was = replica.state
+        if ok:
+            replica.ok_streak += 1
+            replica.fail_streak = 0
+            if replica.state == OUT:
+                replica.state = PROBATION
+                replica.ok_streak = 1
+            if (replica.state == PROBATION
+                    and replica.ok_streak >= self.probation_probes):
+                replica.state = HEALTHY
+        else:
+            replica.fail_streak += 1
+            replica.ok_streak = 0
+            if replica.state == PROBATION or (
+                replica.fail_streak >= self.out_after
+            ):
+                replica.state = OUT
+        if was != replica.state and self._on_state_change is not None:
+            try:
+                self._on_state_change(replica, was, replica.state)
+            except Exception:  # gofrlint: disable=GFL006 — metrics/log hook must not kill the prober
+                pass

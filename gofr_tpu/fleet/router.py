@@ -1,0 +1,781 @@
+"""The fleet front door: health-aware routing, resilient forwarding,
+admission control, and graceful drain — one :class:`FleetRouter` object
+wired onto a plain gofr app (``gofr_tpu.fleet.wire_fleet``).
+
+Request path, in order:
+
+1. **Admission** — draining? 503. Tenant over quota? 429 +
+   ``Retry-After`` (exact token-refill time). Router at its in-flight
+   cap, or every in-rotation replica reporting KV/queue saturation
+   (the replica's ``pool_reject``/``kv_exhausted`` signals, scraped by
+   the prober)? 429 + ``Retry-After`` — the queue is bounded by
+   construction, overload is always an explicit signal upstream.
+2. **Selection** — in-rotation replicas only (prober state machine),
+   prefix-affinity first (rendezvous hash on the conversation key, so
+   a follow-up turn lands on the replica holding its paged-KV prefix
+   blocks), then least-outstanding with a rotating tie-break; the
+   per-replica circuit breaker gets the final veto.
+3. **Forwarding** — per-request deadline budget across attempts;
+   bounded retries with decorrelated-jitter backoff for failures that
+   produced no client-visible bytes (connect errors always; read
+   timeouts and 5xx for requests not yet streamed); streaming requests
+   pass SSE chunks through and stop being retryable the moment the
+   upstream response head arrives.
+4. **Accounting** — every decision rides the existing telemetry:
+   ``gofr_tpu_router_*`` metrics, a bounded ring of per-request route
+   records (the flight-recorder idiom one layer up), and the
+   ``GET /admin/fleet`` snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from gofr_tpu.fleet import breaker as breaker_mod
+from gofr_tpu.fleet.admission import QuotaTable, tenant_of
+from gofr_tpu.fleet.replica import STATE_VALUES, ReplicaSet
+from gofr_tpu.http.response import Response
+from gofr_tpu.service import ServiceCallError, _encode_query, backoff_delays
+
+_JSON = "application/json"
+
+# request headers forwarded to the replica (hop-by-hop and router-local
+# headers are stripped; the service client adds its own traceparent /
+# correlation id so the replica's spans join the router's trace)
+_FORWARD_HEADERS = (
+    "content-type", "accept", "authorization", "x-tenant",
+    "x-session-id", "x-affinity-key", "user-agent", "x-forwarded-for",
+)
+# response headers forwarded back to the client
+_RETURN_HEADERS = ("content-type", "retry-after", "x-request-id")
+
+
+def hash_affinity(key: str) -> str:
+    """The display form of an affinity key: route records and
+    ``/admin/fleet`` must never carry the raw key, which can be the
+    user's prompt text."""
+    import hashlib
+
+    return "aff-" + hashlib.sha256(key.encode("utf-8")).hexdigest()[:16]
+
+
+def affinity_key_of(request: Any, body: Any) -> str:
+    """The conversation/prefix key a request routes by: explicit
+    ``X-Session-ID``/``X-Affinity-Key`` header first, then the OpenAI
+    ``user`` field, else the conversation prefix itself (first user
+    message / prompt head) — the same bytes the replica's prefix cache
+    keys on."""
+    key = request.header("X-Session-ID") or request.header("X-Affinity-Key")
+    if key:
+        return key
+    if not isinstance(body, dict):
+        return ""
+    user = body.get("user")
+    if isinstance(user, str) and user:
+        return user
+    messages = body.get("messages")
+    if isinstance(messages, list) and messages:
+        # the first USER message, not messages[0]: chat traffic shares
+        # its system prompt, and keying on it would rendezvous the
+        # whole fleet's load onto one replica
+        for message in messages:
+            if (isinstance(message, dict)
+                    and message.get("role") == "user"
+                    and isinstance(message.get("content"), str)
+                    and message["content"]):
+                return message["content"][:128]
+        first = messages[0]
+        if isinstance(first, dict) and isinstance(first.get("content"), str):
+            return first["content"][:128]
+    prompt = body.get("prompt")
+    if isinstance(prompt, str) and prompt:
+        return prompt[:128]
+    if isinstance(prompt, list) and prompt:
+        # token-id prompts key on their head, same as the prefix cache
+        return ",".join(str(t) for t in prompt[:32])
+    return ""
+
+
+class FleetRouter:
+    def __init__(
+        self,
+        logger: Any,
+        metrics: Any,
+        replica_set: ReplicaSet,
+        quota: QuotaTable,
+        retries: int = 2,
+        deadline_s: float = 30.0,
+        connect_timeout_s: float = 2.0,
+        read_timeout_s: float = 30.0,
+        max_inflight: int = 256,
+        retry_after_s: float = 1.0,
+        record_capacity: int = 256,
+    ):
+        self.logger = logger
+        self.metrics = metrics
+        self.replica_set = replica_set
+        self.quota = quota
+        self.retries = retries
+        self.deadline_s = deadline_s
+        self.connect_timeout_s = connect_timeout_s
+        self.read_timeout_s = read_timeout_s
+        self.max_inflight = max_inflight
+        self.retry_after_s = retry_after_s
+        self.affinity_enabled = True
+        self.trust_tenant_header = False  # FLEET_TRUST_TENANT_HEADER
+        self._records: deque = deque(maxlen=record_capacity)
+        self._records_lock = threading.Lock()
+        self._inflight = 0
+        self._idle = threading.Condition()
+        self._draining = False
+        self._init_metrics()
+        self._wire_hooks()
+
+    # -- metrics ---------------------------------------------------------------
+    def _init_metrics(self) -> None:
+        m = self.metrics
+        self._req_total = m.counter(
+            "gofr_tpu_router_requests_total",
+            "forwarded requests by replica and outcome "
+            "(ok | upstream_5xx | network_error | client_aborted)",
+            labels=("replica", "outcome"),
+        )
+        self._retries_total = m.counter(
+            "gofr_tpu_router_retries_total",
+            "router retry attempts by failing replica and reason",
+            labels=("replica", "reason"),
+        )
+        self._shed_total = m.counter(
+            "gofr_tpu_router_shed_total",
+            "requests shed at admission (429/503) by reason",
+            labels=("reason",),
+        )
+        self._breaker_total = m.counter(
+            "gofr_tpu_router_breaker_transitions_total",
+            "circuit-breaker state transitions by replica and target state",
+            labels=("replica", "to"),
+        )
+        self._breaker_gauge = m.gauge(
+            "gofr_tpu_router_breaker_state",
+            "breaker state per replica (0 closed, 1 half-open, 2 open)",
+            labels=("replica",),
+        )
+        self._replica_gauge = m.gauge(
+            "gofr_tpu_router_replica_state",
+            "rotation state per replica (0 out, 1 probation, 2 healthy)",
+            labels=("replica",),
+        )
+        self._outstanding_gauge = m.gauge(
+            "gofr_tpu_router_outstanding_depth",
+            "requests currently outstanding against each replica",
+            labels=("replica",),
+        )
+        self._inflight_gauge = m.gauge(
+            "gofr_tpu_router_inflight_depth",
+            "requests currently inside the router (admitted, not finished)",
+        )
+        self._upstream_seconds = m.histogram(
+            "gofr_tpu_router_upstream_seconds",
+            "upstream attempt latency per replica (success or failure)",
+            labels=("replica",),
+        )
+
+    def _wire_hooks(self) -> None:
+        """Attach breaker-transition and rotation-state hooks so every
+        decision is observable the moment it happens."""
+        for replica in self.replica_set.replicas:
+            self._replica_gauge.set(
+                float(STATE_VALUES[replica.state]), replica=replica.name
+            )
+            self._breaker_gauge.set(
+                float(breaker_mod.STATE_VALUES[replica.breaker.state]),
+                replica=replica.name,
+            )
+            replica.breaker._on_transition = self._breaker_hook(replica.name)
+        self.replica_set._on_state_change = self._rotation_hook
+
+    def _breaker_hook(self, name: str) -> Any:
+        def hook(was: str, to: str) -> None:
+            self._breaker_total.inc(replica=name, to=to)
+            self._breaker_gauge.set(
+                float(breaker_mod.STATE_VALUES[to]), replica=name
+            )
+            self.logger.infof("fleet breaker %s: %s -> %s", name, was, to)
+        return hook
+
+    def _rotation_hook(self, replica: Any, was: str, now: str) -> None:
+        self._replica_gauge.set(
+            float(STATE_VALUES[now]), replica=replica.name
+        )
+        self.logger.infof(
+            "fleet replica %s: %s -> %s (%s)",
+            replica.name, was, now, replica.last_probe_error or "ready",
+        )
+
+    # -- lifecycle -------------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def in_flight(self) -> int:
+        with self._idle:
+            return self._inflight
+
+    def begin_drain(self) -> None:
+        """Stop admitting; readiness flips to 503 (handler.py checks
+        :attr:`draining`)."""
+        self._draining = True
+        self.logger.infof(
+            "fleet drain: admission closed, %s in flight", self.in_flight
+        )
+
+    # the in-flight counter releases when the HANDLER finishes; the
+    # server still has to flush that last response onto the socket, so
+    # drain() lingers briefly before declaring the listener safe to stop
+    DRAIN_GRACE_S = 0.25
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Graceful drain: stop admitting, then wait for the in-flight
+        requests to finish. Returns True when fully drained."""
+        self.begin_drain()
+        deadline = time.monotonic() + timeout_s
+        with self._idle:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._idle.wait(remaining)
+            drained = self._inflight == 0
+        if drained:
+            time.sleep(self.DRAIN_GRACE_S)
+        self.logger.infof(
+            "fleet drain %s (%s left)",
+            "complete" if drained else "TIMED OUT", self.in_flight,
+        )
+        return drained
+
+    def close(self) -> None:
+        self._draining = True
+        self.replica_set.close()
+
+    # -- admission -------------------------------------------------------------
+    def _shed(self, status: int, reason: str, retry_after_s: float,
+              detail: str) -> Response:
+        self._shed_total.inc(reason=reason)
+        body = json.dumps({"error": {
+            "message": detail, "reason": reason,
+            "retry_after_s": round(retry_after_s, 3),
+        }}).encode("utf-8")
+        response = Response(
+            status=status,
+            headers={"Content-Type": _JSON,
+                     "Retry-After": str(max(1, int(retry_after_s + 0.999)))},
+            body=body,
+        )
+        response._shed_reason = reason
+        return response
+
+    def _admit(self, request: Any, tenant: str) -> Optional[Response]:
+        """None = admitted AND the in-flight slot is HELD (the caller
+        must ``_release()``); a Response = the shed verdict. Ordering:
+        router-state sheds first, then the slot (check-and-increment
+        atomically under the lock — a read-then-act gap would let a
+        thundering herd overshoot the cap by up to the handler-pool
+        size), then the quota LAST so router-side rejections never
+        charge the tenant a token for a request the router itself
+        refused."""
+        if self._draining:
+            return self._shed(
+                503, "draining", self.retry_after_s,
+                "router is draining; retry against another front door",
+            )
+        if self.replica_set.all_saturated():
+            return self._shed(
+                429, "kv_exhausted", self.retry_after_s,
+                "every replica reports KV/queue saturation",
+            )
+        if not self.replica_set.in_rotation():
+            return self._shed(
+                503, "no_replicas", self.retry_after_s,
+                "no replica in rotation",
+            )
+        if not self._try_acquire_slot():
+            return self._shed(
+                429, "inflight", self.retry_after_s,
+                "router at its in-flight cap",
+            )
+        ok, retry_after = self.quota.take(tenant)
+        if not ok:
+            self._release()
+            return self._shed(
+                429, "quota", retry_after,
+                f"tenant '{tenant}' over its request quota",
+            )
+        return None
+
+    def _try_acquire_slot(self) -> bool:
+        with self._idle:
+            if self.max_inflight > 0 and self._inflight >= self.max_inflight:
+                return False
+            self._inflight += 1
+            self._inflight_gauge.set(float(self._inflight))
+            return True
+
+    # -- the forward handler ---------------------------------------------------
+    def handle(self, ctx: Any) -> Response:
+        """The transport handler registered for every forwarded route
+        (sync: runs on the container's handler pool)."""
+        request = ctx.request
+        tenant = tenant_of(request, self.trust_tenant_header)
+        verdict = self._admit(request, tenant)
+        if verdict is not None:
+            with self._records_lock:
+                self._records.append({
+                    "ts": time.time(),  # gofrlint: wall-clock — route-record display timestamp
+                    "method": request.method, "path": request.path,
+                    "tenant": tenant, "attempts": [], "retries": 0,
+                    "status": verdict.status,
+                    "outcome": f"shed:{verdict._shed_reason}",
+                })
+            return verdict
+        # reached here: _admit HOLDS the in-flight slot for this request
+        body_json = self._body_json(request)
+        affinity = (affinity_key_of(request, body_json)
+                    if self.affinity_enabled else "")
+        wants_stream = isinstance(body_json, dict) and bool(body_json.get("stream"))
+        try:
+            return self._forward(
+                request, tenant, affinity, wants_stream,
+                executor=ctx.container.handler_executor,
+            )
+        finally:
+            # streaming responses decrement in their own finally instead
+            # (the handler returns before the body is pulled); _forward
+            # flags that by setting _stream_owns_release
+            if not getattr(request, "_stream_owns_release", False):
+                self._release()
+
+    def _release(self) -> None:
+        with self._idle:
+            self._inflight = max(0, self._inflight - 1)
+            self._inflight_gauge.set(float(self._inflight))
+            if self._inflight == 0:
+                self._idle.notify_all()
+
+    @staticmethod
+    def _body_json(request: Any) -> Any:
+        if not request.body:
+            return None
+        try:
+            return json.loads(request.body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+    def _target(self, request: Any) -> str:
+        # parse_qs gives {key: [values]}; _encode_query repeats the key
+        # per value, round-tripping the original query string
+        query = _encode_query(request.query)
+        return request.path + ("?" + query if query else "")
+
+    @staticmethod
+    def _forward_headers(request: Any) -> dict[str, str]:
+        return {
+            name: request.headers[name]
+            for name in _FORWARD_HEADERS if name in request.headers
+        }
+
+    def _forward(self, request: Any, tenant: str, affinity: str,
+                 wants_stream: bool, executor: Any = None) -> Response:
+        start = time.monotonic()
+        deadline = start + self.deadline_s
+        target = self._target(request)
+        headers = self._forward_headers(request)
+        record: dict[str, Any] = {
+            "ts": time.time(),  # gofrlint: wall-clock — route-record display timestamp
+            "method": request.method,
+            "path": request.path,
+            "tenant": tenant,
+            # hashed: the raw key can be PROMPT TEXT (affinity_key_of
+            # falls back to the message head) and route records serve
+            # on /admin/fleet — same rule as the tenant hash
+            "affinity_key": hash_affinity(affinity) if affinity else None,
+            "stream": wants_stream,
+            "attempts": [],
+            "outcome": "error",
+            "status": 0,
+        }
+        tried: set[str] = set()
+        delays = backoff_delays(self.retries)
+        response: Optional[Response] = None
+        attempts = 0
+        while attempts <= self.retries:
+            # budget check BEFORE the pick: _pick may claim a breaker's
+            # single half-open probe slot, and only _attempt releases it
+            # (via record_success/record_failure) — breaking between the
+            # two would wedge that breaker half-open forever
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            picked = self._pick(affinity, tried)
+            if picked is None:
+                break
+            replica, is_probe = picked
+            if record["attempts"]:
+                # a retry is now CERTAIN (a replica was found and will
+                # be attempted): count it against the attempt it redoes
+                prev = record["attempts"][-1]
+                self._retries_total.inc(
+                    replica=prev["replica"],
+                    reason=prev.get("reason") or "error",
+                )
+            attempts += 1
+            tried.add(replica.name)
+            response = self._attempt(
+                replica, request, target, headers, wants_stream,
+                remaining, record, executor, is_probe,
+            )
+            if response is not None:
+                if response.stream is None:
+                    # streaming responses finish their record (and the
+                    # in-flight release) when the body completes
+                    self._finish_record(record, response.status)
+                return response
+            delay = next(delays, None)
+            if delay is None or time.monotonic() + delay >= deadline:
+                break
+            time.sleep(delay)
+        # nothing served: every candidate failed, refused, or timed out
+        last = record["attempts"][-1] if record["attempts"] else None
+        detail = (last or {}).get("error") or "no replica could serve the request"
+        self._finish_record(record, 502)
+        body = json.dumps({"error": {
+            "message": f"fleet forward failed after {attempts} attempt(s): {detail}",
+        }}).encode("utf-8")
+        return Response(
+            status=502,
+            headers={"Content-Type": _JSON,
+                     "Retry-After": str(max(1, int(self.retry_after_s)))},
+            body=body,
+        )
+
+    def _pick(self, affinity: str,
+              tried: set[str]) -> Optional[tuple[Any, bool]]:
+        """First candidate whose breaker admits the request, plus
+        whether this dispatch IS that breaker's half-open probe (its
+        success report must carry the probe grant). Falls back to
+        already-tried replicas only when nothing fresh remains (a
+        2-replica fleet with one dead replica must still retry the
+        healthy one rather than give up)."""
+        for exclude in (tried, None):
+            for replica in self.replica_set.candidates(affinity, exclude=exclude):
+                grant = replica.breaker.try_acquire()
+                if grant:
+                    return replica, grant == breaker_mod.PROBE
+            if not tried:
+                break
+        return None
+
+    def _attempt(
+        self,
+        replica: Any,
+        request: Any,
+        target: str,
+        headers: dict[str, str],
+        wants_stream: bool,
+        remaining_s: float,
+        record: dict[str, Any],
+        executor: Any = None,
+        is_probe: bool = False,
+    ) -> Optional[Response]:
+        """One forward attempt. Returns the client-facing Response, or
+        None when the failure is retryable (breaker/metrics/record
+        already updated)."""
+        entry: dict[str, Any] = {"replica": replica.name, "status": None,
+                                 "error": None, "elapsed_ms": 0}
+        record["attempts"].append(entry)
+        depth = replica.mark_dispatch()
+        self._outstanding_gauge.set(float(depth), replica=replica.name)
+        attempt_start = time.monotonic()
+        read_timeout = min(self.read_timeout_s, remaining_s)
+        streaming: Optional[Any] = None
+        try:
+            if wants_stream:
+                streaming = replica.client.stream(
+                    request.method, target, body=request.body or None,
+                    headers=headers,
+                    connect_timeout=min(self.connect_timeout_s, remaining_s),
+                    read_timeout=read_timeout,
+                )
+                status = streaming.status_code
+                if status == 200:
+                    # committed: from here the bytes flow to the client
+                    # and the request stops being retryable
+                    return self._stream_response(
+                        replica, request, streaming, entry, attempt_start,
+                        record, executor, is_probe,
+                    )
+                # bounded drain: an untrusted replica dripping its
+                # error body must not pin this thread past the budget
+                payload = streaming.read(budget_s=read_timeout)
+                resp_headers = streaming.headers
+                streaming = None
+            else:
+                resp = replica.client.request(
+                    request.method, target, body=request.body or None,
+                    headers=headers,
+                    connect_timeout=min(self.connect_timeout_s, remaining_s),
+                    read_timeout=read_timeout,
+                    retries=0,
+                )
+                status, payload, resp_headers = (
+                    resp.status_code, resp.body, resp.headers
+                )
+        except ServiceCallError as exc:
+            return self._note_failure(
+                replica, entry, attempt_start, "network", str(exc.cause)
+            )
+        except Exception as exc:
+            # a mid-read socket timeout / reset from StreamingServiceResponse
+            # arrives unwrapped; the connection closed with it
+            if streaming is not None:
+                streaming.close()
+            return self._note_failure(
+                replica, entry, attempt_start, "read", str(exc)
+            )
+        elapsed = time.monotonic() - attempt_start
+        entry["status"] = status
+        entry["elapsed_ms"] = round(elapsed * 1000, 1)
+        self._upstream_seconds.observe(elapsed, replica=replica.name)
+        self._finish_attempt(replica)
+        if status >= 500:
+            replica.breaker.record_failure()
+            self._req_total.inc(replica=replica.name, outcome="upstream_5xx")
+            entry["error"] = f"upstream {status}"
+            entry["reason"] = f"status_{status}"
+            return None  # retryable: no bytes reached the client
+        replica.breaker.record_success(probe=is_probe)
+        self._req_total.inc(replica=replica.name, outcome="ok")
+        out_headers = _filter_return_headers(resp_headers)
+        if status == 429:
+            # echo the replica's overload verdict upstream, always with
+            # a backoff hint (never an unbounded queue)
+            out_headers.setdefault(
+                "Retry-After", str(max(1, int(self.retry_after_s)))
+            )
+            self._shed_total.inc(reason="upstream_429")
+        return Response(status=status, headers=out_headers, body=payload)
+
+    def _note_failure(self, replica: Any, entry: dict, attempt_start: float,
+                      reason: str, detail: str) -> None:
+        elapsed = time.monotonic() - attempt_start
+        entry["error"] = detail
+        entry["reason"] = reason
+        entry["elapsed_ms"] = round(elapsed * 1000, 1)
+        self._upstream_seconds.observe(elapsed, replica=replica.name)
+        self._finish_attempt(replica)
+        replica.breaker.record_failure()
+        self._req_total.inc(replica=replica.name, outcome="network_error")
+        return None
+
+    def _finish_attempt(self, replica: Any) -> None:
+        depth = replica.mark_done()
+        self._outstanding_gauge.set(float(depth), replica=replica.name)
+
+    def _stream_response(
+        self,
+        replica: Any,
+        request: Any,
+        streaming: Any,
+        entry: dict[str, Any],
+        attempt_start: float,
+        record: dict[str, Any],
+        executor: Any = None,
+        is_probe: bool = False,
+    ) -> Response:
+        """Wrap the upstream chunk iterator for SSE passthrough. The
+        handler returns immediately; accounting (outstanding, breaker,
+        in-flight release, route record) completes when the body
+        finishes — through an IDEMPOTENT finalizer invoked from both
+        the chunk generator's ``finally`` and the async bridge's, so a
+        cancelled connection task (drain, client gone, shutdown) can
+        never leave the in-flight counter elevated or a half-open
+        breaker probe slot claimed, even if the sync generator is
+        mid-``next`` on a pool thread or was never started at all."""
+        request._stream_owns_release = True
+        entry["status"] = 200
+        finalizer = _StreamFinalizer(
+            self, replica, streaming, entry, record, attempt_start, is_probe
+        )
+
+        def chunks() -> Any:
+            try:
+                for chunk in streaming.iter_chunks():
+                    yield chunk
+            except Exception:
+                finalizer.finish("upstream_error")
+                raise  # the server aborts the client connection (truncated)
+            finally:
+                finalizer.finish("ok")
+
+        return Response(
+            status=200,
+            headers=_filter_return_headers(streaming.headers),
+            stream=_sync_pull(chunks(), executor, finalizer),
+        )
+
+    def _finish_record(self, record: dict[str, Any], status: int) -> None:
+        record["status"] = status
+        record["retries"] = max(0, len(record["attempts"]) - 1)
+        # outcome follows the status CLASS — a forwarded 429 or 404 is
+        # not a successful route, and an operator triaging overload
+        # from these records must see it agree with the shed metrics
+        if status == 499:
+            record["outcome"] = "aborted"
+        elif status == 429:
+            record["outcome"] = "shed_upstream"
+        elif 200 <= status < 400:
+            record["outcome"] = "ok"
+        elif 400 <= status < 500:
+            record["outcome"] = "client_error"
+        else:
+            record["outcome"] = "error"
+        if record.get("_stored"):
+            return
+        record["_stored"] = True
+        with self._records_lock:
+            self._records.append(record)
+
+    # -- admin surface ---------------------------------------------------------
+    def records(self, limit: int = 50) -> list[dict[str, Any]]:
+        with self._records_lock:
+            recent = list(self._records)[-limit:]
+        return [
+            {k: v for k, v in r.items() if not k.startswith("_")}
+            for r in reversed(recent)
+        ]
+
+    def snapshot(self) -> dict[str, Any]:
+        """``GET /admin/fleet``: the whole front door on one page."""
+        return {
+            "draining": self._draining,
+            "in_flight": self.in_flight,
+            "max_inflight": self.max_inflight,
+            "retries": self.retries,
+            "deadline_s": self.deadline_s,
+            "quota": self.quota.stats(),
+            "replica_set": self.replica_set.snapshot(),
+            "routes": self.records(limit=50),
+        }
+
+
+class _StreamFinalizer:
+    """Idempotent completion accounting for one proxied stream. Invoked
+    from the chunk generator's ``finally``, the async bridge's
+    ``finally``, or both in either order — the FIRST call wins. Keeping
+    it out of the generators means a generator that is cancelled
+    mid-``next`` (``close()`` would raise 'generator already
+    executing') or finalized before its first pull (its body — and
+    ``finally`` — never ran) still releases everything."""
+
+    def __init__(self, router: "FleetRouter", replica: Any, streaming: Any,
+                 entry: dict[str, Any], record: dict[str, Any],
+                 attempt_start: float, is_probe: bool = False):
+        self._router = router
+        self._replica = replica
+        self._streaming = streaming
+        self._entry = entry
+        self._record = record
+        self._attempt_start = attempt_start
+        self._is_probe = is_probe
+        self._done = False
+        self._lock = threading.Lock()
+
+    def finish(self, outcome: str) -> None:
+        """``outcome``: "ok" (body completed), "upstream_error" (the
+        REPLICA broke the stream — breaker failure), or "aborted" (the
+        CLIENT walked away / the connection task was cancelled — the
+        replica was serving fine, so its breaker records a success:
+        punishing replicas for client disconnects would open breakers
+        on a healthy fleet, and a half-open probe slot must still be
+        released either way)."""
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+        router, replica, entry = self._router, self._replica, self._entry
+        # closing the upstream also unblocks a pool thread still parked
+        # in next() on this stream — its read errors out and returns
+        self._streaming.close()
+        elapsed = time.monotonic() - self._attempt_start
+        entry["elapsed_ms"] = round(elapsed * 1000, 1)
+        router._upstream_seconds.observe(elapsed, replica=replica.name)
+        router._finish_attempt(replica)
+        if outcome == "upstream_error":
+            entry["error"] = "stream aborted mid-body"
+            replica.breaker.record_failure()
+            router._req_total.inc(replica=replica.name, outcome="network_error")
+            router._finish_record(self._record, 499)
+        elif outcome == "aborted":
+            entry["error"] = "client abandoned the stream"
+            replica.breaker.record_success(probe=self._is_probe)
+            router._req_total.inc(replica=replica.name, outcome="client_aborted")
+            router._finish_record(self._record, 499)
+        else:
+            replica.breaker.record_success(probe=self._is_probe)
+            router._req_total.inc(replica=replica.name, outcome="ok")
+            router._finish_record(self._record, 200)
+        router._release()
+
+
+def _filter_return_headers(headers: dict[str, str]) -> dict[str, str]:
+    """The response-header allowlist applied to BOTH the buffered and
+    streaming forward paths."""
+    return {
+        name.title(): value
+        for name, value in ((k.lower(), v) for k, v in headers.items())
+        if name in _RETURN_HEADERS
+    }
+
+
+async def _sync_pull(iterator: Any, executor: Any = None,
+                     finalizer: Any = None) -> Any:
+    """Bridge a sync chunk generator onto the event loop: each ``next``
+    is pulled on the container's I/O-sized handler pool so a slow
+    upstream never stalls other connections (same rationale as the
+    responder's Stream path — asyncio's cpu_count+4 default executor
+    caps concurrent proxied streams on small VMs).
+
+    The ``finally`` runs when this async generator is finalized (client
+    disconnect / task cancellation → the loop's async-gen finalizer →
+    GeneratorExit here): it settles the stream's accounting through the
+    idempotent ``finalizer`` DIRECTLY — never through the sync
+    generator, which may be suspended mid-``next`` on a pool thread.
+    All inline work is socket close + metric writes, no blocking I/O."""
+    import asyncio
+
+    loop = asyncio.get_running_loop()
+    it = iter(iterator)
+    sentinel = object()
+    try:
+        while True:
+            item = await loop.run_in_executor(executor, next, it, sentinel)
+            if item is sentinel:
+                break
+            yield item
+    finally:
+        if finalizer is not None:
+            # an abandoned stream is a CLIENT-side outcome, not a
+            # replica failure; a normally-finished (or upstream-failed)
+            # stream already settled — finish is then a no-op
+            finalizer.finish("aborted")
+        try:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+        except ValueError:
+            pass  # generator mid-next on a pool thread; it exits on its own

@@ -85,12 +85,25 @@ def ready_handler(ctx: Context) -> Response:
     """Readiness probe, distinct from /.well-known/health (liveness): 503
     while the TPU stack is still booting (warmup compiles), with the current
     boot stage in the body so a slow cold boot is observable; 503 with the
-    engine state while the stall watchdog holds the engine degraded/wedged
-    (a wedged device tunnel is a diagnosed condition, not a silent hang);
-    200 once requests would be served without blocking. Apps without a TPU
-    datasource are ready as soon as the server listens."""
+    engine state AND the watchdog's evidence (which dispatch stalled, for
+    how long) while the stall watchdog holds the engine degraded/wedged —
+    the fleet router's probation logic and a human operator both need the
+    WHY, not just the verdict; 503 while a fleet router is draining (new
+    work must go to another front door); 200 once requests would be served
+    without blocking. Apps without a TPU datasource are ready as soon as
+    the server listens."""
     import json
 
+    fleet = getattr(ctx.container, "fleet", None)
+    if fleet is not None and fleet.draining:
+        return Response(
+            status=503,
+            headers={"Content-Type": "application/json"},
+            body=json.dumps({
+                "state": "draining",
+                "detail": f"router draining, {fleet.in_flight} in flight",
+            }).encode("utf-8"),
+        )
     tpu = ctx.container.tpu
     if tpu is None:
         status, state = 200, {"state": "ready"}
@@ -102,6 +115,17 @@ def ready_handler(ctx: Context) -> Response:
             snap = engine.snapshot()
             status = 503
             state = {"state": snap["state"], "detail": snap["detail"]}
+            # the watchdog's evidence: which dispatch kinds stalled and
+            # what it is still watching — the router records this as the
+            # replica's leave-rotation reason
+            watchdog = getattr(tpu, "watchdog", None)
+            if watchdog is not None:
+                wsnap = watchdog.snapshot()
+                state["watchdog"] = {
+                    "stalls": wsnap.get("stalls"),
+                    "watching": wsnap.get("watching"),
+                    "timeout_s": wsnap.get("timeout_s"),
+                }
         else:
             status, state = 200, {"state": "ready"}
     return Response(
@@ -381,6 +405,22 @@ def _trend(points: list) -> dict[str, Any]:
         "now": points[-1][1] if points else None,
         "trend": points,
     }
+
+
+def fleet_admin_handler(ctx: Context) -> Any:
+    """GET /admin/fleet: the fleet front door on one page — rotation
+    state + probe evidence per replica, breaker states, outstanding
+    depths, quota stats, drain status, and the recent route records
+    (which replica served each request, retries, shed verdicts).
+    Registered by ``gofr_tpu.fleet.wire_fleet``; 503 on a process that
+    is not a router."""
+    from gofr_tpu.errors import HTTPError
+
+    _check_admin(ctx)
+    fleet = getattr(ctx.container, "fleet", None)
+    if fleet is None:
+        raise HTTPError(503, "fleet not configured (set FLEET_REPLICAS)")
+    return fleet.snapshot()
 
 
 def postmortem_list_handler(ctx: Context) -> Any:

@@ -34,6 +34,16 @@ Default framework metrics (registered by the container):
 - ``gofr_tpu_requests_total{model,status}`` / ``gofr_tpu_ttft_seconds``
 - ``gofr_tpu_batch_size`` / ``gofr_tpu_queue_depth`` (gauges)
 - ``gofr_tpu_device_memory_bytes{kind}``
+
+Router processes (``gofr_tpu/fleet``) add the ``gofr_tpu_router_*``
+family: ``_requests_total{replica,outcome}`` (outcome: ok |
+upstream_5xx | network_error | client_aborted),
+``_retries_total{replica,reason}``, ``_shed_total{reason}``,
+``_breaker_transitions_total{replica,to}``,
+``_breaker_state{replica}`` / ``_replica_state{replica}`` (enum
+gauges), ``_outstanding_depth{replica}`` / ``_inflight_depth``, and
+``_upstream_seconds{replica}`` — every routing, retry, shed, and
+breaker decision observable (docs/advanced-guide/fleet.md).
 """
 
 from __future__ import annotations

@@ -1,0 +1,932 @@
+"""Fleet front door, tier-1: unit semantics for the breaker / quota /
+affinity pieces, then chaos e2e over real sockets — multi-replica echo
+fleets in ONE process (``gofr_tpu/devtools/chaos.py``), every failure
+injected deterministically:
+
+- a force-wedged replica mid-request: the client request still
+  completes, retried to a healthy replica (non-stream AND not-yet-
+  streamed SSE), and the wedged replica's breaker opens within its
+  configured threshold;
+- connection refused (listener gone): retries land elsewhere, the
+  breaker opens, half-open probes, closes on recovery;
+- a device-level wedge (echo ``stall_hook`` + watchdog): the replica
+  leaves rotation on its OWN readiness 503 — whose body now carries the
+  watchdog evidence — and re-enters through probation;
+- induced ``kv_exhausted``: admission sheds 429 + Retry-After while
+  every in-rotation replica is starved, never queueing unboundedly;
+- graceful drain: in-flight requests finish, new ones shed, readiness
+  flips 503.
+
+These tests spawn several HTTP servers each; CI also runs this module
+serially in the ``fleet-chaos`` step.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gofr_tpu.fleet import parse_replicas
+from gofr_tpu.fleet.admission import QuotaTable, TokenBucket, tenant_of
+from gofr_tpu.fleet.breaker import CLOSED, HALF_OPEN, OPEN, PROBE, CircuitBreaker
+from gofr_tpu.fleet.replica import affinity_order
+
+
+# -- helpers -------------------------------------------------------------------
+
+def _get(url, headers=None, timeout=10):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers.items())
+
+
+def _post(url, payload, headers=None, timeout=10):
+    send = {"Content-Type": "application/json"}
+    send.update(headers or {})
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=send, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers.items())
+
+
+def _wait(cond, timeout=10.0, interval=0.02, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+def _fleet_snapshot(app):
+    return app.container.fleet.snapshot()
+
+
+def _key_for(target: str, names: list) -> str:
+    """An affinity key that rendezvous-routes to ``target``."""
+    for i in range(1000):
+        key = f"conv-{i}"
+        if affinity_order(key, list(names))[0] == target:
+            return key
+    raise AssertionError(f"no key found mapping to {target}")
+
+
+# -- unit: circuit breaker -----------------------------------------------------
+
+def test_breaker_opens_half_opens_and_closes():
+    transitions = []
+    breaker = CircuitBreaker(
+        failure_threshold=2, cooldown_s=0.1,
+        on_transition=lambda was, to: transitions.append((was, to)),
+    )
+    assert breaker.state == CLOSED and breaker.try_acquire()
+    breaker.record_failure()
+    assert breaker.state == CLOSED  # one failure is not a trip
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    assert not breaker.try_acquire()  # cooldown running
+    time.sleep(0.12)
+    assert breaker.try_acquire() == PROBE  # the half-open probe slot
+    assert breaker.state == HALF_OPEN
+    assert not breaker.try_acquire()  # ONE probe at a time
+    breaker.record_success(probe=True)
+    assert breaker.state == CLOSED
+    assert transitions == [
+        (CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED)
+    ]
+    snap = breaker.snapshot()
+    assert snap["state"] == CLOSED and snap["transitions"] == 3
+
+
+def test_breaker_half_open_failure_reopens():
+    breaker = CircuitBreaker(failure_threshold=1, cooldown_s=0.05)
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    time.sleep(0.06)
+    assert breaker.try_acquire()
+    breaker.record_failure()  # the probe failed
+    assert breaker.state == OPEN
+    assert not breaker.try_acquire()  # cooldown restarted
+    assert "cooldown_remaining_s" in breaker.snapshot()
+
+
+def test_breaker_success_resets_failure_streak():
+    breaker = CircuitBreaker(failure_threshold=2, cooldown_s=1)
+    breaker.record_failure()
+    breaker.record_success()
+    breaker.record_failure()
+    assert breaker.state == CLOSED  # streak broken: 1+1 is not 2
+
+
+def test_breaker_open_ignores_stale_success():
+    """A success from a request dispatched BEFORE the trip (or a long
+    stream finishing minutes later) must not close an OPEN breaker —
+    recovery goes through the half-open probe, always."""
+    breaker = CircuitBreaker(failure_threshold=1, cooldown_s=10)
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    breaker.record_success()  # stale: pre-trip dispatch completing
+    assert breaker.state == OPEN
+    assert not breaker.try_acquire()  # cooldown still holds
+
+
+def test_breaker_half_open_stale_success_does_not_preempt_probe():
+    """While probe P runs, a stale non-probe success must not close the
+    breaker on P's behalf — only the probe's own verdict counts."""
+    breaker = CircuitBreaker(failure_threshold=1, cooldown_s=0.05)
+    breaker.record_failure()
+    time.sleep(0.06)
+    assert breaker.try_acquire() == PROBE  # probe P in flight
+    breaker.record_success()  # stale success from a pre-trip request
+    assert breaker.state == HALF_OPEN  # P's outcome still pending
+    breaker.record_success(probe=True)  # P reports
+    assert breaker.state == CLOSED
+
+
+# -- unit: admission -----------------------------------------------------------
+
+def test_token_bucket_denies_with_refill_hint():
+    bucket = TokenBucket(rate=10.0, capacity=2.0)
+    assert bucket.take() == (True, 0.0)
+    assert bucket.take()[0]
+    ok, retry_after = bucket.take()
+    assert not ok and 0 < retry_after <= 0.11
+    time.sleep(retry_after + 0.02)
+    assert bucket.take()[0]  # the hint was honest
+
+
+def test_quota_table_per_tenant_and_disabled():
+    # near-zero refill: at high rates the bucket regains a token within
+    # the microseconds between takes and the deny assertion flakes
+    table = QuotaTable(rate_rps=0.001, burst=1.0)
+    assert table.take("a")[0]
+    assert not table.take("a")[0]  # a's burst spent
+    assert table.take("b")[0]     # b unaffected
+    stats = table.stats()
+    assert stats["tenants"] == 2 and stats["denied"] == 1
+    off = QuotaTable(rate_rps=0.0, burst=0.0)
+    assert all(off.take("x")[0] for _ in range(100))
+
+
+def test_quota_table_redis_backend_and_fail_open():
+    """The redis backing runs against the REAL client + miniredis —
+    the pipelined HGET/HSET/EXPIRE path, fleet-wide bucket sharing
+    across two QuotaTables (two router processes), and fail-open."""
+    from gofr_tpu.datasource.miniredis import MiniRedis
+    from gofr_tpu.datasource.redis import new_client
+    from gofr_tpu.testutil import MockLogger
+
+    mini = MiniRedis().run()
+    client = new_client("127.0.0.1", mini.port, MockLogger())
+    try:
+        table = QuotaTable(rate_rps=0.001, burst=1.0, redis=client)
+        assert table.take("t")[0]
+        # a SECOND router process sees the same spent bucket
+        sibling = QuotaTable(rate_rps=0.001, burst=1.0, redis=client)
+        ok, retry_after = sibling.take("t")
+        assert not ok and retry_after > 0
+        assert table.stats()["backend"] == "redis"
+        assert client.ttl("fleet:quota:t") > 0  # idle tenants expire
+    finally:
+        client.close()
+        mini.close()
+
+    class BrokenRedis:
+        def pipeline(self):
+            raise ConnectionError("redis down")
+
+    logger = MockLogger()
+    failing = QuotaTable(rate_rps=0.001, burst=1.0, redis=BrokenRedis(),
+                         logger=logger)
+    assert failing.take("t")[0]  # fail OPEN to the memory bucket
+    assert not failing.take("t")[0]  # which still enforces
+    assert "failing open" in logger.output
+
+
+def test_tenant_of_header_then_auth_then_anonymous():
+    from gofr_tpu.http.request import Request
+
+    # X-Tenant is honored only when the operator opted in (a gateway
+    # stamps it); trusted from arbitrary clients it would let anyone
+    # mint a fresh quota bucket per request by randomizing the header
+    trusted = Request("GET", "/", {"x-tenant": "acme"})
+    assert tenant_of(trusted, trust_tenant_header=True) == "acme"
+    assert tenant_of(trusted) == "anonymous"
+    both = Request("GET", "/", {"x-tenant": "spoof",
+                                "authorization": "Bearer sk-123"})
+    assert tenant_of(both).startswith("key-")  # the KEY pays, not the header
+    key_a = tenant_of(Request("GET", "/", {"authorization": "Bearer sk-123"}))
+    key_b = tenant_of(Request("GET", "/", {"authorization": "Bearer sk-456"}))
+    # API keys bucket stably but the tenant string (which lands in
+    # route records, /admin/fleet, and redis keys) is a HASH — no
+    # secret material may leak through the admin surface
+    assert key_a.startswith("key-") and key_b.startswith("key-")
+    assert key_a != key_b
+    assert "sk-123" not in key_a
+    assert key_a == tenant_of(
+        Request("GET", "/", {"authorization": "Bearer sk-123"})
+    )
+    assert tenant_of(Request("GET", "/", {})) == "anonymous"
+
+
+def test_router_sheds_do_not_charge_quota_tokens():
+    """Router-side rejections (no replicas, draining, in-flight cap)
+    must not burn the tenant's tokens — a tenant retrying politely
+    through a saturation episode would otherwise arrive quota-blocked
+    when capacity returns. A QUOTA shed in turn must release the
+    in-flight slot it briefly held."""
+    from gofr_tpu.fleet.replica import Replica, ReplicaSet
+    from gofr_tpu.fleet.router import FleetRouter
+    from gofr_tpu.http.request import Request
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.testutil import MockLogger
+
+    logger = MockLogger()
+    quota = QuotaTable(rate_rps=1.0, burst=1.0)
+    router = FleetRouter(
+        logger, Registry(), ReplicaSet([], logger), quota,
+    )
+    request = Request("POST", "/generate", {"x-tenant": "acme"})
+    verdict = router._admit(request, "acme")
+    assert verdict is not None and verdict.status == 503  # no replicas
+    router._draining = True
+    verdict = router._admit(request, "acme")
+    assert verdict is not None and verdict.status == 503  # draining
+    stats = quota.stats()
+    assert stats["admitted"] == 0 and stats["denied"] == 0  # untouched
+    assert router.in_flight == 0  # no slot held across sheds
+
+    # with a rotation: admitted requests HOLD the slot, quota denials
+    # release it
+    with_replica = FleetRouter(
+        logger, Registry(),
+        ReplicaSet([Replica("r0", "http://127.0.0.1:1", logger)], logger),
+        QuotaTable(rate_rps=0.001, burst=1.0),
+    )
+    assert with_replica._admit(request, "acme") is None
+    assert with_replica.in_flight == 1  # held for the forward
+    with_replica._release()
+    verdict = with_replica._admit(request, "acme")  # burst of 1 spent
+    assert verdict is not None and verdict.status == 429
+    assert with_replica.in_flight == 0  # quota shed released the slot
+
+    # the in-flight cap itself is atomic check-and-increment
+    capped = FleetRouter(
+        logger, Registry(),
+        ReplicaSet([Replica("r0", "http://127.0.0.1:1", logger)], logger),
+        QuotaTable(rate_rps=0.0, burst=0.0),
+        max_inflight=1,
+    )
+    assert capped._admit(request, "t") is None
+    verdict = capped._admit(request, "t")
+    assert verdict is not None and verdict.status == 429
+    assert json.loads(verdict.body)["error"]["reason"] == "inflight"
+    capped._release()
+    assert capped._admit(request, "t") is None  # slot freed, admits again
+
+
+# -- unit: affinity + replica spec ---------------------------------------------
+
+def test_affinity_order_is_stable_under_membership_churn():
+    names = ["r0", "r1", "r2", "r3"]
+    for key in ("alice", "bob", "conv-42"):
+        full = affinity_order(key, names)
+        survivor_order = [n for n in full if n != full[0]]
+        assert affinity_order(key, [n for n in names if n != full[0]]) == \
+            survivor_order  # removing the holder only remaps ITS keys
+    # keys spread: not everything lands on one replica
+    firsts = {affinity_order(f"k{i}", names)[0] for i in range(32)}
+    assert len(firsts) > 1
+
+
+def test_parse_replicas_names_and_errors():
+    assert parse_replicas("http://a:1,http://b:2") == [
+        ("r0", "http://a:1"), ("r1", "http://b:2")
+    ]
+    assert parse_replicas("x=http://a:1, y=http://b:2 ,") == [
+        ("x", "http://a:1"), ("y", "http://b:2")
+    ]
+    with pytest.raises(ValueError, match="twice"):
+        parse_replicas("x=http://a:1,x=http://b:2")
+    with pytest.raises(ValueError, match="no URL"):
+        parse_replicas("x=")
+
+
+# -- unit: resilient service client -------------------------------------------
+
+def test_service_call_error_carries_elapsed_and_attempts():
+    from gofr_tpu.service import HTTPService, ServiceCallError
+    from gofr_tpu.testutil import MockLogger
+
+    svc = HTTPService("http://127.0.0.1:1", MockLogger(), name="ghost",
+                      connect_timeout=0.2, read_timeout=0.2)
+    with pytest.raises(ServiceCallError) as excinfo:
+        svc.request("GET", "/x", retries=2)
+    err = excinfo.value
+    assert err.attempts == 3
+    assert err.elapsed_s > 0
+    assert "3 attempt(s)" in str(err)
+
+
+def test_service_client_retries_5xx_for_idempotent_only(free_port):
+    import http.server
+
+    port = free_port()
+    hits = {"n": 0}
+
+    class Flaky(http.server.BaseHTTPRequestHandler):
+        def _serve(self):
+            hits["n"] += 1
+            status = 503 if hits["n"] < 3 else 200
+            payload = b'{"ok": true}'
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        do_GET = do_POST = _serve
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", port), Flaky)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True,
+                              name="test-flaky-http")
+    thread.start()
+    try:
+        from gofr_tpu.service import HTTPService
+        from gofr_tpu.testutil import MockLogger
+
+        svc = HTTPService(f"http://127.0.0.1:{port}", MockLogger())
+        resp = svc.request("GET", "/x", retries=3)
+        assert resp.status_code == 200 and hits["n"] == 3
+        hits["n"] = 0
+        resp = svc.request("POST", "/x", retries=3)  # NOT idempotent
+        assert resp.status_code == 503 and hits["n"] == 1
+        hits["n"] = 0
+        resp = svc.request("POST", "/x", retries=3, retryable=True)
+        assert resp.status_code == 200 and hits["n"] == 3
+    finally:
+        srv.shutdown()
+        thread.join(5)
+
+
+def test_redirects_followed_for_safe_methods_only(free_port):
+    """urlopen parity: GET follows Location hops; POST gets the 3xx
+    raw (replaying a body across a redirect is the caller's call)."""
+    import http.server
+
+    port = free_port()
+
+    class Redirecting(http.server.BaseHTTPRequestHandler):
+        def _serve(self):
+            if self.path == "/old":
+                self.send_response(302)
+                self.send_header("Location", "/new")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            payload = b'{"moved": true}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        do_GET = do_POST = _serve
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", port), Redirecting)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True,
+                              name="test-redirect-http")
+    thread.start()
+    try:
+        from gofr_tpu.service import HTTPService
+        from gofr_tpu.testutil import MockLogger
+
+        svc = HTTPService(f"http://127.0.0.1:{port}", MockLogger())
+        resp = svc.get("/old")
+        assert resp.status_code == 200 and resp.json() == {"moved": True}
+        resp = svc.post("/old", body={"x": 1})
+        assert resp.status_code == 302  # returned raw, not replayed
+    finally:
+        srv.shutdown()
+        thread.join(5)
+
+
+def test_drip_fed_body_cannot_outlive_the_read_budget(free_port):
+    """Socket timeouts are per-recv: an upstream dripping one byte per
+    interval would reset the clock forever and pin a router handler
+    thread. The buffered read is bounded by a TOTAL read_timeout."""
+    import socket as socket_mod
+
+    port = free_port()
+    stop = threading.Event()
+    server = socket_mod.socket()
+    server.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
+    server.bind(("127.0.0.1", port))
+    server.listen(1)
+
+    def drip():
+        conn, _ = server.accept()
+        try:
+            conn.recv(65536)
+            conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 1000000\r\n\r\n")
+            while not stop.wait(0.05):  # one byte per 50ms, forever
+                conn.sendall(b"x")
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    thread = threading.Thread(target=drip, name="test-drip-server")
+    thread.start()
+    try:
+        from gofr_tpu.service import HTTPService, ServiceCallError
+        from gofr_tpu.testutil import MockLogger
+
+        svc = HTTPService(f"http://127.0.0.1:{port}", MockLogger())
+        start = time.monotonic()
+        with pytest.raises(ServiceCallError):
+            svc.request("GET", "/x", read_timeout=0.4, retries=0)
+        assert time.monotonic() - start < 3.0  # bounded, not forever
+    finally:
+        stop.set()
+        server.close()
+        thread.join(5)
+
+
+def test_backoff_delays_decorrelate_and_cap():
+    from gofr_tpu.service import backoff_delays
+
+    delays = list(backoff_delays(50, base=0.01, cap=0.2))
+    assert len(delays) == 50
+    assert all(0.01 <= d <= 0.2 for d in delays)
+    assert len(set(delays)) > 10  # jittered, not a fixed ladder
+
+
+# -- e2e: routing, retry, breaker ---------------------------------------------
+
+def _completion(base, prompt, headers=None, stream=False, max_tokens=4,
+                timeout=15):
+    payload = {"model": "echo", "prompt": prompt, "max_tokens": max_tokens}
+    if stream:
+        payload["stream"] = True
+    return _post(base + "/v1/completions", payload, headers=headers,
+                 timeout=timeout)
+
+
+def test_wedged_replica_mid_request_retries_to_healthy(tmp_path, monkeypatch):
+    """The acceptance spine: one of three replicas force-wedged while
+    serving; the client request still completes, and the wedged
+    replica's breaker opens within its threshold."""
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(3) as replicas, chaos_router(
+        replicas,
+        # read timeout must be comfortably above a healthy echo
+        # completion on a LOADED runner (0.4s raced real decode work
+        # and flaked) while staying far below the 30s chaos stall
+        env={"FLEET_READ_TIMEOUT_S": "2", "FLEET_BREAKER_THRESHOLD": "1",
+             "FLEET_BREAKER_COOLDOWN_S": "30", "FLEET_PROBE_INTERVAL_S": "30"},
+    ) as app:
+        base = f"http://127.0.0.1:{app.http_port}"
+        fleet = app.container.fleet
+        _wait(lambda: len(fleet.replica_set.in_rotation()) == 3,
+              message="3 replicas in rotation")
+        names = [r.name for r in fleet.replica_set.replicas]
+        target = "r1"
+        key = _key_for(target, names)
+        victim = next(r for r in replicas if r.name == target)
+        victim.chaos.stall(30.0)  # wedged: accepts, never answers
+
+        # non-stream: first attempt times out on r1, retry completes
+        status, body, _ = _completion(
+            base, [5, 6, 7], headers={"X-Session-ID": key}
+        )
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["choices"]  # a full completion from a HEALTHY replica
+        assert payload["usage"]["completion_tokens"] == 4
+        snap = _fleet_snapshot(app)
+        route = snap["routes"][0]
+        assert route["retries"] >= 1
+        assert route["attempts"][0]["replica"] == target
+        assert route["attempts"][0]["error"]
+        assert route["attempts"][-1]["status"] == 200
+        assert route["attempts"][-1]["replica"] != target
+        by_name = {r["name"]: r for r in snap["replica_set"]["replicas"]}
+        assert by_name[target]["breaker"]["state"] == "open"  # threshold 1
+
+        # streaming, not-yet-streamed: r1 would stall before the response
+        # head, so the router may still fail over; the SSE completes
+        victim2 = next(r for r in replicas if r.name != target)
+        key2 = _key_for(victim2.name, names)
+        victim2.chaos.stall(30.0)
+        status, body, headers = _completion(
+            base, [1, 2, 3], headers={"X-Session-ID": key2}, stream=True
+        )
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/event-stream")
+        assert b"data: [DONE]" in body  # the stream COMPLETED elsewhere
+
+        # router metrics observed the retries
+        _, metrics_body, _ = _get(base + "/metrics")
+        text = metrics_body.decode()
+        assert "gofr_tpu_router_retries_total" in text
+        assert 'gofr_tpu_router_breaker_state{replica="' + target + '"} 2' \
+            in text
+
+
+def test_connection_refused_breaker_cycle(tmp_path, monkeypatch):
+    """Listener killed: requests retry elsewhere (clients never see the
+    failure), the breaker opens after its threshold, half-opens after
+    the cooldown, and closes once the listener returns."""
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(3) as replicas, chaos_router(
+        replicas,
+        env={"FLEET_BREAKER_THRESHOLD": "2",
+             "FLEET_BREAKER_COOLDOWN_S": "0.2",
+             "FLEET_PROBE_INTERVAL_S": "30"},  # rotation state frozen
+    ) as app:
+        base = f"http://127.0.0.1:{app.http_port}"
+        fleet = app.container.fleet
+        _wait(lambda: len(fleet.replica_set.in_rotation()) == 3,
+              message="3 replicas in rotation")
+        dead = replicas[0]
+        dead.stop_listener()  # connection refused from here on
+
+        def breaker_state():
+            return fleet.replica_set.by_name(dead.name).breaker.state
+
+        # drive requests until the breaker trips (round-robin tie-break
+        # guarantees the dead replica is tried within a few requests)
+        for _ in range(8):
+            status, _, _ = _post(base + "/generate", {"tokens": [1, 2]})
+            assert status == 200  # the CLIENT never sees the dead replica
+            if breaker_state() == "open":
+                break
+        assert breaker_state() == "open"
+
+        dead.start_listener()
+        time.sleep(0.25)  # past the cooldown: next pick half-opens
+        for _ in range(8):
+            status, _, _ = _post(base + "/generate", {"tokens": [3]})
+            assert status == 200
+            if breaker_state() == "closed":
+                break
+        assert breaker_state() == "closed", \
+            "breaker must close after recovery probe"
+        snap = _fleet_snapshot(app)
+        by_name = {r["name"]: r for r in snap["replica_set"]["replicas"]}
+        assert by_name[dead.name]["breaker"]["transitions"] >= 3
+        _, metrics_body, _ = _get(base + "/metrics")
+        assert 'gofr_tpu_router_breaker_transitions_total{replica="' \
+            + dead.name + '",to="open"}' in metrics_body.decode()
+
+
+def test_device_wedge_leaves_rotation_and_probation_reentry(
+        tmp_path, monkeypatch):
+    """A REAL engine wedge (echo stall_hook + watchdog): the replica's
+    own readiness 503s — with the watchdog evidence in the body — the
+    prober takes it out of rotation, and recovery walks probation
+    before traffic returns."""
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(2) as replicas, chaos_router(
+        replicas,
+        env={"FLEET_PROBE_INTERVAL_S": "0.05", "FLEET_OUT_AFTER": "1",
+             "FLEET_PROBATION_PROBES": "3"},
+    ) as app:
+        fleet = app.container.fleet
+        _wait(lambda: len(fleet.replica_set.in_rotation()) == 2,
+              message="both replicas in rotation")
+        victim = replicas[0]
+        victim.wedge(1.2)  # next dispatch stalls 1.2s; watchdog is 0.2s
+
+        def kick():
+            try:
+                _post(victim.address + "/generate",
+                      {"tokens": [9], "max_new_tokens": 2}, timeout=15)
+            except Exception:
+                pass
+
+        kicker = threading.Thread(target=kick, name="test-wedge-kick")
+        kicker.start()
+        try:
+            _wait(lambda: fleet.replica_set.by_name(victim.name).state
+                  == "out", timeout=15, message="wedged replica out")
+            victim.unwedge()  # later dispatches run free; recovery below
+            # the replica's OWN ready body explains why (satellite:
+            # engine state + watchdog reason in the 503 body)
+            try:
+                _get(victim.address + "/.well-known/ready", timeout=5)
+                raise AssertionError("expected 503 while wedged")
+            except urllib.error.HTTPError as exc:
+                payload = json.loads(exc.read())
+                assert payload["state"] in ("degraded", "wedged")
+                assert payload["detail"]
+                assert "watchdog" in payload
+                assert payload["watchdog"]["stalls"]
+            # traffic avoids the wedged replica meanwhile
+            base = f"http://127.0.0.1:{app.http_port}"
+            status, _, _ = _post(base + "/generate", {"tokens": [1]})
+            assert status == 200
+            snap = _fleet_snapshot(app)
+            served = {a["replica"] for r in snap["routes"]
+                      for a in r["attempts"] if a.get("status") == 200}
+            assert victim.name not in served
+        finally:
+            kicker.join(20)
+        # recovery: the stall ends, the engine recovers, and the replica
+        # must string together FLEET_PROBATION_PROBES ok probes
+        _wait(lambda: fleet.replica_set.by_name(victim.name).state
+              == "healthy", timeout=20, message="probation re-entry")
+        assert fleet.replica_set.by_name(victim.name).probes >= 3
+
+
+# -- e2e: admission control ----------------------------------------------------
+
+def test_kv_exhausted_sheds_429_with_retry_after(tmp_path, monkeypatch):
+    """Induced kv_exhausted: a long generation pins EVERY paged-KV
+    block of the only replica, the next request is rejected by the pool
+    (it still completes via the solo fallback — the reject is a signal,
+    not a failure), the prober picks the rising reject counter up, and
+    the router sheds subsequent work with 429 + Retry-After instead of
+    queueing unboundedly."""
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(
+        1,
+        env={"KV_BLOCKS": "64", "KV_BLOCK_TOKENS": "2",
+             "ECHO_STEP_MS": "30", "WATCHDOG_DISPATCH_TIMEOUT_S": "off"},
+    ) as replicas, chaos_router(
+        replicas, env={"FLEET_PROBE_INTERVAL_S": "0.05"}
+    ) as app:
+        base = f"http://127.0.0.1:{app.http_port}"
+        fleet = app.container.fleet
+        replica = fleet.replica_set.replicas[0]
+        _wait(lambda: len(fleet.replica_set.in_rotation()) == 1,
+              message="replica in rotation")
+        _wait(lambda: replica.engine is not None, message="engine scraped")
+        # 28 prompt + 100 new tokens = 128 tokens = ALL 64 blocks of 2,
+        # held for ~3s of step-delayed decoding
+        hog = threading.Thread(
+            target=lambda: _post(
+                base + "/generate",
+                {"tokens": list(range(1, 29)), "max_new_tokens": 100},
+                timeout=30,
+            ),
+            name="test-kv-hog",
+        )
+        hog.start()
+        try:
+            _wait(
+                lambda: (replica.engine or {}).get("kv_free") == 0,
+                timeout=10, message="hog pinned every block",
+            )
+            # the canary is REJECTED by the pool (kv_exhausted) but the
+            # request itself still completes — solo fallback
+            status, _, _ = _post(base + "/generate",
+                                 {"tokens": [1, 2], "max_new_tokens": 2},
+                                 timeout=10)
+            assert status == 200
+            _wait(lambda: replica.saturated, timeout=10,
+                  message="prober sees the kv_exhausted rejects")
+            assert (replica.engine or {}).get("kv_exhausted_rejects", 0) >= 1
+            try:
+                _post(base + "/generate", {"tokens": [1, 2]}, timeout=5)
+                raise AssertionError("expected 429 while saturated")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 429
+                assert int(exc.headers["Retry-After"]) >= 1
+                payload = json.loads(exc.read())
+                assert payload["error"]["reason"] == "kv_exhausted"
+            counter = app.container.metrics.counter(
+                "gofr_tpu_router_shed_total", labels=("reason",)
+            )
+            assert counter.value(reason="kv_exhausted") >= 1
+            snap = _fleet_snapshot(app)
+            assert any(r["outcome"] == "shed:kv_exhausted"
+                       for r in snap["routes"])
+        finally:
+            hog.join(30)
+        # blocks free as the hog finishes: admission recovers
+        _wait(lambda: not replica.saturated,
+              timeout=10, message="saturation clears")
+        status, _, _ = _post(base + "/generate", {"tokens": [1, 2]})
+        assert status == 200
+
+
+def test_quota_sheds_429_per_tenant(tmp_path, monkeypatch):
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(1) as replicas, chaos_router(
+        replicas,
+        env={"FLEET_QUOTA_RPS": "0.5", "FLEET_QUOTA_BURST": "2",
+             "FLEET_TRUST_TENANT_HEADER": "on"},
+    ) as app:
+        base = f"http://127.0.0.1:{app.http_port}"
+        _wait(lambda: len(app.container.fleet.replica_set.in_rotation()) == 1,
+              message="replica in rotation")
+        acme = {"X-Tenant": "acme"}
+        for _ in range(2):
+            status, _, _ = _post(base + "/generate", {"tokens": [1]},
+                                 headers=acme)
+            assert status == 200
+        try:
+            _post(base + "/generate", {"tokens": [1]}, headers=acme)
+            raise AssertionError("expected 429 over quota")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 429
+            assert "Retry-After" in exc.headers
+            assert json.loads(exc.read())["error"]["reason"] == "quota"
+        # another tenant is unaffected
+        status, _, _ = _post(base + "/generate", {"tokens": [1]},
+                             headers={"X-Tenant": "other"})
+        assert status == 200
+
+
+def test_upstream_429_burst_echoes_with_retry_after(tmp_path, monkeypatch):
+    """A replica answering 429 (its own admission) is echoed upstream
+    verbatim with a Retry-After — the router never retry-storms an
+    overloaded replica."""
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(1) as replicas, chaos_router(replicas) as app:
+        base = f"http://127.0.0.1:{app.http_port}"
+        _wait(lambda: len(app.container.fleet.replica_set.in_rotation()) == 1,
+              message="replica in rotation")
+        replicas[0].chaos.error_burst(1, status=429,
+                                      paths=("/generate",))
+        try:
+            _post(base + "/generate", {"tokens": [1]})
+            raise AssertionError("expected 429 echoed")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 429
+            assert "Retry-After" in exc.headers
+        assert replicas[0].chaos.injected.get("error_burst") == 1  # ONE try
+
+
+def test_5xx_burst_retries_and_mid_stream_disconnect_aborts(
+        tmp_path, monkeypatch):
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(2) as replicas, chaos_router(replicas) as app:
+        base = f"http://127.0.0.1:{app.http_port}"
+        fleet = app.container.fleet
+        _wait(lambda: len(fleet.replica_set.in_rotation()) == 2,
+              message="replicas in rotation")
+        # 5xx burst on both replicas: first two attempts eat the bursts,
+        # retry completes on whichever recovered first
+        for replica in replicas:
+            replica.chaos.error_burst(1, status=503, paths=("/generate",))
+        status, _, _ = _post(base + "/generate", {"tokens": [4, 5]})
+        assert status == 200
+        snap = _fleet_snapshot(app)
+        assert snap["routes"][0]["retries"] >= 1
+
+        # mid-stream disconnect: chunks flowed, so NO replay — the
+        # router aborts the client connection (truncated body)
+        names = [r.name for r in fleet.replica_set.replicas]
+        key = _key_for(replicas[0].name, names)
+        replicas[0].chaos.disconnect_after(1, paths=("/v1/",))
+        with pytest.raises(Exception) as excinfo:
+            _completion(base, [1, 2, 3], headers={"X-Session-ID": key},
+                        stream=True, max_tokens=8)
+        assert not isinstance(excinfo.value, urllib.error.HTTPError) or \
+            excinfo.value.code >= 500
+        _wait(
+            lambda: any(r["outcome"] == "aborted"
+                        for r in _fleet_snapshot(app)["routes"]),
+            timeout=5, message="aborted route record",
+        )
+
+
+# -- e2e: graceful drain -------------------------------------------------------
+
+def test_sigterm_drain_finishes_inflight_then_sheds(tmp_path, monkeypatch):
+    """App.shutdown (the SIGTERM path) drains: the in-flight request
+    completes through the still-open listener, new requests shed, and
+    readiness flips to a draining 503."""
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    fleet_ctx = chaos_fleet(2, env={"ECHO_STEP_MS": "25"})
+    replicas = fleet_ctx.__enter__()
+    try:
+        router_ctx = chaos_router(
+            replicas, env={"FLEET_DRAIN_TIMEOUT_S": "20"}
+        )
+        app = router_ctx.__enter__()
+        shutdown_done = False
+        try:
+            base = f"http://127.0.0.1:{app.http_port}"
+            fleet = app.container.fleet
+            _wait(lambda: len(fleet.replica_set.in_rotation()) == 2,
+                  message="replicas in rotation")
+            slow_result = {}
+
+            def slow():
+                # ~100 tokens x 25ms ≈ 2.5s of decoding
+                slow_result["resp"] = _post(
+                    base + "/generate",
+                    {"tokens": [1, 2, 3], "max_new_tokens": 100},
+                    timeout=30,
+                )
+
+            worker = threading.Thread(target=slow, name="test-drain-slow")
+            worker.start()
+            _wait(lambda: fleet.in_flight >= 1, message="request in flight")
+
+            shutdown_thread = threading.Thread(
+                target=app.shutdown, name="test-drain-shutdown"
+            )
+            shutdown_thread.start()
+            _wait(lambda: fleet.draining, message="drain began")
+            # while draining with work in flight the listener is still
+            # up: new work is SHED and readiness says why
+            assert fleet.in_flight >= 1
+            try:
+                _post(base + "/generate", {"tokens": [7]}, timeout=5)
+                raise AssertionError("expected 503 while draining")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 503
+                assert json.loads(exc.read())["error"]["reason"] == "draining"
+            try:
+                _get(base + "/.well-known/ready", timeout=5)
+                raise AssertionError("expected ready 503 while draining")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 503
+                assert json.loads(exc.read())["state"] == "draining"
+
+            shutdown_thread.join(30)
+            assert not shutdown_thread.is_alive()
+            shutdown_done = True
+            worker.join(10)
+            # the in-flight request FINISHED before the listener died
+            status, body, _ = slow_result["resp"]
+            assert status == 200
+            assert json.loads(body)["data"]["count"] == 100
+            counter = app.container.metrics.counter(
+                "gofr_tpu_router_shed_total", labels=("reason",)
+            )
+            assert counter.value(reason="draining") >= 1
+        finally:
+            if not shutdown_done:
+                router_ctx.__exit__(None, None, None)
+            else:
+                # already shut down; just unwind the contextmanager
+                try:
+                    router_ctx.__exit__(None, None, None)
+                except Exception:
+                    pass
+    finally:
+        fleet_ctx.__exit__(None, None, None)
+
+
+# -- e2e: affinity -------------------------------------------------------------
+
+def test_affinity_pins_conversation_to_one_replica(tmp_path, monkeypatch):
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(3) as replicas, chaos_router(replicas) as app:
+        base = f"http://127.0.0.1:{app.http_port}"
+        fleet = app.container.fleet
+        _wait(lambda: len(fleet.replica_set.in_rotation()) == 3,
+              message="replicas in rotation")
+        headers = {"X-Session-ID": "conversation-7"}
+        for _ in range(5):
+            status, _, _ = _post(base + "/generate", {"tokens": [1, 2]},
+                                 headers=headers)
+            assert status == 200
+        from gofr_tpu.fleet.router import hash_affinity
+
+        snap = _fleet_snapshot(app)
+        # records carry the HASHED key (raw keys can be prompt text)
+        pinned = {r["attempts"][0]["replica"] for r in snap["routes"]
+                  if r.get("affinity_key") == hash_affinity("conversation-7")}
+        assert len(pinned) == 1  # every turn landed on ONE replica
+        assert not any(r.get("affinity_key") == "conversation-7"
+                       for r in snap["routes"])
+        # and the SAME prompt routes by its own prefix without a header
+        for _ in range(3):
+            _completion(base, [9, 9, 9, 9])
+        snap = _fleet_snapshot(app)
+        by_prompt = {r["attempts"][0]["replica"] for r in snap["routes"]
+                     if r["path"] == "/v1/completions"}
+        assert len(by_prompt) == 1

@@ -177,6 +177,20 @@ def test_gfl005_convention_enforced_statically():
     assert lint("m.counter(name, 'x')\n") == []
 
 
+def test_gfl005_router_family_covered():
+    """The gofr_tpu_router_* family (fleet/router.py) rides the same
+    convention: the suffix table must keep accepting its gauges (_state,
+    _depth) and rejecting drift within the family."""
+    assert lint('m.gauge("gofr_tpu_router_breaker_state", "b")\n') == []
+    assert lint('m.gauge("gofr_tpu_router_outstanding_depth", "o")\n') == []
+    assert lint('m.counter("gofr_tpu_router_shed_total", "s")\n') == []
+    assert lint('m.histogram("gofr_tpu_router_upstream_seconds", "u")\n') == []
+    assert rules_of(lint('m.gauge("gofr_tpu_router_breakers", "b")\n')) == \
+        ["GFL005"]
+    assert rules_of(lint('m.counter("gofr_tpu_router_sheds", "s")\n')) == \
+        ["GFL005"]
+
+
 # -- GFL006: swallowed exceptions ---------------------------------------------
 
 def test_gfl006_bare_except_everywhere():

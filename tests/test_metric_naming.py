@@ -61,7 +61,18 @@ def test_scanner_sees_the_known_registrations():
     assert {"gofr_tpu_kv_blocks", "gofr_tpu_kv_evictions_total"} <= names
     # the cardinality guard's overflow ledger (metrics.py Registry)
     assert "gofr_tpu_metrics_dropped_series_total" in names
-    assert len(names) >= 24
+    # the fleet front door (fleet/router.py FleetRouter._init_metrics):
+    # every routing/retry/shed/breaker decision must stay scan-visible
+    assert {"gofr_tpu_router_requests_total",
+            "gofr_tpu_router_retries_total",
+            "gofr_tpu_router_shed_total",
+            "gofr_tpu_router_breaker_transitions_total",
+            "gofr_tpu_router_breaker_state",
+            "gofr_tpu_router_replica_state",
+            "gofr_tpu_router_outstanding_depth",
+            "gofr_tpu_router_inflight_depth",
+            "gofr_tpu_router_upstream_seconds"} <= names
+    assert len(names) >= 33
 
 
 def test_every_metric_follows_the_naming_convention():

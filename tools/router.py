@@ -1,0 +1,53 @@
+"""Fleet front-door entrypoint: a thin router process fronting N engine
+replicas (ROADMAP item 3, docs/advanced-guide/fleet.md).
+
+    FLEET_REPLICAS=http://10.0.0.1:8000,http://10.0.0.2:8000 \\
+    HTTP_PORT=7000 python tools/router.py
+
+The process is a plain gofr app — same middleware stack, ``/metrics``,
+admin surface, graceful SIGTERM drain — whose serving routes forward to
+the healthiest replica: readiness-aware rotation with probation,
+prefix-affinity routing (a conversation returns to the replica holding
+its paged-KV blocks), per-replica circuit breakers, bounded retries
+under a per-request deadline budget, per-tenant token-bucket quotas
+(fleet-wide when REDIS_HOST is set), and 429 + Retry-After load
+shedding instead of unbounded queueing. ``GET /admin/fleet`` shows
+every decision. All knobs: the ``FLEET_*`` keys in
+``gofr_tpu/config.py``.
+
+No model boots here: leave ``MODEL_NAME``/``TPU_ENABLED`` unset — the
+router process needs neither jax nor a device.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import gofr_tpu
+    from gofr_tpu.fleet import wire_fleet
+
+    app = gofr_tpu.new()
+    if app.container.tpu is not None:
+        app.logger.errorf(
+            "router process booted a TPU datasource — unset MODEL_NAME/"
+            "TPU_ENABLED; a front door must stay device-free"
+        )
+        return 2
+    try:
+        wire_fleet(app)
+    except ValueError as exc:
+        app.logger.errorf("fleet wiring failed: %s", exc)
+        return 2
+    # SIGTERM → App.run's handler → shutdown() → fleet.drain() finishes
+    # in-flight requests before the listener stops
+    app.run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
