@@ -528,9 +528,23 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
             except Exception as exc:
                 errors.append(f"paged-kv phase: {exc}")
                 traceback.print_exc(file=sys.stderr)
-            kv_live = _scrape_kv_blocks(base)
-            if kv_live is not None:
-                result["kv_blocks"] = kv_live
+            # -- phase: host-mesh round (sharded-serving satellite) -----------
+            # the same paged engine on a tp=2-sharded host arena vs the
+            # single-device arena: per-token dispatch latency and
+            # copied-KV-bytes per prefix hit must not regress when the
+            # block tables span fake devices (tools/bench_gate.py holds
+            # the tolerance against bench_baseline.json)
+            try:
+                result["mesh_microbench"] = _measure_host_mesh()
+                log(f"host mesh: {result['mesh_microbench']}")
+            except Exception as exc:
+                errors.append(f"host-mesh phase: {exc}")
+                traceback.print_exc(file=sys.stderr)
+            engine_live = _scrape_engine(base)
+            if engine_live.get("kv_blocks") is not None:
+                result["kv_blocks"] = engine_live["kv_blocks"]
+            if engine_live.get("mesh") is not None:
+                result["mesh"] = engine_live["mesh"]
         return 0 if result["value"] is not None else 1
     finally:
         # the engine state machine's verdict on the run (serving vs
@@ -771,26 +785,77 @@ def _measure_paged_kv() -> dict:
     return out
 
 
-def _scrape_kv_blocks(base: str) -> "dict | None":
-    """The serving process's live block accounting off GET /admin/engine."""
+def _measure_host_mesh() -> dict:
+    """Host-mesh round (ROADMAP 1 satellite): the echo paged-KV engine
+    on a ``tp=2``-sharded :class:`HostTokenArena` (every block's tokens
+    split across 2 fake devices — the host analogue of the device
+    arena's tp head sharding) against the single-device arena, same
+    allocator, same prompts. Reports the per-token dispatch (append)
+    latency and the copied-KV-bytes per prefix hit for both, plus the
+    mesh/single latency ratio — sharding the tables must cost
+    bookkeeping only, never extra KV copies. Host-side and compile-free
+    (exists even when the device tunnel is wedged)."""
+    import numpy as np
+
+    from gofr_tpu.tpu.kv_blocks import (
+        BlockPool,
+        HostPagedKV,
+        HostTokenArena,
+    )
+
+    prompt = (np.arange(256, dtype=np.int32) * 5) % 199 + 1
+    n_tokens = int(os.environ.get("BENCH_MESH_TOKENS", "2048"))
+    n_hits = int(os.environ.get("BENCH_KV_ITERS", "200"))
+    out: dict = {"tp": 2}
+    for label, shards in (("single", 1), ("mesh", 2)):
+        arena = HostTokenArena(1024, 16, shards=shards)
+        pool = BlockPool(1024, 16, arena=arena, cache_entries=64)
+        eng = HostPagedKV(pool, arena, lcp_min=16)
+        seed = eng.admit(prompt, 0)
+        eng.finish(seed)  # the cached conversation every hit aliases
+        base_bytes = pool.stats()["copied_kv_bytes"]
+        start = time.perf_counter()
+        for _ in range(n_hits):
+            seq = eng.admit(prompt, 8)
+            eng.finish(seq, store=False)
+        admit_ms = (time.perf_counter() - start) / n_hits * 1000
+        copied = (pool.stats()["copied_kv_bytes"] - base_bytes) / n_hits
+        # per-token dispatch: the decode-side append path THROUGH the
+        # (possibly sharded) block tables — COW + capacity bookkeeping
+        # plus the shard-split write itself
+        seq = eng.admit(prompt, n_tokens)
+        start = time.perf_counter()
+        for i in range(n_tokens):
+            eng.append(seq, int(prompt[i % prompt.size]))
+        per_tok_ms = (time.perf_counter() - start) / n_tokens * 1000
+        eng.finish(seq, store=False)
+        out[label] = {
+            "per_token_dispatch_ms": round(per_tok_ms, 5),
+            "admission_ms": round(admit_ms, 4),
+            "copied_kv_bytes_per_hit": round(copied, 1),
+        }
+    out["per_token_overhead_ratio"] = round(
+        out["mesh"]["per_token_dispatch_ms"]
+        / max(out["single"]["per_token_dispatch_ms"], 1e-9), 3,
+    )
+    return out
+
+
+def _scrape_engine(base: str) -> dict:
+    """ONE GET /admin/engine snapshot ({} when unreachable) — every
+    field the artifact wants (state, kv_blocks, mesh) comes from this
+    single fetch."""
     try:
         with urllib.request.urlopen(base + "/admin/engine", timeout=10) as r:
-            data = json.loads(r.read()).get("data") or {}
-        return data.get("kv_blocks")
+            return json.loads(r.read()).get("data") or {}
     except Exception:
-        return None
+        return {}
 
 
 def _scrape_engine_state(base: str) -> "str | None":
-    """Read the engine state machine off GET /admin/engine (when
-    reachable): the emitted artifact then says whether the run ended
-    serving or degraded/wedged."""
-    try:
-        with urllib.request.urlopen(base + "/admin/engine", timeout=10) as r:
-            data = json.loads(r.read()).get("data") or {}
-        return (data.get("engine") or {}).get("state")
-    except Exception:
-        return None
+    """The engine state machine's verdict (when reachable): the emitted
+    artifact then says whether the run ended serving or degraded/wedged."""
+    return (_scrape_engine(base).get("engine") or {}).get("state")
 
 
 def _scrape_mfu(base: str, model: str, op: str) -> float | None:

@@ -18,6 +18,17 @@ block-granular paged mode; ``KV_BLOCK_TOKENS`` (default 64) is the
 block size; ``KV_BLOCKS`` / ``KV_HBM_BUDGET_MB`` size the shared
 block budget (0 = auto, non-binding).
 
+Serving-mesh key (tpu/device.py + parallel/): ``TPU_MESH`` (e.g.
+"tp=2" or "tp=4,dp=4") shards serving executables over a named mesh.
+Paged KV, chunked prefill, the prefix cache, and the penalized pool
+compose with tp-only meshes (the paged block arena shards its kv-head
+axis over tp); dp/fsdp meshes degrade paged KV/chunked prefill and any
+mesh degrades pooled multi-LoRA — each degrade is logged and counted
+on ``gofr_tpu_mesh_degrade_total{feature}``. ``KV_BLOCK_TOKENS`` must
+be divisible by tp for the echo runner's host-mesh arena, and the
+model's ``n_kv_heads`` by tp for device arenas — violations fail the
+boot with the axis named.
+
 Observability keys (timebase + postmortem layer, see
 docs/advanced-guide/observability.md for semantics):
 ``TIMEBASE_INTERVAL_S`` (default 5) / ``TIMEBASE_WINDOW_S`` (default

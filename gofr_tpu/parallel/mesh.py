@@ -61,10 +61,28 @@ def make_mesh(
         raise ValueError(f"mesh shape {shape} needs {total} devices, have {len(devices)}")
     # Auto axes: GSPMD owns propagation and inserts collectives freely
     # (jax 0.9 defaults some paths to explicit sharding-in-types, which
-    # rejects mixed-axis contractions instead of resolving them)
-    axis_types = (jax.sharding.AxisType.Auto,) * len(AXES)
-    return jax.make_mesh(sizes, AXES, axis_types, devices=devices)
+    # rejects mixed-axis contractions instead of resolving them). Older
+    # jax (< 0.5) predates AxisType — its meshes are Auto by definition,
+    # so the plain two-argument call is the same semantics.
+    axis_type = getattr(
+        getattr(jax.sharding, "AxisType", None), "Auto", None
+    )
+    if axis_type is None:
+        return jax.make_mesh(sizes, AXES, devices=devices)
+    return jax.make_mesh(sizes, AXES, (axis_type,) * len(AXES), devices=devices)
 
 
 def axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape.get(axis, 1)
+
+
+def mesh_axes(mesh: Optional[Mesh]) -> Optional[dict[str, int]]:
+    """The mesh's non-trivial axes as a plain dict ({"tp": 2, "dp": 4})
+    — the shape observability carries (``/admin/engine`` ``mesh``,
+    ``gofr_tpu_mesh_axis_size{axis}``, FlightRecord ``mesh_axes``).
+    None when no mesh (single chip)."""
+    if mesh is None:
+        return None
+    # a mesh whose axes are all size 1 yields {} (a mesh, trivially) —
+    # distinct from the None of no mesh at all
+    return {a: s for a, s in mesh.shape.items() if s > 1}

@@ -119,6 +119,15 @@ def cache_specs(cache: Any) -> Any:
     }
 
 
+def kv_arena_spec() -> P:
+    """Paged-KV block arena [L, n_blocks, bt, Hkv, hd]: kv heads over tp
+    (the same head split :func:`cache_specs` gives the compute caches,
+    so scatter/gather between blocks and rows moves no bytes across the
+    tp axis); block and token axes stay unsharded — block ids are
+    mesh-agnostic bookkeeping."""
+    return P(None, None, None, "tp", None)
+
+
 def shard_params(params: Any, mesh: Mesh, specs: Optional[Any] = None) -> Any:
     """Place a param tree onto the mesh with NamedShardings."""
     if specs is None:

@@ -92,7 +92,7 @@ class FlightRecord:
         "tokens_in", "tokens_out", "batch_size", "pool_cohort",
         "prefill_chunks", "prefill_bucket", "sched_defer_s",
         "pool_reject_reason", "dispatch_ids",
-        "kv_blocks", "kv_aliased_blocks",
+        "kv_blocks", "kv_aliased_blocks", "mesh_axes",
         "wall_start", "t_start", "t_enqueue", "t_dispatch",
         "t_first_token", "t_last_token", "t_done", "wall_done", "_lock",
         # the recorder's in-flight index holds records WEAKLY (an
@@ -130,6 +130,9 @@ class FlightRecord:
         self.dispatch_ids: list[int] = []  # device dispatches this rode
         self.kv_blocks = 0  # paged-KV blocks reserved for this request
         self.kv_aliased_blocks = 0  # of those, admitted copy-free (prefix share)
+        # serving-mesh axes this request ran on ({"tp": 2, ...}; None =
+        # single chip) — latency is only comparable within one topology
+        self.mesh_axes: Optional[dict] = None
         # gofrlint: wall-clock — /admin/requests display ts (durations use t_*)
         self.wall_start = time.time()
         self.t_start = time.perf_counter()
@@ -209,6 +212,12 @@ class FlightRecord:
             if aliased > self.kv_aliased_blocks:
                 self.kv_aliased_blocks = aliased
 
+    def note_mesh(self, axes: dict) -> None:
+        """Stamp the serving-mesh shape (set-once; the device stamps it
+        when a request enters its generate path under TPU_MESH)."""
+        if self.mesh_axes is None:
+            self.mesh_axes = dict(axes)
+
     def note_tokens(self, n: int = 1) -> None:
         with self._lock:
             self.tokens_out += n
@@ -277,6 +286,7 @@ class FlightRecord:
             "dispatch_ids": list(self.dispatch_ids),
             "kv_blocks": self.kv_blocks or None,
             "kv_aliased_blocks": self.kv_aliased_blocks or None,
+            "mesh_axes": self.mesh_axes,
             "start_ts": self.wall_start,
             "enqueue_ts": _offset(self.t_enqueue),
             "dispatch_ts": _offset(self.t_dispatch),

@@ -231,6 +231,12 @@ class DecodePool:
             next(iter(cache_shardings.values())).mesh
             if cache_shardings else None
         )
+        from gofr_tpu.parallel.mesh import mesh_axes
+
+        # the pool's own record of the mesh its executables compiled
+        # for — occupancy() carries it so /admin/engine shows which
+        # topology the slot cache is sharded over
+        self.mesh_axes = mesh_axes(mesh)
         self._repl = (
             NamedSharding(mesh, PartitionSpec()) if mesh is not None else None
         )
@@ -1207,6 +1213,7 @@ class DecodePool:
                 "lora_slots": len(self._lora_slots),
                 "penalized_slots": len(self._pen_slots),
                 "closed": self._closed,
+                "mesh_axes": self.mesh_axes,
                 "kv": self._kv.stats() if self._kv is not None else None,
             }
 

@@ -81,6 +81,17 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
   Collectives are emitted by GSPMD over ICI; absent -> single chip.
   (``TPU_TOPOLOGY`` in "axis=N" form is accepted as an alias, but the
   "NxM" physical-grid values TPU VMs export under that name are ignored.)
+  Composition: paged KV, chunked prefill, the prefix cache, and the
+  pooled penalized path all COMPOSE with tp-only meshes (the paged
+  block arena shards its head axis over tp); dp/fsdp meshes degrade
+  paged KV and chunked prefill to their fallbacks and pooled multi-LoRA
+  degrades under any mesh — every degrade logs AND increments
+  ``gofr_tpu_mesh_degrade_total{feature}``. The live mesh shape is on
+  ``GET /admin/engine`` (``mesh``), ``gofr_tpu_mesh_axis_size{axis}``,
+  and each request's FlightRecord (``mesh_axes``). The echo runner
+  parses ``TPU_MESH`` too (host-mesh mode): its paged block arena
+  shards every block across the tp fake devices, so mesh code paths
+  run compile-free in tier-1.
 - ``TPU_ENABLED``: force the datasource on without MODEL_NAME
 
 The datasource receives the container treatment the reference gives Redis
@@ -231,6 +242,7 @@ class TPUDevice:
         self.platform = "pending"
         self.device_kind = "pending"
         self.mesh = None
+        self.mesh_axes: Optional[dict[str, int]] = None
         self.peak_flops = 0.0
         self.peak_hbm_bw = 0.0
 
@@ -372,6 +384,25 @@ class TPUDevice:
             "reuse) or executable (compiled-shape reuse on the decode/"
             "prefill paths), event=hit|partial_hit|miss",
             labels=("cache", "event"),
+        )
+        # serving-mesh shape (TPU_MESH): one sample per non-trivial axis,
+        # set once the probe builds the mesh — dashboards answer "what
+        # mesh is this replica on" without scraping /admin/engine
+        self._mesh_axis_gauge = metrics.gauge(
+            "gofr_tpu_mesh_axis_size",
+            "serving mesh axis sizes (TPU_MESH; absent axes are 1)",
+            labels=("axis",),
+        )
+        # features that silently degraded because of the mesh shape
+        # (paged KV under dp/fsdp, pooled multi-LoRA, chunked prefill,
+        # the decode pool on indivisible slots): each boot-time degrade
+        # increments its feature — a log line alone is not a signal an
+        # alert can watch
+        self._mesh_degrade = metrics.counter(
+            "gofr_tpu_mesh_degrade_total",
+            "serving features degraded/disabled by the TPU_MESH shape "
+            "(the feature still serves through its fallback path)",
+            labels=("feature",),
         )
 
 
@@ -575,6 +606,15 @@ class TPUDevice:
             self.watchdog.arm(WATCHDOG_AUTO_TIMEOUT_S)
         self.device_kind = getattr(self.devices[0], "device_kind", self.platform)
         self.mesh = _mesh_from_topology(self._mesh_request, self.devices)
+        from gofr_tpu.parallel.mesh import mesh_axes
+
+        # live mesh shape -> gauge + snapshot field + flight records:
+        # "what mesh is this replica on" must never require a log dig
+        self.mesh_axes = mesh_axes(self.mesh)
+        if self.mesh is not None:
+            for axis, size in self.mesh.shape.items():
+                if size > 1 or axis in ("dp", "fsdp", "tp"):
+                    self._mesh_axis_gauge.set(size, axis=axis)
         from gofr_tpu.tpu.flops import device_peak_flops, device_peak_hbm_bw
 
         # MFU/MBU denominators = aggregate peak of the chips actually
@@ -692,6 +732,9 @@ class TPUDevice:
             and getattr(self.runner, "prefill_chunk_bucket", None) is None
         ):
             # a silently inert knob voids the documented bound — say so
+            # (and count it: gofr_tpu_mesh_degrade_total is the alertable
+            # half of this warning)
+            self._mesh_degrade.inc(feature="chunked_prefill")
             self.logger.warnf(
                 "PREFILL_CHUNK_TOKENS=%d is inert under a dp/fsdp serving "
                 "mesh (chunked prefill needs an unsharded cache batch "
@@ -710,6 +753,7 @@ class TPUDevice:
         if pool_ok and self.mesh is not None:
             rows = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
             if self._pool_slots % rows:
+                self._mesh_degrade.inc(feature="decode_pool")
                 self.logger.warnf(
                     "decode pool disabled: DECODE_SLOTS=%d not divisible by "
                     "dp*fsdp=%d (pool cache shards its slot axis)",
@@ -772,6 +816,10 @@ class TPUDevice:
         self.kv_pool = getattr(self.runner, "kv_pool", None)
         reason = getattr(self.runner, "kv_paged_disabled", "")
         if reason:
+            if getattr(self.runner, "kv_paged_mesh_degraded", False):
+                # mesh-shaped degrade (dp/fsdp batch sharding), not a
+                # config typo: count it where alerts can see it
+                self._mesh_degrade.inc(feature="kv_paged")
             self.logger.warnf("paged KV disabled: %s", reason)
         if not (
             self._kv_paged
@@ -796,7 +844,14 @@ class TPUDevice:
             )
         else:
             n_blocks = 1024  # ~64k tokens of host "KV" — ample for echo
-        arena = HostTokenArena(n_blocks, bt)
+        # host-mesh mode: a TPU_MESH tp axis shards every block's token
+        # span across tp fake devices (the echo analogue of the device
+        # arena's head sharding) — fleet/chaos and paged-echo tests then
+        # exercise the mesh code paths with zero compiles. Divisibility
+        # fails the boot with the axis named, same contract as the
+        # transformer's head check.
+        tp = (self.mesh_axes or {}).get("tp", 1)
+        arena = HostTokenArena(n_blocks, bt, shards=tp)
         pool = BlockPool(
             n_blocks, bt, arena=arena,
             hbm_budget_bytes=n_blocks * arena.block_bytes,
@@ -935,6 +990,10 @@ class TPUDevice:
         stop_tokens = frozenset(stop_tokens or ()) | self.default_stop_ids
         start = time.perf_counter()
         record = telemetry_record()
+        if record is not None and self.mesh_axes:
+            # flight records carry the serving-mesh shape: a latency
+            # regression must be attributable to the topology it ran on
+            record.note_mesh(self.mesh_axes)
 
         def _ttft() -> None:
             # explicit exemplar: this callback fires on batcher/pool
@@ -1215,6 +1274,12 @@ class TPUDevice:
             # embedding it): "which jax was this wedge on" is the first
             # question a tunnel-failure triage asks
             "versions": runtime_versions(),
+            # live serving-mesh shape (None = single chip): axes with
+            # their sizes plus the device count the mesh spans
+            "mesh": (
+                {"axes": self.mesh_axes, "devices": self.mesh.size}
+                if self.mesh is not None else None
+            ),
             "boot": dict(self.boot_status),
             "boot_timeline": [dict(stage) for stage in self.boot_timeline],
             "watchdog": self.watchdog.snapshot(),
@@ -1430,9 +1495,13 @@ class TPUDevice:
             pool.disable_lora()
             return
         if getattr(runner, "_cache_shardings", None) is not None:
+            # documented degrade, not an error: solo adapter decode is
+            # always correct; the counter makes the capacity loss visible
+            self._mesh_degrade.inc(feature="pooled_lora")
             self.logger.warnf(
                 "pooled multi-LoRA unavailable under a serving mesh — "
-                "adapter requests decode solo"
+                "adapter requests decode solo (gofr_tpu_mesh_degrade_total"
+                "{feature=\"pooled_lora\"})"
             )
             return
         from gofr_tpu.models.lora import build_lora_stack
@@ -1588,6 +1657,29 @@ def _mesh_from_topology(topology: str, devices: list) -> Optional[Any]:
     return make_mesh(mesh_shape_for(n, **kwargs), devices=devices[:n])
 
 
+def _validate_mesh_fit(cfg: Any, mesh: Optional[Any], max_batch: int) -> None:
+    """Model-shape/mesh divisibility, validated BEFORE params load: every
+    failure is a ``ValueError`` naming the offending axis, raised at boot
+    — never a GSPMD shape error (or a wedge) at first dispatch."""
+    if mesh is None:
+        return
+    tp = mesh.shape.get("tp", 1)
+    if cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"n_kv_heads={cfg.n_kv_heads} not divisible by "
+            f"tp={tp} — KV cache shards its head axis over tp"
+        )
+    rows = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    padded = next_pow2(max_batch)
+    if padded % rows:
+        raise ValueError(
+            f"padded batch {padded} (next_pow2 of BATCH_MAX_SIZE="
+            f"{max_batch}) not divisible by dp*fsdp={rows} — token "
+            "batches shard their row axis over (dp, fsdp); raise "
+            "BATCH_MAX_SIZE or shrink the dp/fsdp axes of TPU_MESH"
+        )
+
+
 # -- model runners ------------------------------------------------------------
 
 class _EchoRunner:
@@ -1609,9 +1701,14 @@ class _EchoRunner:
     # attribute to decide whether a decode phase makes sense)
     decode_chunk_size = 1
 
-    def __init__(self, max_batch: int = 8, step_ms: float = 0.0):
+    def __init__(self, max_batch: int = 8, step_ms: float = 0.0,
+                 mesh_axes: Optional[dict] = None):
         self.max_batch = max_batch
         self.step_s = step_ms / 1000.0
+        # host-mesh mode (TPU_MESH on the echo runner): the parsed axis
+        # dict; the device wires the paged host arena with tp shards so
+        # mesh code paths run compile-free in tier-1
+        self.mesh_axes = mesh_axes
         # injectable stall hook (tests): called at the top of every
         # run_batch, so a test can wedge a "device" dispatch on the
         # compile-free path and drive the watchdog/engine state machine
@@ -1942,6 +2039,11 @@ class _TransformerRunner:
 
             self.cfg = dataclasses.replace(self.cfg, **overrides)
         self.decode_chunk_size = decode_chunk
+        # mesh-fit validation BEFORE the params exist: a tp axis that
+        # cannot divide the head count (or a dp/fsdp product the padded
+        # batch cannot shard over) must fail in milliseconds with the
+        # axis named, not after a checkpoint load / param init
+        _validate_mesh_fit(self.cfg, mesh, max_batch)
         self._load_params(model_path, quant)
         self._init_mesh(mesh, max_batch)
         self._build_entry_points(init_cache, prefill, decode_step)
@@ -2070,20 +2172,32 @@ class _TransformerRunner:
         budget) and the decode pool's admission ledger — one HBM ledger,
         so cached prefixes yield to live traffic block by block.
 
-        Disabled (with the reason recorded for the boot log) under a
-        serving mesh (the arena and gather/scatter ops are unsharded) or
-        when ``block_tokens`` does not tile ``max_seq``. With neither a
-        prefix cache nor an explicit arena size there is nothing to
-        page — the slot model is already exact."""
+        A tensor-parallel serving mesh composes: the arena shards its
+        kv-head axis over tp exactly like the compute caches
+        (:class:`~gofr_tpu.tpu.kv_blocks.JaxKVArena` ``mesh=``), so
+        aliasing, COW, eviction, and ledger admission run unchanged —
+        block bookkeeping is host-side and mesh-agnostic. Disabled
+        (with the reason recorded for the boot log, and
+        ``gofr_tpu_mesh_degrade_total{feature="kv_paged"}`` counted by
+        the device) under a dp/fsdp mesh — gather/scatter build [1]-row
+        caches, which need the batch axis unsharded, the same bound
+        chunked prefill has — or when ``block_tokens`` does not tile
+        ``max_seq``. With neither a prefix cache nor an explicit arena
+        size there is nothing to page — the slot model is already
+        exact."""
         self.kv_pool = None
         self._paged_prefix = None
         self.kv_paged_disabled = ""
+        self.kv_paged_mesh_degraded = False
         if not kv_paged or not (prefix_cache > 0 or kv_blocks or kv_budget_bytes):
             return
-        if self.mesh is not None:
+        if not self._can_chunk_prefill():
             self.kv_paged_disabled = (
-                "KV_PAGED is inert under a serving mesh (unsharded arena)"
+                "KV_PAGED degrades to the slot/row model under a dp/fsdp "
+                "serving mesh (block gather/scatter needs an unsharded "
+                "cache batch axis; tp-only meshes compose)"
             )
+            self.kv_paged_mesh_degraded = True
             return
         cfg = self.cfg
         if cfg.max_seq % block_tokens:
@@ -2133,8 +2247,13 @@ class _TransformerRunner:
             # the physical arena (device buffers + scatter/gather
             # compiles) exists only for the prefix cache's blocks —
             # ledger-only mode (PREFIX_CACHE=0 + an explicit budget) is
-            # pure admission accounting and must not pay HBM for it
-            arena = JaxKVArena(cfg, data_blocks + 1, block_tokens)
+            # pure admission accounting and must not pay HBM for it.
+            # Under a tp mesh the arena shards its head axis with the
+            # compute caches (mesh=), so stores/gathers stay collective-
+            # free along tp and rows land pre-placed for the executables
+            arena = JaxKVArena(
+                cfg, data_blocks + 1, block_tokens, mesh=self.mesh
+            )
             self._paged_prefix = _PagedPrefixStore(
                 self.kv_pool, arena, self._prefix_lcp_min
             )
@@ -2170,8 +2289,9 @@ class _TransformerRunner:
 
     def _init_mesh(self, mesh: Optional[Any], max_batch: int) -> None:
         """Serving-mesh placement: Megatron tp/fsdp param layout, KV
-        head axis over tp, token batches over (dp, fsdp); validates
-        divisibility eagerly."""
+        head axis over tp, token batches over (dp, fsdp). Divisibility
+        was validated by :func:`_validate_mesh_fit` before the params
+        were even loaded."""
         self.mesh = mesh
         self._token_sharding = None
         self._cache_shardings = None
@@ -2180,21 +2300,6 @@ class _TransformerRunner:
 
             from gofr_tpu.parallel.sharding import cache_specs, shard_params
 
-            tp = mesh.shape.get("tp", 1)
-            if self.cfg.n_kv_heads % tp:
-                raise ValueError(
-                    f"n_kv_heads={self.cfg.n_kv_heads} not divisible by "
-                    f"tp={tp} — KV cache shards its head axis over tp"
-                )
-            rows = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-            padded = next_pow2(max_batch)
-            if padded % rows:
-                raise ValueError(
-                    f"padded batch {padded} (next_pow2 of BATCH_MAX_SIZE="
-                    f"{max_batch}) not divisible by dp*fsdp={rows} — token "
-                    "batches shard their row axis over (dp, fsdp); raise "
-                    "BATCH_MAX_SIZE or shrink the dp/fsdp axes of TPU_MESH"
-                )
             self.params = shard_params(self.params, mesh)
             self._token_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
             self._row_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
@@ -3962,7 +4067,11 @@ def _build_runner(
             f"LORA_ADAPTERS requires a transformer MODEL_NAME (got '{name}')"
         )
     if name == "echo":
-        return _EchoRunner(max_batch, step_ms=echo_step_ms)
+        from gofr_tpu.parallel.mesh import mesh_axes as _axes
+
+        return _EchoRunner(
+            max_batch, step_ms=echo_step_ms, mesh_axes=_axes(mesh)
+        )
     if name in ("mlp", "tiny-mlp"):
         return _MLPRunner(quant, model_path, max_batch)
     if name.startswith("bert"):

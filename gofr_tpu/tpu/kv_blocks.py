@@ -528,14 +528,50 @@ class BlockPool:
 class HostTokenArena:
     """Host block storage for the echo runner: a block's "KV" is the
     token ids it covers, so aliasing/COW fidelity is directly checkable
-    (read the sequence back, compare to the prompt) with zero compiles."""
+    (read the sequence back, compare to the prompt) with zero compiles.
+
+    ``shards`` is the host-mesh mode (echo's ``TPU_MESH`` analogue of
+    the device arena's tp head sharding): every block's tokens are
+    SPLIT contiguously across ``shards`` fake devices — shard ``s``
+    owns positions ``[s*w, (s+1)*w)`` of each block (``w = block_tokens
+    / shards``) — so block tables, aliasing, COW, and admission all run
+    against genuinely distributed storage, compile-free. Per-shard
+    write counts (``shard_writes``) let tests assert every fake device
+    actually took traffic."""
 
     TOKEN_BYTES = 4  # int32 ids
 
-    def __init__(self, n_blocks: int, block_tokens: int):
+    def __init__(self, n_blocks: int, block_tokens: int, shards: int = 1):
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        if block_tokens % shards:
+            raise ValueError(
+                f"tp={shards} does not divide KV_BLOCK_TOKENS="
+                f"{block_tokens} — host-mesh blocks split their token "
+                "axis evenly across the tp axis"
+            )
         self.block_tokens = block_tokens
         self.block_bytes = block_tokens * self.TOKEN_BYTES
-        self._data = np.zeros((n_blocks, block_tokens), np.int32)
+        self.shards = shards
+        self._width = block_tokens // shards
+        # [shards, n_blocks, width]: axis 0 is the fake-device axis —
+        # shard-major reshape of a block reassembles its token order
+        self._data = np.zeros((shards, n_blocks, self._width), np.int32)
+        self.shard_writes = [0] * shards
+
+    def _write_span(self, blk: int, at: int, ids: np.ndarray) -> None:
+        """Write ``ids`` at block-local offset ``at`` of ``blk``: one
+        direct slice store per shard the span overlaps (never a whole-
+        block read-modify-write — a 1-token decode append must touch
+        one element, not ``block_tokens`` of them)."""
+        w = self._width
+        hi = at + ids.size
+        for s in range(at // w, (hi - 1) // w + 1):
+            s_lo, s_hi = max(at, s * w), min(hi, (s + 1) * w)
+            self._data[s, blk, s_lo - s * w : s_hi - s * w] = (
+                ids[s_lo - at : s_hi - at]
+            )
+            self.shard_writes[s] += 1
 
     def write(self, table: BlockTable, start: int, ids: np.ndarray) -> int:
         """Write ``ids`` at token offset ``start`` of ``table``;
@@ -548,7 +584,7 @@ class HostTokenArena:
             blk = table.blocks[pos // bt]
             at = pos % bt
             n = min(bt - at, ids.size - off)
-            self._data[blk, at : at + n] = ids[off : off + n]
+            self._write_span(blk, at, ids[off : off + n])
             pos += n
             off += n
         return ids.size * self.TOKEN_BYTES
@@ -559,12 +595,21 @@ class HostTokenArena:
         if not table.blocks or table.length == 0:
             return np.zeros(0, np.int32)
         nb = blocks_for(table.length, bt)
-        flat = self._data[table.blocks[:nb]].reshape(-1)
+        # [shards, nb, width] -> [nb, shards, width] -> token order
+        flat = np.transpose(
+            self._data[:, table.blocks[:nb], :], (1, 0, 2)
+        ).reshape(-1)
         return flat[: table.length].copy()
 
     def copy_partial(self, dst_block: int, src_block: int, n_tokens: int) -> int:
-        """COW copy of the boundary block's first ``n_tokens``."""
-        self._data[dst_block, :n_tokens] = self._data[src_block, :n_tokens]
+        """COW copy of the boundary block's first ``n_tokens`` — only
+        the prefix, shard by shard (the suffix belongs to whoever
+        writes it next)."""
+        w = self._width
+        for s in range((n_tokens - 1) // w + 1):
+            n_s = min(n_tokens - s * w, w)
+            self._data[s, dst_block, :n_s] = self._data[s, src_block, :n_s]
+            self.shard_writes[s] += 1
         return n_tokens * self.TOKEN_BYTES
 
 
@@ -786,10 +831,18 @@ class JaxKVArena:
 
     Both are ONE dispatch each (a scan / a take), compiled once at
     construction — no lazy compile on the serving path.
+
+    With a serving ``mesh`` (tp-only; the caller gates dp/fsdp) the
+    arena itself is SHARDED: k/v split their kv-head axis over ``tp``
+    (``parallel/sharding.py::kv_arena_spec``, the same head split the
+    compute caches use), scatter/gather pin their outputs to the
+    arena/cache placements, and the block/token axes stay unsharded —
+    so block ids and table bookkeeping are mesh-agnostic while every
+    device holds only its head slice of every block.
     """
 
     def __init__(self, cfg: Any, n_blocks: int, block_tokens: int,
-                 max_seq: Optional[int] = None):
+                 max_seq: Optional[int] = None, mesh: Optional[Any] = None):
         import jax
         import jax.numpy as jnp
 
@@ -803,12 +856,45 @@ class JaxKVArena:
         self.block_tokens = block_tokens
         self.max_seq = max_seq
         self.blocks_per_seq = max_seq // block_tokens
+        self.mesh = mesh
         shape = (
             cfg.n_layers, n_blocks, block_tokens, cfg.n_kv_heads,
             cfg.head_dim,
         )
-        self.k = jnp.zeros(shape, cfg.cache_dtype)
-        self.v = jnp.zeros(shape, cfg.cache_dtype)
+        arena_sharding = row_shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from gofr_tpu.parallel.sharding import cache_specs, kv_arena_spec
+
+            tp = mesh.shape.get("tp", 1)
+            if cfg.n_kv_heads % tp:
+                raise ValueError(
+                    f"n_kv_heads={cfg.n_kv_heads} not divisible by tp="
+                    f"{tp} — the paged-KV arena shards its head axis "
+                    "over tp"
+                )
+            arena_sharding = NamedSharding(mesh, kv_arena_spec())
+            row_shardings = {
+                k_: NamedSharding(mesh, s)
+                for k_, s in cache_specs(None).items()
+            }
+        self._arena_sharding = arena_sharding
+        self._row_shardings = row_shardings
+        if arena_sharding is not None:
+            # allocate each shard IN PLACE: jnp.zeros-then-device_put
+            # would transiently commit the whole tp-times-larger arena
+            # to one device — an OOM (or peak-HBM spike) at exactly the
+            # arena sizes tp exists to make fit
+            zeros = jax.jit(
+                lambda: jnp.zeros(shape, cfg.cache_dtype),
+                out_shardings=arena_sharding,
+            )
+            self.k = zeros()
+            self.v = zeros()
+        else:
+            self.k = jnp.zeros(shape, cfg.cache_dtype)
+            self.v = jnp.zeros(shape, cfg.cache_dtype)
         itemsize = jnp.zeros((), cfg.cache_dtype).dtype.itemsize
         self.block_bytes = (
             2 * cfg.n_layers * block_tokens * cfg.n_kv_heads
@@ -855,14 +941,32 @@ class JaxKVArena:
             }
 
         # the arena is donated through scatter (updated in place — it is
-        # the second-largest live buffer after the pool cache)
-        self._scatter = jax.jit(scatter, donate_argnums=(0, 1))
-        self._gather = jax.jit(gather)
+        # the second-largest live buffer after the pool cache). Under a
+        # mesh, outputs pin to the arena/cache placements so scatter
+        # keeps the arena sharded and gathered rows land exactly where
+        # the compiled executables expect their cache inputs.
+        self._scatter = jax.jit(
+            scatter, donate_argnums=(0, 1),
+            out_shardings=(
+                (arena_sharding, arena_sharding)
+                if arena_sharding is not None else None
+            ),
+        )
+        self._gather = jax.jit(
+            gather,
+            out_shardings=(
+                dict(row_shardings) if row_shardings is not None else None
+            ),
+        )
         # warm both NOW: serving-path calls must reuse, never compile
         zero_row_k = jnp.zeros(
             (n_layers, 1, max_seq, cfg.n_kv_heads, cfg.head_dim),
             cfg.cache_dtype,
         )
+        if row_shardings is not None:
+            # warm with the EXACT row placement serving-path rows carry
+            # (sharded prefill caches) or the first real store recompiles
+            zero_row_k = jax.device_put(zero_row_k, row_shardings["k"])
         ids0 = jnp.zeros((nps,), jnp.int32)
         self.k, self.v = self._scatter(
             self.k, self.v, zero_row_k, zero_row_k, ids0

@@ -177,6 +177,16 @@ def test_gfl005_convention_enforced_statically():
     assert lint("m.counter(name, 'x')\n") == []
 
 
+def test_gfl005_mesh_family_covered():
+    """The sharded-serving family (tpu/device.py): the _size gauge
+    suffix (gofr_tpu_mesh_axis_size{axis}) and the degrade counter pass;
+    suffix drift within the family still fails."""
+    assert lint('m.gauge("gofr_tpu_mesh_axis_size", "a")\n') == []
+    assert lint('m.counter("gofr_tpu_mesh_degrade_total", "d")\n') == []
+    assert rules_of(lint('m.gauge("gofr_tpu_mesh_axes", "a")\n')) == \
+        ["GFL005"]
+
+
 def test_gfl005_router_family_covered():
     """The gofr_tpu_router_* family (fleet/router.py) rides the same
     convention: the suffix table must keep accepting its gauges (_state,

@@ -458,3 +458,92 @@ def test_jax_arena_rejects_non_tiling_block_size():
 
     with pytest.raises(ValueError, match="must divide"):
         JaxKVArena(CONFIGS["tiny"], n_blocks=4, block_tokens=48)
+
+
+def test_jax_arena_sharded_over_tp_matches_unsharded():
+    """JaxKVArena(mesh=tp-only): k/v shard their head axis across the
+    tp devices, and scatter/gather through the sharded arena is
+    bit-identical to the single-device arena — sharding is placement,
+    never numerics."""
+    import jax
+    import jax.numpy as jnp
+
+    from gofr_tpu.models.llama import CONFIGS
+    from gofr_tpu.parallel.mesh import make_mesh, mesh_shape_for
+    from gofr_tpu.tpu.kv_blocks import JaxKVArena
+
+    cfg = CONFIGS["tiny"]  # 2 kv heads: tp=2 puts one head per device
+    bt = 32
+    mesh = make_mesh(mesh_shape_for(2, tp=2), devices=jax.devices()[:2])
+    sharded = JaxKVArena(cfg, n_blocks=9, block_tokens=bt, mesh=mesh)
+    plain = JaxKVArena(cfg, n_blocks=9, block_tokens=bt)
+    assert len(sharded.k.sharding.device_set) == 2
+
+    rng = np.random.default_rng(3)
+    length = 70
+    shape = (cfg.n_layers, 1, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim)
+    r = rng.standard_normal(shape, dtype=np.float32)
+    row = {
+        "k": jnp.asarray(r, cfg.cache_dtype),
+        "v": jnp.asarray(-r, cfg.cache_dtype),
+        "lengths": jnp.asarray([length], jnp.int32),
+    }
+    for arena in (sharded, plain):
+        pool = BlockPool(9, bt, block_bytes=arena.block_bytes, scratch=True)
+        t = pool.reserve(length)
+        t.length = length
+        assert arena.scatter_row(row, t) == 3 * arena.block_bytes
+        back = arena.gather_row(t, length)
+        for f in ("k", "v"):
+            np.testing.assert_array_equal(
+                np.asarray(back[f][:, :, :length]),
+                np.asarray(row[f][:, :, :length]),
+            )
+
+
+def test_jax_arena_mesh_rejects_indivisible_heads():
+    import jax
+
+    from gofr_tpu.models.llama import CONFIGS
+    from gofr_tpu.parallel.mesh import make_mesh, mesh_shape_for
+    from gofr_tpu.tpu.kv_blocks import JaxKVArena
+
+    mesh = make_mesh(mesh_shape_for(4, tp=4), devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="n_kv_heads=2 not divisible by tp=4"):
+        JaxKVArena(CONFIGS["tiny"], n_blocks=5, block_tokens=32, mesh=mesh)
+
+
+# -- host arena shards (echo host-mesh mode) ----------------------------------
+
+def test_host_arena_sharded_write_read_fidelity():
+    """shards=2: every block's token span splits across two fake
+    devices; writes landing across shard boundaries reassemble exactly,
+    and COW copies preserve content — checked against the unsharded
+    arena on identical traffic."""
+    ids = (np.arange(37, dtype=np.int32) * 11) % 127 + 1
+    for shards in (1, 2, 4):
+        arena = HostTokenArena(16, 8, shards=shards)
+        pool = BlockPool(16, 8, arena=arena)
+        t = pool.reserve(ids.size)
+        t.length = ids.size
+        # two writes split mid-shard: offsets 0..20 then 20..37
+        arena.write(t, 0, ids[:20])
+        arena.write(t, 20, ids[20:])
+        np.testing.assert_array_equal(arena.read(t), ids)
+        if shards > 1:
+            assert sum(arena.shard_writes) > 0
+    # COW across shards: partial copy keeps the donor's prefix
+    arena = HostTokenArena(16, 8, shards=2)
+    pool = BlockPool(16, 8, arena=arena)
+    t = pool.reserve(8)
+    t.length = 6
+    arena.write(t, 0, ids[:6])
+    dst = pool.alloc(1)[0]
+    arena.copy_partial(dst, t.blocks[0], 6)
+    t2 = BlockTable([dst], 6)
+    np.testing.assert_array_equal(arena.read(t2), ids[:6])
+
+
+def test_host_arena_shard_divisibility_enforced():
+    with pytest.raises(ValueError, match="tp=3 does not divide"):
+        HostTokenArena(8, 8, shards=3)

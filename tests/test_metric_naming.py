@@ -24,7 +24,7 @@ _COUNTER_SUFFIXES = ("_total",)
 _HISTOGRAM_SUFFIXES = ("_seconds", "_bytes", "_size")
 _GAUGE_SUFFIXES = (
     "_seconds", "_bytes", "_total", "_depth", "_ratio", "_entries",
-    "_active", "_acceptance", "_state", "_blocks",
+    "_active", "_acceptance", "_state", "_blocks", "_size",
 )
 # roofline utilization gauges: the suffix IS the (well-known) metric name
 _GAUGE_ALLOWLIST = {"gofr_tpu_mfu", "gofr_tpu_mbu"}
@@ -59,6 +59,10 @@ def test_scanner_sees_the_known_registrations():
             "gofr_tpu_profiler_active"} <= names
     # the paged-KV block accounting (tpu/kv_blocks.py BlockPool)
     assert {"gofr_tpu_kv_blocks", "gofr_tpu_kv_evictions_total"} <= names
+    # the sharded-serving suite (TPU_MESH): live mesh shape + the
+    # features a mesh shape degraded (tpu/device.py)
+    assert {"gofr_tpu_mesh_axis_size",
+            "gofr_tpu_mesh_degrade_total"} <= names
     # the cardinality guard's overflow ledger (metrics.py Registry)
     assert "gofr_tpu_metrics_dropped_series_total" in names
     # the fleet front door (fleet/router.py FleetRouter._init_metrics):
@@ -73,6 +77,24 @@ def test_scanner_sees_the_known_registrations():
             "gofr_tpu_router_inflight_depth",
             "gofr_tpu_router_upstream_seconds"} <= names
     assert len(names) >= 33
+
+
+def test_suffix_tables_match_gofrlint():
+    """GFL005 (tools/gofrlint.py) is the static half of this exact
+    convention: the two suffix tables must stay in LOCKSTEP or a new
+    metric family passes one gate and fails the other with a split
+    verdict."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "gofrlint_naming", PKG_DIR.parent / "tools" / "gofrlint.py"
+    )
+    gofrlint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gofrlint)
+    assert gofrlint._COUNTER_SUFFIXES == _COUNTER_SUFFIXES
+    assert gofrlint._HISTOGRAM_SUFFIXES == _HISTOGRAM_SUFFIXES
+    assert gofrlint._GAUGE_SUFFIXES == _GAUGE_SUFFIXES
+    assert gofrlint._GAUGE_ALLOWLIST == _GAUGE_ALLOWLIST
 
 
 def test_every_metric_follows_the_naming_convention():

@@ -19,7 +19,13 @@ baseline:
   model's, and the admission path must not blow up
   (``paged admission_ms <= slot_copy admission_ms *
   BENCH_GATE_KV_FACTOR``, default 3.0 — aliasing bookkeeping may cost
-  a little CPU; it must never cost an order of magnitude).
+  a little CPU; it must never cost an order of magnitude);
+- the host-mesh round (sharded block tables over tp=2 fake devices)
+  must stay bookkeeping-cheap: mesh per-token dispatch latency
+  ``<= single * BENCH_GATE_MESH_FACTOR`` (default 5.0 — loose-first;
+  tighten as the trajectory stabilizes) and mesh copied-KV-bytes per
+  prefix hit ``<= single + 64`` (sharding must never introduce KV
+  copies; aliasing is placement-agnostic).
 
 Usage::
 
@@ -48,6 +54,7 @@ def gate(bench: dict, baseline: dict) -> list[str]:
     rps_factor = float(os.environ.get("BENCH_GATE_RPS_FACTOR", "0.40"))
     ttft_factor = float(os.environ.get("BENCH_GATE_TTFT_FACTOR", "2.5"))
     kv_factor = float(os.environ.get("BENCH_GATE_KV_FACTOR", "3.0"))
+    mesh_factor = float(os.environ.get("BENCH_GATE_MESH_FACTOR", "5.0"))
 
     if bench.get("backend") != baseline.get("backend"):
         failures.append(
@@ -92,6 +99,31 @@ def gate(bench: dict, baseline: dict) -> list[str]:
                     f"paged admission latency blew up: "
                     f"{paged['admission_ms']}ms > "
                     f"{slot['admission_ms']}ms * {kv_factor}"
+                )
+
+    mesh = bench.get("mesh_microbench") or {}
+    if baseline.get("mesh_microbench"):
+        single, meshed = mesh.get("single"), mesh.get("mesh")
+        if not (single and meshed):
+            failures.append("mesh_microbench missing from the bench artifact")
+        else:
+            if (
+                meshed["per_token_dispatch_ms"]
+                > single["per_token_dispatch_ms"] * mesh_factor
+            ):
+                failures.append(
+                    "host-mesh per-token dispatch blew up: "
+                    f"{meshed['per_token_dispatch_ms']}ms > "
+                    f"{single['per_token_dispatch_ms']}ms * {mesh_factor}"
+                )
+            if (
+                meshed["copied_kv_bytes_per_hit"]
+                > single["copied_kv_bytes_per_hit"] + 64
+            ):
+                failures.append(
+                    "sharded block tables introduced KV copies: "
+                    f"{meshed['copied_kv_bytes_per_hit']} bytes/hit mesh vs "
+                    f"{single['copied_kv_bytes_per_hit']} single (+64 slack)"
                 )
     return failures
 
