@@ -540,6 +540,26 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
             except Exception as exc:
                 errors.append(f"host-mesh phase: {exc}")
                 traceback.print_exc(file=sys.stderr)
+            # -- phase: journal overhead (self-healing satellite) --------------
+            # the per-token cost of the durable generation journal —
+            # the price every stream pays for resumability; gated
+            # against bench_baseline.json (BENCH_GATE_JOURNAL_FACTOR)
+            try:
+                result["journal_microbench"] = _measure_journal()
+                log(f"journal: {result['journal_microbench']}")
+            except Exception as exc:
+                errors.append(f"journal phase: {exc}")
+                traceback.print_exc(file=sys.stderr)
+            # -- phase: recovery MTTR (self-healing tentpole) ------------------
+            # wedge -> serving wall time on an in-process echo engine:
+            # the trajectory records RESILIENCE, not just speed — the
+            # number that says how long a wedged replica is dark
+            try:
+                result["recovery_microbench"] = _measure_recovery()
+                log(f"recovery: {result['recovery_microbench']}")
+            except Exception as exc:
+                errors.append(f"recovery phase: {exc}")
+                traceback.print_exc(file=sys.stderr)
             engine_live = _scrape_engine(base)
             if engine_live.get("kv_blocks") is not None:
                 result["kv_blocks"] = engine_live["kv_blocks"]
@@ -839,6 +859,114 @@ def _measure_host_mesh() -> dict:
         / max(out["single"]["per_token_dispatch_ms"], 1e-9), 3,
     )
     return out
+
+
+def _measure_journal() -> dict:
+    """Per-token cost of the durable generation journal (telemetry.py):
+    request-key hashing + entry start/finish per request, one bounded
+    append per token — the overhead every stream pays for
+    resumability. Host-side and compile-free; the gate holds
+    ``per_token_us`` against bench_baseline.json
+    (``BENCH_GATE_JOURNAL_FACTOR``)."""
+    from gofr_tpu.telemetry import GenerationJournal, request_key
+
+    n_req = int(os.environ.get("BENCH_JOURNAL_REQUESTS", "200"))
+    n_tok = int(os.environ.get("BENCH_JOURNAL_TOKENS", "64"))
+    journal = GenerationJournal(capacity=256, max_tokens=8192)
+    prompt = [(7 * i) % 251 + 1 for i in range(48)]
+    start = time.perf_counter()
+    for i in range(n_req):
+        key = request_key("echo", prompt, n_tok, None)
+        entry = journal.start(key, "echo", n_tok, seeded=False,
+                              deterministic=True)
+        for token in range(n_tok):
+            entry.append(token)
+        journal.finish(entry)
+    elapsed = time.perf_counter() - start
+    # the control: the same loop shape journaling nothing — isolates
+    # the journal's own cost from loop overhead
+    sink = 0
+    start = time.perf_counter()
+    for i in range(n_req):
+        for token in range(n_tok):
+            sink += token
+    control = time.perf_counter() - start
+    overhead = max(elapsed - control, 0.0)
+    return {
+        "requests": n_req,
+        "tokens_per_request": n_tok,
+        "per_token_us": round(overhead / (n_req * n_tok) * 1e6, 4),
+        "per_request_us": round(overhead / n_req * 1e6, 2),
+    }
+
+
+def _measure_recovery() -> dict:
+    """Recovery MTTR, measured for real: boot an in-process echo
+    engine, wedge a dispatch on a latch, let the watchdog walk
+    degraded → wedged and the recovery supervisor rebuild back to
+    serving — and stamp the wedge→serving wall time plus the recovery
+    counts into the artifact. The watchdog deadline dominates (the
+    detection half of MTTR); the rebuild is the repair half."""
+    import threading
+
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.logging import Level
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.testutil import MockLogger
+    from gofr_tpu.tpu.device import new_device
+
+    watchdog_s = float(os.environ.get("BENCH_RECOVERY_WATCHDOG_S", "0.1"))
+    overrides = {
+        "MODEL_NAME": "echo",
+        "WATCHDOG_DISPATCH_TIMEOUT_S": str(watchdog_s),
+        "RECOVERY_BACKOFF_S": "0.05",
+        "TIMEBASE_ENABLED": "off",
+    }
+    old = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.FATAL), Registry())
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+    release = threading.Event()
+    try:
+        device.runner.stall_hook = lambda: release.wait(30)
+        wedge_start = time.perf_counter()
+
+        def kick() -> None:
+            try:
+                device.generate([9], max_new_tokens=2)
+            except Exception:
+                pass  # the wedged dispatch fails by design
+
+        kicker = threading.Thread(target=kick, name="bench-wedge-kick")
+        kicker.start()
+        deadline = time.monotonic() + 30
+        while not device.recovery.snapshot()["recoveries"].get("recovered"):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"recovery did not complete: {device.recovery.snapshot()}"
+                )
+            time.sleep(0.01)
+        wall = time.perf_counter() - wedge_start
+        release.set()
+        kicker.join(10)
+        snap = device.recovery.snapshot()
+        return {
+            "watchdog_timeout_s": watchdog_s,
+            # wedge->serving as the supervisor measured it (wedged
+            # transition to serving transition)
+            "mttr_s": snap["last_mttr_s"],
+            # stall-injection->serving as the bench saw it (includes
+            # the watchdog's detection window)
+            "stall_to_serving_s": round(wall, 3),
+            "attempts": snap["attempts"],
+            "recoveries": snap["recoveries"],
+        }
+    finally:
+        release.set()
+        device.close()
 
 
 def _scrape_engine(base: str) -> dict:

@@ -55,7 +55,21 @@ admission: ``FLEET_QUOTA_RPS`` (0 = off), ``FLEET_QUOTA_BURST``,
 ``FLEET_TRUST_TENANT_HEADER`` (off — only behind a gateway that stamps
 ``X-Tenant``), ``FLEET_MAX_INFLIGHT`` (256),
 ``FLEET_SATURATION_QUEUE`` (64), ``FLEET_RETRY_AFTER_S`` (1); drain:
-``FLEET_DRAIN_TIMEOUT_S`` (10).
+``FLEET_DRAIN_TIMEOUT_S`` (10); resumable streams: ``FLEET_RESUME``
+(on — mid-stream failover for deterministic SSE), ``FLEET_MAX_RESUMES``
+(4 continuation attempts per stream).
+
+Self-healing keys (tpu/recovery.py + telemetry.py, see
+docs/advanced-guide/fleet.md "Wedge-recovery runbook"):
+``RECOVERY_ENABLED`` (on — a wedged engine quarantines the stuck
+dispatch and rebuilds back to serving; off restores terminal wedged),
+``RECOVERY_MAX_ATTEMPTS`` (3), ``RECOVERY_BACKOFF_S`` (1, doubling) /
+``RECOVERY_BACKOFF_MAX_S`` (30), ``RECOVERY_ATTEMPT_TIMEOUT_S`` (300 —
+a rebuild hanging past it is terminal ``failed``); ``JOURNAL`` (on —
+durable generation journal: prompt hash + sampling params + emitted
+token ids per request, the substrate of bit-identical stream resume),
+``JOURNAL_CAPACITY`` (256 interrupted entries retained),
+``JOURNAL_MAX_TOKENS`` (8192 tokens recorded per entry).
 
 Correctness-tooling keys (devtools/sanitizer.py + tests/conftest.py,
 see docs/advanced-guide/static-analysis.md): ``GOFR_SANITIZE=1`` arms

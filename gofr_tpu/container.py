@@ -144,6 +144,15 @@ class Container:
             # a wedged or boot-failed engine writes its own black-box
             # bundle the moment the state machine says so
             self.postmortem.watch_engine(self.tpu.engine)
+            # the recovery supervisor writes its bundle SYNCHRONOUSLY
+            # before quarantining the stuck dispatch (the quarantine
+            # destroys the live watchdog evidence a bundle must carry;
+            # rate limiting dedupes against the listener's own write)
+            self.tpu.recovery.postmortem = (
+                lambda detail: self.postmortem.write(
+                    reason="wedged", detail=detail
+                )
+            )
             if self.config.get_or_default("TPU_BOOT", "") == "background":
                 # the device logs its describe() line once probe+warmup end
                 self.logger.infof(

@@ -239,17 +239,35 @@ class ChaosReplica:
             self.app.http_server.run_in_thread()
 
     # -- device-level chaos ----------------------------------------------------
-    def wedge(self, seconds: float) -> None:
-        """Inject a device stall: the NEXT dispatch blocks ``seconds``
-        on the echo runner's ``stall_hook``; with the watchdog armed the
-        replica walks degraded → wedged and its readiness 503s."""
-        import time as _time
-
+    def wedge(self, seconds: Optional[float] = None) -> None:
+        """Inject a device stall: the NEXT dispatch blocks on an
+        internal latch — until :meth:`recover` releases it, or
+        ``seconds`` elapse (None = held until recovered). With the
+        watchdog armed the replica walks degraded → wedged and its
+        readiness 503s; with the recovery supervisor on, the engine
+        then quarantines the stuck dispatch and rebuilds. The paired
+        wedge()/recover() controls make the WHOLE recovery loop
+        testable compile-free — chaos can heal, not just break."""
+        release = threading.Event()
+        self._wedge_release = release
         tpu = self.app.container.tpu
-        tpu.runner.stall_hook = lambda: _time.sleep(seconds)
+        tpu.runner.stall_hook = lambda: release.wait(seconds)
+
+    def recover(self) -> None:
+        """Un-wedge: release every dispatch parked on the latch and
+        clear the hook. After a recovery rebuild the CURRENT runner is
+        a fresh object (hook already gone) — this still frees the OLD
+        stack's stuck dispatch thread so tests never leak it."""
+        release = getattr(self, "_wedge_release", None)
+        if release is not None:
+            release.set()
+        runner = getattr(self.app.container.tpu, "runner", None)
+        if runner is not None:
+            runner.stall_hook = None
 
     def unwedge(self) -> None:
-        self.app.container.tpu.runner.stall_hook = None
+        """Back-compat alias for :meth:`recover`."""
+        self.recover()
 
     def close(self) -> None:
         self.app.shutdown()
@@ -271,6 +289,9 @@ def build_replica(name: str, env: Optional[dict[str, str]] = None,
         "BATCH_MAX_SIZE": "4",
         "BATCH_TIMEOUT_MS": "1",
         "WATCHDOG_DISPATCH_TIMEOUT_S": "0.2",
+        # recovery on a test leash: rebuild attempts back off in
+        # fractions of a second so wedge->recover e2e fits test budgets
+        "RECOVERY_BACKOFF_S": "0.1",
         "TIMEBASE_ENABLED": "off",
         "GRPC_PORT": str(_free_port()),
     }

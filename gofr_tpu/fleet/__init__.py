@@ -137,6 +137,12 @@ def wire_fleet(app: Any) -> FleetRouter:
         max_inflight=_i("FLEET_MAX_INFLIGHT", "256"),
         retry_after_s=_f("FLEET_RETRY_AFTER_S", "1"),
     )
+    if (config.get_or_default("FLEET_RESUME", "on") or "").lower() in (
+        "off", "0", "false", "no"
+    ):
+        # resume off: mid-stream upstream failure truncates (pre-PR-9)
+        fleet.resume_enabled = False
+    fleet.max_resumes = max(0, _i("FLEET_MAX_RESUMES", "4"))
     if (config.get_or_default("FLEET_AFFINITY", "on") or "").lower() in (
         "off", "0", "false", "no"
     ):

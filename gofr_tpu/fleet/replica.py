@@ -258,10 +258,10 @@ class ReplicaSet:
         """One probe round for ``replica``: readiness decides rotation,
         the piggybacked engine scrape updates saturation. Returns the
         readiness verdict (also applied to the state machine)."""
-        ok, detail = self._ready_probe(replica)
+        ok, detail, recovering = self._ready_probe(replica)
         replica.probes += 1
         replica.last_probe_error = "" if ok else detail
-        self._apply_probe(replica, ok)
+        self._apply_probe(replica, ok, recovering=recovering)
         if ok:
             self._scrape_engine(replica)
         else:
@@ -269,12 +269,32 @@ class ReplicaSet:
             replica.engine = None
         return ok
 
-    def _ready_probe(self, replica: Replica) -> tuple[bool, str]:
+    def _ready_probe(self, replica: Replica) -> tuple[bool, str, bool]:
         if self.hedge_ms and self.hedge_ms > 0:
             return self._hedged_ready(replica)
         return self._ready_once(replica)
 
-    def _ready_once(self, replica: Replica) -> tuple[bool, str]:
+    @staticmethod
+    def _recovering_verdict(body: bytes) -> bool:
+        """Does a 503 ready body say the engine is COMING BACK (an
+        active wedge-recovery incident) rather than hard-down? Keys on
+        the engine state and the recovery evidence block handler.py
+        attaches; terminal verdicts (exhausted/hung) are NOT coming
+        back."""
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return False
+        if not isinstance(payload, dict):
+            return False
+        if payload.get("state") == "recovering":
+            return True
+        recovery = payload.get("recovery")
+        return isinstance(recovery, dict) and recovery.get("state") in (
+            "recovering", "waiting_backoff"
+        )
+
+    def _ready_once(self, replica: Replica) -> tuple[bool, str, bool]:
         try:
             resp = replica.client.request(
                 "GET", "/.well-known/ready",
@@ -283,17 +303,20 @@ class ReplicaSet:
                 retries=0,
             )
         except Exception as exc:
-            return False, str(exc)
+            return False, str(exc), False
         if resp.status_code == 200:
-            return True, ""
+            return True, "", False
         detail = resp.body.decode("utf-8", "replace")[:200]
-        return False, f"ready {resp.status_code}: {detail}"
+        return (
+            False, f"ready {resp.status_code}: {detail}",
+            self._recovering_verdict(resp.body),
+        )
 
-    def _hedged_ready(self, replica: Replica) -> tuple[bool, str]:
+    def _hedged_ready(self, replica: Replica) -> tuple[bool, str, bool]:
         """Hedged readiness read: fire a second probe if the first is
         slower than ``hedge_ms``; first answer wins. The loser's reply
         is discarded (its connection closes with its thread)."""
-        results: "queue.Queue[tuple[bool, str]]" = queue.Queue()
+        results: "queue.Queue[tuple[bool, str, bool]]" = queue.Queue()
 
         def attempt() -> None:
             results.put(self._ready_once(replica))
@@ -313,7 +336,7 @@ class ReplicaSet:
         try:
             return results.get(timeout=self.probe_timeout_s * 2 + 1.0)
         except queue.Empty:
-            return False, "hedged probe timed out"
+            return False, "hedged probe timed out", False
 
     def _scrape_engine(self, replica: Replica) -> None:
         """Saturation signals off ``GET /admin/engine``: paged-KV free
@@ -372,9 +395,16 @@ class ReplicaSet:
         queue_full = self.saturation_queue > 0 and depth >= self.saturation_queue
         replica.saturated = replica.kv_starved or queue_full
 
-    def _apply_probe(self, replica: Replica, ok: bool) -> None:
+    def _apply_probe(self, replica: Replica, ok: bool,
+                     recovering: bool = False) -> None:
         """The probation state machine. Runs on the prober thread only
-        (plus tests), so plain attribute writes are safe."""
+        (plus tests), so plain attribute writes are safe.
+
+        ``recovering``: the failed probe's 503 body carried an ACTIVE
+        wedge-recovery incident — the replica is coming back, not
+        hard-down. It parks in PROBATION (no traffic, but the router's
+        stream-resume path may target it, and re-entry needs only the
+        usual ok-probe streak) instead of dropping to OUT."""
         was = replica.state
         if ok:
             replica.ok_streak += 1
@@ -388,12 +418,20 @@ class ReplicaSet:
         else:
             replica.fail_streak += 1
             replica.ok_streak = 0
-            if replica.state == PROBATION or (
+            if recovering:
+                if replica.state == OUT or (
+                    replica.state == HEALTHY
+                    and replica.fail_streak >= self.out_after
+                ):
+                    replica.state = PROBATION
+                # PROBATION holds: a replica mid-recovery never demotes
+                # to hard-out on the strength of its own progress report
+            elif replica.state == PROBATION or (
                 replica.fail_streak >= self.out_after
             ):
                 replica.state = OUT
         if was != replica.state and self._on_state_change is not None:
             try:
                 self._on_state_change(replica, was, replica.state)
-            except Exception:  # gofrlint: disable=GFL006 — metrics/log hook must not kill the prober
+            except Exception:  # gofrlint: disable=GFL006 — hook must not kill the prober
                 pass

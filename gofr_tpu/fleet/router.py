@@ -19,8 +19,16 @@ Request path, in order:
    bounded retries with decorrelated-jitter backoff for failures that
    produced no client-visible bytes (connect errors always; read
    timeouts and 5xx for requests not yet streamed); streaming requests
-   pass SSE chunks through and stop being retryable the moment the
-   upstream response head arrives.
+   pass SSE chunks through. DETERMINISTIC streams (an explicit seed,
+   or temperature 0) stay recoverable even after bytes flowed: the
+   router journals the last SSE event id it delivered
+   (:class:`_StreamRelay`), and a mid-stream upstream failure — a
+   dropped connection, a read timeout, or the replica's own error
+   frame when its engine wedges — retries/fails over with
+   ``X-Resume-From: <next id>`` and splices the continuation into the
+   SAME client response, filtering by event id so the client sees zero
+   missing and zero duplicated tokens. Non-deterministic streams keep
+   the old contract (abort truncated).
 4. **Accounting** — every decision rides the existing telemetry:
    ``gofr_tpu_router_*`` metrics, a bounded ring of per-request route
    records (the flight-recorder idiom one layer up), and the
@@ -37,11 +45,29 @@ from typing import Any, Optional
 
 from gofr_tpu.fleet import breaker as breaker_mod
 from gofr_tpu.fleet.admission import QuotaTable, tenant_of
-from gofr_tpu.fleet.replica import STATE_VALUES, ReplicaSet
+from gofr_tpu.fleet.replica import HEALTHY, PROBATION, STATE_VALUES, ReplicaSet
 from gofr_tpu.http.response import Response
 from gofr_tpu.service import ServiceCallError, _encode_query, backoff_delays
 
 _JSON = "application/json"
+
+
+class _ResumeSpec:
+    """Everything a stream relay needs to re-issue its request on a
+    failover: the wire request (method/target/headers/body), the
+    absolute deadline, and the affinity key for candidate ordering."""
+
+    __slots__ = ("method", "target", "headers", "body", "deadline",
+                 "affinity")
+
+    def __init__(self, method: str, target: str, headers: dict[str, str],
+                 body: Any, deadline: float, affinity: str):
+        self.method = method
+        self.target = target
+        self.headers = headers
+        self.body = body
+        self.deadline = deadline
+        self.affinity = affinity
 
 # request headers forwarded to the replica (hop-by-hop and router-local
 # headers are stripped; the service client adds its own traceparent /
@@ -125,6 +151,12 @@ class FleetRouter:
         self.read_timeout_s = read_timeout_s
         self.max_inflight = max_inflight
         self.retry_after_s = retry_after_s
+        # resumable streams: journal delivered SSE event ids and splice
+        # a failover continuation into a broken deterministic stream
+        # instead of truncating (FLEET_RESUME / FLEET_MAX_RESUMES —
+        # wire_fleet sets both post-construction, like affinity)
+        self.resume_enabled = True
+        self.max_resumes = 4
         self.affinity_enabled = True
         self.trust_tenant_header = False  # FLEET_TRUST_TENANT_HEADER
         self._records: deque = deque(maxlen=record_capacity)
@@ -182,6 +214,14 @@ class FleetRouter:
             "gofr_tpu_router_upstream_seconds",
             "upstream attempt latency per replica (success or failure)",
             labels=("replica",),
+        )
+        self._stream_resumes = m.counter(
+            "gofr_tpu_router_stream_resumes_total",
+            "mid-stream failover outcomes on resumable (deterministic) "
+            "SSE streams: resumed (continuation spliced in), exhausted "
+            "(deadline/attempts spent — truncated), refused (the "
+            "replica rejected the resume — truncated)",
+            labels=("outcome",),
         )
 
     def _wire_hooks(self) -> None:
@@ -348,10 +388,18 @@ class FleetRouter:
         affinity = (affinity_key_of(request, body_json)
                     if self.affinity_enabled else "")
         wants_stream = isinstance(body_json, dict) and bool(body_json.get("stream"))
+        # resumable: deterministic streams (seed / greedy) can be
+        # regenerated bit-identically, so a mid-stream upstream failure
+        # is recoverable by event-id splicing instead of truncation
+        resumable = (
+            self.resume_enabled and wants_stream and self.max_resumes > 0
+            and _deterministic_body(body_json)
+        )
         try:
             return self._forward(
                 request, tenant, affinity, wants_stream,
                 executor=ctx.container.handler_executor,
+                resumable=resumable,
             )
         finally:
             # streaming responses decrement in their own finally instead
@@ -390,7 +438,8 @@ class FleetRouter:
         }
 
     def _forward(self, request: Any, tenant: str, affinity: str,
-                 wants_stream: bool, executor: Any = None) -> Response:
+                 wants_stream: bool, executor: Any = None,
+                 resumable: bool = False) -> Response:
         start = time.monotonic()
         deadline = start + self.deadline_s
         target = self._target(request)
@@ -405,6 +454,8 @@ class FleetRouter:
             # on /admin/fleet — same rule as the tenant hash
             "affinity_key": hash_affinity(affinity) if affinity else None,
             "stream": wants_stream,
+            "resumable": resumable,
+            "resumes": 0,
             "attempts": [],
             "outcome": "error",
             "status": 0,
@@ -435,9 +486,14 @@ class FleetRouter:
                 )
             attempts += 1
             tried.add(replica.name)
+            resume = (
+                _ResumeSpec(request.method, target, headers,
+                            request.body or None, deadline, affinity)
+                if resumable else None
+            )
             response = self._attempt(
                 replica, request, target, headers, wants_stream,
-                remaining, record, executor, is_probe,
+                remaining, record, executor, is_probe, resume=resume,
             )
             if response is not None:
                 if response.stream is None:
@@ -491,6 +547,7 @@ class FleetRouter:
         record: dict[str, Any],
         executor: Any = None,
         is_probe: bool = False,
+        resume: Optional[_ResumeSpec] = None,
     ) -> Optional[Response]:
         """One forward attempt. Returns the client-facing Response, or
         None when the failure is retryable (breaker/metrics/record
@@ -513,6 +570,15 @@ class FleetRouter:
                 )
                 status = streaming.status_code
                 if status == 200:
+                    if resume is not None:
+                        # committed, but NOT final: the relay journals
+                        # delivered event ids and can splice a failover
+                        # continuation into this very response
+                        return self._relay_response(
+                            replica, request, streaming, entry,
+                            attempt_start, record, executor, is_probe,
+                            resume,
+                        )
                     # committed: from here the bytes flow to the client
                     # and the request stops being retryable
                     return self._stream_response(
@@ -628,6 +694,33 @@ class FleetRouter:
             stream=_sync_pull(chunks(), executor, finalizer),
         )
 
+    def _relay_response(
+        self,
+        replica: Any,
+        request: Any,
+        streaming: Any,
+        entry: dict[str, Any],
+        attempt_start: float,
+        record: dict[str, Any],
+        executor: Any,
+        is_probe: bool,
+        resume: _ResumeSpec,
+    ) -> Response:
+        """Resumable SSE passthrough: like ``_stream_response`` but the
+        relay owns a retry loop — a mid-stream upstream failure resumes
+        from the last delivered event id instead of truncating."""
+        request._stream_owns_release = True
+        entry["status"] = 200
+        relay = _StreamRelay(
+            self, replica, streaming, entry, record, attempt_start,
+            is_probe, resume,
+        )
+        return Response(
+            status=200,
+            headers=_filter_return_headers(streaming.headers),
+            stream=_sync_pull(relay.chunks(), executor, relay),
+        )
+
     def _finish_record(self, record: dict[str, Any], status: int) -> None:
         record["status"] = status
         record["retries"] = max(0, len(record["attempts"]) - 1)
@@ -729,6 +822,353 @@ class _StreamFinalizer:
             replica.breaker.record_success(probe=self._is_probe)
             router._req_total.inc(replica=replica.name, outcome="ok")
             router._finish_record(self._record, 200)
+        router._release()
+
+
+def _deterministic_body(body: Any) -> bool:
+    """True when the request's stream can be REGENERATED bit-identically
+    (the resume precondition): an explicit seed, or explicit greedy
+    sampling (temperature 0). Anything else — including the server-side
+    default temperature, which this router must not assume — keeps the
+    non-resumable truncate-on-failure contract."""
+    if not isinstance(body, dict):
+        return False
+    if body.get("seed") is not None:
+        return True
+    temperature = body.get("temperature")
+    return isinstance(temperature, (int, float)) and float(temperature) == 0.0
+
+
+class _SSEEventScanner:
+    """Incremental SSE event framer: feed raw chunks, get back complete
+    ``(block_bytes, event_id, is_error)`` events. ``block_bytes`` is the
+    verbatim wire slice (passthrough stays byte-identical); ``event_id``
+    is the parsed ``id:`` line (None when absent); ``is_error`` flags
+    the engine's error frame (``data: {"error": ...}``) — the signal
+    that a replica's generation died mid-stream even though the HTTP
+    stream ended 'cleanly'."""
+
+    MAX_BUFFER = 1 << 20  # a frame larger than 1 MiB is not ours
+
+    def __init__(self) -> None:
+        self._buf = b""
+
+    def feed(self, chunk: bytes) -> list[tuple[bytes, Optional[int], bool]]:
+        self._buf += chunk
+        if len(self._buf) > self.MAX_BUFFER:
+            raise ValueError("SSE frame exceeds the relay buffer bound")
+        events: list[tuple[bytes, Optional[int], bool]] = []
+        while True:
+            idx = self._buf.find(b"\n\n")
+            if idx < 0:
+                return events
+            block, self._buf = self._buf[:idx + 2], self._buf[idx + 2:]
+            event_id: Optional[int] = None
+            is_error = False
+            for line in block.split(b"\n"):
+                line = line.rstrip(b"\r")
+                if line.startswith(b"id:"):
+                    try:
+                        event_id = int(line[3:].strip())
+                    except ValueError:
+                        pass
+                elif line.startswith(b"data:") and line[5:].lstrip(
+                ).startswith(b'{"error"'):
+                    is_error = True
+            events.append((block, event_id, is_error))
+
+
+class _UpstreamStreamError(Exception):
+    """A proxied stream's upstream died mid-body (transport failure or
+    the replica's own error frame)."""
+
+
+class _StreamRelay:
+    """Resumable proxied stream: one client response spliced together
+    from up to ``max_resumes + 1`` upstream attempts.
+
+    The relay forwards complete SSE events, journaling the next
+    expected event id as it goes. When the CURRENT upstream fails — a
+    socket error, a read timeout, or the replica's in-band error frame
+    (a wedged engine ends its stream with ``data: {"error": ...}``, not
+    a reset) — the relay settles that attempt's accounting (breaker
+    failure, outstanding depth, route record) and hunts for a
+    continuation: the ORIGINATING replica first (it holds the
+    generation journal, and probation counts as "coming back"), then
+    any healthy candidate, re-issuing the request with
+    ``X-Resume-From: <next id>``. Continuation events are filtered by
+    id, so a replica that ignores the resume header and regenerates
+    from zero still splices correctly — the deterministic-body
+    precondition guarantees the regenerated frames match.
+
+    Idempotent ``finish``-style finalization mirrors
+    :class:`_StreamFinalizer` (the async bridge calls
+    ``finish("aborted")`` on client disconnect)."""
+
+    def __init__(self, router: "FleetRouter", replica: Any, streaming: Any,
+                 entry: dict[str, Any], record: dict[str, Any],
+                 attempt_start: float, is_probe: bool, resume: _ResumeSpec):
+        self._router = router
+        self._replica = replica          # current upstream's replica
+        self._origin = replica           # served the original prefix
+        self._streaming = streaming
+        self._entry = entry              # current attempt's route entry
+        self._record = record
+        self._attempt_start = attempt_start
+        self._is_probe = is_probe
+        self._resume = resume
+        self._scanner = _SSEEventScanner()
+        self._next_id = 0         # next event id the client expects
+        self._saw_ids = False     # the upstream actually numbers frames
+        self._resumed = False     # current upstream is a continuation
+        self._resumes = 0
+        self._attempt_settled = False
+        self._done = False
+        self._lock = threading.Lock()
+
+    # -- the client-facing chunk generator -------------------------------------
+    def chunks(self) -> Any:
+        while True:
+            try:
+                failed = False
+                for chunk in self._streaming.iter_chunks():
+                    for block in self._drain(chunk):
+                        yield block
+                # the upstream closed; an error frame mid-buffer still
+                # counts as a failure (flagged by _drain via exception)
+                self._settle_attempt("ok")
+                self._finalize("ok")
+                return
+            except GeneratorExit:
+                # client gone: _sync_pull finalizes via finish(); close
+                # the CURRENT upstream here too — a continuation opened
+                # after the abort would otherwise leak until GC
+                self._streaming.close()
+                raise
+            except _UpstreamStreamError as exc:
+                failed = str(exc)
+            except Exception as exc:
+                failed = f"{type(exc).__name__}: {exc}"
+            self._settle_attempt("upstream_error", failed)
+            if not self._try_resume():
+                self._finalize("upstream_error")
+                raise _UpstreamStreamError(
+                    f"stream failed and could not resume: {failed}"
+                )
+
+    def _drain(self, chunk: bytes) -> list[bytes]:
+        """Complete events from one raw chunk, filtered for delivery.
+        Raises :class:`_UpstreamStreamError` on the replica's in-band
+        error frame — it must never reach the client (the relay's whole
+        point is to replace it with a continuation)."""
+        out: list[bytes] = []
+        for block, event_id, is_error in self._scanner.feed(chunk):
+            if is_error:
+                raise _UpstreamStreamError("replica error frame")
+            if event_id is not None:
+                self._saw_ids = True
+                if event_id < self._next_id:
+                    continue  # continuation replaying delivered events
+                self._next_id = event_id + 1
+            elif self._resumed:
+                # id-less frames are only trustworthy from the original
+                # attempt (a regenerating continuation re-emits them)
+                continue
+            out.append(block)
+        return out
+
+    # -- per-attempt accounting ------------------------------------------------
+    def _settle_attempt(self, outcome: str, detail: str = "") -> None:
+        """Close the CURRENT upstream attempt's books (idempotent per
+        attempt): outstanding depth, latency histogram, breaker verdict,
+        request counter. The guard is LOCKED: a client abort (event
+        loop) and an upstream failure (pool thread) can race here, and
+        a double settle would double-record breaker verdicts."""
+        with self._lock:
+            if self._attempt_settled:
+                return
+            self._attempt_settled = True
+        router, replica = self._router, self._replica
+        self._streaming.close()
+        elapsed = time.monotonic() - self._attempt_start
+        self._entry["elapsed_ms"] = round(elapsed * 1000, 1)
+        router._upstream_seconds.observe(elapsed, replica=replica.name)
+        router._finish_attempt(replica)
+        if outcome == "upstream_error":
+            self._entry["error"] = detail or "stream aborted mid-body"
+            self._entry["reason"] = "stream"
+            replica.breaker.record_failure()
+            router._req_total.inc(replica=replica.name, outcome="network_error")
+        elif outcome == "aborted":
+            self._entry["error"] = "client abandoned the stream"
+            replica.breaker.record_success(probe=self._is_probe)
+            router._req_total.inc(replica=replica.name, outcome="client_aborted")
+        else:
+            replica.breaker.record_success(probe=self._is_probe)
+            router._req_total.inc(replica=replica.name, outcome="ok")
+
+    def _install_attempt(self, replica: Any, streaming: Any,
+                         entry: dict[str, Any], attempt_start: float,
+                         is_probe: bool) -> bool:
+        """Adopt a continuation upstream as the current attempt. Under
+        the relay lock, and REFUSED once finalized: a client abort that
+        landed while the hunt was mid-connect must not adopt (and then
+        never settle) a fresh upstream — its outstanding mark and
+        connection would leak forever."""
+        with self._lock:
+            if self._done:
+                return False
+            self._replica = replica
+            self._streaming = streaming
+            self._entry = entry
+            self._attempt_start = attempt_start
+            self._is_probe = is_probe
+            self._scanner = _SSEEventScanner()
+            self._resumed = True
+            self._attempt_settled = False
+        return True
+
+    # -- the resume hunt -------------------------------------------------------
+    def _pick_resume_target(self) -> Optional[tuple[Any, bool]]:
+        """The originating replica first — it holds the generation
+        journal (teacher-forced resume is nearly free there), and its
+        PROBATION state counts as "coming back" rather than hard-out —
+        then any healthy candidate the breaker admits."""
+        candidates: list[Any] = []
+        if self._origin.state in (HEALTHY, PROBATION):
+            candidates.append(self._origin)
+        candidates.extend(
+            r for r in self._router.replica_set.candidates(
+                self._resume.affinity
+            )
+            if r.name != self._origin.name
+        )
+        for replica in candidates:
+            grant = replica.breaker.try_acquire()
+            if grant:
+                return replica, grant == breaker_mod.PROBE
+        return None
+
+    def _try_resume(self) -> bool:
+        router = self._router
+        if not self._saw_ids:
+            # the upstream never numbered its frames (e.g. a fan-out
+            # stream): without ids a continuation cannot be spliced —
+            # id-less frames would all be dropped and the truncation
+            # would masquerade as success. Keep the abort contract.
+            router._stream_resumes.inc(outcome="refused")
+            return False
+        while True:
+            with self._lock:
+                if self._done:
+                    return False  # client already abandoned the stream
+            remaining = self._resume.deadline - time.monotonic()
+            if remaining <= 0.05 or self._resumes >= router.max_resumes:
+                router._stream_resumes.inc(outcome="exhausted")
+                return False
+            picked = self._pick_resume_target()
+            if picked is None:
+                # nothing admitted right now: the origin may be mid-
+                # recovery (probation arrives within a probe interval)
+                time.sleep(min(0.1, remaining))
+                continue
+            replica, is_probe = picked
+            self._resumes += 1
+            self._record["resumes"] = self._resumes
+            router._retries_total.inc(
+                replica=self._replica.name, reason="stream_resume"
+            )
+            headers = dict(self._resume.headers)
+            headers["X-Resume-From"] = str(self._next_id)
+            entry: dict[str, Any] = {
+                "replica": replica.name, "status": None, "error": None,
+                "elapsed_ms": 0, "resume_from": self._next_id,
+            }
+            self._record["attempts"].append(entry)
+            depth = replica.mark_dispatch()
+            router._outstanding_gauge.set(float(depth), replica=replica.name)
+            attempt_start = time.monotonic()
+            try:
+                streaming = replica.client.stream(
+                    self._resume.method, self._resume.target,
+                    body=self._resume.body, headers=headers,
+                    connect_timeout=min(router.connect_timeout_s, remaining),
+                    read_timeout=min(router.read_timeout_s, remaining),
+                )
+            except Exception as exc:
+                entry["error"] = str(exc)
+                entry["elapsed_ms"] = round(
+                    (time.monotonic() - attempt_start) * 1000, 1
+                )
+                router._finish_attempt(replica)
+                replica.breaker.record_failure()
+                router._req_total.inc(
+                    replica=replica.name, outcome="network_error"
+                )
+                continue
+            status = streaming.status_code
+            if status == 200:
+                entry["status"] = 200
+                if not self._install_attempt(
+                    replica, streaming, entry, attempt_start, is_probe
+                ):
+                    # the client aborted while we connected: settle this
+                    # never-adopted upstream and stop hunting
+                    streaming.close()
+                    router._finish_attempt(replica)
+                    replica.breaker.record_success(probe=is_probe)
+                    return False
+                router._stream_resumes.inc(outcome="resumed")
+                return True
+            # non-200: drain bounded, close, judge
+            try:
+                streaming.read(budget_s=min(2.0, remaining))
+            except Exception:
+                pass  # the error body is best-effort evidence only
+            streaming.close()
+            entry["status"] = status
+            entry["elapsed_ms"] = round(
+                (time.monotonic() - attempt_start) * 1000, 1
+            )
+            router._finish_attempt(replica)
+            if status >= 500:
+                replica.breaker.record_failure()
+                router._req_total.inc(
+                    replica=replica.name, outcome="upstream_5xx"
+                )
+                continue
+            # 4xx: the replica is healthy but refuses the resume
+            # (non-resumable shape, journal gone AND determinism
+            # rejected, …) — continuing elsewhere cannot help
+            replica.breaker.record_success(probe=is_probe)
+            router._stream_resumes.inc(outcome="refused")
+            return False
+
+    # -- terminal accounting ---------------------------------------------------
+    def finish(self, outcome: str) -> None:
+        """Async-bridge finalizer hook (client disconnect / task
+        cancellation). After a NORMAL completion ``_finalize`` already
+        ran — the idempotency guard makes this a no-op then."""
+        del outcome  # the bridge only ever reports an abort
+        self._settle_attempt_safe("aborted")
+        self._finalize("aborted")
+
+    def _settle_attempt_safe(self, outcome: str) -> None:
+        with self._lock:
+            if self._done:
+                return
+        self._settle_attempt(outcome)
+
+    def _finalize(self, outcome: str) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+        router = self._router
+        if outcome == "ok":
+            router._finish_record(self._record, 200)
+        else:
+            router._finish_record(self._record, 499)
         router._release()
 
 

@@ -109,9 +109,14 @@ def ready_handler(ctx: Context) -> Response:
         status, state = 200, {"state": "ready"}
     elif not tpu.ready():
         status, state = 503, dict(tpu.boot_status)
+        # a recovery rebuild clears readiness too: carry the incident
+        # evidence so the prober can tell "coming back" from "cold boot"
+        _attach_recovery_evidence(tpu, state)
     else:
         engine = getattr(tpu, "engine", None)
-        if engine is not None and engine.state in ("degraded", "wedged"):
+        if engine is not None and engine.state in (
+            "degraded", "wedged", "recovering"
+        ):
             snap = engine.snapshot()
             status = 503
             state = {"state": snap["state"], "detail": snap["detail"]}
@@ -126,6 +131,11 @@ def ready_handler(ctx: Context) -> Response:
                     "watching": wsnap.get("watching"),
                     "timeout_s": wsnap.get("timeout_s"),
                 }
+            # the recovery supervisor's evidence next to the watchdog's:
+            # attempt count, backoff deadline, last outcome — the fleet
+            # prober treats an engine with an ACTIVE recovery incident
+            # as coming back (probation) rather than hard-out
+            _attach_recovery_evidence(tpu, state)
         else:
             status, state = 200, {"state": "ready"}
     return Response(
@@ -133,6 +143,27 @@ def ready_handler(ctx: Context) -> Response:
         headers={"Content-Type": "application/json"},
         body=json.dumps(state).encode("utf-8"),
     )
+
+
+def _attach_recovery_evidence(tpu: Any, state: dict) -> None:
+    """Wedge-recovery incident evidence for the readiness 503 body:
+    attempt count, backoff deadline, last outcome (the /admin/engine
+    ``recovery`` block's probe-sized subset). Attached only while an
+    incident is live or has history — a never-wedged replica's ready
+    body stays unchanged."""
+    recovery = getattr(tpu, "recovery", None)
+    if recovery is None:
+        return
+    snap = recovery.snapshot()
+    if snap["state"] == "idle" and not snap["incidents"]:
+        return
+    state["recovery"] = {
+        "state": snap["state"],
+        "attempts": snap["attempts"],
+        "max_attempts": snap["max_attempts"],
+        "backoff_in_s": snap["backoff_in_s"],
+        "last_outcome": snap["last_outcome"],
+    }
 
 
 def metrics_handler(ctx: Context) -> Response:

@@ -33,21 +33,32 @@ def _jsonable(obj: Any) -> Any:
     return str(obj)
 
 
-def _frame_sse(item: Any) -> bytes:
+def _frame_sse(item: Any, event_id: Optional[int] = None) -> bytes:
     if isinstance(item, bytes):
         data = item.decode("utf-8", "replace")
     elif isinstance(item, str):
         data = item
     else:
         data = json.dumps(item, default=_jsonable)
-    return ("data: " + data + "\n\n").encode("utf-8")
+    prefix = f"id: {event_id}\n" if event_id is not None else ""
+    return (prefix + "data: " + data + "\n\n").encode("utf-8")
 
 
 async def _sse_iter(stream: Stream, executor: Any = None) -> AsyncIterator[bytes]:
     events = stream.events
+    # resumable-stream numbering (Stream.ids): every frame carries a
+    # monotonic SSE `id:` line anchored at id_offset, so a proxy (the
+    # fleet router) can journal the last delivered offset and resume a
+    # broken stream without missing or duplicated events
+    next_id = stream.id_offset if stream.ids else None
     if hasattr(events, "__aiter__"):
         async for item in events:  # type: ignore[union-attr]
-            yield _frame_sse(item) if stream.sse else _to_bytes(item)
+            if stream.sse:
+                yield _frame_sse(item, next_id)
+                if next_id is not None:
+                    next_id += 1
+            else:
+                yield _to_bytes(item)
     else:
         # Sync generators (e.g. blocking token decode) must not stall the
         # event loop between yields; pull each item on a worker thread —
@@ -64,7 +75,12 @@ async def _sse_iter(stream: Stream, executor: Any = None) -> AsyncIterator[bytes
             item = await loop.run_in_executor(executor, next, iterator, sentinel)
             if item is sentinel:
                 break
-            yield _frame_sse(item) if stream.sse else _to_bytes(item)
+            if stream.sse:
+                yield _frame_sse(item, next_id)
+                if next_id is not None:
+                    next_id += 1
+            else:
+                yield _to_bytes(item)
 
 
 def _to_bytes(item: Any) -> bytes:

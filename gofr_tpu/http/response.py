@@ -46,8 +46,18 @@ class File:
 class Stream:
     """Return from a handler to stream chunks (e.g. decoded tokens) to the
     client. ``events`` yields str or bytes; when ``sse`` is True each item is
-    framed as a server-sent event ``data: <item>\\n\\n``."""
+    framed as a server-sent event ``data: <item>\\n\\n``.
+
+    ``ids=True`` additionally numbers every frame with a monotonic SSE
+    ``id:`` line (``id_offset`` + frame index) — the resumable-stream
+    contract: the fleet router journals the last id it delivered to the
+    client, and a mid-stream failover resumes from that offset instead
+    of truncating (``X-Resume-From``). Frame ids are POSITIONS in the
+    deterministic event sequence, so a regenerated stream renumbers
+    identically and duplicates are filterable by id alone."""
 
     events: Union[Iterator[Any], AsyncIterator[Any]]
     sse: bool = True
     content_type: str = "text/event-stream"
+    ids: bool = False
+    id_offset: int = 0

@@ -130,7 +130,11 @@ def _stream_chat(
         finally:
             stream_iter.close()  # no-op if already exhausted
 
-    return Stream(events())
+    # ids=True: frames carry monotonic SSE ids so the fleet router can
+    # resume a deterministic chat stream by replaying from zero and
+    # filtering already-delivered frames (chat frames are not 1:1 with
+    # tokens, so there is no replica-side X-Resume-From shortcut here)
+    return Stream(events(), ids=True)
 
 
 def _stream_chat_fanout(

@@ -25,7 +25,7 @@ def _stream_completion(
     stop_ids: Any, stop_strs: list, want_logprobs: bool, top_n: int,
     adapter: Any, n: int, best_of: int, echo: bool,
     cmpl_id: str, created: int, model: str, tok: Any,
-    include_usage: bool = False,
+    include_usage: bool = False, resume_from: int = 0,
 ) -> Any:
     """The SSE branch of /v1/completions: per-token text chunks with
     host-side stop matching, terminated by ``data: [DONE]``. ``n`` > 1
@@ -51,6 +51,28 @@ def _stream_completion(
             "streaming; drop \"stream\" or request chosen-token "
             "logprobs only"
         )
+    if resume_from:
+        # resume (X-Resume-From) restores an interrupted stream at a
+        # frame offset. n > 1 refuses outright: candidate interleaving
+        # is thread-timing-dependent, so the frame sequence is not
+        # reproducible and no resume strategy can splice it.
+        if n > 1:
+            raise HTTPError(
+                400, "resume is not supported on n > 1 streams (the "
+                "candidate interleave is not reproducible)"
+            )
+        # the SKIP-AHEAD shortcut (regenerate only positions >= k) is
+        # sound only when each frame depends on its own token alone.
+        # A tokenizer stream decoder and a stop-sequence scanner carry
+        # cross-token state (partial UTF-8 bytes, a half-matched stop
+        # string) that a mid-stream restart cannot rebuild, echo
+        # prepends replay frames, and logprob values are not journaled
+        # — those streams fall back to FULL regeneration from frame 0:
+        # deterministic by the resume precondition, renumbered
+        # identically, and the router's id filter drops the frames the
+        # client already holds. Slower, never wrong.
+        if echo or want_logprobs or stop_strs or tok is not None:
+            resume_from = 0
     import json as _json
 
     from gofr_tpu.http.response import Stream
@@ -91,14 +113,21 @@ def _stream_completion(
         )
 
     # constructed OUTSIDE events(): parameter errors (unknown adapter,
-    # bad sampler) must 400 before the SSE 200 commits
+    # bad sampler) must 400 before the SSE 200 commits. resume_from is
+    # clamped to the token budget: a client interrupted between the
+    # last token frame and [DONE] resumes straight into the tail
     stream_iter = ctx.tpu.generate_stream(
         prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
         adapter=adapter, logprobs=want_logprobs,
+        resume_from=min(resume_from, max_tokens),
     )
 
     def events():
-        emitted = 0
+        # a resumed stream's token iterator starts at the resume
+        # position; the emitted counter must keep counting ABSOLUTE
+        # positions or finish_reason ("length" vs "stop") would drift
+        # from the uninterrupted run's
+        emitted = min(resume_from, max_tokens)
         finish = None
         dec = tok.stream_decoder() if tok is not None else None
         # stop_strs imply a tokenizer (enforced at parse), so dec
@@ -151,7 +180,10 @@ def _stream_completion(
         finally:
             stream_iter.close()  # no-op if already exhausted
 
-    return Stream(events())
+    # ids=True: every frame carries its monotonic SSE id (anchored at
+    # the resume offset), making the stream resumable through the fleet
+    # router's journal — see docs/advanced-guide/fleet.md
+    return Stream(events(), ids=True, id_offset=resume_from)
 
 
 def _stream_completion_fanout(
@@ -262,11 +294,28 @@ def completions(ctx: Any) -> Any:
         stream=bool(body.get("stream")),
     ) as fl:
         if body.get("stream"):
+            # X-Resume-From: the fleet router (or a reconnecting
+            # client) holds frames 0..k-1 of an interrupted stream and
+            # asks for the rest — journal-backed teacher-forced resume
+            # when this replica served the original, deterministic
+            # replay otherwise (device.generate_stream owns the rules)
+            resume_from = 0
+            raw_resume = ctx.request.header("X-Resume-From")
+            if raw_resume:
+                try:
+                    resume_from = int(raw_resume)
+                except ValueError:
+                    raise HTTPError(
+                        400, '"X-Resume-From" must be an integer frame '
+                        "offset"
+                    ) from None
+                if resume_from < 0:
+                    raise HTTPError(400, '"X-Resume-From" must be >= 0')
             # defer: the record completes when the stream ends
             return fl.defer(_stream_completion(
                 ctx, body, prompt_ids, max_tokens, sampler, stop_ids,
                 stop_strs, want_logprobs, top_n, adapter, n, best_of, echo,
-                cmpl_id, created, model, tok, include_usage,
+                cmpl_id, created, model, tok, include_usage, resume_from,
             ))
 
         prompt_lps = None

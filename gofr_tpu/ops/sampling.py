@@ -336,6 +336,10 @@ class Sampler:
                 parsed[tok] = val
             self.logit_bias = parsed
         self.seeded = seed is not None
+        # the REQUEST's seed (None when unseeded): the generation
+        # journal keys on it — two requests that sample from different
+        # key streams must never share a resume identity
+        self.seed = int(seed) if seed is not None else None
         if seed is None:
             # unseeded requests must be genuinely random, not key(0)
             import secrets

@@ -132,9 +132,19 @@ class StreamingServiceResponse:
         self._closed = False
 
     def iter_chunks(self, size: int = 8192) -> Iterator[bytes]:
+        # read1, not read: read(size) BLOCKS until `size` bytes (or EOF)
+        # accumulate, which turned an SSE passthrough into an 8 KiB
+        # store-and-forward buffer — every proxied token waited for the
+        # whole stream on short responses. read1 returns as soon as the
+        # socket has ANY bytes, so each upstream flush reaches the
+        # client (and the router's resume journal) immediately.
+        read1 = getattr(self._resp, "read1", None)
         try:
             while True:
-                chunk = self._resp.read(size)
+                chunk = (
+                    read1(size) if read1 is not None
+                    else self._resp.read(size)
+                )
                 if not chunk:
                     break
                 yield chunk

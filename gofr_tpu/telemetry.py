@@ -22,6 +22,21 @@ and cohort size on the queue item's captured record, the decode pool
 stamps pool occupancy, the device stamps token timing. Thread
 boundaries (handler pool, batcher dispatch, stream generation thread)
 propagate it via ``contextvars.copy_context()``.
+
+This module also hosts the **durable generation journal**
+(:class:`GenerationJournal`): a bounded per-request record of prompt
+hash, sampling parameters (including the seed), and the emitted token
+ids. The flight recorder answers "what happened"; the journal answers
+"where exactly was this generation when the engine wedged" — after the
+recovery supervisor (tpu/recovery.py) rebuilds the stack, an
+interrupted request is re-admitted and RESUMED: the journaled tokens
+replay instantly, the continuation teacher-forces a prefill over
+prompt+emitted through the paged-KV path (block aliasing makes the
+re-prefill nearly copy-free), and the resumed stream is bit-identical
+to an uninterrupted run for deterministic (greedy/seeded) requests.
+The journal entry rides its own contextvar (``current_journal_entry``)
+so the decode pool can stamp interruption causes without a new
+plumbing layer.
 """
 
 from __future__ import annotations
@@ -36,6 +51,22 @@ from typing import Any, Optional
 _current_record: contextvars.ContextVar[Optional["FlightRecord"]] = (
     contextvars.ContextVar("gofr_flight_record", default=None)
 )
+
+_current_journal_entry: contextvars.ContextVar[Optional["JournalEntry"]] = (
+    contextvars.ContextVar("gofr_journal_entry", default=None)
+)
+
+
+def current_journal_entry() -> Optional["JournalEntry"]:
+    """The in-flight generation's journal entry, if journaling is on."""
+    return _current_journal_entry.get()
+
+
+def activate_journal_entry(entry: Optional["JournalEntry"]) -> Any:
+    """Bind ``entry`` as the current one (None clears); returns the
+    reset token. The device binds it around each generation so the
+    decode pool / batcher layers can stamp interruption causes."""
+    return _current_journal_entry.set(entry)
 
 
 def current_record() -> Optional["FlightRecord"]:
@@ -297,6 +328,229 @@ class FlightRecord:
             "tpot_s": self.tpot,
             "duration_s": self.duration,
         }
+
+
+def request_key(model: str, prompt_ids: Any, max_new_tokens: int,
+                sampler: Any = None, stop_tokens: Any = None) -> str:
+    """Deterministic identity of one generation request: the journal
+    key interrupted entries are claimed back by at resume time. Hashes
+    the prompt (never stores it raw — prompts are user data, the
+    journal serves on no endpoint but its key could leak into logs),
+    the sampling knobs INCLUDING the seed, the budget, and the stop
+    set: two requests that could produce different streams must never
+    share a key."""
+    import hashlib
+
+    parts = [model, str(int(max_new_tokens))]
+    if sampler is not None:
+        parts.append(
+            f"t={getattr(sampler, 'temperature', 0)}"
+            f"|k={getattr(sampler, 'top_k', 0)}"
+            f"|p={getattr(sampler, 'top_p', 1.0)}"
+            f"|m={getattr(sampler, 'min_p', 0.0)}"
+            f"|r={getattr(sampler, 'repetition_penalty', 1.0)}"
+            f"|pp={getattr(sampler, 'presence_penalty', 0.0)}"
+            f"|fp={getattr(sampler, 'frequency_penalty', 0.0)}"
+            f"|s={getattr(sampler, 'seed', None)}"
+        )
+    if stop_tokens:
+        parts.append(",".join(str(t) for t in sorted(stop_tokens)))
+    digest = hashlib.sha256()
+    for part in parts:
+        digest.update(part.encode("utf-8"))
+        digest.update(b"\x00")
+    digest.update(
+        ",".join(str(int(t)) for t in (prompt_ids or ())).encode("ascii")
+    )
+    return digest.hexdigest()[:32]
+
+
+class JournalEntry:
+    """One generation's durable record. Single-writer append (the
+    emitting thread); ``tokens`` reads take a snapshot copy under the
+    GIL (list slicing is atomic). Status walks
+    active → done | interrupted → resumed."""
+
+    __slots__ = (
+        "key", "model", "max_new_tokens", "seeded", "deterministic",
+        "tokens", "status", "reason", "t_start", "t_interrupted",
+        "prior", "truncated", "max_tokens",
+    )
+
+    def __init__(self, key: str, model: str, max_new_tokens: int,
+                 seeded: bool, deterministic: bool, max_tokens: int,
+                 prior: Optional[list] = None):
+        self.key = key
+        self.model = model
+        self.max_new_tokens = max_new_tokens
+        self.seeded = seeded
+        # greedy or seeded: replaying the request reproduces the stream
+        # bit-identically — the precondition for resume
+        self.deterministic = deterministic
+        self.max_tokens = max_tokens
+        # a RESUMED request's entry pre-seeds the tokens the interrupted
+        # incarnation already produced, so a second wedge resumes from
+        # the union, not from the resume point
+        self.tokens: list[int] = list(prior or ())
+        self.truncated = False
+        self.status = "active"
+        self.reason = ""
+        self.t_start = time.perf_counter()
+        self.t_interrupted: Optional[float] = None
+
+    def append(self, token: int) -> None:
+        if len(self.tokens) >= self.max_tokens:
+            # a bounded record can no longer prove bit-identity past its
+            # cap — the entry stays for forensics but refuses resume
+            self.truncated = True
+            return
+        self.tokens.append(int(token))
+
+    def note_interrupted(self, reason: str) -> None:
+        """Stamp WHY (pool failure, batcher close, recovery teardown);
+        the first cause wins — later layers see consequences."""
+        if not self.reason:
+            self.reason = reason
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "key": self.key,
+            "model": self.model,
+            "status": self.status,
+            "tokens": len(self.tokens),
+            "max_new_tokens": self.max_new_tokens,
+            "deterministic": self.deterministic,
+            "reason": self.reason or None,
+        }
+
+
+class GenerationJournal:
+    """Bounded store of :class:`JournalEntry` records keyed by
+    :func:`request_key`.
+
+    Completed entries retire immediately (their tokens already reached
+    the client); INTERRUPTED entries are the valuable ones — they wait,
+    bounded by ``capacity`` (oldest evicted first), for a resume to
+    :meth:`claim` them. The journal never initiates anything: the
+    device consults it on a resume request (``X-Resume-From`` /
+    ``generate_stream(resume_from=...)``) and the fleet router decides
+    WHEN to resume."""
+
+    def __init__(self, capacity: int = 256, max_tokens: int = 8192,
+                 metrics: Any = None):
+        self.capacity = max(1, capacity)
+        self.max_tokens = max(1, max_tokens)
+        self._lock = threading.Lock()
+        # key -> list of entries (concurrent identical seeded requests
+        # are legal; each gets its own entry, claims pop one)
+        self._interrupted: "dict[str, list[JournalEntry]]" = {}
+        self._interrupted_order: "deque[JournalEntry]" = deque()
+        self._active = 0
+        self.interruptions = 0
+        self.completions = 0
+        self._resumes = (
+            metrics.counter(
+                "gofr_tpu_journal_resumes_total",
+                "interrupted generations resumed from the journal by "
+                "mode: teacher_forced (prefill over prompt+emitted, "
+                "paged-KV aliased) or replayed (full deterministic "
+                "regeneration, first tokens suppressed)",
+                labels=("mode",),
+            )
+            if metrics is not None else None
+        )
+
+    # -- lifecycle (device-side) ----------------------------------------------
+    def start(self, key: str, model: str, max_new_tokens: int,
+              seeded: bool, deterministic: bool,
+              prior: Optional[list] = None) -> JournalEntry:
+        entry = JournalEntry(
+            key, model, max_new_tokens, seeded, deterministic,
+            max_tokens=self.max_tokens, prior=prior,
+        )
+        with self._lock:
+            self._active += 1
+        return entry
+
+    def finish(self, entry: JournalEntry) -> None:
+        """Clean completion: the entry retires (its stream reached the
+        client; nothing to resume)."""
+        if entry.status != "active":
+            return
+        entry.status = "done"
+        with self._lock:
+            self._active = max(0, self._active - 1)
+            self.completions += 1
+
+    def interrupt(self, entry: JournalEntry, reason: str) -> None:
+        """The generation died mid-flight: retain the entry for resume
+        (idempotent — the first interruption wins)."""
+        if entry.status != "active":
+            return
+        entry.status = "interrupted"
+        entry.note_interrupted(reason)
+        entry.t_interrupted = time.perf_counter()
+        with self._lock:
+            self._active = max(0, self._active - 1)
+            self.interruptions += 1
+            self._interrupted.setdefault(entry.key, []).append(entry)
+            self._interrupted_order.append(entry)
+            while len(self._interrupted_order) > self.capacity:
+                evicted = self._interrupted_order.popleft()
+                bucket = self._interrupted.get(evicted.key)
+                if bucket is not None:
+                    try:
+                        bucket.remove(evicted)
+                    except ValueError:
+                        pass  # already claimed
+                    if not bucket:
+                        self._interrupted.pop(evicted.key, None)
+
+    # -- resume (device-side, driven by the router/client) ---------------------
+    def claim(self, key: str, min_tokens: int = 0) -> Optional[JournalEntry]:
+        """Pop one interrupted entry for ``key`` holding at least
+        ``min_tokens`` journaled tokens (the client already received
+        that many — a shorter record cannot prove them). Returns None
+        when nothing matches; the caller then falls back to full
+        deterministic replay."""
+        with self._lock:
+            bucket = self._interrupted.get(key)
+            if not bucket:
+                return None
+            for i, entry in enumerate(bucket):
+                if entry.truncated or len(entry.tokens) < min_tokens:
+                    continue
+                del bucket[i]
+                if not bucket:
+                    self._interrupted.pop(key, None)
+                try:
+                    self._interrupted_order.remove(entry)
+                except ValueError:
+                    pass
+                entry.status = "resumed"
+                return entry
+        return None
+
+    def note_resume(self, mode: str) -> None:
+        """Count one resume by mode (teacher_forced | replayed)."""
+        if self._resumes is not None:
+            self._resumes.inc(mode=mode)
+
+    # -- read side -------------------------------------------------------------
+    def interrupted(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [e.snapshot() for e in self._interrupted_order]
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "active": self._active,
+                "interrupted": len(self._interrupted_order),
+                "capacity": self.capacity,
+                "max_tokens_per_entry": self.max_tokens,
+                "interruptions": self.interruptions,
+                "completions": self.completions,
+            }
 
 
 def _percentiles(samples: list[float]) -> dict[str, float]:

@@ -49,7 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gofr_tpu.config import env_flag
-from gofr_tpu.telemetry import current_record
+from gofr_tpu.telemetry import current_journal_entry, current_record
 
 DONE = object()  # end-of-stream marker on a slot's token queue
 
@@ -86,14 +86,14 @@ class _Request:
     __slots__ = (
         "out_queue", "remaining", "cache_len", "stop", "stop_tokens",
         "finished", "want_lp", "want_top", "want_kv", "record",
-        "kv_reserved",
+        "kv_reserved", "journal",
     )
 
     def __init__(self, out_queue: "queue.Queue", remaining: int, cache_len: int,
                  stop: Optional[threading.Event], stop_tokens: frozenset,
                  want_lp: bool = False, want_top: bool = False,
                  want_kv: bool = False, record: Any = None,
-                 kv_reserved: int = 0):
+                 kv_reserved: int = 0, journal: Any = None):
         self.out_queue: Optional[queue.Queue] = out_queue
         self.remaining = remaining
         self.cache_len = cache_len
@@ -117,6 +117,11 @@ class _Request:
         # the request finishes — freed budget admits the next request
         # mid-flight instead of waiting for any drain
         self.kv_reserved = kv_reserved
+        # the caller's generation-journal entry (if journaling is on):
+        # a pool death stamps WHERE the stream was interrupted so the
+        # recovery-resume path can distinguish pool failures from
+        # client aborts
+        self.journal = journal
 
 
 class _Slot:
@@ -632,7 +637,8 @@ class DecodePool:
                                     want_lp=want_logprobs,
                                     want_top=want_top_logprobs,
                                     want_kv=want_kv, record=record,
-                                    kv_reserved=kv_reserved)
+                                    kv_reserved=kv_reserved,
+                                    journal=current_journal_entry())
             if record is not None and kv_reserved:
                 record.note_kv(kv_reserved)
             self._apply_sampling(slot.index, sampler)
@@ -795,6 +801,13 @@ class DecodePool:
         for slot in self._active.values():
             req = slot.request
             if req is not None and not req.finished and req.out_queue is not None:
+                if req.journal is not None:
+                    # stamp the interruption CAUSE before the waiter even
+                    # re-raises: the journal entry is what the recovery
+                    # resume path claims back
+                    req.journal.note_interrupted(
+                        f"decode pool failed: {type(exc).__name__}: {exc}"
+                    )
                 req.out_queue.put(PoolFailure(exc))
                 req.out_queue.put(DONE)
                 req.finished = True

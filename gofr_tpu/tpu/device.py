@@ -264,6 +264,31 @@ class TPUDevice:
             self.engine, metrics=metrics, logger=logger,
             timeout_s=self._watchdog_timeout,
         )
+        # durable generation journal: prompt hash + sampling params +
+        # emitted token ids per request, the substrate resumable streams
+        # rebuild from after a wedge (see generate/generate_stream)
+        from gofr_tpu.telemetry import GenerationJournal
+
+        self.journal = (
+            GenerationJournal(
+                capacity=self._journal_capacity,
+                max_tokens=self._journal_max_tokens,
+                metrics=metrics,
+            )
+            if self._journal_enabled else None
+        )
+        # wedge-recovery supervisor: listens on the engine state machine
+        # and drives quarantine -> rebuild -> serving on wedged
+        from gofr_tpu.tpu.recovery import RecoverySupervisor
+
+        self.recovery = RecoverySupervisor(
+            self, metrics=metrics, logger=logger,
+            max_attempts=self._recovery_attempts,
+            backoff_s=self._recovery_backoff,
+            backoff_max_s=self._recovery_backoff_max,
+            attempt_timeout_s=self._recovery_attempt_timeout,
+            enabled=self._recovery_enabled,
+        )
         # per-stage boot wall times ({stage, kind, bucket, seconds}) —
         # the boot timeline /admin/engine serves; compile stages also
         # feed gofr_tpu_compile_seconds{kind,bucket}
@@ -572,6 +597,44 @@ class TPUDevice:
                     "WATCHDOG_DISPATCH_TIMEOUT_S must be >= 0 (0/off = "
                     "disabled, unset = auto-arm on TPU platforms)"
                 )
+        # wedge-recovery supervisor (tpu/recovery.py): on wedged, emit
+        # evidence, quarantine the stuck dispatch, rebuild the stack,
+        # re-enter warming->serving — bounded attempts with exponential
+        # backoff, then terminal failed. RECOVERY_ENABLED=off restores
+        # the pre-recovery behavior (wedged until the stall resolves or
+        # a human restarts the process).
+        self._recovery_enabled = (
+            config.get_or_default("RECOVERY_ENABLED", "on") != "off"
+        )
+        self._recovery_attempts = int(
+            config.get_or_default("RECOVERY_MAX_ATTEMPTS", "3")
+        )
+        self._recovery_backoff = float(
+            config.get_or_default("RECOVERY_BACKOFF_S", "1")
+        )
+        self._recovery_backoff_max = float(
+            config.get_or_default("RECOVERY_BACKOFF_MAX_S", "30")
+        )
+        self._recovery_attempt_timeout = float(
+            config.get_or_default("RECOVERY_ATTEMPT_TIMEOUT_S", "300")
+        )
+        # durable generation journal (telemetry.py GenerationJournal):
+        # per-request prompt hash + sampling params + emitted token ids,
+        # so interrupted requests resume after recovery instead of
+        # truncating. JOURNAL=off disables (streams then abort on wedge
+        # exactly as before); JOURNAL_CAPACITY bounds retained entries,
+        # JOURNAL_MAX_TOKENS bounds one entry's recorded tokens.
+        self._journal_enabled = config.get_or_default("JOURNAL", "on") != "off"
+        self._journal_capacity = int(
+            config.get_or_default("JOURNAL_CAPACITY", "256")
+        )
+        if self._journal_capacity < 1:
+            raise ValueError("JOURNAL_CAPACITY must be >= 1")
+        self._journal_max_tokens = int(
+            config.get_or_default("JOURNAL_MAX_TOKENS", "8192")
+        )
+        if self._journal_max_tokens < 1:
+            raise ValueError("JOURNAL_MAX_TOKENS must be >= 1")
 
     def _probe_devices(self) -> None:
         """First touch of the device runtime (can block/fail on a wedged
@@ -662,9 +725,15 @@ class TPUDevice:
             self.logger.infof("TPU datasource ready: %s", self.describe())
 
     def _teardown_stack(self) -> None:
+        # the runner closes too (echo runner: poisons its in-flight
+        # generate loops so a recovery rebuild interrupts streams on the
+        # OLD stack instead of letting them emit forever beside the new
+        # one — the compile-free mirror of the pool's PoolFailure)
+        runner_close = getattr(getattr(self, "runner", None), "close", None)
         for closer in (
             lambda: self.batcher.close() if getattr(self, "batcher", None) else None,
             lambda: self.decode_pool.close() if getattr(self, "decode_pool", None) else None,
+            lambda: runner_close() if runner_close is not None else None,
         ):
             try:
                 closer()
@@ -955,6 +1024,35 @@ class TPUDevice:
             self._observe("infer", "error", start)
             raise
 
+    def _journal_key(self, ids: Any, max_new_tokens: int, sampler: Any,
+                     stop_tokens: Any, adapter: Optional[str]) -> str:
+        """The request's durable identity (telemetry.request_key over
+        the COMPOSED stop set — resume and original must agree)."""
+        from gofr_tpu.telemetry import request_key
+
+        model = f"{self.model_name}+{adapter}" if adapter else self.model_name
+        return request_key(model, ids, max_new_tokens, sampler, stop_tokens)
+
+    def _journal_start(self, ids: Any, max_new_tokens: int, sampler: Any,
+                       stop_tokens: Any, adapter: Optional[str],
+                       journal_key: Optional[str],
+                       journal_prior: Optional[list]) -> Any:
+        """Open this generation's journal entry (None when journaling is
+        off). Deterministic = greedy or seeded: the property resume
+        leans on (replaying the request reproduces the stream)."""
+        if self.journal is None:
+            return None
+        greedy = sampler is None or sampler.greedy
+        seeded = sampler is not None and sampler.seeded
+        key = journal_key or self._journal_key(
+            ids, max_new_tokens, sampler, stop_tokens, adapter
+        )
+        return self.journal.start(
+            key, self.model_name, max_new_tokens,
+            seeded=seeded, deterministic=greedy or seeded,
+            prior=journal_prior,
+        )
+
     def generate(
         self,
         tokens: list[int],
@@ -967,6 +1065,9 @@ class TPUDevice:
         top_logprobs: bool = False,
         adapter: Optional[str] = None,
         adapter_params: Optional[Any] = None,
+        journal_key: Optional[str] = None,
+        journal_prior: Optional[list] = None,
+        resume_from: int = 0,
     ) -> "list[int] | tuple[list[int], list[float]] | tuple":
         """Autoregressive generation (transformer models): prefill goes
         through the dynamic batcher (TTFT path); decode steps run per
@@ -981,7 +1082,15 @@ class TPUDevice:
         values (delivered from the shared pool — logprobs ride every pool
         chunk). ``top_logprobs=True`` returns (tokens, logprobs, tops)
         where tops[i] is the TOP_LOGPROBS [(alt_id, alt_lp), ...]
-        alternatives at position i, best first."""
+        alternatives at position i, best first.
+
+        Journal plumbing (resume path, see ``generate_stream``):
+        ``journal_key`` pins the journal identity to the ORIGINAL
+        request when this call is a teacher-forced continuation over
+        prompt+emitted (whose own key would differ); ``journal_prior``
+        pre-seeds the entry with the tokens the interrupted incarnation
+        already produced; ``resume_from`` asks a natively-resumable
+        runner (echo) to start its emission at that position."""
         self.wait_ready(600.0)
         if isinstance(tokens, str):
             tokens = self._detokenize(tokens)["tokens"]
@@ -990,6 +1099,10 @@ class TPUDevice:
         stop_tokens = frozenset(stop_tokens or ()) | self.default_stop_ids
         start = time.perf_counter()
         record = telemetry_record()
+        entry = self._journal_start(
+            tokens, max_new_tokens, sampler, stop_tokens, adapter,
+            journal_key, journal_prior,
+        )
         if record is not None and self.mesh_axes:
             # flight records carry the serving-mesh shape: a latency
             # regression must be attributable to the topology it ran on
@@ -1013,11 +1126,23 @@ class TPUDevice:
                 record.mark_first_token()
 
         emit = on_token
-        if record is not None:
+        if record is not None or entry is not None:
             def emit(item: Any, _cb: Any = on_token) -> None:
-                record.note_tokens(1)
+                if record is not None:
+                    record.note_tokens(1)
+                if entry is not None:
+                    # journal the bare id ((token, lp) rides logprob runs)
+                    entry.append(item[0] if isinstance(item, tuple) else item)
                 if _cb is not None:
                     _cb(item)
+        from gofr_tpu.telemetry import activate_journal_entry
+
+        journal_token = activate_journal_entry(entry) if entry is not None else None
+        extra: dict[str, Any] = {}
+        if resume_from and getattr(self.runner, "supports_resume", False):
+            # natively-resumable runner (echo): emission starts at the
+            # resume position instead of replaying from zero
+            extra["resume_from"] = resume_from
         try:
             # activated per-request device span: the prefill batcher item
             # captures it, so tpu-batch nests under it in the same trace
@@ -1031,6 +1156,7 @@ class TPUDevice:
                     adapter=adapter, adapter_params=adapter_params,
                     ttft_cb=_ttft,
                     scheduler=getattr(self, "scheduler", None),
+                    **extra,
                 )
                 emitted = out[0] if isinstance(out, tuple) else out
                 span.set_tag("tpu.tokens_out", len(emitted))
@@ -1056,12 +1182,21 @@ class TPUDevice:
                     self._prefix_entries_gauge.set(
                         len(cache), model=self.model_name
                     )
+            if entry is not None:
+                self.journal.finish(entry)
             return out
         except Exception as exc:
             if record is not None:
                 record.note_error(exc)
+            if entry is not None:
+                # keep the record: a recovery-interrupted request is
+                # re-admitted from exactly this entry (resume path)
+                self.journal.interrupt(entry, f"{type(exc).__name__}: {exc}")
             self._requests.inc(model=self.model_name, op="generate", status="error")
             raise
+        finally:
+            if journal_token is not None:
+                activate_journal_entry(None)
 
     def generate_stream(
         self, tokens: list[int], max_new_tokens: int = 32,
@@ -1069,12 +1204,26 @@ class TPUDevice:
         stop_tokens: Optional[Any] = None,
         adapter: Optional[str] = None,
         logprobs: bool = False,
+        resume_from: int = 0,
     ) -> Any:
         """Iterator of decoded token ids, yielded as they decode — the shared
         bridge for SSE and gRPC streaming transports. With ``logprobs=True``
         each item is a (token, raw_logprob) pair instead of a bare id.
         Closing the iterator (client disconnect) cancels the background
-        decode instead of letting it run to completion unread."""
+        decode instead of letting it run to completion unread.
+
+        ``resume_from=k`` resumes an INTERRUPTED deterministic stream at
+        token position k (the client already holds tokens 0..k-1):
+        tokens the journal recorded before the interruption replay
+        instantly, and the continuation teacher-forces a prefill over
+        prompt+emitted through the paged-KV path (block aliasing makes
+        the re-prefill nearly copy-free). Without a journal entry — a
+        different replica, or the journal evicted it — the request
+        regenerates from scratch and the first k emissions are
+        suppressed; either way the resumed stream is bit-identical to
+        the uninterrupted run's positions k.. for greedy and seeded
+        requests. Non-deterministic (unseeded sampled) requests refuse
+        resume with a 400-class error."""
         adapter_params = None
         if adapter is not None:
             # validate EAGERLY (this wrapper is not a generator, so the
@@ -1108,6 +1257,29 @@ class TPUDevice:
                 from gofr_tpu.errors import InvalidParamError
 
                 raise InvalidParamError(str(exc)) from None
+        if resume_from:
+            if resume_from < 0:
+                from gofr_tpu.errors import InvalidParamError
+
+                raise InvalidParamError("resume offset must be >= 0")
+            if logprobs:
+                from gofr_tpu.errors import InvalidParamError
+
+                raise InvalidParamError(
+                    "resume is not supported with logprobs (the journal "
+                    "records token ids only)"
+                )
+            if sampler is not None and not sampler.greedy and not sampler.seeded:
+                from gofr_tpu.errors import InvalidParamError
+
+                raise InvalidParamError(
+                    "resume requires a deterministic request (greedy or "
+                    "seeded) — an unseeded sampled stream cannot be "
+                    "reproduced"
+                )
+            self.wait_ready(600.0)
+            if isinstance(tokens, str):
+                tokens = self._detokenize(tokens)["tokens"]
         import contextvars
 
         # snapshot NOW, in the handler thread: the generator body below
@@ -1117,12 +1289,94 @@ class TPUDevice:
         snapshot = contextvars.copy_context()
         return self._stream_iter(
             tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs,
-            adapter_params, snapshot,
+            adapter_params, snapshot, resume_from,
         )
+
+    def _resume_producer(
+        self, ids, max_new_tokens, sampler, stop_tokens, adapter,
+        adapter_params, resume_from,
+    ) -> Any:
+        """Build the producer for a RESUMED stream: returns
+        ``fn(put, stop)`` emitting items for positions >= resume_from.
+
+        Two modes (gofr_tpu_journal_resumes_total{mode}):
+        - ``teacher_forced``: a journal entry survived — replay its
+          suffix, then continue by prefilling prompt+emitted (echo: the
+          runner's native ``resume_from``; transformer greedy: a plain
+          generate over the concatenation — the paged prefix cache
+          aliases the prompt's blocks, so the re-prefill moves almost
+          no KV bytes).
+        - ``replayed``: no usable entry — regenerate the whole stream
+          (deterministic by precondition) and suppress the first
+          ``resume_from`` emissions.
+        """
+        composed_stops = frozenset(stop_tokens or ()) | self.default_stop_ids
+        key = self._journal_key(
+            ids, max_new_tokens, sampler, composed_stops, adapter
+        )
+        native = getattr(self.runner, "supports_resume", False)
+        greedy = sampler is None or sampler.greedy
+        entry = None
+        if self.journal is not None and (native or greedy):
+            # seeded non-greedy continuations cannot rebuild the chunk-
+            # aligned RNG schedule mid-stream — they take the replay
+            # path, so the entry stays unclaimed for forensics
+            entry = self.journal.claim(key, resume_from)
+        if self.journal is not None:
+            self.journal.note_resume(
+                "teacher_forced" if entry is not None else "replayed"
+            )
+
+        if entry is not None:
+            emitted = list(entry.tokens)
+
+            def produce(put: Any, stop: Any) -> None:
+                for token in emitted[resume_from:]:
+                    if stop is not None and stop.is_set():
+                        return
+                    put(token)
+                remaining = max_new_tokens - len(emitted)
+                if remaining <= 0:
+                    return
+                if native:
+                    self.generate(
+                        ids, max_new_tokens, on_token=put, stop=stop,
+                        sampler=sampler, stop_tokens=stop_tokens,
+                        adapter=adapter, adapter_params=adapter_params,
+                        journal_key=key, journal_prior=emitted,
+                        resume_from=len(emitted),
+                    )
+                else:
+                    self.generate(
+                        list(ids) + emitted, remaining, on_token=put,
+                        stop=stop, sampler=sampler, stop_tokens=stop_tokens,
+                        adapter=adapter, adapter_params=adapter_params,
+                        journal_key=key, journal_prior=emitted,
+                    )
+
+            return produce
+
+        def produce(put: Any, stop: Any) -> None:
+            skip = resume_from
+
+            def emit(item: Any) -> None:
+                nonlocal skip
+                if skip > 0:
+                    skip -= 1
+                    return
+                put(item)
+
+            self.generate(
+                ids, max_new_tokens, on_token=emit, stop=stop,
+                sampler=sampler, stop_tokens=stop_tokens, adapter=adapter,
+                adapter_params=adapter_params, journal_key=key,
+            )
+
+        return produce
 
     def _stream_iter(
         self, tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs,
-        adapter_params=None, snapshot=None,
+        adapter_params=None, snapshot=None, resume_from=0,
     ) -> Any:
         import queue as queue_mod
         import threading
@@ -1131,14 +1385,22 @@ class TPUDevice:
         done = object()
         failure: list[BaseException] = []
         stop = threading.Event()
-
-        def run() -> None:
-            try:
+        if resume_from:
+            produce = self._resume_producer(
+                tokens, max_new_tokens, sampler, stop_tokens, adapter,
+                adapter_params, resume_from,
+            )
+        else:
+            def produce(put: Any, stop_evt: Any) -> None:
                 self.generate(
-                    tokens, max_new_tokens, on_token=out.put, stop=stop,
+                    tokens, max_new_tokens, on_token=put, stop=stop_evt,
                     sampler=sampler, stop_tokens=stop_tokens, adapter=adapter,
                     logprobs=logprobs, adapter_params=adapter_params,
                 )
+
+        def run() -> None:
+            try:
+                produce(out.put, stop)
             except BaseException as exc:
                 failure.append(exc)
             finally:
@@ -1283,6 +1545,13 @@ class TPUDevice:
             "boot": dict(self.boot_status),
             "boot_timeline": [dict(stage) for stage in self.boot_timeline],
             "watchdog": self.watchdog.snapshot(),
+            # wedge-recovery incident state (attempts, backoff deadline,
+            # last outcome, MTTR) — the /admin/engine half of the
+            # gofr_tpu_engine_recoveries_total counter
+            "recovery": self.recovery.snapshot(),
+            # generation-journal accounting: entries retained, currently
+            # interrupted (resumable), resume outcomes
+            "journal": self.journal.stats() if self.journal is not None else None,
             "dispatches": self.timeline.stats(),
         }
         batcher = getattr(self, "batcher", None)
@@ -1349,7 +1618,42 @@ class TPUDevice:
         with self._reinit_lock:
             self._reinit_locked()
 
-    def _reinit_locked(self) -> None:
+    def recover(self, detail: str = "") -> None:
+        """Wedge-recovery rebuild (tpu/recovery.py): the same teardown +
+        re-probe + rebuild as :meth:`reinit`, but walking the engine
+        explicitly through ``warming`` before ``serving`` so the
+        incident's state history reads recovering → warming → serving.
+        Requests pinned to the wedged stack fail at teardown (their
+        journal entries stay, marked interrupted, for resume); the
+        rebuilt stack reuses whatever jax's compile caches kept warm for
+        surviving shapes, so a healthy-device recovery costs re-trace
+        time, not a cold boot's optimization time.
+
+        ``_ready`` clears for the duration: a resume request landing
+        mid-rebuild PARKS on ``wait_ready`` until the stack is back
+        (that is the router's resume-to-the-recovering-replica path)
+        instead of racing the teardown. A failed rebuild sets the boot
+        error and re-sets the event so parked waiters fail fast rather
+        than sleeping out their full timeout."""
+        with self._reinit_lock:
+            self._ready.clear()
+            # truthful readiness body during the rebuild: the 503 must
+            # never claim "ready" (the probe stage flips it to warming)
+            self.boot_status = {
+                "state": "recovering", "detail": detail or "recovery rebuild"
+            }
+            try:
+                self._reinit_locked(
+                    detail=detail or "recovered", via_recovery=True
+                )
+            except BaseException as exc:
+                self._boot_error = exc
+                self.boot_status = {"state": "failed", "detail": repr(exc)}
+                self._ready.set()
+                raise
+
+    def _reinit_locked(self, detail: str = "reinitialized",
+                       via_recovery: bool = False) -> None:
         self.logger.warnf(
             "reinitializing TPU device stack (model=%s)", self.model_name
         )
@@ -1363,17 +1667,32 @@ class TPUDevice:
         # runtime state anyway (jax caches make this cheap when healthy)
         try:
             self._probe_devices()
+            if via_recovery:
+                # the incident's history must read recovering -> warming
+                # -> serving, mirroring a boot (ISSUE 9 contract)
+                self.engine.transition("warming", "recovery rebuild")
             self._build_stack()
         except BaseException:
             self._close_boot_stage(status="error")
             raise
         self._close_boot_stage()
+        if self._closed:
+            # the device was closed while this rebuild ran (recovery
+            # racing shutdown): tear the fresh stack down instead of
+            # leaking its threads, and never overwrite `closed` with
+            # `serving` — the same guard the background boot has
+            self._boot_error = RuntimeError("device closed during rebuild")
+            self.boot_status = {"state": "closed", "detail": ""}
+            self.engine.transition("closed")
+            self._teardown_stack()
+            self._ready.set()
+            return
         # a successful rebuild recovers a failed background boot too:
         # requests unblock and /.well-known/ready flips to 200
         self._boot_error = None
         self._boot_error_permanent = False
         self.boot_status = {"state": "ready", "detail": ""}
-        self.engine.transition("serving", "reinitialized")
+        self.engine.transition("serving", detail)
         self._ready.set()
 
     def _maybe_auto_reinit(self) -> bool:
@@ -1601,6 +1920,7 @@ class TPUDevice:
 
     def close(self) -> None:
         self._closed = True  # an in-flight background boot self-tears-down
+        self.recovery.close()
         self.watchdog.close()
         self.engine.transition("closed")
         self._teardown_stack()
@@ -1700,6 +2020,10 @@ class _EchoRunner:
     # bench gate: echo HAS a real generate loop (bench.py probes this
     # attribute to decide whether a decode phase makes sense)
     decode_chunk_size = 1
+    # journal-resume contract: echo continues a generation natively at
+    # ``resume_from`` (its decode is position-indexed), the compile-free
+    # analogue of the transformer's teacher-forced prefill
+    supports_resume = True
 
     def __init__(self, max_batch: int = 8, step_ms: float = 0.0,
                  mesh_axes: Optional[dict] = None):
@@ -1722,6 +2046,14 @@ class _EchoRunner:
         self.paged: Optional[Any] = None
         self.kv_pool: Optional[Any] = None
         self._kv_reject: Optional[Any] = None
+        # recovery poison: a torn-down runner must BREAK its in-flight
+        # generate loops (the compile-free mirror of the decode pool's
+        # PoolFailure), so a wedge-recovery rebuild interrupts streams
+        # instead of leaving them emitting beside the new stack
+        self._closed = False
+
+    def close(self) -> None:
+        self._closed = True
 
     def enable_paged_kv(self, engine: Any, reject_counter: Any = None) -> None:
         """Attach a host paged-KV engine; the runner then decodes off
@@ -1755,6 +2087,8 @@ class _EchoRunner:
     def run_batch(self, payloads: list[np.ndarray]) -> list[dict]:
         if self.stall_hook is not None:
             self.stall_hook()
+        if self._closed:
+            raise RuntimeError("echo runner closed (engine recovering)")
         if self.step_s:
             time.sleep(self.step_s)
         return [
@@ -1782,6 +2116,7 @@ class _EchoRunner:
         adapter: Optional[str] = None,
         adapter_params: Optional[Any] = None,
         scheduler: Any = None,
+        resume_from: int = 0,
     ) -> Any:
         if adapter is not None:
             from gofr_tpu.errors import InvalidParamError
@@ -1829,9 +2164,18 @@ class _EchoRunner:
         lps: list[float] = []
         tops: list = []
         try:
-            for i in range(max_new_tokens):
+            # resume_from > 0: a journal-resumed request — emission
+            # starts at that position (echo decode is position-indexed,
+            # so positions resume_from.. are bit-identical to an
+            # uninterrupted run's)
+            for i in range(resume_from, max_new_tokens):
                 if stop is not None and stop.is_set():
                     break
+                if self._closed:
+                    raise RuntimeError(
+                        "echo runner closed mid-generation (engine "
+                        "recovering)"
+                    )
                 token = int(src[i % src.size])
                 if token in stop_tokens:
                     break

@@ -17,10 +17,13 @@ This module gives the serving engine that layer:
   (``FlightRecord.note_dispatch_id``), so a slow request in
   ``/admin/requests`` links directly to the dispatches that made it slow.
 - ``EngineState``: an explicit state machine
-  (booting → warming → serving → degraded → wedged, plus failed/closed)
-  surfaced on ``GET /admin/engine`` and ``/.well-known/ready`` (which
-  returns 503 with the state while degraded/wedged) and mirrored into
-  the ``gofr_tpu_engine_state{state}`` gauge.
+  (booting → warming → serving → degraded → wedged → recovering, plus
+  failed/closed) surfaced on ``GET /admin/engine`` and
+  ``/.well-known/ready`` (which returns 503 with the state while
+  degraded/wedged/recovering) and mirrored into the
+  ``gofr_tpu_engine_state{state}`` gauge. ``wedged`` is no longer
+  terminal: the recovery supervisor (tpu/recovery.py) quarantines the
+  stuck dispatch and rebuilds the stack back to ``serving``.
 - ``StallWatchdog``: a heartbeat thread that wraps every dispatch with a
   deadline (``WATCHDOG_DISPATCH_TIMEOUT_S``; armed automatically on TPU
   platforms). A dispatch exceeding it increments
@@ -56,13 +59,14 @@ DISPATCH_KINDS = (
 )
 
 ENGINE_STATES = (
-    "booting",   # constructed; runtime not probed yet
-    "warming",   # probe done / warmup compiles running
-    "serving",   # ready; dispatches completing inside their deadline
-    "degraded",  # >=1 dispatch past WATCHDOG_DISPATCH_TIMEOUT_S
-    "wedged",    # a stalled dispatch outlived timeout x wedge_factor
-    "failed",    # boot failed (health's rate-limited reinit may recover)
-    "closed",    # device closed
+    "booting",     # constructed; runtime not probed yet
+    "warming",     # probe done / warmup compiles running
+    "serving",     # ready; dispatches completing inside their deadline
+    "degraded",    # >=1 dispatch past WATCHDOG_DISPATCH_TIMEOUT_S
+    "wedged",      # a stalled dispatch outlived timeout x wedge_factor
+    "recovering",  # recovery supervisor quarantining/rebuilding the stack
+    "failed",      # boot/recovery failed terminally (reinit may still fix)
+    "closed",      # device closed
 )
 
 # the contextvar lets device code deep below a dispatcher (e.g. the
@@ -266,7 +270,8 @@ class EngineState:
             metrics.gauge(
                 "gofr_tpu_engine_state",
                 "engine state machine (1 for the current state): booting, "
-                "warming, serving, degraded, wedged, failed, closed",
+                "warming, serving, degraded, wedged, recovering, failed, "
+                "closed",
                 labels=("state",),
             )
             if metrics is not None else None
@@ -386,6 +391,9 @@ class StallWatchdog:
         self.timeout_s = float(timeout_s)
         self.wedge_factor = wedge_factor
         self._entries: dict[int, _Watch] = {}
+        # the last recovery incident's quarantined (forgotten) stalled
+        # entries — evidence that outlives the quarantine
+        self._quarantined: list[dict[str, Any]] = []
         self._tokens = itertools.count(1)
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -480,6 +488,39 @@ class StallWatchdog:
                 entry.kind, entry.dispatch_id, elapsed,
             )
 
+    def quarantine(self) -> list[dict[str, Any]]:
+        """Recovery-supervisor entry: forget every currently-flagged
+        (stalled/wedged) watch entry and return their descriptions.
+
+        The stuck thread is unreachable — it may never return from its
+        device call — but its watch entry must not keep poisoning the
+        engine state machine after the stack around it is rebuilt: a
+        LATER dispatch completing its own recovery checks
+        ``any(e.flagged ...)`` over the live entries, and a permanently
+        wedged ghost would hold the engine degraded forever. The ghost
+        thread's eventual ``_unwatch`` pops a token that is already
+        gone (harmless) and only transitions the engine when it still
+        reads degraded/wedged — never after recovery reached serving."""
+        quarantined: list[dict[str, Any]] = []
+        with self._lock:
+            for token, entry in list(self._entries.items()):
+                if entry.flagged:
+                    quarantined.append({
+                        "kind": entry.kind,
+                        "dispatch_id": entry.dispatch_id,
+                        "thread": entry.thread_name,
+                        "elapsed_s": round(
+                            time.perf_counter() - entry.started, 3
+                        ),
+                    })
+                    self._entries.pop(token, None)
+            # evidence survives the quarantine: snapshot() keeps serving
+            # the LAST incident's stuck dispatches on /admin/engine and
+            # in postmortem bundles written after the rebuild
+            if quarantined:
+                self._quarantined = quarantined
+        return quarantined
+
     # -- heartbeat ------------------------------------------------------------
     def _loop(self) -> None:
         while not self._stop.wait(self._poll_interval()):
@@ -572,10 +613,14 @@ class StallWatchdog:
                 for e in self._entries.values()
             ]
             counts = dict(self.stall_counts)
+            quarantined = list(self._quarantined)
         return {
             "enabled": self.enabled,
             "timeout_s": self.timeout_s if self.enabled else None,
             "wedge_factor": self.wedge_factor,
             "stalls": counts,
             "watching": watching,
+            # the last recovery incident's quarantined dispatches
+            # (empty until a recovery has run)
+            "quarantined": quarantined,
         }

@@ -283,12 +283,16 @@ def test_flight_record_dispatch_ids_resolve(echo_app):
 
 def test_injected_stall_walks_the_state_machine(echo_app):
     """The acceptance spine: injected stall -> degraded -> wedged ->
-    ready 503 with the state -> stall counter -> recovery -> ready 200."""
+    ready 503 with the state -> stall counter -> recovery -> ready 200.
+    The recovery SUPERVISOR is disabled for the duration: this test pins
+    the watchdog's own stall-resolution walk (the supervisor's rebuild
+    path has its own suite, tests/test_recovery.py)."""
     app, base = echo_app
     tpu = app.container.tpu
     counter_before = tpu.metrics.counter(
         "gofr_tpu_device_stalls_total", labels=("kind",)
     ).value(kind="prefill")
+    tpu.recovery.enabled = False
     tpu.runner.stall_hook = lambda: time.sleep(0.7)
     try:
         worker = threading.Thread(
@@ -315,6 +319,7 @@ def test_injected_stall_walks_the_state_machine(echo_app):
         worker.join()
     finally:
         tpu.runner.stall_hook = None
+        tpu.recovery.enabled = True
     assert "degraded" in states
     assert "wedged" in states  # 0.7s stall > 3x the 0.15s deadline
     # ready told the truth while stalled: 503 with the engine state

@@ -462,6 +462,11 @@ def test_stall_leaves_black_box_bundle_and_history(echo_app):
     time.sleep(0.12)
     tpu = app.container.tpu
     stall_start = time.time()
+    # supervisor off for the duration: this test pins the postmortem
+    # layer's own evidence capture against a LIVE wedge (the recovery
+    # rebuild path — including its bundle-before-quarantine order —
+    # is covered by tests/test_recovery.py)
+    tpu.recovery.enabled = False
     tpu.runner.stall_hook = lambda: time.sleep(0.7)
     try:
         worker = threading.Thread(
@@ -484,6 +489,7 @@ def test_stall_leaves_black_box_bundle_and_history(echo_app):
         worker.join()
     finally:
         tpu.runner.stall_hook = None
+        tpu.recovery.enabled = True
     stall_end = time.time()
     assert bundle_path, "wedge never produced a postmortem bundle"
     bundle = json.load(open(bundle_path))

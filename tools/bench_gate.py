@@ -25,7 +25,11 @@ baseline:
   ``<= single * BENCH_GATE_MESH_FACTOR`` (default 5.0 — loose-first;
   tighten as the trajectory stabilizes) and mesh copied-KV-bytes per
   prefix hit ``<= single + 64`` (sharding must never introduce KV
-  copies; aliasing is placement-agnostic).
+  copies; aliasing is placement-agnostic);
+- the durable generation journal (resumable streams) must stay
+  per-token cheap: ``journal_microbench.per_token_us <= baseline *
+  BENCH_GATE_JOURNAL_FACTOR`` (default 5.0 — the journal append is a
+  GIL-atomic list append; a regression here taxes EVERY stream).
 
 Usage::
 
@@ -55,6 +59,7 @@ def gate(bench: dict, baseline: dict) -> list[str]:
     ttft_factor = float(os.environ.get("BENCH_GATE_TTFT_FACTOR", "2.5"))
     kv_factor = float(os.environ.get("BENCH_GATE_KV_FACTOR", "3.0"))
     mesh_factor = float(os.environ.get("BENCH_GATE_MESH_FACTOR", "5.0"))
+    journal_factor = float(os.environ.get("BENCH_GATE_JOURNAL_FACTOR", "5.0"))
 
     if bench.get("backend") != baseline.get("backend"):
         failures.append(
@@ -125,6 +130,19 @@ def gate(bench: dict, baseline: dict) -> list[str]:
                     f"{meshed['copied_kv_bytes_per_hit']} bytes/hit mesh vs "
                     f"{single['copied_kv_bytes_per_hit']} single (+64 slack)"
                 )
+    journal = bench.get("journal_microbench") or {}
+    base_journal = baseline.get("journal_microbench") or {}
+    if base_journal:
+        per_token = _num(journal, "per_token_us")
+        base_token = _num(base_journal, "per_token_us")
+        if per_token is None:
+            failures.append("journal_microbench missing from the bench artifact")
+        elif base_token and per_token > base_token * journal_factor:
+            failures.append(
+                f"journal per-token overhead regression: {per_token}us > "
+                f"{base_token}us * {journal_factor} "
+                f"(= {base_token * journal_factor:.3f}us)"
+            )
     return failures
 
 
