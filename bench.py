@@ -560,6 +560,16 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
             except Exception as exc:
                 errors.append(f"recovery phase: {exc}")
                 traceback.print_exc(file=sys.stderr)
+            # -- phase: deadline shed + abandoned-stream reclaim ---------------
+            # how fast the engine says NO (expired-request rejection)
+            # and how fast an abandoned stream's KV comes back — the
+            # overload numbers the brownout/deadline layer lives on
+            try:
+                result["shed_microbench"] = _measure_shed()
+                log(f"shed: {result['shed_microbench']}")
+            except Exception as exc:
+                errors.append(f"shed phase: {exc}")
+                traceback.print_exc(file=sys.stderr)
             engine_live = _scrape_engine(base)
             if engine_live.get("kv_blocks") is not None:
                 result["kv_blocks"] = engine_live["kv_blocks"]
@@ -898,6 +908,98 @@ def _measure_journal() -> dict:
         "per_token_us": round(overhead / (n_req * n_tok) * 1e6, 4),
         "per_request_us": round(overhead / n_req * 1e6, 2),
     }
+
+
+def _measure_shed() -> dict:
+    """Deadline-aware serving micro-round (host-side, compile-free):
+
+    - **shed latency** — wall time from submitting an already-expired
+      request to its 504-mapped rejection (batcher dequeue shed, stage
+      ``queue``): the cost of saying no, which under overload is paid
+      far more often than the cost of saying yes;
+    - **abandoned-stream reclaim** — from tripping a stream's cancel
+      event (the SSE responder's client-abort hook) to the paged-KV
+      free-block count returning to baseline: how long an abandoned
+      request keeps holding blocks a waiting request could use.
+
+    Gated loose-first vs bench_baseline.json
+    (``BENCH_GATE_SHED_FACTOR`` / ``BENCH_GATE_RECLAIM_FACTOR``)."""
+    import threading
+
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.deadline import Deadline, activate_deadline
+    from gofr_tpu.errors import DeadlineExceeded
+    from gofr_tpu.logging import Level
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.testutil import MockLogger
+    from gofr_tpu.tpu.device import new_device
+
+    overrides = {
+        "MODEL_NAME": "echo",
+        "ECHO_STEP_MS": "2",
+        "BATCH_TIMEOUT_MS": "1",
+        "TIMEBASE_ENABLED": "off",
+    }
+    old = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        device = new_device(EnvConfig(), MockLogger(Level.FATAL), Registry())
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+    try:
+        device.wait_ready(30)
+        n = int(os.environ.get("BENCH_SHED_REQUESTS", "50"))
+        sheds: list[float] = []
+        for _ in range(n):
+            expired = Deadline(0.0)
+            activate_deadline(expired)
+            start = time.perf_counter()
+            try:
+                device.generate([1, 2, 3], max_new_tokens=8)
+            except DeadlineExceeded:
+                sheds.append(time.perf_counter() - start)
+            finally:
+                activate_deadline(None)
+        if not sheds:
+            raise RuntimeError("no expired request was shed")
+        sheds.sort()
+        # reclaim: warm the prompt's cache entry first (admission
+        # caches a never-seen prompt by design — that is not a leak),
+        # then abandon a stream mid-decode and time the blocks back
+        prompt = [(3 * i) % 251 + 1 for i in range(96)]
+        for _ in device.generate_stream(prompt, 2):
+            pass
+        kv = device.kv_pool
+        baseline_free = kv.stats()["free"] if kv is not None else None
+        reclaim_ms = None
+        if baseline_free is not None:
+            cancel = threading.Event()
+            stream = device.generate_stream(prompt, 200, cancel=cancel)
+            got = 0
+            for _ in stream:
+                got += 1
+                if got >= 3:
+                    break
+            start = time.perf_counter()
+            cancel.set()  # what the SSE abort hook does on write failure
+            stream.close()
+            wait_until = time.monotonic() + 10
+            while time.monotonic() < wait_until:
+                if kv.stats()["free"] >= baseline_free:
+                    reclaim_ms = round(
+                        (time.perf_counter() - start) * 1e3, 3
+                    )
+                    break
+                time.sleep(0.0005)
+        return {
+            "shed_requests": n,
+            "shed_p50_us": round(sheds[len(sheds) // 2] * 1e6, 1),
+            "shed_mean_us": round(sum(sheds) / len(sheds) * 1e6, 1),
+            "reclaim_ms": reclaim_ms,
+        }
+    finally:
+        device.close()
 
 
 def _measure_recovery() -> dict:

@@ -237,6 +237,10 @@ class App:
         return self
 
     def shutdown(self) -> None:
+        # visible to in-flight stream teardown: asyncio acloses every
+        # suspended response generator on shutdown, and those aborts
+        # must not count as client_abort cancellations (no client left)
+        self.container.closing = True
         fleet = getattr(self.container, "fleet", None)
         if fleet is not None:
             # graceful drain BEFORE the listener stops: admission closes

@@ -71,6 +71,28 @@ token ids per request, the substrate of bit-identical stream resume),
 ``JOURNAL_CAPACITY`` (256 interrupted entries retained),
 ``JOURNAL_MAX_TOKENS`` (8192 tokens recorded per entry).
 
+Deadline-aware-serving keys (gofr_tpu/deadline.py, see
+docs/advanced-guide/fleet.md "Deadlines & brownout"):
+``REQUEST_DEADLINE_S`` (0 = off — the default end-to-end budget for
+requests without an ``X-Request-Deadline-Ms`` header; the header
+always wins, and a header of 0 opts a single request out), every
+serving stage honors it: the batcher sheds expired items at dequeue
+(stage ``queue``), pool/paged-KV admission rejects budgets that
+cannot cover one decode chunk at the observed cadence (stage
+``admission``), and the decode loop expires rows per chunk (stage
+``decode``) — all 504-mapped and counted on
+``gofr_tpu_deadline_exceeded_total{stage}``. ``PRIORITY_DEFAULT``
+(5) is the tier requests without an ``X-Priority`` header (0
+sheddable .. 9 protected, router-forwarded) serve at. Brownout:
+``BROWNOUT_QUEUE_DEPTH`` (0 = off; queue depth arming level 1 at the
+threshold, level 2 at 2x) and ``BROWNOUT_KV_UTIL`` (0 = off; a 0..1
+KV-ledger-utilization fraction, hard level at the midpoint to full)
+arm the graded controller; at level >= 1 priorities below
+``BROWNOUT_SHED_PRIORITY`` (5) 429 with Retry-After, at level 2
+priorities at-or-below it shed and ``BROWNOUT_CLAMP_TOKENS`` (0 =
+off) clamps ``max_tokens``. The live level serves on
+``/admin/engine`` and ``gofr_tpu_brownout_level``.
+
 Correctness-tooling keys (devtools/sanitizer.py + tests/conftest.py,
 see docs/advanced-guide/static-analysis.md): ``GOFR_SANITIZE=1`` arms
 the runtime concurrency sanitizer under tests;

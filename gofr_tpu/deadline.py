@@ -1,0 +1,375 @@
+"""End-to-end request deadlines and overload brownout.
+
+Production serving needs three overload behaviors the happy path never
+exercises (ROADMAP "millions of users"; the admission-vs-latency
+discipline of the interference-scheduler literature, PAPERS.md
+arxiv 2308.13490, and the backpressure contract the gRPC benchmarking
+methodology measures against, arxiv 1804.01138):
+
+1. **Deadline propagation** — a client (or the fleet router, which
+   forwards its *remaining* budget downstream) stamps
+   ``X-Request-Deadline-Ms`` on the request; every stage between the
+   HTTP edge and the device checks it and sheds work that can no
+   longer succeed: the batcher before cohort formation (stage
+   ``queue``), pool/paged-KV admission when the remaining budget
+   cannot cover even one decode chunk at the observed cadence (stage
+   ``admission``), and the decode loop per chunk (stage ``decode``).
+   Shed work fails with :class:`gofr_tpu.errors.DeadlineExceeded`
+   (HTTP 504) and counts on
+   ``gofr_tpu_deadline_exceeded_total{stage}``.
+2. **Client-abort cancellation** — an abandoned SSE stream trips the
+   request's stop event within one write failure, freeing its decode
+   slot and paged-KV blocks within one chunk
+   (``gofr_tpu_cancellations_total{cause=client_abort}``).
+3. **Graded brownout** — when queue depth or KV-block utilization
+   crosses the ``BROWNOUT_*`` thresholds, the
+   :class:`BrownoutController` sheds lowest-priority work first
+   (``X-Priority`` 0-9, router-forwarded) and at the harder level
+   clamps ``max_tokens``; the live level serves on ``/admin/engine``
+   and the ``gofr_tpu_brownout_level`` gauge.
+
+The deadline travels with the request exactly like the flight record
+and the active span: a contextvar, captured by the batcher queue item
+and the decode-pool request at submit time, so every stage reads the
+same absolute monotonic deadline with no new plumbing layer. This
+module is import-light on purpose (stdlib + errors only): handlers and
+the fleet router import it without paying the ``gofr_tpu.tpu`` package
+init (which pulls jax).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from gofr_tpu.errors import HTTPError
+
+# priority tiers: 0 (most sheddable) .. 9 (most protected); requests
+# without an X-Priority header get PRIORITY_DEFAULT (config, default 5)
+PRIORITY_MIN = 0
+PRIORITY_MAX = 9
+PRIORITY_DEFAULT = 5
+
+_current_deadline: contextvars.ContextVar[Optional["Deadline"]] = (
+    contextvars.ContextVar("gofr_request_deadline", default=None)
+)
+
+
+def current_deadline() -> Optional["Deadline"]:
+    """The in-flight request's deadline, if one is active."""
+    return _current_deadline.get()
+
+
+def activate_deadline(deadline: Optional["Deadline"]) -> Any:
+    """Bind ``deadline`` as the current one (None clears); returns the
+    reset token. Handlers run inside a per-request copied context
+    (handler.py), so not resetting leaks nothing past the request."""
+    return _current_deadline.set(deadline)
+
+
+# priority travels on its OWN contextvar, not just on the Deadline: a
+# request can carry X-Priority without any deadline (REQUEST_DEADLINE_S
+# off, no header) and its FlightRecord must still show the tier the
+# brownout controller sheds by
+_current_priority: contextvars.ContextVar[Optional[int]] = (
+    contextvars.ContextVar("gofr_request_priority", default=None)
+)
+
+
+def current_priority() -> Optional[int]:
+    """The in-flight request's shed tier, if admission parsed one."""
+    return _current_priority.get()
+
+
+def activate_priority(priority: Optional[int]) -> Any:
+    """Bind ``priority`` as the current tier (None clears)."""
+    return _current_priority.set(priority)
+
+
+class Deadline:
+    """One request's absolute completion deadline plus its shed
+    priority. Monotonic-clock anchored: wall-clock steps must never
+    grow or shrink a budget mid-request."""
+
+    __slots__ = ("budget_s", "t_deadline", "priority")
+
+    def __init__(self, budget_s: float,
+                 priority: int = PRIORITY_DEFAULT) -> None:
+        self.budget_s = float(budget_s)
+        self.t_deadline = time.perf_counter() + self.budget_s
+        self.priority = int(priority)
+
+    def remaining(self) -> float:
+        """Seconds of budget left (negative once expired)."""
+        return self.t_deadline - time.perf_counter()
+
+    def expired(self) -> bool:
+        return time.perf_counter() >= self.t_deadline
+
+    def __repr__(self) -> str:
+        return (
+            f"Deadline(budget_s={self.budget_s:.3f}, "
+            f"remaining_s={self.remaining():.3f}, "
+            f"priority={self.priority})"
+        )
+
+
+def deadline_exceeded_counter(metrics: Any) -> Any:
+    """The ONE registration of ``gofr_tpu_deadline_exceeded_total``:
+    every stage (batcher queue, pool/echo admission, decode loop)
+    registers through here so the stage semantics cannot drift between
+    copies (the registry dedupes by name — first wins)."""
+    return metrics.counter(
+        "gofr_tpu_deadline_exceeded_total",
+        "requests shed because their end-to-end deadline expired, by "
+        "stage (queue: batcher dequeue; admission: pool/paged-KV "
+        "submit; decode: mid-generation)",
+        labels=("stage",),
+    )
+
+
+def cancellations_counter(metrics: Any) -> Any:
+    """The ONE registration of ``gofr_tpu_cancellations_total`` —
+    shared by the SSE abort hook, the decode pool, and the echo
+    runner's compile-free mirror."""
+    return metrics.counter(
+        "gofr_tpu_cancellations_total",
+        "mid-flight generation cancellations by cause (client_abort: "
+        "the SSE consumer vanished; deadline: the request's budget "
+        "expired mid-decode)",
+        labels=("cause",),
+    )
+
+
+def pool_reject_counter(metrics: Any) -> Any:
+    """The ONE registration of ``gofr_tpu_pool_reject_total``. It lives
+    beside the deadline factories because the ``deadline`` reject
+    reason made its semantics cross-cutting (that reason 504s instead
+    of soloing) — and because three hand-synced copies of the help
+    string had already drifted once."""
+    return metrics.counter(
+        "gofr_tpu_pool_reject_total",
+        "decode-pool submit rejections (most reasons fall back to solo "
+        "decode; deadline sheds with a 504)",
+        labels=("reason",),
+    )
+
+
+def parse_priority(raw: Optional[str], default: int = PRIORITY_DEFAULT) -> int:
+    """``X-Priority`` header -> a clamped 0-9 tier. Malformed values
+    400 (a gateway stamping garbage must hear about it, not silently
+    serve at the default tier)."""
+    if raw is None or raw == "":
+        return default
+    try:
+        priority = int(raw)
+    except ValueError:
+        raise HTTPError(
+            400, '"X-Priority" must be an integer 0 (sheddable) to 9 '
+            "(protected)"
+        ) from None
+    return max(PRIORITY_MIN, min(PRIORITY_MAX, priority))
+
+
+def parse_deadline(
+    raw_ms: Optional[str],
+    default_s: float,
+    priority: int = PRIORITY_DEFAULT,
+) -> Optional[Deadline]:
+    """``X-Request-Deadline-Ms`` header -> a :class:`Deadline`.
+
+    Precedence: an explicit header always wins; absent, ``default_s``
+    (the ``REQUEST_DEADLINE_S`` config) applies; ``default_s`` 0 with
+    no header preserves the pre-deadline behavior (None — nothing
+    sheds). A header of ``0`` explicitly opts one request OUT of the
+    configured default (load harnesses, admin probes)."""
+    if raw_ms is not None and raw_ms != "":
+        try:
+            ms = int(raw_ms)
+        except ValueError:
+            raise HTTPError(
+                400, '"X-Request-Deadline-Ms" must be an integer '
+                "millisecond budget (0 disables the deadline)"
+            ) from None
+        if ms < 0:
+            raise HTTPError(400, '"X-Request-Deadline-Ms" must be >= 0')
+        if ms == 0:
+            return None
+        return Deadline(ms / 1000.0, priority=priority)
+    if default_s and default_s > 0:
+        return Deadline(float(default_s), priority=priority)
+    return None
+
+
+# -- overload brownout ---------------------------------------------------------
+
+# brownout levels: 0 normal, 1 shed below-default-priority work,
+# 2 shed default-and-below priority work + clamp max_tokens
+BROWNOUT_LEVELS = (0, 1, 2)
+
+
+class BrownoutController:
+    """Graded overload response, evaluated from host-side signals.
+
+    Signals (each armed only when its threshold is > 0):
+
+    - queue depth (batcher queue + displaced cohort items) vs
+      ``queue_hi``;
+    - paged-KV block utilization — COMMITTED blocks only (active rows
+      + admission reservations over the ledger budget; cached
+      prefix-cache blocks are excluded because they evict on demand —
+      a warm, otherwise-idle replica must read near 0, not pinned at
+      level 2) — vs ``kv_hi`` (a 0..1 fraction).
+
+    Level per signal: 0 below threshold, 1 at/above it, 2 at/above the
+    *hard* mark (2x ``queue_hi``; the midpoint between ``kv_hi`` and
+    full for KV). The controller's level is the max over armed
+    signals, re-evaluated at most every ``refresh_s`` (the reads are
+    cheap but take the pool lock; admission must not serialize on it).
+
+    Shedding: at level >= 1 requests with priority < ``shed_priority``
+    429; at level 2 priority <= ``shed_priority`` 429s (only
+    explicitly-elevated traffic keeps flowing) and ``max_tokens``
+    clamps to ``clamp_tokens`` (when set). All thresholds 0 = the
+    controller is inert (today's behavior)."""
+
+    def __init__(
+        self,
+        metrics: Any = None,
+        queue_hi: int = 0,
+        kv_hi: float = 0.0,
+        shed_priority: int = PRIORITY_DEFAULT,
+        clamp_tokens: int = 0,
+        queue_depth_fn: Optional[Callable[[], int]] = None,
+        kv_util_fn: Optional[Callable[[], float]] = None,
+        refresh_s: float = 0.2,
+    ) -> None:
+        self.queue_hi = int(queue_hi)
+        self.kv_hi = float(kv_hi)
+        self.shed_priority = int(shed_priority)
+        self.clamp_tokens = int(clamp_tokens)
+        self._queue_depth_fn = queue_depth_fn
+        self._kv_util_fn = kv_util_fn
+        self.refresh_s = refresh_s
+        self._lock = threading.Lock()
+        self._level = 0
+        self._signals: dict[str, float] = {}
+        self._evaluated_at = 0.0  # perf_counter mark of the last eval
+        self.sheds = 0  # lifetime brownout 429s (snapshot convenience)
+        self._level_gauge = (
+            metrics.gauge(
+                "gofr_tpu_brownout_level",
+                "active overload-brownout level (0 normal, 1 shedding "
+                "below-default-priority work, 2 shedding default-and-"
+                "below + clamping max_tokens)",
+            )
+            if metrics is not None else None
+        )
+        self._shed_counter = (
+            metrics.counter(
+                "gofr_tpu_brownout_shed_total",
+                "requests 429d by the brownout controller, by the "
+                "request's priority tier",
+                labels=("priority",),
+            )
+            if metrics is not None else None
+        )
+        if self._level_gauge is not None:
+            self._level_gauge.set(0.0)
+
+    @property
+    def armed(self) -> bool:
+        return self.queue_hi > 0 or self.kv_hi > 0
+
+    # -- evaluation ------------------------------------------------------------
+    def _signal_levels(self) -> dict[str, float]:
+        signals: dict[str, float] = {}
+        if self.queue_hi > 0 and self._queue_depth_fn is not None:
+            try:
+                signals["queue_depth"] = float(self._queue_depth_fn())
+            except Exception:
+                pass  # a torn-down batcher mid-recovery: signal absent
+        if self.kv_hi > 0 and self._kv_util_fn is not None:
+            try:
+                signals["kv_util"] = float(self._kv_util_fn())
+            except Exception:
+                pass
+        return signals
+
+    def level(self) -> int:
+        """The current brownout level (cached for ``refresh_s``)."""
+        if not self.armed:
+            return 0
+        now = time.perf_counter()
+        with self._lock:
+            if now - self._evaluated_at < self.refresh_s:
+                return self._level
+            # mark BEFORE the reads: concurrent callers piggyback on
+            # this evaluation instead of stampeding the pool lock
+            self._evaluated_at = now
+        signals = self._signal_levels()
+        level = 0
+        queue_depth = signals.get("queue_depth")
+        if queue_depth is not None:
+            if queue_depth >= 2 * self.queue_hi:
+                level = max(level, 2)
+            elif queue_depth >= self.queue_hi:
+                level = max(level, 1)
+        kv_util = signals.get("kv_util")
+        if kv_util is not None:
+            hard = self.kv_hi + (1.0 - self.kv_hi) / 2.0
+            if kv_util >= hard:
+                level = max(level, 2)
+            elif kv_util >= self.kv_hi:
+                level = max(level, 1)
+        with self._lock:
+            self._level = level
+            self._signals = signals
+        if self._level_gauge is not None:
+            self._level_gauge.set(float(level))
+        return level
+
+    # -- admission -------------------------------------------------------------
+    def admit(self, priority: int, max_tokens: Optional[int] = None,
+              ) -> tuple[bool, Optional[int], int]:
+        """One request's brownout verdict:
+        ``(admitted, clamped_max_tokens, level)``. ``max_tokens``
+        passes through unclamped below level 2 (or when
+        ``clamp_tokens`` is 0)."""
+        level = self.level()
+        if level <= 0:
+            return True, max_tokens, level
+        floor = self.shed_priority
+        shed = priority < floor if level == 1 else priority <= floor
+        if shed:
+            with self._lock:
+                self.sheds += 1
+            if self._shed_counter is not None:
+                self._shed_counter.inc(priority=str(priority))
+            return False, max_tokens, level
+        if level >= 2 and self.clamp_tokens and max_tokens is not None:
+            max_tokens = min(max_tokens, self.clamp_tokens)
+        return True, max_tokens, level
+
+    # -- read side -------------------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        """``GET /admin/engine`` brownout block: the live level, the
+        raw signals behind it, the thresholds, and the shed count."""
+        level = self.level()
+        with self._lock:
+            signals = dict(self._signals)
+            sheds = self.sheds
+        return {
+            "armed": self.armed,
+            "level": level,
+            "signals": signals,
+            "thresholds": {
+                "queue_hi": self.queue_hi or None,
+                "kv_hi": self.kv_hi or None,
+            },
+            "shed_priority": self.shed_priority,
+            "clamp_tokens": self.clamp_tokens or None,
+            "sheds": sheds,
+        }

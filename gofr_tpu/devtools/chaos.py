@@ -26,6 +26,10 @@ modes, all armable and clearable at runtime:
   runner's ``stall_hook``: the watchdog walks degraded → wedged, the
   replica's own readiness 503s, and the fleet prober takes it out of
   rotation (the r03–r05 tunnel-wedge failure, reproduced on demand).
+- :func:`abandoning_client` — a CLIENT-side scenario: open an SSE
+  stream over a raw socket, read k frames, hard-close (RST). The
+  replica must reclaim the stream's decode slot and paged-KV blocks
+  within one chunk (deadline-aware serving acceptance).
 
 ``chaos_fleet(n)`` builds N replicas + teardown; ``chaos_router``
 fronts them with a wired fleet app. Both swap env vars only around app
@@ -39,6 +43,7 @@ import asyncio
 import contextlib
 import os
 import socket
+import struct
 import threading
 from typing import Any, Iterator, Optional
 
@@ -177,6 +182,72 @@ async def _mangle_stream(stream: Any, delay_s: float,
             await asyncio.sleep(delay_s)
         yield chunk
         sent += 1
+
+
+def abandoning_client(
+    base_url: str, path: str, body: bytes, frames: int,
+    headers: Optional[dict[str, str]] = None, timeout_s: float = 15.0,
+) -> list[bytes]:
+    """The client-abort chaos scenario: POST an SSE request over a raw
+    socket, read ``frames`` complete SSE events off the wire, then
+    HARD-close the connection (SO_LINGER 0 → TCP RST — the abrupt
+    vanish of a killed browser tab, not a polite FIN). Returns the raw
+    event blocks read before the abort.
+
+    The replica under test must then free the stream's decode slot and
+    paged-KV blocks within one chunk: the server's next write fails,
+    the responder's abort hook trips the generation's stop event, and
+    the KV free-block count returns to baseline
+    (``gofr_tpu_cancellations_total{cause=client_abort}`` counts it)."""
+    from urllib.parse import urlparse
+
+    parsed = urlparse(base_url)
+    sock = socket.create_connection(
+        (parsed.hostname, parsed.port), timeout=timeout_s
+    )
+    try:
+        head = [
+            f"POST {path} HTTP/1.1",
+            f"Host: {parsed.hostname}:{parsed.port}",
+            "Content-Type: application/json",
+            f"Content-Length: {len(body)}",
+        ]
+        for name, value in (headers or {}).items():
+            head.append(f"{name}: {value}")
+        sock.sendall(
+            ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body
+        )
+        # read until `frames` complete SSE events (\n\n separators)
+        # arrive past the response head; the chunked framing rides
+        # inside buf — event boundaries are all this client needs
+        buf = b""
+        events: list[bytes] = []
+        body_started = False
+        while len(events) < frames:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+            if not body_started:
+                split = buf.find(b"\r\n\r\n")
+                if split < 0:
+                    continue
+                buf = buf[split + 4:]
+                body_started = True
+            while len(events) < frames:
+                idx = buf.find(b"\n\n")
+                if idx < 0:
+                    break
+                events.append(buf[:idx + 2])
+                buf = buf[idx + 2:]
+        # HARD close: linger 0 turns close() into an immediate RST —
+        # the server's next chunk write fails instead of buffering
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+        )
+    finally:
+        sock.close()
+    return events
 
 
 def _free_port() -> int:

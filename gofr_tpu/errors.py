@@ -91,6 +91,23 @@ class TooManyRequestsError(GofrError):
         super().__init__(message)
 
 
+class DeadlineExceeded(GofrError):
+    """The request's end-to-end deadline expired before (or while) it
+    could be served -> 504 (TPU-native addition: deadline-aware serving
+    sheds hopeless work at the queue/admission/decode stages instead of
+    burning device time on an answer nobody is waiting for).
+    ``stage`` records WHERE the budget ran out (queue | admission |
+    decode) — the same label the
+    ``gofr_tpu_deadline_exceeded_total{stage}`` counter carries."""
+
+    status_code = 504
+
+    def __init__(self, message: str = "request deadline exceeded",
+                 stage: str = ""):
+        super().__init__(message)
+        self.stage = stage
+
+
 class HTTPError(GofrError):
     """Arbitrary status escape hatch."""
 

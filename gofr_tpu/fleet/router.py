@@ -58,23 +58,36 @@ class _ResumeSpec:
     absolute deadline, and the affinity key for candidate ordering."""
 
     __slots__ = ("method", "target", "headers", "body", "deadline",
-                 "affinity")
+                 "affinity", "budgeted")
 
     def __init__(self, method: str, target: str, headers: dict[str, str],
-                 body: Any, deadline: float, affinity: str):
+                 body: Any, deadline: float, affinity: str,
+                 budgeted: bool = False):
         self.method = method
         self.target = target
         self.headers = headers
         self.body = body
         self.deadline = deadline
         self.affinity = affinity
+        # True only when the CLIENT set a positive deadline: a
+        # continuation re-stamps the remaining budget iff the original
+        # attempt did (an opted-out stream must stay opted out)
+        self.budgeted = budgeted
 
 # request headers forwarded to the replica (hop-by-hop and router-local
 # headers are stripped; the service client adds its own traceparent /
-# correlation id so the replica's spans join the router's trace)
+# correlation id so the replica's spans join the router's trace).
+# x-priority forwards VERBATIM (the replica's brownout controller sheds
+# by tier). x-request-deadline-ms also forwards verbatim by DEFAULT —
+# an absent header, an explicit "0" opt-out, and a malformed value all
+# reach the replica untouched (the 400 for garbage is the replica's to
+# give) — but when the client set a positive budget, _forward OVERWRITES
+# it per attempt with the REMAINING budget, so a retried hop never
+# hands a replica more time than the client has left.
 _FORWARD_HEADERS = (
     "content-type", "accept", "authorization", "x-tenant",
     "x-session-id", "x-affinity-key", "user-agent", "x-forwarded-for",
+    "x-priority", "x-request-deadline-ms",
 )
 # response headers forwarded back to the client
 _RETURN_HEADERS = ("content-type", "retry-after", "x-request-id")
@@ -437,11 +450,35 @@ class FleetRouter:
             for name in _FORWARD_HEADERS if name in request.headers
         }
 
+    @staticmethod
+    def _client_budget_s(request: Any) -> Optional[float]:
+        """The client's own ``X-Request-Deadline-Ms`` budget in seconds
+        (None when absent/malformed — the router's FLEET_DEADLINE_S
+        then stands alone; a malformed header is the REPLICA's 400 to
+        give, the router must not eat the request first)."""
+        raw = request.header("X-Request-Deadline-Ms")
+        if not raw:
+            return None
+        try:
+            ms = int(raw)
+        except ValueError:
+            return None
+        if ms <= 0:
+            return None
+        return ms / 1000.0
+
     def _forward(self, request: Any, tenant: str, affinity: str,
                  wants_stream: bool, executor: Any = None,
                  resumable: bool = False) -> Response:
         start = time.monotonic()
-        deadline = start + self.deadline_s
+        # the effective budget is the TIGHTER of the router's own
+        # forwarding deadline and the client's end-to-end deadline —
+        # retrying past what the client will wait for is pure waste
+        budget_s = self.deadline_s
+        client_budget = self._client_budget_s(request)
+        if client_budget is not None:
+            budget_s = min(budget_s, client_budget)
+        deadline = start + budget_s
         target = self._target(request)
         headers = self._forward_headers(request)
         record: dict[str, Any] = {
@@ -486,9 +523,21 @@ class FleetRouter:
                 )
             attempts += 1
             tried.add(replica.name)
+            # deadline propagation: each attempt hands the replica the
+            # REMAINING budget (floored at 1 ms) — a second attempt
+            # after a 2 s failure sees a budget 2 s smaller, so no hop
+            # is ever granted more time than the client has left. Only
+            # when the client SET a budget: no header / "0" / garbage
+            # forward verbatim (the replica's default or 400 applies) —
+            # the router must never mint a deadline the client didn't ask for
+            if client_budget is not None:
+                headers["X-Request-Deadline-Ms"] = str(
+                    max(1, int(remaining * 1000))
+                )
             resume = (
                 _ResumeSpec(request.method, target, headers,
-                            request.body or None, deadline, affinity)
+                            request.body or None, deadline, affinity,
+                            budgeted=client_budget is not None)
                 if resumable else None
             )
             response = self._attempt(
@@ -1080,6 +1129,13 @@ class _StreamRelay:
             )
             headers = dict(self._resume.headers)
             headers["X-Resume-From"] = str(self._next_id)
+            # a budgeted continuation gets the remaining budget, never
+            # the original attempt's stale stamp; an opted-out stream
+            # stays opted out
+            if self._resume.budgeted:
+                headers["X-Request-Deadline-Ms"] = str(
+                    max(1, int(remaining * 1000))
+                )
             entry: dict[str, Any] = {
                 "replica": replica.name, "status": None, "error": None,
                 "elapsed_ms": 0, "resume_from": self._next_id,

@@ -51,36 +51,51 @@ async def _sse_iter(stream: Stream, executor: Any = None) -> AsyncIterator[bytes
     # fleet router) can journal the last delivered offset and resume a
     # broken stream without missing or duplicated events
     next_id = stream.id_offset if stream.ids else None
-    if hasattr(events, "__aiter__"):
-        async for item in events:  # type: ignore[union-attr]
-            if stream.sse:
-                yield _frame_sse(item, next_id)
-                if next_id is not None:
-                    next_id += 1
-            else:
-                yield _to_bytes(item)
-    else:
-        # Sync generators (e.g. blocking token decode) must not stall the
-        # event loop between yields; pull each item on a worker thread —
-        # the CALLER-provided pool (container.handler_executor), because a
-        # stream's blocking next() holds its thread for the full
-        # inter-token wait and asyncio's cpu_count+4 default executor
-        # caps concurrent streams at a handful on small serving VMs.
-        import asyncio
+    # client-abort detection: if this async generator is finalized
+    # before the events exhausted — a write failure aborted the
+    # connection, or the connection task was cancelled — the stream's
+    # abort hook fires DIRECTLY (never via the events generator, which
+    # may be suspended mid-next on a pool thread), so the generation's
+    # stop event trips and its slot/KV free within one chunk
+    completed = False
+    try:
+        if hasattr(events, "__aiter__"):
+            async for item in events:  # type: ignore[union-attr]
+                if stream.sse:
+                    yield _frame_sse(item, next_id)
+                    if next_id is not None:
+                        next_id += 1
+                else:
+                    yield _to_bytes(item)
+        else:
+            # Sync generators (e.g. blocking token decode) must not stall the
+            # event loop between yields; pull each item on a worker thread —
+            # the CALLER-provided pool (container.handler_executor), because a
+            # stream's blocking next() holds its thread for the full
+            # inter-token wait and asyncio's cpu_count+4 default executor
+            # caps concurrent streams at a handful on small serving VMs.
+            import asyncio
 
-        loop = asyncio.get_running_loop()
-        iterator = iter(events)  # type: ignore[arg-type]
-        sentinel = object()
-        while True:
-            item = await loop.run_in_executor(executor, next, iterator, sentinel)
-            if item is sentinel:
-                break
-            if stream.sse:
-                yield _frame_sse(item, next_id)
-                if next_id is not None:
-                    next_id += 1
-            else:
-                yield _to_bytes(item)
+            loop = asyncio.get_running_loop()
+            iterator = iter(events)  # type: ignore[arg-type]
+            sentinel = object()
+            while True:
+                item = await loop.run_in_executor(executor, next, iterator, sentinel)
+                if item is sentinel:
+                    break
+                if stream.sse:
+                    yield _frame_sse(item, next_id)
+                    if next_id is not None:
+                        next_id += 1
+                else:
+                    yield _to_bytes(item)
+        completed = True
+    finally:
+        if not completed and stream.on_abort is not None:
+            try:
+                stream.on_abort()
+            except Exception:
+                pass  # an abort hook must never mask the teardown
 
 
 def _to_bytes(item: Any) -> bytes:
@@ -106,7 +121,13 @@ def respond(
         else:
             message = str(error) or error.__class__.__name__
         body = _json_bytes({"error": {"message": message}})
-        return Response(status=status, headers={"Content-Type": _JSON}, body=body)
+        headers = {"Content-Type": _JSON}
+        # overload verdicts (brownout 429s, admission sheds) carry an
+        # explicit backoff hint — bounded-queue discipline end to end
+        retry_after = getattr(error, "retry_after_s", None)
+        if isinstance(retry_after, (int, float)) and retry_after > 0:
+            headers["Retry-After"] = str(max(1, int(retry_after + 0.999)))
+        return Response(status=status, headers=headers, body=body)
 
     if isinstance(result, Response):
         return result

@@ -54,10 +54,19 @@ class Stream:
     client, and a mid-stream failover resumes from that offset instead
     of truncating (``X-Resume-From``). Frame ids are POSITIONS in the
     deterministic event sequence, so a regenerated stream renumbers
-    identically and duplicates are filterable by id alone."""
+    identically and duplicates are filterable by id alone.
+
+    ``on_abort`` (optional callable) fires when the stream is torn
+    down BEFORE its events exhausted — a client disconnect (write
+    failure) or connection-task cancellation. The responder invokes it
+    directly (never through the events generator, which may be
+    suspended mid-``next`` on a pool thread): handlers use it to trip
+    the generation's stop event so an abandoned stream frees its
+    decode slot and paged-KV blocks within one chunk."""
 
     events: Union[Iterator[Any], AsyncIterator[Any]]
     sse: bool = True
     content_type: str = "text/event-stream"
     ids: bool = False
     id_offset: int = 0
+    on_abort: Optional[Any] = None

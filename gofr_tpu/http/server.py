@@ -42,6 +42,7 @@ _STATUS_TEXT = {
     413: "Payload Too Large", 429: "Too Many Requests",
     500: "Internal Server Error", 501: "Not Implemented",
     502: "Bad Gateway", 503: "Service Unavailable",
+    504: "Gateway Timeout",
 }
 
 
@@ -244,6 +245,17 @@ class HTTPServer:
                 transport = writer.transport
                 if transport is not None:
                     transport.abort()
+                # close the response stream NOW, not at GC: its finally
+                # (the responder's client-abort hook) trips the
+                # generation's stop event, so an abandoned stream frees
+                # its decode slot and paged-KV blocks within one chunk
+                # instead of decoding to max_tokens unread
+                aclose = getattr(response.stream, "aclose", None)
+                if aclose is not None:
+                    try:
+                        await aclose()
+                    except Exception:
+                        pass  # teardown best-effort; the abort already won
                 return
             writer.write(b"0\r\n\r\n")
             await writer.drain()

@@ -80,9 +80,12 @@ def _stream_chat(
             usage_frame if include_usage else None,
         )
 
+    from gofr_tpu.openai.parse import _abortable
+
+    cancel, on_abort = _abortable(ctx)
     stream_iter = ctx.tpu.generate_stream(
         prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
-        adapter=adapter, logprobs=want_logprobs,
+        adapter=adapter, logprobs=want_logprobs, cancel=cancel,
     )
 
     def events():
@@ -134,7 +137,7 @@ def _stream_chat(
     # resume a deterministic chat stream by replaying from zero and
     # filtering already-delivered frames (chat frames are not 1:1 with
     # tokens, so there is no replica-side X-Resume-From shortcut here)
-    return Stream(events(), ids=True)
+    return Stream(events(), ids=True, on_abort=on_abort)
 
 
 def _stream_chat_fanout(
@@ -157,12 +160,13 @@ def _stream_chat_fanout(
         _index_tail_text,
         _stream_candidates,
     )
-    from gofr_tpu.openai.parse import _StopScanner
+    from gofr_tpu.openai.parse import _abortable, _StopScanner
 
     replicate = sampler.greedy
+    cancel, on_abort = _abortable(ctx)
     iters = _stream_candidates(
         ctx, body, prompt_ids, max_tokens, sampler, stop_ids, adapter,
-        want_logprobs, 1 if replicate else n,
+        want_logprobs, 1 if replicate else n, cancel=cancel,
     )
     decs = [tok.stream_decoder() for _ in range(n)]
     scans = [_StopScanner(stop_strs) if stop_strs else None
@@ -201,10 +205,13 @@ def _stream_chat_fanout(
         (lambda: [usage_frame(sum(emitted))])
         if usage_frame is not None else None
     )
-    return Stream(_drive_stream_fanout(
-        iters, replicate, n, finish, want_logprobs, open_frames, feed,
-        tail, error_frame, usage_frames,
-    ))
+    return Stream(
+        _drive_stream_fanout(
+            iters, replicate, n, finish, want_logprobs, open_frames, feed,
+            tail, error_frame, usage_frames,
+        ),
+        on_abort=on_abort,
+    )
 
 
 def chat_completions(ctx: Any) -> Any:

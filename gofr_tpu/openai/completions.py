@@ -116,10 +116,13 @@ def _stream_completion(
     # bad sampler) must 400 before the SSE 200 commits. resume_from is
     # clamped to the token budget: a client interrupted between the
     # last token frame and [DONE] resumes straight into the tail
+    from gofr_tpu.openai.parse import _abortable
+
+    cancel, on_abort = _abortable(ctx)
     stream_iter = ctx.tpu.generate_stream(
         prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
         adapter=adapter, logprobs=want_logprobs,
-        resume_from=min(resume_from, max_tokens),
+        resume_from=min(resume_from, max_tokens), cancel=cancel,
     )
 
     def events():
@@ -183,7 +186,8 @@ def _stream_completion(
     # ids=True: every frame carries its monotonic SSE id (anchored at
     # the resume offset), making the stream resumable through the fleet
     # router's journal — see docs/advanced-guide/fleet.md
-    return Stream(events(), ids=True, id_offset=resume_from)
+    return Stream(events(), ids=True, id_offset=resume_from,
+                  on_abort=on_abort)
 
 
 def _stream_completion_fanout(
@@ -206,12 +210,13 @@ def _stream_completion_fanout(
         _index_tail_text,
         _stream_candidates,
     )
-    from gofr_tpu.openai.parse import _StopScanner
+    from gofr_tpu.openai.parse import _abortable, _StopScanner
 
     replicate = sampler.greedy
+    cancel, on_abort = _abortable(ctx)
     iters = _stream_candidates(
         ctx, body, prompt_ids, max_tokens, sampler, stop_ids, adapter,
-        want_logprobs, 1 if replicate else n,
+        want_logprobs, 1 if replicate else n, cancel=cancel,
     )
     decs = [tok.stream_decoder() if tok is not None else None
             for _ in range(n)]
@@ -252,10 +257,13 @@ def _stream_completion_fanout(
         (lambda: [usage_frame(sum(emitted))])
         if usage_frame is not None else None
     )
-    return Stream(_drive_stream_fanout(
-        iters, replicate, n, finish, want_logprobs, open_frames, feed,
-        tail, error_frame, usage_frames,
-    ))
+    return Stream(
+        _drive_stream_fanout(
+            iters, replicate, n, finish, want_logprobs, open_frames, feed,
+            tail, error_frame, usage_frames,
+        ),
+        on_abort=on_abort,
+    )
 
 
 def completions(ctx: Any) -> Any:

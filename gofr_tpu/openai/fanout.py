@@ -12,6 +12,33 @@ from gofr_tpu.errors import HTTPError
 STREAM_END = object()  # per-index end marker on the multiplex queue
 
 
+class _LinkedCancel:
+    """Event-like stop for ONE fan-out candidate: reads as set when
+    either the shared client-abort event or this candidate's own
+    teardown tripped. ``set()`` marks only the local side — a finished
+    candidate's generator close (``_stream_iter``'s ``finally:
+    stop.set()``) must never cancel its still-decoding siblings, while
+    a real client abort (the shared event) must cancel all of them.
+    The decode paths only ever ``is_set()`` their stop events, so this
+    is the full surface they need."""
+
+    __slots__ = ("_shared", "_local")
+
+    def __init__(self, shared: Any):
+        import threading
+
+        self._shared = shared
+        self._local = threading.Event()
+
+    def set(self) -> None:
+        self._local.set()
+
+    def is_set(self) -> bool:
+        return self._local.is_set() or (
+            self._shared is not None and self._shared.is_set()
+        )
+
+
 def _candidate_samplers(body: dict, count: int) -> list:
     """Per-candidate samplers with the seed+index derivation — THE
     reproducibility contract the stream and non-stream fan-outs share
@@ -60,7 +87,7 @@ def _fanout_workers(ctx: Any, default_slots: int = 4) -> int:
 def _stream_candidates(
     ctx: Any, body: dict, prompt_ids: list, max_tokens: int,
     sampler: Any, stop_ids: Any, adapter: Any, want_logprobs: bool,
-    n: int,
+    n: int, cancel: Any = None,
 ) -> list:
     """Construct the n candidate stream iterators for interleaved SSE.
     Built BEFORE the 200 commits (parameter errors must 400 first).
@@ -74,7 +101,7 @@ def _stream_candidates(
     if n == 1:
         return [ctx.tpu.generate_stream(
             prompt_ids, max_tokens, sampler=sampler, stop_tokens=stop_ids,
-            adapter=adapter, logprobs=want_logprobs,
+            adapter=adapter, logprobs=want_logprobs, cancel=cancel,
         )]
     override = _fanout_workers_override(ctx)
     if override is not None:
@@ -95,9 +122,13 @@ def _stream_candidates(
     iters = []
     try:
         for s in samplers:
+            # a client abort must free EVERY candidate's slot/KV — but
+            # one candidate finishing first must not cancel the rest:
+            # each candidate stops on (shared abort OR its own teardown)
             iters.append(ctx.tpu.generate_stream(
                 prompt_ids, max_tokens, sampler=s, stop_tokens=stop_ids,
                 adapter=adapter, logprobs=want_logprobs,
+                cancel=_LinkedCancel(cancel),
             ))
     except BaseException:
         for it in iters:  # a late candidate failing must free the early ones

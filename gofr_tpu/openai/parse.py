@@ -1,12 +1,105 @@
 """Request parsing shared by every OpenAI endpoint: prompts, stop
 sequences (device ids + host-matched strings), sampling knobs, the
-shared knob parse, and n/best_of/echo fan-out constraints."""
+shared knob parse, n/best_of/echo fan-out constraints, and the
+deadline/priority/brownout admission gate."""
 
 from __future__ import annotations
 
 from typing import Any
 
-from gofr_tpu.errors import HTTPError
+from gofr_tpu.errors import HTTPError, TooManyRequestsError
+
+
+def _admit_request(ctx: Any, max_tokens: int) -> int:
+    """Deadline + priority + brownout admission, shared by both OpenAI
+    endpoints (one home — the chat/completions copies drifted once).
+
+    Parses ``X-Request-Deadline-Ms`` (default ``REQUEST_DEADLINE_S``;
+    0/absent with no header = no deadline, today's behavior) and
+    ``X-Priority`` (default ``PRIORITY_DEFAULT``), activates the
+    deadline contextvar so the batcher/pool/device stages read the same
+    absolute budget, and consults the engine's brownout controller:
+    under brownout, below-floor priorities 429 with a Retry-After and
+    level 2 may clamp ``max_tokens``. Returns the (possibly clamped)
+    ``max_tokens``."""
+    from gofr_tpu.deadline import (
+        PRIORITY_DEFAULT,
+        activate_deadline,
+        activate_priority,
+        parse_deadline,
+        parse_priority,
+    )
+
+    config = ctx.config
+    default_priority = int(
+        config.get_or_default("PRIORITY_DEFAULT", str(PRIORITY_DEFAULT))
+    )
+    priority = parse_priority(
+        ctx.request.header("X-Priority"), default=default_priority
+    )
+    activate_priority(priority)
+    default_deadline_s = float(
+        config.get_or_default("REQUEST_DEADLINE_S", "0")
+    )
+    deadline = parse_deadline(
+        ctx.request.header("X-Request-Deadline-Ms"),
+        default_deadline_s, priority=priority,
+    )
+    activate_deadline(deadline)
+    brownout = getattr(ctx.tpu, "brownout", None)
+    if brownout is not None:
+        admitted, max_tokens, level = brownout.admit(priority, max_tokens)
+        if not admitted:
+            exc = TooManyRequestsError(
+                f"shed by overload brownout (level {level}, request "
+                f"priority {priority}); retry later or raise X-Priority"
+            )
+            exc.retry_after_s = 1.0
+            raise exc
+    return max_tokens
+
+
+def _abortable(ctx: Any) -> tuple:
+    """One streaming generation's client-abort wiring, shared by every
+    stream builder in chat.py/completions.py (four hand-rolled copies
+    of this block once existed — same drift hazard the admission gate
+    docstring records): a fresh cancel event (pass it to
+    ``generate_stream`` / every fan-out candidate — the responder's
+    on_abort hook trips it on a write failure so an abandoned stream
+    frees its decode slot and KV within one chunk) and the matching
+    ``Stream.on_abort`` callable. Returns ``(cancel, on_abort)``."""
+    import threading
+
+    from gofr_tpu.telemetry import current_record
+
+    cancel = threading.Event()
+    return cancel, _client_abort_hook(ctx, cancel, current_record())
+
+
+def _client_abort_hook(ctx: Any, cancel: Any, record: Any) -> Any:
+    """The Stream.on_abort callable for one streaming generation: trips
+    the request's stop event (the decode loop then frees its slot and
+    KV within one chunk), counts the abort, and finishes the flight
+    record as cancelled (idempotent — a normally-finished stream's
+    record already completed)."""
+    from gofr_tpu.deadline import cancellations_counter
+
+    container = ctx.container
+    counter = cancellations_counter(container.metrics)
+    telemetry = getattr(container, "telemetry", None)
+
+    def on_abort() -> None:
+        cancel.set()
+        if getattr(container, "closing", False):
+            # process shutdown acloses every in-flight response
+            # generator: still free the compute, but a restart must not
+            # masquerade as a spike of phantom client aborts
+            return
+        counter.inc(cause="client_abort")
+        if telemetry is not None and record is not None:
+            telemetry.finish(record, status="cancelled")
+
+    return on_abort
 
 
 def _prompt_tokens(ctx: Any, prompt: Any) -> list[int]:
@@ -181,6 +274,10 @@ def _parse_request(ctx: Any, default_max: int) -> tuple:
             '"max_tokens" must be a positive integer'
             + (" (0 allowed with echo)" if floor == 0 else ""),
         )
+    # deadline + priority + brownout (after max_tokens validates, so
+    # the brownout clamp never masks a type error; before any encode
+    # work, so shed requests cost the server nothing)
+    max_tokens = _admit_request(ctx, max_tokens)
     sampler = _sampler(body)
     stop_ids, stop_strs = _parse_stops(ctx, body)
     lp_req = body.get("logprobs")

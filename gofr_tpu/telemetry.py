@@ -124,6 +124,7 @@ class FlightRecord:
         "prefill_chunks", "prefill_bucket", "sched_defer_s",
         "pool_reject_reason", "dispatch_ids",
         "kv_blocks", "kv_aliased_blocks", "mesh_axes",
+        "deadline_s", "priority", "shed_stage",
         "wall_start", "t_start", "t_enqueue", "t_dispatch",
         "t_first_token", "t_last_token", "t_done", "wall_done", "_lock",
         # the recorder's in-flight index holds records WEAKLY (an
@@ -164,6 +165,20 @@ class FlightRecord:
         # serving-mesh axes this request ran on ({"tp": 2, ...}; None =
         # single chip) — latency is only comparable within one topology
         self.mesh_axes: Optional[dict] = None
+        # deadline-aware serving (gofr_tpu/deadline.py): the request's
+        # total budget + priority tier, read off the request contextvars
+        # at record start (priority rides its own var so a deadline-less
+        # X-Priority request still records the tier brownout sheds by);
+        # shed_stage records WHERE an exceeded deadline shed it
+        # (queue | admission | decode), "" = never shed
+        from gofr_tpu.deadline import current_deadline, current_priority
+
+        deadline = current_deadline()
+        self.deadline_s = deadline.budget_s if deadline is not None else None
+        self.priority = (
+            deadline.priority if deadline is not None else current_priority()
+        )
+        self.shed_stage = ""
         # gofrlint: wall-clock — /admin/requests display ts (durations use t_*)
         self.wall_start = time.time()
         self.t_start = time.perf_counter()
@@ -254,10 +269,27 @@ class FlightRecord:
             self.tokens_out += n
         self.t_last_token = time.perf_counter()
 
+    def note_shed(self, stage: str) -> None:
+        """Deadline shed accounting: the FIRST stage that gave up on
+        this request wins (a queue shed's DeadlineExceeded also unwinds
+        through the handler's error path)."""
+        if not self.shed_stage:
+            self.shed_stage = stage
+
     def note_error(self, exc: BaseException) -> None:
         """Device-layer failure: remembered even if the transport still
-        manages a response (a stream that already committed its 200)."""
-        self.status = "error"
+        manages a response (a stream that already committed its 200).
+        A deadline shed keeps its own terminal status — "the budget ran
+        out" and "the device broke" must stay distinguishable on
+        /admin/requests and in the SLO error rate."""
+        from gofr_tpu.errors import DeadlineExceeded
+
+        if isinstance(exc, DeadlineExceeded):
+            self.status = "deadline_exceeded"
+            if getattr(exc, "stage", ""):
+                self.note_shed(exc.stage)
+        else:
+            self.status = "error"
         self.error = f"{type(exc).__name__}: {exc}"
 
     # -- derived -------------------------------------------------------------
@@ -318,6 +350,9 @@ class FlightRecord:
             "kv_blocks": self.kv_blocks or None,
             "kv_aliased_blocks": self.kv_aliased_blocks or None,
             "mesh_axes": self.mesh_axes,
+            "deadline_s": self.deadline_s,
+            "priority": self.priority,
+            "shed_stage": self.shed_stage or None,
             "start_ts": self.wall_start,
             "enqueue_ts": _offset(self.t_enqueue),
             "dispatch_ts": _offset(self.t_dispatch),

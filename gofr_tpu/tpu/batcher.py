@@ -32,12 +32,13 @@ import queue
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
-from gofr_tpu.errors import TooManyRequestsError
+from gofr_tpu.deadline import current_deadline, deadline_exceeded_counter
+from gofr_tpu.errors import DeadlineExceeded, TooManyRequestsError
 from gofr_tpu.telemetry import current_record
 from gofr_tpu.tpu.introspect import activate_dispatch
 from gofr_tpu.tracing import current_span, get_tracer
@@ -51,7 +52,7 @@ def next_pow2(n: int) -> int:
 
 
 class _Item:
-    __slots__ = ("payload", "future", "arrival", "span", "record")
+    __slots__ = ("payload", "future", "arrival", "span", "record", "deadline")
 
     def __init__(self, payload: Any):
         self.payload = payload
@@ -63,6 +64,10 @@ class _Item:
         # and the request's record learns its queue wait + batch cohort
         self.span = current_span()
         self.record = current_record()
+        # the request's end-to-end deadline rides the item too: the
+        # worker sheds an expired item at dequeue instead of dispatching
+        # work nobody is waiting for
+        self.deadline = current_deadline()
         if self.record is not None:
             self.record.mark_enqueue()
 
@@ -142,9 +147,15 @@ class DynamicBatcher:
                 )
                 if bucket_fn is not None else None
             )
+            # queue-stage deadline sheds: an item whose end-to-end
+            # budget expired while waiting is failed at dequeue, never
+            # dispatched (one shared family across the stages — the
+            # pool/device register admission/decode on the same name)
+            self._deadline_counter = deadline_exceeded_counter(metrics)
         else:
             self._batch_hist = self._queue_gauge = self._wait_hist = None
             self._padded_counter = None
+            self._deadline_counter = None
         self.name = name
         self._thread = threading.Thread(target=self._run, daemon=True, name=f"gofr-batcher-{name}")
         self._thread.start()
@@ -194,12 +205,16 @@ class DynamicBatcher:
                     continue
                 if first is None:
                     return
+            if not self._viable(first):
+                continue  # shed/skipped at dequeue: never holds a batch open
             batch = [first]
             deadline = first.arrival + self.timeout_s
             closing = False
             while len(batch) < self.max_batch:
                 if pending:
-                    batch.append(pending.popleft())
+                    item = pending.popleft()
+                    if self._viable(item):
+                        batch.append(item)
                     continue
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0:
@@ -211,10 +226,16 @@ class DynamicBatcher:
                 if item is None:
                     closing = True
                     break
-                batch.append(item)
-            cohort, rest = self._form_cohort(batch)
-            pending.extend(rest)
-            self._dispatch_pool.submit(self._dispatch, cohort)
+                if self._viable(item):
+                    batch.append(item)
+            # final sweep BEFORE cohort formation: an item can expire (or
+            # its caller vanish) during the drain wait above — expired
+            # items must never consume cohort slots or padded tokens
+            batch = [item for item in batch if self._viable(item)]
+            if batch:
+                cohort, rest = self._form_cohort(batch)
+                pending.extend(rest)
+                self._dispatch_pool.submit(self._dispatch, cohort)
             if closing:
                 # displaced items are invisible to close()'s queue drain —
                 # flush them as cohorts before exiting, never strand them
@@ -224,6 +245,40 @@ class DynamicBatcher:
                     pending.extend(rest)
                     self._dispatch_pool.submit(self._dispatch, cohort)
                 return
+
+    def _viable(self, item: "_Item") -> bool:
+        """Dequeue-time gate: False for items that must not dispatch.
+        A cancelled/already-resolved future is skipped silently (the
+        caller walked away — satellite of the delivery-time
+        ``future.cancelled()`` check, which still left the item riding
+        a cohort). An item whose end-to-end deadline expired while
+        queued is SHED: its future fails with a 504-mapped
+        :class:`DeadlineExceeded` (stage ``queue``), the shed counts on
+        the stage counter, and its FlightRecord learns the stage — the
+        device never sees it (no dispatch record, no padded tokens)."""
+        future = item.future
+        if future.cancelled() or future.done():
+            return False
+        if item.deadline is not None and item.deadline.expired():
+            if item.record is not None:
+                item.record.note_shed("queue")
+            if self._deadline_counter is not None:
+                self._deadline_counter.inc(stage="queue")
+            waited = time.perf_counter() - item.arrival
+            try:
+                future.set_exception(DeadlineExceeded(
+                    f"deadline expired after {waited * 1000:.0f} ms in "
+                    f"the batch queue (budget "
+                    f"{item.deadline.budget_s * 1000:.0f} ms)",
+                    stage="queue",
+                ))
+            except InvalidStateError:
+                # the caller cancelled between the check above and this
+                # set: either way the item must not dispatch, and the
+                # race must never kill the (unrecoverable) worker thread
+                pass
+            return False
+        return True
 
     def _form_cohort(self, batch: list["_Item"]) -> tuple[list["_Item"], list["_Item"]]:
         """Split a drained batch into per-bucket cohorts and pick ONE to
@@ -253,6 +308,14 @@ class DynamicBatcher:
         return chosen, displaced
 
     def _dispatch(self, batch: list[_Item]) -> None:
+        # last-chance shed before the device: a batch can wait for a
+        # dispatch-pool worker (the pipeline handoff) long enough for a
+        # member's deadline to expire — an expired item must never ride
+        # the dispatch. Filtering HERE keeps it off the timeline too
+        # (_note_dispatch below creates the DispatchRecord).
+        batch = [item for item in batch if self._viable(item)]
+        if not batch:
+            return
         now = time.perf_counter()
         if self._batch_hist:
             self._batch_hist.observe(len(batch), model=self.name)

@@ -49,9 +49,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from gofr_tpu.config import env_flag
+from gofr_tpu.deadline import (
+    cancellations_counter,
+    current_deadline,
+    deadline_exceeded_counter,
+    pool_reject_counter,
+)
+from gofr_tpu.errors import DeadlineExceeded
 from gofr_tpu.telemetry import current_journal_entry, current_record
 
 DONE = object()  # end-of-stream marker on a slot's token queue
+# precedes DONE on a slot queue whose request's end-to-end deadline
+# expired mid-decode: the consumer re-raises DeadlineExceeded instead
+# of treating the truncated stream as a clean finish
+DEADLINE = object()
 
 # Chunks in flight (DECODE_PIPELINE config): the host fetch of chunk N's
 # tokens overlaps execution of the younger in-flight chunks. Round-3 pool
@@ -86,14 +97,15 @@ class _Request:
     __slots__ = (
         "out_queue", "remaining", "cache_len", "stop", "stop_tokens",
         "finished", "want_lp", "want_top", "want_kv", "record",
-        "kv_reserved", "journal",
+        "kv_reserved", "journal", "deadline",
     )
 
     def __init__(self, out_queue: "queue.Queue", remaining: int, cache_len: int,
                  stop: Optional[threading.Event], stop_tokens: frozenset,
                  want_lp: bool = False, want_top: bool = False,
                  want_kv: bool = False, record: Any = None,
-                 kv_reserved: int = 0, journal: Any = None):
+                 kv_reserved: int = 0, journal: Any = None,
+                 deadline: Any = None):
         self.out_queue: Optional[queue.Queue] = out_queue
         self.remaining = remaining
         self.cache_len = cache_len
@@ -122,6 +134,10 @@ class _Request:
         # recovery-resume path can distinguish pool failures from
         # client aborts
         self.journal = journal
+        # the request's end-to-end deadline (gofr_tpu/deadline.py):
+        # the worker checks it per delivered chunk — an expired row
+        # finishes with DEADLINE, freeing its slot and KV mid-flight
+        self.deadline = deadline
 
 
 class _Slot:
@@ -342,14 +358,27 @@ class DecodePool:
         # counter (and the FlightRecord's pool_reject_reason) says WHY a
         # stream missed the pool
         self._reject_counter = (
-            metrics.counter(
-                "gofr_tpu_pool_reject_total",
-                "decode-pool submit rejections (the request decoded solo)",
-                labels=("reason",),
-            )
+            pool_reject_counter(metrics)
             if metrics is not None
             else None
         )
+        # deadline-aware serving: the admission gate and the per-chunk
+        # row expiry share these families with the batcher's queue
+        # stage (one registration home: gofr_tpu/deadline.py)
+        self._deadline_counter = (
+            deadline_exceeded_counter(metrics)
+            if metrics is not None
+            else None
+        )
+        self._cancel_counter = (
+            cancellations_counter(metrics)
+            if metrics is not None
+            else None
+        )
+        # observed chunk cadence (EMA of the dispatch->fetch span per
+        # chunk): the admission gate's unit of "can this request still
+        # get even one chunk of decode before its deadline"
+        self._chunk_ema_s = 0.0
         self._mfu_gauge = self._tokens_counter = self._mbu_gauge = None
         if metrics is not None and n_params and peak_flops:
             self._mfu_gauge = metrics.gauge(
@@ -622,10 +651,12 @@ class DecodePool:
         off/rebuilding, the name is unknown to the bank, or a penalized
         slot is active (the chunk runs ONE executable; the mix solos)."""
         out: "queue.Queue" = queue.Queue()
+        deadline = current_deadline()
         with self._work:
             if self._closed:
                 self._reject("closed", count_only=True)
                 raise RuntimeError("decode pool closed")
+            self._admit_deadline(deadline)
             adapter_idx = self._admit(adapter, penalty)
             if not self._free:
                 self._reject("no_free_slots", "no free decode slots")
@@ -638,7 +669,8 @@ class DecodePool:
                                     want_top=want_top_logprobs,
                                     want_kv=want_kv, record=record,
                                     kv_reserved=kv_reserved,
-                                    journal=current_journal_entry())
+                                    journal=current_journal_entry(),
+                                    deadline=deadline)
             if record is not None and kv_reserved:
                 record.note_kv(kv_reserved)
             self._apply_sampling(slot.index, sampler)
@@ -685,6 +717,41 @@ class DecodePool:
             )
         except KVExhausted as exc:
             self._reject("kv_exhausted", f"KV block budget exhausted: {exc}")
+
+    def _admit_deadline(self, deadline: Any) -> None:
+        """Deadline admission gate (pool lock held): a request whose
+        remaining budget cannot cover even ONE decode chunk at the
+        pool's observed cadence is hopeless — admitting it would burn a
+        slot, KV blocks, and chunk dispatches on an answer that misses
+        its deadline by construction. Unlike every other reject reason
+        this does NOT fall back to solo decode (solo is slower, not
+        faster): it raises the 504-mapped :class:`DeadlineExceeded`
+        after accounting the ``deadline`` pool-reject reason and the
+        ``admission`` stage counter."""
+        if deadline is None:
+            return
+        remaining = deadline.remaining()
+        if remaining > 0 and remaining >= self._chunk_ema_s:
+            return
+        # idle-pool bypass: with no rows decoding, the observed cadence
+        # is STALE (one anomalous chunk — a GC pause, a host preemption
+        # — would otherwise inflate the EMA, reject everything, and
+        # never decay because rejections prevent the chunks that decay
+        # it). An idle pool runs the chunk immediately; only a budget
+        # that is already spent is hopeless there.
+        if remaining > 0 and not self._active:
+            return
+        self._reject("deadline", count_only=True)
+        if self._deadline_counter is not None:
+            self._deadline_counter.inc(stage="admission")
+        record = current_record()
+        if record is not None:
+            record.note_shed("admission")
+        raise DeadlineExceeded(
+            f"remaining deadline budget {max(remaining, 0) * 1000:.0f} ms "
+            f"cannot cover one decode chunk (observed cadence "
+            f"{self._chunk_ema_s * 1000:.0f} ms)", stage="admission",
+        )
 
     def _admit(self, adapter: Optional[str], penalty: Optional[tuple]) -> int:
         """The submit reject gates (pool lock held): raises queue.Full
@@ -1028,6 +1095,14 @@ class DecodePool:
     def _deliver(self, records: list, toks: np.ndarray, lps: np.ndarray,
                  tvals: Any, tids: Any, elapsed: float,
                  drec: Any = None) -> None:
+        # observed cadence EMA (pool lock held): the steady-state
+        # inter-delivery interval — what one more chunk of decode
+        # actually costs a deadline right now
+        if elapsed > 0:
+            self._chunk_ema_s = (
+                elapsed if self._chunk_ema_s <= 0
+                else 0.8 * self._chunk_ema_s + 0.2 * elapsed
+            )
         delivered = 0
         for index, req in records:
             if req is None or req.finished:
@@ -1051,9 +1126,17 @@ class DecodePool:
         req.cache_len += self.chunk
         take = min(self.chunk, req.remaining, max(room, 0))
         cancelled = req.stop is not None and req.stop.is_set()
+        # per-chunk deadline check: an expired row finishes NOW —
+        # status deadline_exceeded to the waiter, slot + KV released
+        # mid-flight exactly like the cancellation path, so a queued
+        # request admits into the freed budget within one chunk
+        expired = (
+            not cancelled
+            and req.deadline is not None and req.deadline.expired()
+        )
         hit_stop_token = False
         delivered = 0
-        if not cancelled and req.out_queue is not None:
+        if not cancelled and not expired and req.out_queue is not None:
             burst, hit_stop_token = self._build_burst(
                 req, index, toks[index], lps[index], tvals, tids, take
             )
@@ -1063,11 +1146,21 @@ class DecodePool:
         req.remaining -= take
         if (
             cancelled
+            or expired
             or hit_stop_token
             or req.remaining <= 0
             or req.cache_len >= self.max_len
         ):
-            self._finish_request(index, req, cancelled)
+            if expired:
+                if self._deadline_counter is not None:
+                    self._deadline_counter.inc(stage="decode")
+                if self._cancel_counter is not None:
+                    self._cancel_counter.inc(cause="deadline")
+                if req.record is not None:
+                    req.record.note_shed("decode")
+                if req.journal is not None:
+                    req.journal.note_interrupted("deadline exceeded mid-decode")
+            self._finish_request(index, req, cancelled, expired=expired)
         return delivered
 
     def _account_chunk(self, delivered: int, elapsed: float,
@@ -1130,14 +1223,15 @@ class DecodePool:
         return burst, False
 
     def _finish_request(self, index: int, req: "_Request",
-                        cancelled: bool) -> None:
+                        cancelled: bool, expired: bool = False) -> None:
         """Terminal delivery for one request (pool lock held): optional
-        KV hand-back, DONE, and — unless the slot was already reused —
+        KV hand-back, DONE (preceded by the DEADLINE marker for an
+        expired row), and — unless the slot was already reused —
         freeing it with every per-slot state reset (sampling knobs,
         adapter id, penalty rows)."""
         req.finished = True
         if (
-            req.want_kv and not cancelled
+            req.want_kv and not cancelled and not expired
             and req.out_queue is not None
             and self._slots[index].request is req
         ):
@@ -1153,6 +1247,10 @@ class DecodePool:
                 ("kv", self._read_slot(self.cache, index))
             )
         if req.out_queue is not None:
+            if expired:
+                # the waiter must re-raise DeadlineExceeded, not treat
+                # the truncated stream as a clean early finish
+                req.out_queue.put(DEADLINE)
             req.out_queue.put(DONE)
         req.out_queue = None
         req.stop = None
@@ -1227,6 +1325,9 @@ class DecodePool:
                 "penalized_slots": len(self._pen_slots),
                 "closed": self._closed,
                 "mesh_axes": self.mesh_axes,
+                # the deadline admission gate's unit: what one more
+                # chunk of decode costs right now (0 = not yet observed)
+                "chunk_cadence_s": self._chunk_ema_s,
                 "kv": self._kv.stats() if self._kv is not None else None,
             }
 

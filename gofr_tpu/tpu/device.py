@@ -277,6 +277,21 @@ class TPUDevice:
             )
             if self._journal_enabled else None
         )
+        # overload brownout controller: graded shed off host-side
+        # signals (batcher queue depth, KV-block utilization); the
+        # signal callables read through getattr because the batcher and
+        # kv_pool are (re)built by _build_stack and recovery rebuilds
+        from gofr_tpu.deadline import BrownoutController
+
+        self.brownout = BrownoutController(
+            metrics=metrics,
+            queue_hi=self._brownout_queue_hi,
+            kv_hi=self._brownout_kv_hi,
+            shed_priority=self._brownout_shed_priority,
+            clamp_tokens=self._brownout_clamp,
+            queue_depth_fn=self._brownout_queue_depth,
+            kv_util_fn=self._brownout_kv_util,
+        )
         # wedge-recovery supervisor: listens on the engine state machine
         # and drives quarantine -> rebuild -> serving on wedged
         from gofr_tpu.tpu.recovery import RecoverySupervisor
@@ -635,6 +650,64 @@ class TPUDevice:
         )
         if self._journal_max_tokens < 1:
             raise ValueError("JOURNAL_MAX_TOKENS must be >= 1")
+        # overload brownout (gofr_tpu/deadline.py BrownoutController):
+        # thresholds arm the graded shed — queue depth and/or KV-block
+        # utilization; both 0 (the default) keeps the controller inert.
+        # BROWNOUT_SHED_PRIORITY is the tier boundary (level 1 sheds
+        # below it, level 2 sheds at-or-below it); BROWNOUT_CLAMP_TOKENS
+        # clamps max_tokens at level 2 (0 = never clamp).
+        from gofr_tpu.deadline import PRIORITY_MAX, PRIORITY_MIN
+
+        self._brownout_queue_hi = int(
+            config.get_or_default("BROWNOUT_QUEUE_DEPTH", "0")
+        )
+        if self._brownout_queue_hi < 0:
+            raise ValueError("BROWNOUT_QUEUE_DEPTH must be >= 0 (0 = off)")
+        self._brownout_kv_hi = float(
+            config.get_or_default("BROWNOUT_KV_UTIL", "0")
+        )
+        if not 0.0 <= self._brownout_kv_hi < 1.0:
+            raise ValueError(
+                "BROWNOUT_KV_UTIL must be a fraction in [0, 1) (0 = off)"
+            )
+        self._brownout_shed_priority = int(
+            config.get_or_default("BROWNOUT_SHED_PRIORITY", "5")
+        )
+        if not PRIORITY_MIN <= self._brownout_shed_priority <= PRIORITY_MAX:
+            raise ValueError(
+                f"BROWNOUT_SHED_PRIORITY must be {PRIORITY_MIN}.."
+                f"{PRIORITY_MAX}"
+            )
+        self._brownout_clamp = int(
+            config.get_or_default("BROWNOUT_CLAMP_TOKENS", "0")
+        )
+        if self._brownout_clamp < 0:
+            raise ValueError("BROWNOUT_CLAMP_TOKENS must be >= 0 (0 = off)")
+
+    def _brownout_queue_depth(self) -> int:
+        """Brownout signal: requests waiting for a prefill batch (queue
+        + cohort-displaced). 0 before the batcher exists (booting) —
+        brownout must never shed on a replica that has no queue yet."""
+        batcher = getattr(self, "batcher", None)
+        return batcher._depth() if batcher is not None else 0
+
+    def _brownout_kv_util(self) -> float:
+        """Brownout signal: fraction of the paged-KV ledger budget that
+        is COMMITTED — active rows plus admission reservations (0
+        without a paged pool). Cached prefix-cache blocks are excluded
+        on purpose: they are reclaimable (they evict the moment live
+        traffic needs blocks, the allocator's own admission math
+        excludes them too), and counting them would pin a warm,
+        otherwise-idle replica at level 2 forever."""
+        kv = getattr(self, "kv_pool", None)
+        if kv is None:
+            return 0.0
+        stats = kv.stats()
+        budget = stats.get("ledger") or stats.get("total") or 0
+        if not budget:
+            return 0.0
+        used = stats.get("active", 0) + stats.get("reserved", 0)
+        return min(1.0, used / budget)
 
     def _probe_devices(self) -> None:
         """First touch of the device runtime (can block/fail on a wedged
@@ -934,13 +1007,11 @@ class TPUDevice:
             lcp_min = 8  # echo has no compiled buckets to anchor on
         elif lcp_min < 0:
             lcp_min = 1 << 30  # -1 = exact-only, same as the row store
+        from gofr_tpu.deadline import pool_reject_counter
+
         self.runner.enable_paged_kv(
             HostPagedKV(pool, arena, lcp_min=lcp_min),
-            reject_counter=self.metrics.counter(
-                "gofr_tpu_pool_reject_total",
-                "decode-pool submit rejections (the request decoded solo)",
-                labels=("reason",),
-            ),
+            reject_counter=pool_reject_counter(self.metrics),
         )
         self.kv_pool = pool
 
@@ -1205,12 +1276,20 @@ class TPUDevice:
         adapter: Optional[str] = None,
         logprobs: bool = False,
         resume_from: int = 0,
+        cancel: Optional[Any] = None,
     ) -> Any:
         """Iterator of decoded token ids, yielded as they decode — the shared
         bridge for SSE and gRPC streaming transports. With ``logprobs=True``
         each item is a (token, raw_logprob) pair instead of a bare id.
         Closing the iterator (client disconnect) cancels the background
         decode instead of letting it run to completion unread.
+
+        ``cancel`` (a ``threading.Event``) is an EXTERNALLY-trippable
+        stop: the SSE responder's client-abort hook sets it the moment
+        a write fails, so an abandoned stream frees its decode slot and
+        paged-KV blocks within one chunk — without having to close a
+        generator that may be mid-``next`` on a pool thread. Omitted,
+        the stream creates its own private event (the old behavior).
 
         ``resume_from=k`` resumes an INTERRUPTED deterministic stream at
         token position k (the client already holds tokens 0..k-1):
@@ -1289,7 +1368,7 @@ class TPUDevice:
         snapshot = contextvars.copy_context()
         return self._stream_iter(
             tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs,
-            adapter_params, snapshot, resume_from,
+            adapter_params, snapshot, resume_from, cancel,
         )
 
     def _resume_producer(
@@ -1376,7 +1455,7 @@ class TPUDevice:
 
     def _stream_iter(
         self, tokens, max_new_tokens, sampler, stop_tokens, adapter, logprobs,
-        adapter_params=None, snapshot=None, resume_from=0,
+        adapter_params=None, snapshot=None, resume_from=0, cancel=None,
     ) -> Any:
         import queue as queue_mod
         import threading
@@ -1384,7 +1463,10 @@ class TPUDevice:
         out: "queue_mod.Queue" = queue_mod.Queue()
         done = object()
         failure: list[BaseException] = []
-        stop = threading.Event()
+        # the caller's cancel event (SSE abort hook) doubles as the
+        # producer's stop event so a tripped abort reaches the decode
+        # loop without touching this (possibly mid-next) generator
+        stop = cancel if cancel is not None else threading.Event()
         if resume_from:
             produce = self._resume_producer(
                 tokens, max_new_tokens, sampler, stop_tokens, adapter,
@@ -1553,6 +1635,9 @@ class TPUDevice:
             # interrupted (resumable), resume outcomes
             "journal": self.journal.stats() if self.journal is not None else None,
             "dispatches": self.timeline.stats(),
+            # overload-brownout state: live level, the signals behind
+            # it, thresholds, shed count (deadline-aware serving)
+            "brownout": self.brownout.snapshot(),
         }
         batcher = getattr(self, "batcher", None)
         snap["queue_depth"] = batcher._depth() if batcher is not None else None
@@ -2026,9 +2111,32 @@ class _EchoRunner:
     supports_resume = True
 
     def __init__(self, max_batch: int = 8, step_ms: float = 0.0,
-                 mesh_axes: Optional[dict] = None):
+                 mesh_axes: Optional[dict] = None, metrics: Any = None):
         self.max_batch = max_batch
         self.step_s = step_ms / 1000.0
+        # deadline-aware serving counters (one registration home:
+        # gofr_tpu/deadline.py — the registry dedupes with the
+        # batcher/pool registrations): the echo decode loop is the
+        # compile-free mirror of the pool's admission gate and
+        # per-chunk expiry check
+        from gofr_tpu.deadline import (
+            cancellations_counter,
+            deadline_exceeded_counter,
+            pool_reject_counter,
+        )
+
+        self._deadline_counter = (
+            deadline_exceeded_counter(metrics)
+            if metrics is not None else None
+        )
+        self._cancel_counter = (
+            cancellations_counter(metrics)
+            if metrics is not None else None
+        )
+        self._pool_reject = (
+            pool_reject_counter(metrics)
+            if metrics is not None else None
+        )
         # host-mesh mode (TPU_MESH on the echo runner): the parsed axis
         # dict; the device wires the paged host arena with tp shards so
         # mesh code paths run compile-free in tier-1
@@ -2128,12 +2236,39 @@ class _EchoRunner:
         stop_tokens = frozenset(stop_tokens or ())
         # prefill rides the REAL dynamic batcher so queue wait, batch
         # cohort, and the tpu-batch span behave exactly as on a device
+        # (and its dequeue-time deadline shed fires here, stage=queue)
         if prefill_batcher is not None:
             prefill_batcher.infer(ids)
         else:
             self.run_batch([ids])
         if ttft_cb:
             ttft_cb()
+        record = telemetry_record()
+        # deadline admission gate — the compile-free mirror of
+        # DecodePool._admit_deadline: a request whose remaining budget
+        # cannot cover even one decode step at the observed cadence is
+        # shed with the ``deadline`` pool-reject reason and a 504,
+        # before it reserves KV blocks or decodes a single token
+        from gofr_tpu.deadline import current_deadline
+
+        deadline = current_deadline()
+        if deadline is not None:
+            remaining = deadline.remaining()
+            if remaining <= 0 or remaining < self.step_s:
+                if self._pool_reject is not None:
+                    self._pool_reject.inc(reason="deadline")
+                if self._deadline_counter is not None:
+                    self._deadline_counter.inc(stage="admission")
+                if record is not None:
+                    record.note_pool_reject("deadline")
+                    record.note_shed("admission")
+                from gofr_tpu.errors import DeadlineExceeded
+
+                raise DeadlineExceeded(
+                    f"remaining deadline budget {max(remaining, 0) * 1000:.0f} "
+                    f"ms cannot cover one decode step (cadence "
+                    f"{self.step_s * 1000:.0f} ms)", stage="admission",
+                )
         # paged-KV admission (decode side, mirroring the real pool's
         # submit timing): reserve the request's block budget, aliasing
         # cached prefix blocks copy-free; exhaustion falls back to the
@@ -2144,7 +2279,6 @@ class _EchoRunner:
         if self.paged is not None:
             from gofr_tpu.tpu.kv_blocks import KVExhausted
 
-            record = telemetry_record()
             try:
                 seq = self.paged.admit(ids, max_new_tokens)
             except KVExhausted:
@@ -2176,6 +2310,23 @@ class _EchoRunner:
                         "echo runner closed mid-generation (engine "
                         "recovering)"
                     )
+                if deadline is not None and deadline.expired():
+                    # per-step expiry — the echo mirror of the pool's
+                    # per-chunk row check: the raise below unwinds
+                    # through the abort path, releasing the sequence's
+                    # KV blocks within this very step
+                    if self._deadline_counter is not None:
+                        self._deadline_counter.inc(stage="decode")
+                    if self._cancel_counter is not None:
+                        self._cancel_counter.inc(cause="deadline")
+                    if record is not None:
+                        record.note_shed("decode")
+                    from gofr_tpu.errors import DeadlineExceeded
+
+                    raise DeadlineExceeded(
+                        f"request deadline exceeded mid-decode (after "
+                        f"{len(out)} tokens)", stage="decode",
+                    )
                 token = int(src[i % src.size])
                 if token in stop_tokens:
                     break
@@ -2196,10 +2347,18 @@ class _EchoRunner:
                 self.paged.abort(seq)
             raise
         if seq is not None:
-            # trim the unused reservation (freed blocks admit the next
-            # request immediately) and store the conversation copy-free
-            # — the request's table BECOMES the cache entry
-            self.paged.finish(seq)
+            if stop is not None and stop.is_set():
+                # cancelled (client abort): release EVERYTHING — the
+                # free-block count returns to its pre-request baseline
+                # within this very step, and an abandoned partial
+                # generation never becomes a cache entry (mirroring the
+                # pool's cancelled path, which skips the KV hand-back)
+                self.paged.abort(seq)
+            else:
+                # trim the unused reservation (freed blocks admit the
+                # next request immediately) and store the conversation
+                # copy-free — the request's table BECOMES the cache entry
+                self.paged.finish(seq)
         if top_logprobs:
             return out, lps, tops
         return (out, lps) if logprobs else out
@@ -2353,6 +2512,7 @@ class _TransformerRunner:
         # cache-event counter callback; all optional (bare test runners)
         self.timeline = timeline
         self.watchdog = watchdog
+        self.metrics = metrics  # deadline-shed counters (solo decode)
         self._cache_events = cache_events or (lambda cache, event: None)
         # compiled-shape cache accounting: keys this runner has already
         # paid a compile for (seeded by warmup); a serving-path first-use
@@ -3074,6 +3234,13 @@ class _TransformerRunner:
         speculative chunk, whose results are simply abandoned."""
         from collections import deque
 
+        from gofr_tpu.deadline import current_deadline
+
+        # the solo path honors the per-chunk decode expiry too: a
+        # pool-rejected (no_free_slots / adapter-mix) request must not
+        # decode unmetered past its budget just because it fell out of
+        # the pool — same stage=decode contract as the pooled rows
+        deadline = current_deadline()
         max_len = int(cache["k"].shape[2])
         temp, tk, tp = sampler.temperature, sampler.top_k, sampler.top_p
         mp = sampler.min_p
@@ -3140,6 +3307,8 @@ class _TransformerRunner:
                 ]
             steps_in_flight -= n
             cache_len += n
+            if deadline is not None and deadline.expired():
+                self._shed_solo_decode(deadline, len(out))
             take = min(n, max_new_tokens - len(out))
             for j, t in enumerate(chunk[:take]):
                 if t in stop_tokens:
@@ -3158,6 +3327,30 @@ class _TransformerRunner:
             if len(out) >= max_new_tokens:
                 stopped = True
         return cache
+
+    def _shed_solo_decode(self, deadline: Any, emitted: int) -> None:
+        """Mid-flight expiry for the solo decode loop: same accounting
+        as the pooled per-chunk check (stage ``decode``, cause
+        ``deadline``, shed stage on the FlightRecord), then the
+        504-mapped raise — pending speculative chunks are abandoned
+        with the request."""
+        from gofr_tpu.deadline import (
+            cancellations_counter,
+            deadline_exceeded_counter,
+        )
+        from gofr_tpu.errors import DeadlineExceeded
+
+        if self.metrics is not None:
+            deadline_exceeded_counter(self.metrics).inc(stage="decode")
+            cancellations_counter(self.metrics).inc(cause="deadline")
+        record = telemetry_record()
+        if record is not None:
+            record.note_shed("decode")
+        raise DeadlineExceeded(
+            f"deadline expired mid-decode after {emitted} tokens "
+            f"(budget {deadline.budget_s * 1000:.0f} ms, solo path)",
+            stage="decode",
+        )
 
     def _can_chunk_prefill(self) -> bool:
         """Chunked prefill builds a [1]-row cache; under a mesh that only
@@ -3329,13 +3522,23 @@ class _TransformerRunner:
         immediately; the pool frees the slot at its next delivery — it
         checks stop too). Returns the finish-time KV row when the submit
         asked for one (("kv", row) precedes DONE), else None."""
-        from gofr_tpu.tpu.decode_pool import DONE, PoolFailure
+        from gofr_tpu.tpu.decode_pool import DEADLINE, DONE, PoolFailure
 
         kv_row = None
         while True:
             item = slot_q.get()
             if item is DONE:
                 return kv_row
+            if item is DEADLINE:
+                # the pool expired this row mid-decode (slot + KV
+                # already freed); surface the 504, never a silently
+                # truncated "ok" stream
+                from gofr_tpu.errors import DeadlineExceeded
+
+                raise DeadlineExceeded(
+                    "request deadline exceeded mid-decode "
+                    f"(after {len(out)} tokens)", stage="decode",
+                )
             if isinstance(item, PoolFailure):
                 raise item.exc
             if isinstance(item, tuple) and item and item[0] == "kv":
@@ -4414,7 +4617,8 @@ def _build_runner(
         from gofr_tpu.parallel.mesh import mesh_axes as _axes
 
         return _EchoRunner(
-            max_batch, step_ms=echo_step_ms, mesh_axes=_axes(mesh)
+            max_batch, step_ms=echo_step_ms, mesh_axes=_axes(mesh),
+            metrics=metrics,
         )
     if name in ("mlp", "tiny-mlp"):
         return _MLPRunner(quant, model_path, max_batch)

@@ -1,0 +1,655 @@
+"""Deadline-aware serving: end-to-end request deadlines, client-abort
+cancellation, and overload brownout (PR 10).
+
+Units: deadline/priority parsing and clamping, the batcher's
+pre-dispatch shed, the brownout controller's graded levels, and the
+fleet router's remaining-budget forwarding across a retry.
+
+Chaos e2e (echo runner — the full serving stack, compile-free): under
+a saturated decode path (a) a 50 ms-deadline request sheds at the
+queue/admission stage and never reaches the device, (b) a client that
+hard-closes its SSE stream mid-decode has its KV blocks reclaimed
+within one chunk, and (c) with brownout armed low-priority requests
+429 while high-priority requests keep serving — all asserted through
+/admin/engine, /admin/requests, and the new counters.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gofr_tpu.deadline import (
+    BrownoutController,
+    Deadline,
+    activate_deadline,
+    parse_deadline,
+    parse_priority,
+)
+from gofr_tpu.errors import DeadlineExceeded
+
+
+# -- parsing / clamping units -------------------------------------------------
+
+def test_parse_deadline_header_wins_over_default():
+    d = parse_deadline("250", 30.0, priority=7)
+    assert d is not None
+    assert d.budget_s == pytest.approx(0.25)
+    assert d.priority == 7
+    assert 0 < d.remaining() <= 0.25
+
+
+def test_parse_deadline_default_applies_without_header():
+    d = parse_deadline(None, 1.5)
+    assert d is not None
+    assert d.budget_s == pytest.approx(1.5)
+
+
+def test_parse_deadline_off_preserves_old_behavior():
+    assert parse_deadline(None, 0.0) is None
+    assert parse_deadline("", 0.0) is None
+    # an explicit 0 header opts OUT of a configured default
+    assert parse_deadline("0", 30.0) is None
+
+
+def test_parse_deadline_rejects_garbage():
+    from gofr_tpu.errors import HTTPError
+
+    with pytest.raises(HTTPError):
+        parse_deadline("soon", 0.0)
+    with pytest.raises(HTTPError):
+        parse_deadline("-5", 0.0)
+
+
+def test_parse_priority_clamps_and_rejects():
+    from gofr_tpu.errors import HTTPError
+
+    assert parse_priority(None) == 5
+    assert parse_priority("", default=3) == 3
+    assert parse_priority("7") == 7
+    assert parse_priority("99") == 9  # clamped into the tier range
+    assert parse_priority("-4") == 0
+    with pytest.raises(HTTPError):
+        parse_priority("high")
+
+
+def test_deadline_expiry():
+    d = Deadline(0.01, priority=2)
+    assert not d.expired()
+    time.sleep(0.02)
+    assert d.expired()
+    assert d.remaining() < 0
+    # the 504-mapped error every shed site raises
+    err = DeadlineExceeded("spent", stage="queue")
+    assert err.status_code == 504
+    assert err.stage == "queue"
+
+
+# -- batcher pre-dispatch shedding --------------------------------------------
+
+def test_batcher_sheds_expired_items_before_dispatch():
+    """An item whose deadline expired in the queue fails with a
+    504-mapped DeadlineExceeded and NEVER reaches run_batch; fresh
+    items dispatch normally."""
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.tpu.batcher import DynamicBatcher
+
+    seen: list = []
+    gate = threading.Event()
+
+    def run_batch(payloads):
+        if payloads == ["blocker"]:
+            gate.wait(5.0)
+        seen.extend(payloads)
+        return payloads
+
+    registry = Registry()
+    # ONE dispatch worker: the blocker parks it, so the doomed item
+    # expires while waiting for dispatch capacity
+    batcher = DynamicBatcher(
+        run_batch, max_batch=1, timeout_ms=1, metrics=registry,
+        name="t-shed", pipeline_depth=1,
+    )
+    try:
+        blocker = batcher.submit("blocker")
+        time.sleep(0.02)  # the blocker is inside run_batch now
+        activate_deadline(Deadline(0.03))
+        try:
+            doomed = batcher.submit("doomed")
+        finally:
+            activate_deadline(None)
+        time.sleep(0.06)  # expire while queued behind the blocker
+        gate.set()
+        assert blocker.result(timeout=5) == "blocker"
+        with pytest.raises(DeadlineExceeded) as err:
+            doomed.result(timeout=5)
+        assert err.value.stage == "queue"
+        assert "doomed" not in seen  # never dispatched
+        fresh = batcher.submit("fresh")
+        assert fresh.result(timeout=5) == "fresh"
+        counter = registry.counter(
+            "gofr_tpu_deadline_exceeded_total", labels=("stage",)
+        )
+        assert counter.value(stage="queue") >= 1
+    finally:
+        gate.set()
+        batcher.close()
+
+
+def test_batcher_skips_cancelled_items_at_dequeue():
+    """A future cancelled while queued is skipped at dequeue — it never
+    consumes a cohort slot (satellite of the delivery-time check)."""
+    from gofr_tpu.tpu.batcher import DynamicBatcher
+
+    seen: list = []
+    gate = threading.Event()
+
+    def run_batch(payloads):
+        gate.wait(2.0)
+        seen.extend(payloads)
+        return payloads
+
+    batcher = DynamicBatcher(run_batch, max_batch=1, timeout_ms=1)
+    try:
+        blocker = batcher.submit("blocker")
+        victim = batcher.submit("victim")
+        assert victim.cancel()  # caller walked away while queued
+        gate.set()
+        assert blocker.result(timeout=5) == "blocker"
+        survivor = batcher.submit("survivor")
+        assert survivor.result(timeout=5) == "survivor"
+        assert "victim" not in seen
+    finally:
+        batcher.close()
+
+
+# -- brownout controller units ------------------------------------------------
+
+def test_brownout_levels_and_graded_shedding():
+    depth = {"value": 0}
+    controller = BrownoutController(
+        queue_hi=10, kv_hi=0.8, shed_priority=5, clamp_tokens=16,
+        queue_depth_fn=lambda: depth["value"],
+        kv_util_fn=lambda: 0.0,
+        refresh_s=0.0,
+    )
+    # normal: everyone admitted, nothing clamped
+    ok, tokens, level = controller.admit(0, 512)
+    assert (ok, tokens, level) == (True, 512, 0)
+    # level 1: queue at threshold — below-floor priorities shed
+    depth["value"] = 10
+    assert controller.level() == 1
+    ok, _, _ = controller.admit(4, 512)
+    assert not ok
+    ok, tokens, _ = controller.admit(5, 512)
+    assert ok and tokens == 512  # no clamp below level 2
+    # level 2: queue at 2x — at-or-below-floor sheds, max_tokens clamps
+    depth["value"] = 20
+    assert controller.level() == 2
+    ok, _, _ = controller.admit(5, 512)
+    assert not ok
+    ok, tokens, _ = controller.admit(6, 512)
+    assert ok and tokens == 16
+    snap = controller.snapshot()
+    assert snap["level"] == 2 and snap["sheds"] == 2
+    assert snap["signals"]["queue_depth"] == 20
+
+
+def test_brownout_kv_signal_and_disarmed_controller():
+    util = {"value": 0.0}
+    controller = BrownoutController(
+        kv_hi=0.8, kv_util_fn=lambda: util["value"], refresh_s=0.0,
+    )
+    assert controller.level() == 0
+    util["value"] = 0.85
+    assert controller.level() == 1
+    util["value"] = 0.95  # past the (kv_hi + (1-kv_hi)/2) hard mark
+    assert controller.level() == 2
+    inert = BrownoutController(queue_depth_fn=lambda: 10 ** 6)
+    assert not inert.armed
+    assert inert.level() == 0
+    assert inert.admit(0, 8) == (True, 8, 0)
+
+
+# -- echo e2e helpers ---------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def echo_app(tmp_path, monkeypatch):
+    """A saturatable echo app: 1-wide batches with a real per-token
+    cadence (ECHO_STEP_MS), a SMALL paged-KV arena (32 blocks) and the
+    brownout controller armed on KV utilization — a handful of
+    long-budget streams is 'overload'."""
+    import gofr_tpu
+    from gofr_tpu.openai_compat import register_openai_routes
+
+    port = _free_port()
+    env = {
+        "HTTP_PORT": str(port), "LOG_LEVEL": "FATAL",
+        "MODEL_NAME": "echo", "TOKENIZER": "byte",
+        "BATCH_MAX_SIZE": "1", "BATCH_TIMEOUT_MS": "1",
+        "ECHO_STEP_MS": "15", "FLIGHT_SLOW_MS": "60000",
+        "KV_BLOCKS": "32",
+        "BROWNOUT_KV_UTIL": "0.5", "BROWNOUT_CLAMP_TOKENS": "4",
+        "TIMEBASE_ENABLED": "off",
+        "GRPC_PORT": str(_free_port()),
+    }
+    for key, value in env.items():
+        monkeypatch.setenv(key, value)
+    monkeypatch.chdir(tmp_path)
+    app = gofr_tpu.new()
+    register_openai_routes(app)
+    app.start()
+    yield app, f"http://127.0.0.1:{port}"
+    app.shutdown()
+
+
+def _post(base, payload, path="/v1/completions", headers=None,
+          timeout=30):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read())["data"]
+
+
+def _counter_value(base, name, **labels):
+    """Read one counter series off /metrics (classic exposition)."""
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    want = name + "{" if labels else name
+    for line in text.splitlines():
+        if not line.startswith(want):
+            continue
+        if all(f'{k}="{v}"' in line for k, v in labels.items()):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def _background_streams(base, n, max_tokens=300, prompt_width=3):
+    """Open n SSE streams and keep reading them on daemon threads —
+    the saturation load the deadline/brownout cases shed against (wide
+    prompts + long budgets reserve real KV blocks). Returns a stop
+    event."""
+    stop = threading.Event()
+    started = threading.Event()
+
+    def pump() -> None:
+        body = json.dumps({
+            "prompt": list(range(1, prompt_width + 1)),
+            "max_tokens": max_tokens,
+            "stream": True, "temperature": 0,
+        }).encode()
+        req = urllib.request.Request(
+            base + "/v1/completions", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                started.set()
+                while not stop.is_set():
+                    if not resp.read(256):
+                        break
+        except Exception:
+            started.set()  # saturated enough that even this one shed
+
+    threads = [
+        threading.Thread(target=pump, daemon=True, name=f"gofr-test-load-{i}")
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    started.wait(10)
+    return stop
+
+
+# -- e2e: deadline threading (header -> record -> stages) ---------------------
+
+def test_deadline_header_stamps_flight_record(echo_app):
+    _, base = echo_app
+    status, body, _ = _post(
+        base, {"prompt": [1, 2, 3], "max_tokens": 3, "temperature": 0},
+        headers={"X-Request-Deadline-Ms": "30000", "X-Priority": "8"},
+    )
+    assert status == 200
+    records = _get(base, "/admin/requests")["requests"]
+    mine = [r for r in records if r["deadline_s"] is not None]
+    assert mine, records
+    rec = mine[0]
+    assert rec["deadline_s"] == pytest.approx(30.0)
+    assert rec["priority"] == 8
+    assert rec["shed_stage"] is None
+    assert rec["status"] == "ok"
+
+
+def test_no_deadline_by_default(echo_app):
+    _, base = echo_app
+    status, _, _ = _post(
+        base, {"prompt": [4], "max_tokens": 2, "temperature": 0},
+    )
+    assert status == 200
+    rec = _get(base, "/admin/requests")["requests"][0]
+    assert rec["deadline_s"] is None
+    # priority records even without a deadline: it is the tier the
+    # brownout controller sheds by (PRIORITY_DEFAULT absent a header)
+    assert rec["priority"] == 5
+
+
+def test_malformed_deadline_header_is_400(echo_app):
+    _, base = echo_app
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(base, {"prompt": [1], "max_tokens": 1},
+              headers={"X-Request-Deadline-Ms": "whenever"})
+    assert err.value.code == 400
+
+
+def test_expired_request_sheds_before_the_device(echo_app):
+    """Acceptance (a): with every prefill-dispatch worker stalled (a
+    saturated device), a 50 ms-deadline request 504s at the queue
+    stage — its flight record carries the shed stage and NO dispatch
+    ids (it never reached the device), and the stage counter moved."""
+    app, base = echo_app
+    runner = app.container.tpu.runner
+    before = _counter_value(
+        base, "gofr_tpu_deadline_exceeded_total", stage="queue"
+    )
+    # stall every run_batch 120 ms: both dispatch-pool workers park,
+    # so the doomed item's 50 ms budget expires before any dispatch
+    runner.stall_hook = lambda: time.sleep(0.12)
+    occupiers = []
+    try:
+        def occupy() -> None:
+            try:
+                _post(base, {"prompt": [9], "max_tokens": 1,
+                             "temperature": 0})
+            except Exception:
+                pass  # only there to hold a dispatch worker
+
+        for i in range(2):  # batcher pipeline_depth = 2 workers
+            t = threading.Thread(target=occupy, daemon=True,
+                                 name=f"gofr-test-occupy-{i}")
+            t.start()
+            occupiers.append(t)
+        time.sleep(0.05)  # both occupiers inside the stalled run_batch
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(
+                base, {"prompt": [1, 2, 3], "max_tokens": 50,
+                       "temperature": 0},
+                headers={"X-Request-Deadline-Ms": "50"},
+            )
+        assert err.value.code == 504
+        payload = json.loads(err.value.read())
+        assert "deadline" in payload["error"]["message"]
+    finally:
+        runner.stall_hook = None
+        for t in occupiers:
+            t.join(timeout=10)
+    after = _counter_value(
+        base, "gofr_tpu_deadline_exceeded_total", stage="queue"
+    )
+    assert after >= before + 1
+    records = _get(base, "/admin/requests?errored=true")["requests"]
+    shed = [r for r in records if r["status"] == "deadline_exceeded"]
+    assert shed, records
+    rec = shed[0]
+    assert rec["shed_stage"] == "queue"
+    assert rec["dispatch_ids"] == []  # never carried by a device dispatch
+
+
+def test_decode_stage_expiry_mid_generation(echo_app):
+    """A deadline generous enough to clear admission but too small for
+    the full generation expires mid-decode: 504 (non-stream), shed
+    stage decode, cancellations{cause=deadline} counts."""
+    _, base = echo_app
+    before = _counter_value(
+        base, "gofr_tpu_cancellations_total", cause="deadline"
+    )
+    # ~15 ms/token x 100 tokens >> 150 ms budget; admission passes
+    # (budget covers one step) and the loop expires partway
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(
+            base, {"prompt": [5, 6], "max_tokens": 100, "temperature": 0},
+            headers={"X-Request-Deadline-Ms": "150"},
+        )
+    assert err.value.code == 504
+    after = _counter_value(
+        base, "gofr_tpu_cancellations_total", cause="deadline"
+    )
+    assert after >= before + 1
+    records = _get(base, "/admin/requests?errored=true")["requests"]
+    mine = [r for r in records if r["shed_stage"] == "decode"]
+    assert mine, records
+
+
+# -- e2e: client-abort cancellation (acceptance b) ----------------------------
+
+def _kv_free(base) -> int:
+    return _get(base, "/admin/engine")["kv_blocks"]["free"]
+
+
+def test_abandoning_client_reclaims_kv_within_one_chunk(echo_app):
+    """Acceptance (b): a client that hard-closes its SSE socket
+    mid-stream has the stream's KV blocks reclaimed within ~one decode
+    step, and the abort is counted and recorded."""
+    from gofr_tpu.devtools.chaos import abandoning_client
+
+    _, base = echo_app
+    prompt = list(range(1, 200))  # ~4 KV blocks wide
+    # warm the prompt into the prefix cache FIRST: admission caches a
+    # never-seen prompt by design (copy-free store), and the baseline
+    # must not mistake that deliberate entry for a leak
+    status, _, _ = _post(
+        base, {"prompt": prompt, "max_tokens": 1, "temperature": 0},
+        timeout=60,
+    )
+    assert status == 200
+    baseline = _kv_free(base)
+    before = _counter_value(
+        base, "gofr_tpu_cancellations_total", cause="client_abort"
+    )
+    body = json.dumps({
+        # the warmed prompt aliases its cached blocks; a budget long
+        # enough that the abort clearly lands mid-generation
+        "prompt": prompt, "max_tokens": 400,
+        "stream": True, "temperature": 0,
+    }).encode()
+    frames = abandoning_client(base, "/v1/completions", body, frames=3)
+    assert len(frames) == 3
+    # the engine must notice within one chunk: the next write fails,
+    # the abort hook trips the stop event, and the paged sequence
+    # aborts. Poll briefly (the write failure needs one more token).
+    deadline = time.monotonic() + 5.0
+    reclaimed = False
+    while time.monotonic() < deadline:
+        if _kv_free(base) >= baseline:
+            reclaimed = True
+            break
+        time.sleep(0.02)
+    assert reclaimed, (
+        f"KV blocks leaked: free={_kv_free(base)} baseline={baseline}"
+    )
+    after = _counter_value(
+        base, "gofr_tpu_cancellations_total", cause="client_abort"
+    )
+    assert after >= before + 1
+    records = _get(base, "/admin/requests?errored=true")["requests"]
+    assert any(r["status"] == "cancelled" for r in records), records
+
+
+# -- e2e: brownout (acceptance c) ---------------------------------------------
+
+def test_brownout_sheds_low_priority_serves_high(echo_app):
+    """Acceptance (c): with brownout armed (queue threshold 2) and the
+    queue saturated, a low-priority request 429s with Retry-After
+    while a high-priority request still completes; the level is
+    visible on /admin/engine and the gauge."""
+    _, base = echo_app
+    # wide prompts + long budgets: 4 streams reserve ~28 of the 32 KV
+    # blocks, pushing utilization past the 0.5 threshold (and usually
+    # past the 0.75 hard mark)
+    stop = _background_streams(base, 4, max_tokens=300, prompt_width=99)
+    try:
+        # wait for the armed signal to cross (prober-style poll)
+        level = 0
+        poll_deadline = time.monotonic() + 10.0
+        while time.monotonic() < poll_deadline:
+            level = _get(base, "/admin/engine")["brownout"]["level"]
+            if level >= 1:
+                break
+            time.sleep(0.05)
+        assert level >= 1, _get(base, "/admin/engine")["brownout"]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(
+                base, {"prompt": [1], "max_tokens": 1, "temperature": 0},
+                headers={"X-Priority": "0"},
+            )
+        assert err.value.code == 429
+        assert err.value.headers.get("Retry-After")
+        payload = json.loads(err.value.read())
+        assert "brownout" in payload["error"]["message"]
+        status, body, _ = _post(
+            base, {"prompt": [2, 3], "max_tokens": 2, "temperature": 0},
+            headers={"X-Priority": "9"}, timeout=60,
+        )
+        assert status == 200
+        assert body["choices"][0]["text"] is not None
+    finally:
+        stop.set()
+    snap = _get(base, "/admin/engine")["brownout"]
+    assert snap["armed"] is True
+    assert snap["sheds"] >= 1
+    assert _counter_value(
+        base, "gofr_tpu_brownout_shed_total", priority="0"
+    ) >= 1
+
+
+def test_brownout_level_metric_exposed(echo_app):
+    _, base = echo_app
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    assert "gofr_tpu_brownout_level" in text
+
+
+# -- fleet router: remaining-budget forwarding --------------------------------
+
+@pytest.fixture()
+def budget_fleet(tmp_path, monkeypatch):
+    """Two device-free replicas that RECORD the deadline header they
+    receive — the first 503s its first request (forcing a retry), the
+    second serves. Fronted by a real FleetRouter."""
+    import gofr_tpu
+    from gofr_tpu.devtools.chaos import _env_overrides, chaos_router
+    from gofr_tpu.http.response import Raw
+
+    seen: dict[str, list] = {"r0": [], "r1": []}
+    # BOTH replicas fail their first request: whichever the router
+    # picks first forces a retry, deterministically
+    fail_first = {"r0": True, "r1": True}
+
+    def make_handler(name):
+        def handler(ctx):
+            seen[name].append(ctx.request.header("X-Request-Deadline-Ms"))
+            if fail_first.get(name):
+                fail_first[name] = False
+                time.sleep(0.2)  # burn visible budget before failing
+                from gofr_tpu.errors import HTTPError
+
+                raise HTTPError(503, "warming up")
+            return Raw({"served_by": name})
+        return handler
+
+    apps = []
+    replicas = []
+    for name in ("r0", "r1"):
+        port = _free_port()
+        with _env_overrides({
+            "HTTP_PORT": str(port), "LOG_LEVEL": "FATAL",
+            "MODEL_NAME": None, "TPU_ENABLED": None,
+            "TIMEBASE_ENABLED": "off", "GRPC_PORT": str(_free_port()),
+        }):
+            app = gofr_tpu.new()
+            app.post("/v1/completions", make_handler(name))
+            app.start()
+        apps.append(app)
+
+        class _Stub:
+            def __init__(self, name, port):
+                self.name = name
+                self.port = port
+                self.address = f"http://127.0.0.1:{port}"
+
+        replicas.append(_Stub(name, port))
+    with chaos_router(replicas, env={
+        "FLEET_RETRIES": "2", "FLEET_DEADLINE_S": "30",
+        "FLEET_AFFINITY": "off",
+    }) as router_app:
+        # both replicas healthy in rotation
+        fleet = router_app.container.fleet
+        poll_deadline = time.monotonic() + 10.0
+        while time.monotonic() < poll_deadline:
+            if len(fleet.replica_set.in_rotation()) == 2:
+                break
+            time.sleep(0.05)
+        port = router_app.http_server.port
+        yield f"http://127.0.0.1:{port}", seen
+    for app in apps:
+        app.shutdown()
+
+
+def test_router_forwards_remaining_budget_across_retry(budget_fleet):
+    """The second attempt must see a SMALLER X-Request-Deadline-Ms than
+    the first (the failed attempt's elapsed time is subtracted), and
+    both must be bounded by the client's own budget."""
+    base, seen = budget_fleet
+    status, body, _ = _post(
+        base, {"prompt": [1], "max_tokens": 1},
+        headers={"X-Request-Deadline-Ms": "5000"},
+    )
+    assert status == 200
+    budgets = [int(v) for v in seen["r0"] + seen["r1"] if v]
+    assert len(budgets) >= 2, seen
+    first, second = budgets[0], budgets[-1]
+    assert first <= 5000  # capped at the client's budget
+    # the failed attempt slept 200 ms before 503ing: the retry's
+    # forwarded budget must be visibly smaller
+    assert second <= first - 150, (first, second)
+    assert second >= 1  # floored per attempt, never zero/negative
+
+
+def test_router_never_mints_a_deadline(budget_fleet):
+    """A request with no deadline header — and an explicit ``0``
+    opt-out — must reach the replica with its header untouched: the
+    router caps and re-stamps only budgets the client actually set
+    (FLEET_DEADLINE_S bounds the router's own forwarding, it must not
+    become an engine-enforced deadline the client never asked for)."""
+    base, seen = budget_fleet
+    status, _, _ = _post(base, {"prompt": [1], "max_tokens": 1})
+    assert status == 200
+    # every attempt (failing firsts + the serving retry) saw NO header
+    # (absent reads back as "")
+    assert seen["r0"] + seen["r1"], seen
+    assert all(not v for v in seen["r0"] + seen["r1"]), seen
+    status, _, _ = _post(
+        base, {"prompt": [1], "max_tokens": 1},
+        headers={"X-Request-Deadline-Ms": "0"},
+    )
+    assert status == 200
+    stamped = [v for v in seen["r0"] + seen["r1"] if v]
+    assert stamped and all(v == "0" for v in stamped), seen

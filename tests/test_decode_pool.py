@@ -160,6 +160,111 @@ def test_pooled_cancellation_frees_slot(pooled):
     assert len(pooled.generate([1, 2, 3], max_new_tokens=5)) == 5
 
 
+def test_pool_deadline_admission_reject_accounting(pooled):
+    """The submit-time deadline gate: a spent budget rejects with the
+    ``deadline`` pool-reject reason stamped on the FlightRecord, the
+    ``admission`` stage on the shared counter, and a DeadlineExceeded
+    raise (NO solo fallback — solo is slower, not faster)."""
+    import time as _time
+
+    from gofr_tpu.deadline import Deadline, activate_deadline
+    from gofr_tpu.errors import DeadlineExceeded
+    from gofr_tpu.telemetry import FlightRecorder, activate_record
+
+    pool = pooled.decode_pool
+    recorder = FlightRecorder()
+    record = recorder.start(model="tiny", endpoint="/test")
+    expired = Deadline(0.001)
+    _time.sleep(0.005)
+    try:
+        with pool._work:
+            with pytest.raises(DeadlineExceeded) as err:
+                pool._admit_deadline(expired)
+        assert err.value.stage == "admission"
+        assert record.pool_reject_reason == "deadline"
+        assert record.shed_stage == "admission"
+        # a live-but-insufficient budget rejects too once a cadence is
+        # observed (cannot cover even one chunk) — but only while rows
+        # are DECODING: on an idle pool the cadence is stale (a single
+        # anomalous chunk must not wedge the gate into rejecting
+        # everything forever) and the chunk runs immediately anyway
+        pool._chunk_ema_s = max(pool._chunk_ema_s, 0.05)
+        thin = Deadline(0.01)
+        with pool._work:
+            assert not pool._active
+            pool._admit_deadline(thin)  # idle: stale cadence bypassed
+            pool._active[0] = pool._slots[0]
+            try:
+                with pytest.raises(DeadlineExceeded):
+                    pool._admit_deadline(Deadline(0.01))
+            finally:
+                del pool._active[0]
+        # a roomy budget admits
+        with pool._work:
+            pool._admit_deadline(Deadline(30.0))
+    finally:
+        activate_record(None)
+        recorder.finish(record)
+
+
+def test_pool_deadline_expiry_mid_stream_frees_slot(pooled):
+    """Per-chunk row expiry: a deadline that expires mid-generation
+    ends the pooled stream with DeadlineExceeded (stage decode), and
+    the slot + KV budget are free for the next request."""
+    from gofr_tpu.deadline import Deadline, activate_deadline
+    from gofr_tpu.errors import DeadlineExceeded
+
+    d = Deadline(30.0)
+    seen = []
+
+    def on_token(t):
+        seen.append(t)
+        if len(seen) == 2:
+            # force expiry mid-stream, deterministically (no sleeps):
+            # the worker's next per-chunk check sees it
+            d.t_deadline = 0.0
+
+    activate_deadline(d)
+    try:
+        with pytest.raises(DeadlineExceeded) as err:
+            pooled.generate([1, 2, 3], max_new_tokens=200,
+                            on_token=on_token)
+        assert err.value.stage == "decode"
+    finally:
+        activate_deadline(None)
+    assert 0 < len(seen) < 200
+    # slot must be free again: another full round completes
+    assert len(pooled.generate([1, 2, 3], max_new_tokens=5)) == 5
+
+
+def test_solo_deadline_expiry_mid_decode(solo):
+    """The SOLO path honors the per-chunk decode expiry too: a request
+    that fell out of the pool (or a pool-off deployment) must not
+    decode unmetered past its budget."""
+    from gofr_tpu.deadline import Deadline, activate_deadline
+    from gofr_tpu.errors import DeadlineExceeded
+
+    d = Deadline(30.0)
+    seen = []
+
+    def on_token(t):
+        seen.append(t)
+        if len(seen) == 2:
+            d.t_deadline = 0.0
+
+    activate_deadline(d)
+    try:
+        with pytest.raises(DeadlineExceeded) as err:
+            solo.generate([1, 2, 3], max_new_tokens=200,
+                          on_token=on_token)
+        assert err.value.stage == "decode"
+    finally:
+        activate_deadline(None)
+    assert 0 < len(seen) < 200
+    # the device serves the next request normally
+    assert len(solo.generate([1, 2, 3], max_new_tokens=5)) == 5
+
+
 def test_cache_bound_in_pool(pooled, solo):
     # tiny max_seq=128; prompt 100 -> at most 28-ish decodes
     out = pooled.generate(list(range(1, 100)), max_new_tokens=300)

@@ -187,6 +187,20 @@ def test_gfl005_mesh_family_covered():
         ["GFL005"]
 
 
+def test_gfl005_deadline_family_covered():
+    """The deadline/brownout family (deadline.py, batcher.py,
+    decode_pool.py): the _level gauge suffix and the stage/cause
+    counters pass; suffix drift within the family still fails."""
+    assert lint('m.gauge("gofr_tpu_brownout_level", "b")\n') == []
+    assert lint('m.counter("gofr_tpu_deadline_exceeded_total", "d")\n') == []
+    assert lint('m.counter("gofr_tpu_cancellations_total", "c")\n') == []
+    assert lint('m.counter("gofr_tpu_brownout_shed_total", "s")\n') == []
+    assert rules_of(lint('m.gauge("gofr_tpu_brownout", "b")\n')) == \
+        ["GFL005"]
+    assert rules_of(lint('m.counter("gofr_tpu_deadline_exceeded", "d")\n')) \
+        == ["GFL005"]
+
+
 def test_gfl005_router_family_covered():
     """The gofr_tpu_router_* family (fleet/router.py) rides the same
     convention: the suffix table must keep accepting its gauges (_state,

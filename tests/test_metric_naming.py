@@ -24,7 +24,7 @@ _COUNTER_SUFFIXES = ("_total",)
 _HISTOGRAM_SUFFIXES = ("_seconds", "_bytes", "_size")
 _GAUGE_SUFFIXES = (
     "_seconds", "_bytes", "_total", "_depth", "_ratio", "_entries",
-    "_active", "_acceptance", "_state", "_blocks", "_size",
+    "_active", "_acceptance", "_state", "_blocks", "_size", "_level",
 )
 # roofline utilization gauges: the suffix IS the (well-known) metric name
 _GAUGE_ALLOWLIST = {"gofr_tpu_mfu", "gofr_tpu_mbu"}
@@ -65,6 +65,11 @@ def test_scanner_sees_the_known_registrations():
             "gofr_tpu_mesh_degrade_total"} <= names
     # the cardinality guard's overflow ledger (metrics.py Registry)
     assert "gofr_tpu_metrics_dropped_series_total" in names
+    # deadline-aware serving + overload brownout (PR 10)
+    assert {"gofr_tpu_deadline_exceeded_total",
+            "gofr_tpu_cancellations_total",
+            "gofr_tpu_brownout_level",
+            "gofr_tpu_brownout_shed_total"} <= names
     # the fleet front door (fleet/router.py FleetRouter._init_metrics):
     # every routing/retry/shed/breaker decision must stay scan-visible
     assert {"gofr_tpu_router_requests_total",

@@ -29,7 +29,15 @@ baseline:
 - the durable generation journal (resumable streams) must stay
   per-token cheap: ``journal_microbench.per_token_us <= baseline *
   BENCH_GATE_JOURNAL_FACTOR`` (default 5.0 — the journal append is a
-  GIL-atomic list append; a regression here taxes EVERY stream).
+  GIL-atomic list append; a regression here taxes EVERY stream);
+- deadline-aware serving must stay fast at saying no:
+  ``shed_microbench.shed_p50_us <= baseline *
+  BENCH_GATE_SHED_FACTOR`` (default 10.0, loose-first — the shed path
+  is what overload leans on) and an abandoned stream's KV blocks must
+  reclaim within ``baseline reclaim_ms * BENCH_GATE_RECLAIM_FACTOR``
+  (default 10.0 — "within one chunk" is the contract; an order of
+  magnitude past baseline means the abort hook stopped reaching the
+  decode loop).
 
 Usage::
 
@@ -60,6 +68,8 @@ def gate(bench: dict, baseline: dict) -> list[str]:
     kv_factor = float(os.environ.get("BENCH_GATE_KV_FACTOR", "3.0"))
     mesh_factor = float(os.environ.get("BENCH_GATE_MESH_FACTOR", "5.0"))
     journal_factor = float(os.environ.get("BENCH_GATE_JOURNAL_FACTOR", "5.0"))
+    shed_factor = float(os.environ.get("BENCH_GATE_SHED_FACTOR", "10.0"))
+    reclaim_factor = float(os.environ.get("BENCH_GATE_RECLAIM_FACTOR", "10.0"))
 
     if bench.get("backend") != baseline.get("backend"):
         failures.append(
@@ -143,6 +153,32 @@ def gate(bench: dict, baseline: dict) -> list[str]:
                 f"{base_token}us * {journal_factor} "
                 f"(= {base_token * journal_factor:.3f}us)"
             )
+    shed = bench.get("shed_microbench") or {}
+    base_shed = baseline.get("shed_microbench") or {}
+    if base_shed:
+        p50, base_p50 = _num(shed, "shed_p50_us"), _num(base_shed, "shed_p50_us")
+        if p50 is None:
+            failures.append("shed_microbench missing from the bench artifact")
+        elif base_p50 and p50 > base_p50 * shed_factor:
+            failures.append(
+                f"deadline shed latency regression: {p50}us > "
+                f"{base_p50}us * {shed_factor} "
+                f"(= {base_p50 * shed_factor:.1f}us)"
+            )
+        reclaim = _num(shed, "reclaim_ms")
+        base_reclaim = _num(base_shed, "reclaim_ms")
+        if base_reclaim:
+            if reclaim is None:
+                failures.append(
+                    "abandoned-stream KV blocks never reclaimed "
+                    "(reclaim_ms null in the bench artifact)"
+                )
+            elif reclaim > base_reclaim * reclaim_factor:
+                failures.append(
+                    f"abandoned-stream reclaim regression: {reclaim}ms > "
+                    f"{base_reclaim}ms * {reclaim_factor} "
+                    f"(= {base_reclaim * reclaim_factor:.1f}ms)"
+                )
     return failures
 
 
