@@ -570,6 +570,17 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
             except Exception as exc:
                 errors.append(f"shed phase: {exc}")
                 traceback.print_exc(file=sys.stderr)
+            # -- phase: disaggregated KV handoff (ROADMAP 1 tentpole) ----------
+            # cross-replica transfer vs local prefill on two in-process
+            # echo replicas over real HTTP, plus the wire bytes one
+            # pull moves; gated loose-first against bench_baseline.json
+            # (BENCH_GATE_TRANSFER_FACTOR)
+            try:
+                result["transfer_microbench"] = _measure_kv_transfer()
+                log(f"kv transfer: {result['transfer_microbench']}")
+            except Exception as exc:
+                errors.append(f"kv-transfer phase: {exc}")
+                traceback.print_exc(file=sys.stderr)
             engine_live = _scrape_engine(base)
             if engine_live.get("kv_blocks") is not None:
                 result["kv_blocks"] = engine_live["kv_blocks"]
@@ -1000,6 +1011,100 @@ def _measure_shed() -> dict:
         }
     finally:
         device.close()
+
+
+def _measure_kv_transfer() -> dict:
+    """Disaggregated KV handoff, measured end to end on two in-process
+    echo replicas over real HTTP (the same chaos-harness replicas the
+    fleet e2es use):
+
+    - **transfer latency** — a donor-warmed prompt served by the OTHER
+      replica with the router's ``X-KV-Donor`` stamp: pull + verify +
+      install + aliased admission (the disaggregated fast path);
+    - **local-prefill latency** — the identical-size cold prompt on the
+      same replica with no donor: what the fallback costs, and the
+      number a transfer must beat on real hardware to pay for itself;
+    - **bytes moved** — one pull's wire size off the real
+      ``GET /admin/kv/<hash>`` endpoint (header + per-block CRC frames
+      + trailer), the cross-replica traffic each handoff costs.
+
+    Echo "KV" is token ids, so the ratio here prices the PROTOCOL
+    (HTTP + framing + checksums + install), not saved prefill compute.
+    Gated loose-first vs bench_baseline.json
+    (``BENCH_GATE_TRANSFER_FACTOR``)."""
+    from gofr_tpu.devtools.chaos import chaos_fleet
+    from gofr_tpu.fleet import kvwire
+
+    prompt_tokens = int(os.environ.get("BENCH_TRANSFER_PROMPT", "96"))
+    rounds = int(os.environ.get("BENCH_TRANSFER_ROUNDS", "8"))
+    fleet_env = {
+        "ECHO_STEP_MS": "0",
+        "KV_BLOCK_TOKENS": "16",  # 96-token prompts span 6 blocks
+        "KV_TRANSFER_TIMEOUT_S": "5",
+        "WATCHDOG_DISPATCH_TIMEOUT_S": "30",
+    }
+
+    def generate_ms(replica, tokens, donor=None):
+        headers = {"Content-Type": "application/json"}
+        if donor is not None:
+            headers["X-KV-Donor"] = donor.address
+        req = urllib.request.Request(
+            replica.address + "/generate",
+            data=json.dumps(
+                {"tokens": tokens, "max_new_tokens": 1}
+            ).encode(),
+            headers=headers,
+            method="POST",
+        )
+        start = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()
+        return (time.perf_counter() - start) * 1e3
+
+    with chaos_fleet(2, env=fleet_env) as (donor, receiver):
+        transfer_ms: list[float] = []
+        local_ms: list[float] = []
+        for i in range(rounds):
+            # fresh prompts per round: a locally-warm prompt skips the
+            # pull, so reuse would measure the cache, not the transfer
+            warm = [(j % 251) + 1 for j in range(
+                i * prompt_tokens, (i + 1) * prompt_tokens
+            )]
+            cold = [(j % 251) + 1 for j in range(
+                (rounds + i) * prompt_tokens,
+                (rounds + i + 1) * prompt_tokens,
+            )]
+            generate_ms(donor, warm)  # the donor prefills + caches it
+            transfer_ms.append(generate_ms(receiver, warm, donor=donor))
+            local_ms.append(generate_ms(receiver, cold))
+        # one pull's wire bytes, measured off the real endpoint
+        probe = [(j % 251) + 1 for j in range(prompt_tokens)]
+        with urllib.request.urlopen(
+            donor.address + f"/admin/kv/{kvwire.prompt_hash(probe)}",
+            timeout=10,
+        ) as resp:
+            wire_bytes = len(resp.read())
+        # the receiver's own ledger proves the fast path actually ran
+        with urllib.request.urlopen(
+            receiver.address + "/admin/engine", timeout=10
+        ) as resp:
+            stats = json.loads(resp.read())["data"]["kv_transfer"]
+    if stats.get("ok", 0) < rounds:
+        raise RuntimeError(
+            f"only {stats.get('ok', 0)}/{rounds} pulls took the "
+            f"transfer fast path: {stats}"
+        )
+    transfer_ms.sort()
+    local_ms.sort()
+    return {
+        "prompt_tokens": prompt_tokens,
+        "rounds": rounds,
+        "transfer_ms_p50": round(transfer_ms[len(transfer_ms) // 2], 3),
+        "local_prefill_ms_p50": round(local_ms[len(local_ms) // 2], 3),
+        "wire_bytes_per_pull": wire_bytes,
+        "pulls_ok": stats.get("ok", 0),
+        "fallbacks": stats.get("fallback", 0),
+    }
 
 
 def _measure_recovery() -> dict:

@@ -32,6 +32,7 @@ from gofr_tpu.handler import (
     engine_admin_handler,
     favicon_handler,
     health_handler,
+    kv_export_handler,
     make_endpoint,
     metrics_handler,
     overview_admin_handler,
@@ -179,6 +180,10 @@ class App:
                         make_endpoint(postmortem_list_handler, self.container))
         self.router.add("POST", "/admin/postmortem",
                         make_endpoint(postmortem_trigger_handler, self.container))
+        # cross-replica KV transfer (disaggregated prefill/decode):
+        # peers pull cached paged-KV block tables by prompt hash
+        self.router.add("GET", "/admin/kv/{hash}",
+                        make_endpoint(kv_export_handler, self.container))
         self.router.add("GET", "/admin/adapters",
                         make_endpoint(adapters_list_handler, self.container))
         self.router.add("POST", "/admin/adapters",

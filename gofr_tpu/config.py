@@ -93,6 +93,36 @@ priorities at-or-below it shed and ``BROWNOUT_CLAMP_TOKENS`` (0 =
 off) clamps ``max_tokens``. The live level serves on
 ``/admin/engine`` and ``gofr_tpu_brownout_level``.
 
+Disaggregated prefill/decode keys (fleet/kvwire.py + tpu/device.py,
+see docs/advanced-guide/fleet.md "Disaggregated prefill/decode"):
+``FLEET_ROLE`` (``mixed`` — what a replica advertises on
+``/admin/engine``: ``prefill`` replicas take prefill-heavy work and
+act as KV donors, ``decode`` replicas take token generation, ``mixed``
+takes anything) and ``FLEET_ROLE_ROUTING`` (on, router-side — off
+ignores advertised roles and stamps no donor hints) steer the tiers;
+an empty or breaker-vetoed tier always degrades to mixed routing, so
+role config can never shrink what the fleet serves.
+``KV_TRANSFER`` (on — a replica serves its cached paged-KV block
+tables on ``GET /admin/kv/<prompt_hash>`` and pulls a router-stamped
+``X-KV-Donor``'s warm prefix before admission; off disarms both
+directions), ``KV_TRANSFER_TIMEOUT_S`` (2 — one pull's overall budget,
+also the export side's default deadline; a pull additionally never
+spends more than half the request's remaining deadline),
+``KV_TRANSFER_PIN_TTL_S`` (60 — the bounded lifetime of the block pins
+an export holds, released by a named timer even if the serving thread
+dies mid-send), ``KV_TRANSFER_TRUST_HINT`` (off — ``X-KV-Donor`` names
+a URL the replica will FETCH into its shared prefix cache, so the
+header is an SSRF/cache-poisoning primitive if client-minted; set
+``on`` ONLY on replicas whose front door is the fleet router, exactly
+the ``FLEET_TRUST_TENANT_HEADER`` contract). ``/admin/kv`` is on the
+``ADMIN_TOKEN``-gated admin plane; a pull forwards the replica's own
+token, so a tokened fleet (one shared token) keeps transferring.
+Every pull outcome counts
+on
+``gofr_tpu_kv_transfer_total{outcome}``; any failure falls back to
+local chunked prefill — a transfer can make a request faster, never
+break it.
+
 Correctness-tooling keys (devtools/sanitizer.py + tests/conftest.py,
 see docs/advanced-guide/static-analysis.md): ``GOFR_SANITIZE=1`` arms
 the runtime concurrency sanitizer under tests;

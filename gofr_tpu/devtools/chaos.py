@@ -53,6 +53,10 @@ from gofr_tpu.http.response import Response
 # health/admin plane (the prober must keep seeing the truth unless a
 # test explicitly widens the blast radius)
 DEFAULT_CHAOS_PATHS = ("/v1/", "/generate", "/infer")
+# the KV-transfer pull surface (disaggregated prefill/decode): the
+# corrupting proxy targets it by default — KV chaos must break
+# TRANSFERS, not the serving plane the fallback path needs
+KV_CHAOS_PATHS = ("/admin/kv/",)
 
 
 class ChaosController:
@@ -83,6 +87,33 @@ class ChaosController:
     def disconnect_after(self, chunks: int,
                          paths: tuple = DEFAULT_CHAOS_PATHS) -> None:
         self.arm("disconnect_after", chunks=chunks, paths=paths)
+
+    def corrupting_proxy(self, mode: str = "flip", n: int = 1,
+                         after_bytes: int = 512, stall_s: float = 5.0,
+                         paths: tuple = KV_CHAOS_PATHS) -> None:
+        """The KV-transfer failure injector, sitting where a broken
+        network element would: the next ``n`` matching STREAMED
+        responses are mangled mid-body —
+
+        - ``flip``: one byte past ``after_bytes`` is bit-flipped (the
+          receiver's per-block CRC must catch it: outcome ``corrupt``);
+        - ``truncate``: the body ends after ``after_bytes`` with no
+          trailer frame (donor killed mid-pull: outcome ``corrupt``);
+        - ``stall``: every chunk past ``after_bytes`` waits ``stall_s``
+          (a wedged donor: the receiver's pull budget expires, outcome
+          ``timeout``).
+
+        Defaults target ``/admin/kv/`` only — the serving plane (where
+        the local-prefill fallback runs) stays healthy."""
+        if mode not in ("flip", "truncate", "stall"):
+            raise ValueError(
+                f"corrupting_proxy mode '{mode}' not supported — use "
+                "flip, truncate, or stall"
+            )
+        self.arm(
+            "kv_corrupt", remaining=n, kind=mode,
+            after_bytes=after_bytes, stall_s=stall_s, paths=paths,
+        )
 
     def clear(self, mode: Optional[str] = None) -> None:
         with self._lock:
@@ -161,6 +192,14 @@ def chaos_middleware(controller: ChaosController):
                         delay_s=float(loris["delay_s"]) if loris else 0.0,
                         cut_after=int(cut["chunks"]) if cut else -1,
                     )
+                corrupt = controller.take("kv_corrupt", path)
+                if corrupt is not None:
+                    response.stream = _corrupt_stream(
+                        response.stream,
+                        mode=corrupt["kind"],
+                        after_bytes=int(corrupt["after_bytes"]),
+                        stall_s=float(corrupt["stall_s"]),
+                    )
             return response
 
         return endpoint
@@ -182,6 +221,33 @@ async def _mangle_stream(stream: Any, delay_s: float,
             await asyncio.sleep(delay_s)
         yield chunk
         sent += 1
+
+
+async def _corrupt_stream(stream: Any, mode: str, after_bytes: int,
+                          stall_s: float) -> Any:
+    """The :meth:`ChaosController.corrupting_proxy` byte-mangler,
+    applied to one streamed response body. ``flip`` XORs one bit in
+    the first byte past ``after_bytes`` (every later chunk passes
+    untouched — the receiver must localize the damage via its per-block
+    CRC); ``truncate`` ends the body there with a CLEAN end-of-stream
+    (no exception: the trailer frame is simply missing, exactly what a
+    killed donor process leaves on the wire); ``stall`` delays every
+    chunk past the mark by ``stall_s`` (a wedged donor: the puller's
+    overall budget, not its between-chunk socket timeout, must catch
+    it)."""
+    sent = 0
+    mangled = False
+    async for chunk in stream:
+        if sent >= after_bytes:
+            if mode == "truncate":
+                return
+            if mode == "stall":
+                await asyncio.sleep(stall_s)
+            elif mode == "flip" and not mangled and chunk:
+                chunk = bytes([chunk[0] ^ 0x40]) + chunk[1:]
+                mangled = True
+        sent += len(chunk)
+        yield chunk
 
 
 def abandoning_client(
@@ -364,6 +430,10 @@ def build_replica(name: str, env: Optional[dict[str, str]] = None,
         # fractions of a second so wedge->recover e2e fits test budgets
         "RECOVERY_BACKOFF_S": "0.1",
         "TIMEBASE_ENABLED": "off",
+        # chaos replicas model a fleet behind the router on a trusted
+        # segment, so the router's X-KV-Donor stamp is honored; pass
+        # "off" in env to exercise the untrusted default posture
+        "KV_TRANSFER_TRUST_HINT": "on",
         "GRPC_PORT": str(_free_port()),
     }
     overrides.update(env or {})
@@ -379,7 +449,12 @@ def build_replica(name: str, env: Optional[dict[str, str]] = None,
 
 def _generate_handler(ctx: Any) -> Any:
     """Minimal token-in/token-out surface for fleet tests: reserves real
-    paged-KV blocks for the full generation like any decode."""
+    paged-KV blocks for the full generation like any decode. Honors the
+    router's ``X-KV-Donor`` stamp the same way the OpenAI admission path
+    does, so disaggregated-transfer e2es drive the real pull path."""
+    from gofr_tpu.fleet.kvwire import activate_kv_hint, parse_kv_hint
+
+    activate_kv_hint(parse_kv_hint(ctx.request.header("X-KV-Donor")))
     body = ctx.bind() if ctx.request.body else {}
     tokens = body.get("tokens") or [1, 2, 3]
     max_new = int(body.get("max_new_tokens") or 8)

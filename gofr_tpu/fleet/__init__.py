@@ -39,15 +39,31 @@ from __future__ import annotations
 
 from typing import Any
 
-from gofr_tpu.fleet.admission import QuotaTable
-from gofr_tpu.fleet.breaker import CircuitBreaker
-from gofr_tpu.fleet.replica import Replica, ReplicaSet, affinity_order
-from gofr_tpu.fleet.router import FleetRouter
-
 __all__ = [
     "CircuitBreaker", "FleetRouter", "QuotaTable", "Replica",
     "ReplicaSet", "affinity_order", "parse_replicas", "wire_fleet",
 ]
+
+_EXPORTS = {
+    "QuotaTable": "gofr_tpu.fleet.admission",
+    "CircuitBreaker": "gofr_tpu.fleet.breaker",
+    "Replica": "gofr_tpu.fleet.replica",
+    "ReplicaSet": "gofr_tpu.fleet.replica",
+    "affinity_order": "gofr_tpu.fleet.replica",
+    "FleetRouter": "gofr_tpu.fleet.router",
+}
+
+
+def __getattr__(name):  # PEP 562: kvwire importers (every replica's
+    # pull path) must not pay for the router stack
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module 'gofr_tpu.fleet' has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(module), name)
 
 DEFAULT_ROUTES = (
     "POST /v1/completions,POST /v1/chat/completions,POST /v1/embeddings,"
@@ -81,6 +97,11 @@ def parse_replicas(spec: str) -> list[tuple[str, str]]:
 
 def wire_fleet(app: Any) -> FleetRouter:
     """Wire the fleet router onto ``app`` (see module docstring)."""
+    from gofr_tpu.fleet.admission import QuotaTable
+    from gofr_tpu.fleet.breaker import CircuitBreaker
+    from gofr_tpu.fleet.replica import Replica, ReplicaSet
+    from gofr_tpu.fleet.router import FleetRouter
+
     config = app.config
     container = app.container
     logger = app.logger
@@ -127,6 +148,7 @@ def wire_fleet(app: Any) -> FleetRouter:
         burst=_f("FLEET_QUOTA_BURST", "0"),
         redis=container.redis,
         logger=logger,
+        metrics=container.metrics,
     )
     fleet = FleetRouter(
         logger, container.metrics, replica_set, quota,
@@ -148,6 +170,13 @@ def wire_fleet(app: Any) -> FleetRouter:
     ):
         # affinity off: every request routes least-outstanding
         fleet.affinity_enabled = False
+    if (config.get_or_default("FLEET_ROLE_ROUTING", "on") or "").lower() in (
+        "off", "0", "false", "no"
+    ):
+        # role routing off: replicas' advertised FLEET_ROLE is ignored
+        # and no X-KV-Donor hints are stamped (pre-disaggregation
+        # behavior)
+        fleet.role_routing = False
     if (config.get_or_default("FLEET_TRUST_TENANT_HEADER", "off") or "").lower() in (
         "on", "1", "true", "yes"
     ):

@@ -69,12 +69,30 @@ class QuotaTable:
     (every take admits)."""
 
     def __init__(self, rate_rps: float, burst: float,
-                 redis: Optional[Any] = None, logger: Optional[Any] = None):
+                 redis: Optional[Any] = None, logger: Optional[Any] = None,
+                 metrics: Optional[Any] = None):
         self.rate_rps = rate_rps
         self.burst = burst if burst > 0 else max(1.0, 2 * rate_rps)
         self._redis = redis
         self._logger = logger
-        self._redis_down_logged = False
+        # outage-window tracking: the first failure of an outage logs
+        # (once — a dead redis must not flood the log at request rate),
+        # recovery logs the all-clear and RE-ARMS the next outage's
+        # first-failure log. Every fail-open take also counts on
+        # gofr_tpu_router_quota_fallback_total, so a silent redis
+        # outage — quotas quietly per-process instead of fleet-wide —
+        # is visible on /admin/fleet and alertable, not just a single
+        # log line scrolled away days ago.
+        self._redis_down = False
+        self._fallbacks = 0
+        self._fallback_counter = (
+            metrics.counter(
+                "gofr_tpu_router_quota_fallback_total",
+                "quota decisions that failed open to the per-process "
+                "bucket because the redis backend was unavailable",
+            )
+            if metrics is not None else None
+        )
         self._buckets: dict[str, TokenBucket] = {}
         self._lock = threading.Lock()
         self._denied = 0
@@ -92,6 +110,7 @@ class QuotaTable:
             if verdict is not None:
                 self._count(verdict[0])
                 return verdict
+            self._note_fallback()
         ok, retry_after = self._bucket(tenant).take()
         self._count(ok)
         return ok, retry_after
@@ -103,6 +122,8 @@ class QuotaTable:
                 "rate_rps": self.rate_rps,
                 "burst": self.burst,
                 "backend": "redis" if self._redis is not None else "memory",
+                "redis_down": self._redis_down,
+                "fallbacks": self._fallbacks,
                 "tenants": len(self._buckets),
                 "admitted": self._admitted,
                 "denied": self._denied,
@@ -157,15 +178,28 @@ class QuotaTable:
             self._redis.pipeline().hset(key, "tokens", repr(tokens)).hset(
                 key, "ts", repr(now)
             ).expire(key, ttl).execute()
+            if self._redis_down:
+                self._redis_down = False
+                if self._logger is not None:
+                    self._logger.infof(
+                        "fleet quota redis backend recovered; quotas are "
+                        "fleet-wide again"
+                    )
             return admitted, retry_after
         except Exception as exc:
-            if not self._redis_down_logged and self._logger is not None:
-                self._redis_down_logged = True
+            if not self._redis_down and self._logger is not None:
                 self._logger.errorf(
                     "fleet quota redis backend failed (%r); failing open "
-                    "to per-process buckets", exc
+                    "to per-process buckets until it recovers", exc
                 )
+            self._redis_down = True
             return None
+
+    def _note_fallback(self) -> None:
+        with self._lock:
+            self._fallbacks += 1
+        if self._fallback_counter is not None:
+            self._fallback_counter.inc()
 
 
 def _as_float(value: Any, default: float) -> float:

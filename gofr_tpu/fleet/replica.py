@@ -84,6 +84,13 @@ class Replica:
         self.engine: Optional[dict[str, Any]] = None
         self.last_kv_rejects: Optional[int] = None  # prober-only state
         self.kv_starved = False  # KV-only component of `saturated`
+        # disaggregated serving: the role the replica ADVERTISES on
+        # /admin/engine (FLEET_ROLE). "mixed" — the default, and what a
+        # replica that advertises nothing gets — serves every tier, so
+        # role routing can never shrink the fleet below today's
+        # behavior. Sticky across probe failures (an out-of-rotation
+        # replica keeps its last-known role for when it returns).
+        self.role = "mixed"
 
     # -- outstanding-request accounting (selection signal) -------------------
     def mark_dispatch(self) -> int:
@@ -106,6 +113,7 @@ class Replica:
             "name": self.name,
             "address": self.address,
             "state": self.state,
+            "role": self.role,
             "outstanding": self.outstanding,
             "saturated": self.saturated,
             "probes": self.probes,
@@ -169,7 +177,8 @@ class ReplicaSet:
         return None
 
     def candidates(self, affinity_key: str = "",
-                   exclude: Optional[set[str]] = None) -> list[Replica]:
+                   exclude: Optional[set[str]] = None,
+                   role: Optional[str] = None) -> list[Replica]:
         """Dispatch order for one attempt round: in-rotation replicas,
         affinity target first (rendezvous on the conversation key —
         that replica holds the paged-KV blocks of the prefix), the rest
@@ -178,11 +187,16 @@ class ReplicaSet:
         more outstanding requests than the least-loaded sibling — a
         popular shared prefix must not funnel the whole fleet onto one
         replica. ``exclude`` drops replicas already tried this
-        request."""
+        request. ``role`` restricts to that tier (role-advertising
+        replicas plus ``mixed`` ones); an empty tier returns [] and the
+        CALLER falls back to role-free selection — role config narrows
+        preference, never capacity."""
         eligible = [
             r for r in self.replicas
             if r.state == HEALTHY and (exclude is None or r.name not in exclude)
         ]
+        if role is not None:
+            eligible = [r for r in eligible if r.role in (role, "mixed")]
         if not eligible:
             return []
         with self._rr_lock:
@@ -363,6 +377,16 @@ class ReplicaSet:
             "state": (data.get("engine") or {}).get("state"),
             "queue_depth": data.get("queue_depth"),
         }
+        # disaggregated serving: adopt the advertised role (FLEET_ROLE)
+        # and carry the KV-transfer ledger onto /admin/fleet
+        role = data.get("role")
+        if role in ("prefill", "decode", "mixed"):
+            replica.role = role
+        engine["role"] = replica.role
+        engine["kv_transfer"] = (
+            data.get("kv_transfer")
+            if isinstance(data.get("kv_transfer"), dict) else None
+        )
         kv = data.get("kv_blocks") or {}
         engine["kv_free"] = kv.get("free")
         engine["kv_cached"] = kv.get("cached")

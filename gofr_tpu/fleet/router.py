@@ -45,7 +45,13 @@ from typing import Any, Optional
 
 from gofr_tpu.fleet import breaker as breaker_mod
 from gofr_tpu.fleet.admission import QuotaTable, tenant_of
-from gofr_tpu.fleet.replica import HEALTHY, PROBATION, STATE_VALUES, ReplicaSet
+from gofr_tpu.fleet.replica import (
+    HEALTHY,
+    PROBATION,
+    STATE_VALUES,
+    ReplicaSet,
+    affinity_order,
+)
 from gofr_tpu.http.response import Response
 from gofr_tpu.service import ServiceCallError, _encode_query, backoff_delays
 
@@ -171,6 +177,15 @@ class FleetRouter:
         self.resume_enabled = True
         self.max_resumes = 4
         self.affinity_enabled = True
+        # disaggregated prefill/decode (FLEET_ROLE_ROUTING): route
+        # prefill-heavy work to prefill-tier replicas and decodes to
+        # decode-tier ones, with KV-locality (prompt-hash rendezvous)
+        # beating plain prefix affinity, and stamp X-KV-Donor with the
+        # prefill replica that rendezvous-owns the prompt's KV. Every
+        # tier decision DEGRADES to mixed routing when the tier is
+        # empty or its breakers veto — role config can never make the
+        # fleet serve less than it does without it.
+        self.role_routing = True
         self.trust_tenant_header = False  # FLEET_TRUST_TENANT_HEADER
         self._records: deque = deque(maxlen=record_capacity)
         self._records_lock = threading.Lock()
@@ -400,6 +415,20 @@ class FleetRouter:
         body_json = self._body_json(request)
         affinity = (affinity_key_of(request, body_json)
                     if self.affinity_enabled else "")
+        # disaggregated routing: classify the request's tier and, for
+        # token-id prompts, derive the EXACT KV identity — prompt-hash
+        # rendezvous then beats the PROMPT-HEAD affinity heuristic
+        # (locality to actual cached blocks, not to a conversation
+        # guess). An EXPLICIT client key (X-Session-ID / X-Affinity-Key
+        # / the OpenAI user field) still wins: the client asked to pin,
+        # and the donor hint carries KV locality anyway.
+        role = self._classify_role(request.path) if self.role_routing else None
+        kv_hash = (
+            self._kv_hash_of(body_json)
+            if self.role_routing and self.affinity_enabled else ""
+        )
+        if kv_hash and not self._explicit_affinity(request, body_json):
+            affinity = kv_hash
         wants_stream = isinstance(body_json, dict) and bool(body_json.get("stream"))
         # resumable: deterministic streams (seed / greedy) can be
         # regenerated bit-identically, so a mid-stream upstream failure
@@ -412,7 +441,7 @@ class FleetRouter:
             return self._forward(
                 request, tenant, affinity, wants_stream,
                 executor=ctx.container.handler_executor,
-                resumable=resumable,
+                resumable=resumable, role=role, kv_hash=kv_hash,
             )
         finally:
             # streaming responses decrement in their own finally instead
@@ -467,9 +496,70 @@ class FleetRouter:
             return None
         return ms / 1000.0
 
+    @staticmethod
+    def _classify_role(path: str) -> Optional[str]:
+        """The replica tier a route prefers: prefill-only surfaces
+        (embeddings, single-shot infer) want the prefill tier; token
+        generation wants the decode tier; everything else (models
+        listing, unknown routes) has no preference."""
+        if path.endswith("/embeddings") or path.endswith("/infer"):
+            return "prefill"
+        if path.endswith("/completions") or path.endswith("/generate"):
+            return "decode"
+        return None
+
+    @staticmethod
+    def _explicit_affinity(request: Any, body: Any) -> bool:
+        """True when the CLIENT pinned the conversation (session/
+        affinity header or the OpenAI ``user`` field) — those pins
+        outrank KV-hash rendezvous; only the prompt-head heuristic
+        yields to it."""
+        if request.header("X-Session-ID") or request.header("X-Affinity-Key"):
+            return True
+        return isinstance(body, dict) and bool(body.get("user"))
+
+    @staticmethod
+    def _kv_hash_of(body: Any) -> str:
+        """The prompt's exact KV identity, derivable only for token-id
+        prompts (text prompts tokenize replica-side; their locality
+        stays with the affinity heuristics)."""
+        if not isinstance(body, dict):
+            return ""
+        tokens = body.get("tokens")
+        if not isinstance(tokens, list):
+            tokens = body.get("prompt")
+        if (
+            isinstance(tokens, list) and tokens
+            and all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in tokens
+            )
+        ):
+            from gofr_tpu.fleet.kvwire import prompt_hash
+
+            return prompt_hash(tokens)
+        return ""
+
+    def _kv_donor(self, kv_hash: str) -> Optional[Any]:
+        """The prefill-tier replica that rendezvous-owns this prompt's
+        KV — the X-KV-Donor stamp for decode-bound requests. None when
+        no prefill replica is in rotation (a mixed fleet has no
+        dedicated donors; locality then rides selection alone)."""
+        if not kv_hash:
+            return None
+        tier = [
+            r for r in self.replica_set.replicas
+            if r.state == HEALTHY and r.role == "prefill"
+        ]
+        if not tier:
+            return None
+        ranked = affinity_order(kv_hash, [r.name for r in tier])
+        return next(r for r in tier if r.name == ranked[0])
+
     def _forward(self, request: Any, tenant: str, affinity: str,
                  wants_stream: bool, executor: Any = None,
-                 resumable: bool = False) -> Response:
+                 resumable: bool = False, role: Optional[str] = None,
+                 kv_hash: str = "") -> Response:
         start = time.monotonic()
         # the effective budget is the TIGHTER of the router's own
         # forwarding deadline and the client's end-to-end deadline —
@@ -493,10 +583,22 @@ class FleetRouter:
             "stream": wants_stream,
             "resumable": resumable,
             "resumes": 0,
+            # disaggregated routing evidence: the tier asked for, and
+            # which replica (if any) was named as the KV donor
+            "role": role,
+            "kv_donor": None,
             "attempts": [],
             "outcome": "error",
             "status": 0,
         }
+        # the donor is decided ONCE per request (the prefill replica
+        # rendezvous-owning the prompt's KV), then stamped per attempt
+        # so a failover hop still knows where the warm blocks live
+        donor = (
+            self._kv_donor(kv_hash) if role == "decode" else None
+        )
+        if donor is not None:
+            record["kv_donor"] = donor.name
         tried: set[str] = set()
         delays = backoff_delays(self.retries)
         response: Optional[Response] = None
@@ -509,10 +611,17 @@ class FleetRouter:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
-            picked = self._pick(affinity, tried)
+            picked = self._pick(affinity, tried, role=role)
             if picked is None:
                 break
             replica, is_probe = picked
+            # the donor hint: stamped only when a DIFFERENT replica
+            # holds the warm blocks (pulling from yourself is a no-op
+            # the replica would skip anyway, but why ask)
+            if donor is not None and donor.name != replica.name:
+                headers["X-KV-Donor"] = donor.address
+            else:
+                headers.pop("X-KV-Donor", None)
             if record["attempts"]:
                 # a retry is now CERTAIN (a replica was found and will
                 # be attempted): count it against the attempt it redoes
@@ -568,21 +677,32 @@ class FleetRouter:
             body=body,
         )
 
-    def _pick(self, affinity: str,
-              tried: set[str]) -> Optional[tuple[Any, bool]]:
+    def _pick(self, affinity: str, tried: set[str],
+              role: Optional[str] = None) -> Optional[tuple[Any, bool]]:
         """First candidate whose breaker admits the request, plus
         whether this dispatch IS that breaker's half-open probe (its
-        success report must carry the probe grant). Falls back to
-        already-tried replicas only when nothing fresh remains (a
-        2-replica fleet with one dead replica must still retry the
-        healthy one rather than give up)."""
-        for exclude in (tried, None):
-            for replica in self.replica_set.candidates(affinity, exclude=exclude):
+        success report must carry the probe grant). Pass order: the
+        requested role tier first, then role-free (an empty tier OR a
+        tier whose breakers all veto must degrade to mixed routing,
+        never to a 502 the un-roled fleet would have served), then
+        already-tried replicas as the last resort (a 2-replica fleet
+        with one dead replica must still retry the healthy one rather
+        than give up). Re-testing a breaker across passes is harmless:
+        a closed breaker grants again (we returned the first time), a
+        vetoing one vetoes again."""
+        passes: list[tuple[Optional[set[str]], Optional[str]]] = []
+        if role is not None:
+            passes.append((tried, role))
+        passes.append((tried, None))
+        if tried:
+            passes.append((None, None))
+        for exclude, tier in passes:
+            for replica in self.replica_set.candidates(
+                affinity, exclude=exclude, role=tier
+            ):
                 grant = replica.breaker.try_acquire()
                 if grant:
                     return replica, grant == breaker_mod.PROBE
-            if not tried:
-                break
         return None
 
     def _attempt(
@@ -809,6 +929,7 @@ class FleetRouter:
             "max_inflight": self.max_inflight,
             "retries": self.retries,
             "deadline_s": self.deadline_s,
+            "role_routing": self.role_routing,
             "quota": self.quota.stats(),
             "replica_set": self.replica_set.snapshot(),
             "routes": self.records(limit=50),

@@ -454,6 +454,98 @@ def fleet_admin_handler(ctx: Context) -> Any:
     return fleet.snapshot()
 
 
+def kv_export_handler(ctx: Context) -> Response:
+    """GET /admin/kv/{hash}: the donor side of a cross-replica paged-KV
+    transfer (disaggregated prefill/decode). Serves the cached block
+    table whose prompt hashes to ``{hash}`` in the kvwire format —
+    versioned header, per-block CRC frames, mandatory trailer — so the
+    pulling replica can detect truncation, corruption, and version
+    skew and fall back to local prefill.
+
+    Contract points the fleet depends on:
+
+    - the entry's blocks are PINNED (increfed) for the duration of the
+      stream and released when the response closes — an aborted pull
+      never leaks refcounts, and a dead serving thread is covered by
+      the pin's own bounded-lifetime timer (``KV_TRANSFER_PIN_TTL_S``);
+    - the PR 10 deadline budget applies (``X-Request-Deadline-Ms``,
+      default ``KV_TRANSFER_TIMEOUT_S``): an expired budget stops the
+      stream mid-body — a deliberate truncation the receiver detects;
+    - 404 when the entry was evicted between advertise and pull (or
+      was never here, or transfer is off) — never a 500."""
+    from gofr_tpu.deadline import parse_deadline
+    from gofr_tpu.errors import HTTPError, InvalidParamError
+
+    _check_admin(ctx)
+    tpu = ctx.container.tpu
+    if tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    if not getattr(tpu, "kv_transfer_enabled", False):
+        raise HTTPError(404, "KV transfer disabled (KV_TRANSFER=off)")
+    prompt_hash = (ctx.request.path_param("hash") or "").strip().lower()
+    if not prompt_hash or len(prompt_hash) > 64 or any(
+        c not in "0123456789abcdef" for c in prompt_hash
+    ):
+        raise InvalidParamError('"hash" must be a hex prompt hash')
+    default_s = float(
+        ctx.container.config.get_or_default("KV_TRANSFER_TIMEOUT_S", "2")
+    )
+    deadline = parse_deadline(
+        ctx.request.header("X-Request-Deadline-Ms"), default_s
+    )
+    export = tpu.kv_export(prompt_hash)
+    if export is None:
+        raise HTTPError(
+            404,
+            f"no cached KV for {prompt_hash} (evicted between advertise "
+            "and pull, never seen here, or paged KV inactive)",
+        )
+    spec, table, arena, pin = export
+    from gofr_tpu.fleet.kvwire import (
+        CONTENT_TYPE,
+        encode_block,
+        encode_header,
+        encode_trailer,
+    )
+
+    n_blocks = int(spec["n_blocks"])
+
+    executor = ctx.container.handler_executor
+
+    async def frames() -> Any:
+        # runs on the event loop after the handler returns; the pin is
+        # released on EVERY exit — completion, client abort (the server
+        # acloses the stream), or an exception — and the TTL timer
+        # backstops a loop that never finalizes this generator
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        try:
+            yield encode_header(spec)
+            for j in range(n_blocks):
+                if pin.expired:
+                    return  # the TTL guard took the blocks back
+                if deadline is not None and deadline.expired():
+                    return  # budget spent: truncate; the receiver's
+                    # trailer check turns this into a clean fallback
+                # a real arena's per-block export is a synchronous
+                # device->host copy — off the serving loop, or every
+                # concurrent stream on the donor stalls per block
+                payload = await loop.run_in_executor(
+                    executor, arena.export_block_payload, table, j
+                )
+                yield encode_block(j, payload)
+            yield encode_trailer(n_blocks)
+        finally:
+            pin.release()
+
+    return Response(
+        status=200,
+        headers={"Content-Type": CONTENT_TYPE},
+        stream=frames(),
+    )
+
+
 def postmortem_list_handler(ctx: Context) -> Any:
     """GET /admin/postmortem: the on-disk bundle inventory."""
     _check_admin(ctx)

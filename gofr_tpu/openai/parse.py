@@ -46,6 +46,16 @@ def _admit_request(ctx: Any, max_tokens: int) -> int:
         default_deadline_s, priority=priority,
     )
     activate_deadline(deadline)
+    # KV-donor hint (disaggregated prefill/decode): the fleet router
+    # stamps the replica likely holding this prompt's warm paged-KV
+    # blocks; the device pulls them before admission. Travels like the
+    # deadline — a contextvar read once by TPU.generate. A malformed
+    # hint degrades to local prefill, never to a 4xx — and the device
+    # acts on it only under KV_TRANSFER_TRUST_HINT=on (the hint names
+    # a URL the replica will fetch into its shared prefix cache).
+    from gofr_tpu.fleet.kvwire import activate_kv_hint, parse_kv_hint
+
+    activate_kv_hint(parse_kv_hint(ctx.request.header("X-KV-Donor")))
     brownout = getattr(ctx.tpu, "brownout", None)
     if brownout is not None:
         admitted, max_tokens, level = brownout.admit(priority, max_tokens)

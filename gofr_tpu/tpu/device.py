@@ -444,6 +444,17 @@ class TPUDevice:
             "(the feature still serves through its fallback path)",
             labels=("feature",),
         )
+        from gofr_tpu.fleet.kvwire import transfer_counter
+
+        self._kv_transfer_counter = transfer_counter(metrics)
+        # host-side mirror of the counter for /admin/engine (plus the
+        # donor-side `served` count, which is not a receiver outcome):
+        # the fleet prober scrapes this into /admin/fleet per replica
+        self.kv_transfer_stats: dict[str, int] = {
+            "ok": 0, "timeout": 0, "corrupt": 0, "evicted": 0,
+            "fallback": 0, "served": 0,
+        }
+        self._kv_transfer_lock = threading.Lock()
 
 
     def _parse_serving_config(self, config: Any) -> None:
@@ -574,6 +585,58 @@ class TPUDevice:
         )
         if self._kv_budget_mb < 0:
             raise ValueError("KV_HBM_BUDGET_MB must be >= 0 (0 = auto)")
+        # cross-replica KV transfer (fleet/kvwire.py + /admin/kv): this
+        # replica serves its cached block tables to peers and, when a
+        # request arrives with an X-KV-Donor hint, pulls the warm prefix
+        # instead of re-prefilling. KV_TRANSFER=off disarms both sides;
+        # KV_TRANSFER_TIMEOUT_S bounds one pull (the client's read
+        # budget AND the serving side's default deadline);
+        # KV_TRANSFER_PIN_TTL_S bounds how long an export can pin
+        # blocks if its serving thread dies mid-send.
+        self.kv_transfer_enabled = (
+            config.get_or_default("KV_TRANSFER", "on") != "off"
+        )
+        # X-KV-Donor names a URL this replica will FETCH and whose
+        # payload seeds the SHARED prefix cache — client-minted it is
+        # an SSRF + cache-poisoning primitive, so the hint is acted on
+        # only when the operator declares the front door trusted
+        # (replicas behind the fleet router; the
+        # FLEET_TRUST_TENANT_HEADER contract)
+        self.kv_hint_trusted = (
+            config.get_or_default("KV_TRANSFER_TRUST_HINT", "off") == "on"
+        )
+        self._kv_transfer_timeout = float(
+            config.get_or_default("KV_TRANSFER_TIMEOUT_S", "2")
+        )
+        if self._kv_transfer_timeout <= 0:
+            raise ValueError("KV_TRANSFER_TIMEOUT_S must be > 0")
+        self._kv_pin_ttl = float(
+            config.get_or_default("KV_TRANSFER_PIN_TTL_S", "60")
+        )
+        if self._kv_pin_ttl <= 0:
+            raise ValueError("KV_TRANSFER_PIN_TTL_S must be > 0")
+        # the donor's /admin/kv sits on the token-gated admin plane
+        # (ADMIN_TOKEN): the fleet shares one token, so pulls forward
+        # ours — otherwise a tokened fleet would 401 every transfer and
+        # misread its own lockout as donor timeouts
+        self._kv_admin_token = config.get("ADMIN_TOKEN") or ""
+        # cache-key -> prompt-hash memo for kv_export's donor-side scan
+        # (sha256 over every cached key per pull would otherwise repeat;
+        # pruned against the live cache when it outgrows it)
+        self._kv_hash_memo: dict[bytes, str] = {}
+        # the role this replica advertises to the fleet router
+        # (disaggregated prefill/decode; /admin/engine carries it):
+        # prefill replicas take prefill-heavy work and act as KV
+        # donors, decode replicas take token generation, mixed (the
+        # default) takes anything — exactly today's behavior
+        self.role = (
+            config.get_or_default("FLEET_ROLE", "mixed").strip().lower()
+        )
+        if self.role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"FLEET_ROLE '{self.role}' not supported — use prefill, "
+                "decode, or mixed"
+            )
         self._pool_enabled = config.get_or_default("DECODE_POOL", "on") != "off"
         self._pool_slots = int(config.get_or_default("DECODE_SLOTS", str(self.max_batch)))
         from gofr_tpu.tpu.decode_pool import PIPELINE_DEPTH
@@ -1015,6 +1078,253 @@ class TPUDevice:
         )
         self.kv_pool = pool
 
+    # -- cross-replica KV transfer (fleet/kvwire.py) -------------------------
+    def _kv_store(self) -> Any:
+        """The runner's paged store (echo: HostPagedKV; transformer:
+        _PagedPrefixStore) — the object both transfer directions work
+        against. None when paged KV is off/degraded."""
+        runner = getattr(self, "runner", None)
+        store = getattr(runner, "paged", None)
+        if store is None:
+            store = getattr(runner, "_paged_prefix", None)
+        return store
+
+    def kv_transfer_snapshot(self) -> dict:
+        with self._kv_transfer_lock:
+            out: dict[str, Any] = dict(self.kv_transfer_stats)
+        out["enabled"] = self.kv_transfer_enabled
+        return out
+
+    def _note_transfer(self, outcome: str) -> None:
+        self._kv_transfer_counter.inc(outcome=outcome)
+        with self._kv_transfer_lock:
+            self.kv_transfer_stats[outcome] = (
+                self.kv_transfer_stats.get(outcome, 0) + 1
+            )
+
+    def kv_export(self, prompt_hash: str) -> Optional[tuple]:
+        """Donor side of a KV pull: locate the cached entry whose key
+        hashes to ``prompt_hash`` and PIN its blocks for the transfer
+        (a concurrent admission evicting the entry mid-send must not
+        free blocks the wire is still reading). Returns
+        ``(spec, table, arena, pin)`` or None (evicted / never seen /
+        transfer off — the endpoint 404s cleanly). The caller owns the
+        pin: release on stream close; the pin's own TTL guard covers a
+        serving thread that dies mid-send."""
+        if not self.kv_transfer_enabled:
+            return None
+        store = self._kv_store()
+        if store is None:
+            return None
+        from gofr_tpu.fleet.kvwire import hash_of_key
+        from gofr_tpu.tpu.kv_blocks import BlockTable, TransferPin, blocks_for
+
+        pool, arena = store.pool, store.arena
+        # hash the snapshot OUTSIDE pool.lock: sha256 over every cached
+        # key under the admission lock would serialize concurrent pulls
+        # against reserve/release on the serving hot path
+        memo = self._kv_hash_memo
+        items = pool.cache_items()
+        key = None
+        for k, _ in items:
+            h = memo.get(k)
+            if h is None:
+                h = hash_of_key(k)
+                memo[k] = h
+            if h == prompt_hash:
+                key = k
+                break
+        if len(memo) > 2 * len(items) + 16:
+            live = {k for k, _ in items}
+            self._kv_hash_memo = {
+                k: v for k, v in memo.items() if k in live
+            }
+        if key is None:
+            return None
+        with pool.lock:
+            entry = pool.cache_lookup(key)
+            if entry is None:
+                # evicted between scan and pin: the endpoint's clean 404
+                return None
+            length = entry.table.length
+            nb = min(
+                blocks_for(length, pool.block_tokens), len(entry.table.blocks)
+            )
+            blocks = list(entry.table.blocks[:nb])
+            pin = TransferPin(pool, blocks, ttl_s=self._kv_pin_ttl)
+        from gofr_tpu.telemetry import request_key
+
+        ids = np.frombuffer(key, np.int32)
+        spec = dict(arena.wire_spec())
+        spec.update({
+            "prompt_hash": prompt_hash,
+            "model": self.model_name,
+            # sampling-identity digest (telemetry.request_key): prompt
+            # KV is sampler-independent, but the identity pins MODEL +
+            # prompt — a donor serving different weights must be
+            # refused before its KV is trusted
+            "identity": request_key(self.model_name, ids.tolist(), 0),
+            "length": int(length),
+            "n_blocks": nb,
+            "meta": {
+                "length": int(length),
+                "next_token": entry.meta.get("next_token"),
+            },
+        })
+        with self._kv_transfer_lock:
+            self.kv_transfer_stats["served"] += 1
+        return spec, BlockTable(blocks, length), arena, pin
+
+    def prefetch_kv(self, tokens: Any) -> None:
+        """Receiving side: when admission parsed an ``X-KV-Donor`` hint
+        (the fleet router's KV-locality routing), pull the warm prefix
+        from that replica BEFORE paged admission, so the imminent admit
+        aliases it copy-free instead of re-prefilling. Strictly
+        best-effort: every failure (donor gone, timeout, corruption,
+        version skew, eviction, local exhaustion) is counted on
+        ``gofr_tpu_kv_transfer_total{outcome}`` and the request falls
+        back to local chunked prefill — a transfer can make a request
+        faster, never break it."""
+        from gofr_tpu.fleet.kvwire import current_kv_hint
+
+        hint = current_kv_hint()
+        if (
+            hint is None
+            or not self.kv_transfer_enabled
+            or not self.kv_hint_trusted
+        ):
+            return
+        store = self._kv_store()
+        if store is None or not hasattr(store, "install_remote"):
+            return
+        if isinstance(tokens, str):
+            return  # hints ride token-id requests only (hash identity)
+        ids = np.asarray(tokens, np.int32).reshape(-1)
+        if ids.size == 0:
+            return
+        with store.pool.lock:
+            if store.pool.cache_lookup(ids.tobytes()) is not None:
+                return  # already warm locally — no pull, no fallback
+        outcome = self._pull_kv(hint, ids, store)
+        if outcome == "ok":
+            self._note_transfer("ok")
+            return
+        if outcome != "local_exhausted":
+            # a transfer-side failure: count the cause AND the fallback
+            self._note_transfer(outcome)
+        self._note_transfer("fallback")
+
+    def _pull_kv(self, donor: str, ids: np.ndarray, store: Any) -> str:
+        """One bounded pull + verify + install. Returns the outcome:
+        ok | timeout | corrupt | evicted | local_exhausted."""
+        from gofr_tpu.deadline import current_deadline
+        from gofr_tpu.fleet import kvwire
+        from gofr_tpu.service import HTTPService
+        from gofr_tpu.tpu.kv_blocks import ForeignKVRejected, blocks_for
+
+        budget = self._kv_transfer_timeout
+        deadline = current_deadline()
+        if deadline is not None:
+            # the pull spends the REQUEST's budget: never let a slow
+            # donor eat time the local prefill fallback will still need
+            budget = min(budget, deadline.remaining() * 0.5)
+        if budget <= 0.01:
+            return "timeout"
+        phash = kvwire.prompt_hash(ids)
+        streaming = None
+        start = time.perf_counter()
+        try:
+            # HTTPService holds config, not connections (every call
+            # opens and closes its own socket) — nothing to cache
+            client = HTTPService(
+                donor, self.logger, name="kv-donor",
+                connect_timeout=2.0,
+                read_timeout=self._kv_transfer_timeout,
+            )
+            headers = {
+                "X-Request-Deadline-Ms": str(max(1, int(budget * 1000)))
+            }
+            if self._kv_admin_token:
+                headers["Authorization"] = f"Bearer {self._kv_admin_token}"
+            streaming = client.stream(
+                "GET", f"/admin/kv/{phash}",
+                headers=headers,
+                connect_timeout=min(budget, 2.0),
+                read_timeout=budget,
+            )
+            if streaming.status_code == 404:
+                streaming.read(budget_s=min(budget, 1.0))
+                return "evicted"
+            if streaming.status_code != 200:
+                # donor unhealthy/refusing: same verdict as unreachable
+                streaming.read(budget_s=min(budget, 1.0))
+                return "timeout"
+            header, payloads = kvwire.decode_stream(
+                self._budgeted_chunks(streaming, start, budget),
+                # an over-claiming donor is refused at its header, not
+                # buffered: the prompt bounds what a pull may carry
+                max_blocks=blocks_for(
+                    int(ids.size), store.pool.block_tokens
+                ),
+            )
+            kvwire.check_spec(header, store.arena.wire_spec())
+            if header.get("prompt_hash") != phash:
+                raise kvwire.VersionSkew(
+                    f"donor answered for hash {header.get('prompt_hash')!r}"
+                )
+            if int(header.get("length") or 0) != int(ids.size):
+                raise kvwire.VersionSkew(
+                    f"donor entry is {header.get('length')!r} tokens, "
+                    f"prompt is {ids.size}"
+                )
+            from gofr_tpu.telemetry import request_key
+
+            if header.get("identity") != request_key(
+                self.model_name, ids.tolist(), 0
+            ):
+                raise kvwire.VersionSkew(
+                    "sampling/model identity mismatch (donor serves "
+                    "different weights?)"
+                )
+            meta = header.get("meta") if isinstance(
+                header.get("meta"), dict
+            ) else {}
+            installed = store.install_remote(ids, payloads, meta)
+        except kvwire.KVWireError as exc:
+            self.logger.warnf("KV pull from %s: %s", donor, exc)
+            return exc.outcome
+        except ForeignKVRejected as exc:
+            self.logger.warnf("KV pull from %s rejected: %s", donor, exc)
+            return "corrupt"
+        except TimeoutError:
+            # socket.timeout: the donor stalled past the read budget
+            return "timeout"
+        except Exception as exc:
+            from gofr_tpu.service import ServiceCallError
+
+            if isinstance(exc, ServiceCallError):
+                return "timeout"  # never connected / request never sent
+            # the stream broke mid-body (reset, protocol error): the
+            # payload is a partial read — corruption, not slowness
+            self.logger.warnf("KV pull from %s broke mid-body: %r", donor, exc)
+            return "corrupt"
+        finally:
+            if streaming is not None:
+                streaming.close()
+        return "ok" if installed else "local_exhausted"
+
+    @staticmethod
+    def _budgeted_chunks(streaming: Any, start: float, budget: float) -> Any:
+        """The pull's chunk source with an OVERALL budget: the socket
+        timeout only bounds silence between chunks — a donor dripping
+        one frame per second would stay inside it forever."""
+        for chunk in streaming.iter_chunks():
+            if time.perf_counter() - start > budget:
+                raise TimeoutError(
+                    f"KV pull exceeded its {budget * 1000:.0f} ms budget"
+                )
+            yield chunk
+
     def _boot_progress(
         self, detail: str, kind: str = "", bucket: int = 0
     ) -> None:
@@ -1168,6 +1478,12 @@ class TPUDevice:
         # the checkpoint's EOS always ends generation (OpenAI semantics);
         # request stops compose with it
         stop_tokens = frozenset(stop_tokens or ()) | self.default_stop_ids
+        # disaggregated prefill/decode: a router-stamped donor hint
+        # pulls the warm prefix into the local paged arena BEFORE
+        # admission (best-effort — any failure falls back to local
+        # prefill, counted on gofr_tpu_kv_transfer_total)
+        if self.kv_transfer_enabled:
+            self.prefetch_kv(tokens)
         start = time.perf_counter()
         record = telemetry_record()
         entry = self._journal_start(
@@ -1624,6 +1940,13 @@ class TPUDevice:
                 {"axes": self.mesh_axes, "devices": self.mesh.size}
                 if self.mesh is not None else None
             ),
+            # disaggregated serving: the role this replica advertises
+            # (FLEET_ROLE — the router's tier routing keys on it) and
+            # the cross-replica KV-transfer ledger (receiver outcomes +
+            # donor-side serves), scraped by the fleet prober onto
+            # /admin/fleet
+            "role": self.role,
+            "kv_transfer": self.kv_transfer_snapshot(),
             "boot": dict(self.boot_status),
             "boot_timeline": [dict(stage) for stage in self.boot_timeline],
             "watchdog": self.watchdog.snapshot(),
@@ -4486,6 +4809,30 @@ class _PagedPrefixStore:
         probe entries must not greet live traffic."""
         with self._lock:
             self.pool.cache_clear()
+
+    def install_remote(self, ids: np.ndarray, payloads: list,
+                       meta: dict) -> bool:
+        """Receiving end of a cross-replica KV transfer: install the
+        verified foreign blocks as a cache entry, so the imminent
+        lookup of the same prompt hits copy-free. Wire checksums and
+        the spec/identity checks already ran (device KV has no semantic
+        read-back, so no readback verify); returns False on local
+        exhaustion — that is the local arena's problem, not the
+        donor's."""
+        from gofr_tpu.tpu.kv_blocks import install_foreign_entry
+
+        next_token = meta.get("next_token")
+        with self._lock:
+            return install_foreign_entry(
+                self.pool, self.arena, ids, payloads,
+                {
+                    "next_token": (
+                        int(next_token) if next_token is not None else None
+                    ),
+                    "logits": None,
+                },
+                verify_readback=False, count_copied=True,
+            )
 
     def store_generation(
         self, full: np.ndarray, row: Any, exactable: bool, out: list

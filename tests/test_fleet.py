@@ -75,6 +75,19 @@ def _key_for(target: str, names: list) -> str:
     raise AssertionError(f"no key found mapping to {target}")
 
 
+def _tokens_for(target: str, names: list) -> list:
+    """A token-id prompt whose KV-hash rendezvous routes to ``target``
+    (token prompts route by prompt hash, not by the session-key
+    heuristics, unless the client pins a session explicitly)."""
+    from gofr_tpu.fleet.kvwire import prompt_hash
+
+    for i in range(1000):
+        tokens = [i + 1, i + 2, i + 3]
+        if affinity_order(prompt_hash(tokens), list(names))[0] == target:
+            return tokens
+    raise AssertionError(f"no tokens found mapping to {target}")
+
+
 # -- unit: circuit breaker -----------------------------------------------------
 
 def test_breaker_opens_half_opens_and_closes():
@@ -597,16 +610,19 @@ def test_connection_refused_breaker_cycle(tmp_path, monkeypatch):
         fleet = app.container.fleet
         _wait(lambda: len(fleet.replica_set.in_rotation()) == 3,
               message="3 replicas in rotation")
+        names = [r.name for r in fleet.replica_set.replicas]
         dead = replicas[0]
         dead.stop_listener()  # connection refused from here on
 
         def breaker_state():
             return fleet.replica_set.by_name(dead.name).breaker.state
 
-        # drive requests until the breaker trips (round-robin tie-break
-        # guarantees the dead replica is tried within a few requests)
+        # drive requests until the breaker trips; the prompt's KV-hash
+        # rendezvous pins the dead replica first in every pick, so each
+        # request charges its breaker deterministically
+        tokens = _tokens_for(dead.name, names)
         for _ in range(8):
-            status, _, _ = _post(base + "/generate", {"tokens": [1, 2]})
+            status, _, _ = _post(base + "/generate", {"tokens": tokens})
             assert status == 200  # the CLIENT never sees the dead replica
             if breaker_state() == "open":
                 break
@@ -615,7 +631,7 @@ def test_connection_refused_breaker_cycle(tmp_path, monkeypatch):
         dead.start_listener()
         time.sleep(0.25)  # past the cooldown: next pick half-opens
         for _ in range(8):
-            status, _, _ = _post(base + "/generate", {"tokens": [3]})
+            status, _, _ = _post(base + "/generate", {"tokens": tokens})
             assert status == 200
             if breaker_state() == "closed":
                 break
@@ -1125,3 +1141,371 @@ def test_fleet_replicas_on_host_mesh(tmp_path, monkeypatch):
             data = json.loads(engine)["data"]
             assert data["mesh"] == {"axes": {"tp": 2}, "devices": 2}
             assert data["kv_blocks"]["total"] == 64
+
+
+# -- unit: disaggregated role routing (ISSUE 11) -------------------------------
+
+def _role_set(roles, logger=None):
+    """A ReplicaSet of named replicas with fixed roles, all healthy, no
+    prober traffic (probe thread is started by start(), never called)."""
+    from gofr_tpu.fleet.replica import Replica, ReplicaSet
+    from gofr_tpu.testutil import MockLogger
+
+    logger = logger or MockLogger()
+    replicas = []
+    for i, role in enumerate(roles):
+        replica = Replica(f"r{i}", f"http://127.0.0.1:{20000 + i}", logger)
+        replica.role = role
+        replicas.append(replica)
+    return ReplicaSet(replicas, logger)
+
+
+def test_candidates_role_tier_includes_mixed_and_empty_tier_is_empty():
+    rs = _role_set(["prefill", "decode", "mixed"])
+    assert {r.name for r in rs.candidates(role="decode")} == {"r1", "r2"}
+    assert {r.name for r in rs.candidates(role="prefill")} == {"r0", "r2"}
+    assert {r.name for r in rs.candidates()} == {"r0", "r1", "r2"}
+    # an empty tier returns [] — the CALLER degrades, candidates never
+    # invents capacity
+    only_prefill = _role_set(["prefill", "prefill"])
+    assert only_prefill.candidates(role="decode") == []
+    # roles compose with exclusion
+    assert {r.name for r in rs.candidates(role="decode", exclude={"r1"})} \
+        == {"r2"}
+
+
+def test_classify_role_and_kv_hash_of():
+    from gofr_tpu.fleet.kvwire import prompt_hash
+    from gofr_tpu.fleet.router import FleetRouter
+
+    classify = FleetRouter._classify_role
+    assert classify("/v1/completions") == "decode"
+    assert classify("/v1/chat/completions") == "decode"
+    assert classify("/generate") == "decode"
+    assert classify("/v1/embeddings") == "prefill"
+    assert classify("/infer") == "prefill"
+    assert classify("/v1/models") is None
+
+    kv_hash = FleetRouter._kv_hash_of
+    assert kv_hash({"tokens": [1, 2, 3]}) == prompt_hash([1, 2, 3])
+    assert kv_hash({"prompt": [4, 5]}) == prompt_hash([4, 5])
+    # text prompts tokenize replica-side: no router-side identity
+    assert kv_hash({"prompt": "hello"}) == ""
+    assert kv_hash({"prompt": [1, True, 3]}) == ""  # bools are not ids
+    assert kv_hash({"prompt": []}) == ""
+    assert kv_hash(None) == ""
+
+
+def test_pick_degrades_from_empty_and_vetoed_tiers():
+    """Role config can never make the fleet serve less: an empty tier
+    AND a tier whose breakers all veto both fall through to role-free
+    selection; only a fleet with nothing admittable returns None."""
+    from gofr_tpu.fleet.router import FleetRouter
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.testutil import MockLogger
+
+    logger = MockLogger()
+    rs = _role_set(["prefill", "decode", "decode"], logger=logger)
+    router = FleetRouter(logger, Registry(), rs, QuotaTable(0.0, 0.0))
+
+    picked, _ = router._pick("", set(), role="decode")
+    assert picked.role == "decode"  # the tier is preferred when alive
+
+    # every decode breaker open: the prefill replica must still serve
+    for name in ("r1", "r2"):
+        breaker = rs.by_name(name).breaker
+        for _ in range(breaker.failure_threshold):
+            breaker.record_failure()
+    picked, _ = router._pick("", set(), role="decode")
+    assert picked.name == "r0"  # degraded to role-free, not to a 502
+
+    # empty tier (no decode/mixed at all): same degradation
+    prefill_only = _role_set(["prefill", "prefill"], logger=logger)
+    router2 = FleetRouter(logger, Registry(), prefill_only,
+                          QuotaTable(0.0, 0.0))
+    picked, _ = router2._pick("", set(), role="decode")
+    assert picked.role == "prefill"
+
+    # nothing admittable anywhere: None (the caller 502s/retries)
+    for replica in prefill_only.replicas:
+        for _ in range(replica.breaker.failure_threshold):
+            replica.breaker.record_failure()
+    assert router2._pick("", set(), role="decode") is None
+
+
+def test_kv_donor_picks_the_prefill_replica_by_rendezvous():
+    from gofr_tpu.fleet.kvwire import prompt_hash
+    from gofr_tpu.fleet.router import FleetRouter
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.testutil import MockLogger
+
+    logger = MockLogger()
+    rs = _role_set(["prefill", "prefill", "decode"], logger=logger)
+    router = FleetRouter(logger, Registry(), rs, QuotaTable(0.0, 0.0))
+    kv_hash = prompt_hash([7, 8, 9])
+    donor = router._kv_donor(kv_hash)
+    assert donor is not None and donor.role == "prefill"
+    # deterministic: rendezvous on the hash over the prefill tier only
+    expected = affinity_order(kv_hash, ["r0", "r1"])[0]
+    assert donor.name == expected
+    assert router._kv_donor("") is None
+    # a mixed/decode-only fleet has no dedicated donors
+    no_prefill = _role_set(["mixed", "decode"], logger=logger)
+    router2 = FleetRouter(logger, Registry(), no_prefill,
+                          QuotaTable(0.0, 0.0))
+    assert router2._kv_donor(kv_hash) is None
+
+
+def test_explicit_session_key_outranks_kv_hash_affinity():
+    """KV-hash rendezvous replaces the prompt-head HEURISTIC only; a
+    client that pinned a session keeps its pin."""
+    from gofr_tpu.fleet.router import FleetRouter
+    from gofr_tpu.http.request import Request
+
+    body = {"tokens": [1, 2, 3]}
+    assert not FleetRouter._explicit_affinity(
+        Request("POST", "/generate", {}), body)
+    assert FleetRouter._explicit_affinity(
+        Request("POST", "/generate", {"x-session-id": "conv"}), body)
+    assert FleetRouter._explicit_affinity(
+        Request("POST", "/generate", {"x-affinity-key": "k"}), body)
+    assert FleetRouter._explicit_affinity(
+        Request("POST", "/generate", {}), {"user": "alice"})
+
+
+# -- unit: quota redis outage-window observability -----------------------------
+
+class _FlakyRedis:
+    """A chainable pipeline stub with a kill switch — deterministic
+    outage windows without racing a real miniredis teardown."""
+
+    def __init__(self):
+        self.down = False
+
+    def pipeline(self):
+        if self.down:
+            raise ConnectionError("redis down")
+        return self
+
+    def hget(self, *a):
+        return self
+
+    def hset(self, *a):
+        return self
+
+    def expire(self, *a):
+        return self
+
+    def execute(self):
+        if self.down:
+            raise ConnectionError("redis down")
+        return [None, None]
+
+
+def test_quota_fail_open_counts_fallbacks_and_logs_once_per_outage():
+    """A silent redis outage must be VISIBLE: every fail-open take
+    counts on gofr_tpu_router_quota_fallback_total (and the stats
+    block), while the log gets ONE line per outage window — not one per
+    request — and recovery re-arms the next window's line."""
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.testutil import MockLogger
+
+    logger = MockLogger()
+    registry = Registry()
+    redis = _FlakyRedis()
+    table = QuotaTable(rate_rps=100.0, burst=10.0, redis=redis,
+                       logger=logger, metrics=registry)
+    counter = registry.counter("gofr_tpu_router_quota_fallback_total")
+    assert table.take("t")[0] and counter.value() == 0
+    assert not table.stats()["redis_down"]
+
+    redis.down = True
+    for _ in range(5):
+        assert table.take("t")[0]  # fail-open: still admitted
+    assert counter.value() == 5
+    stats = table.stats()
+    assert stats["redis_down"] and stats["fallbacks"] == 5
+    assert stats["backend"] == "redis"
+    failed_lines = [ln for ln in logger.lines if "failed" in ln]
+    assert len(failed_lines) == 1  # once per window, not per request
+
+    redis.down = False
+    assert table.take("t")[0]
+    assert counter.value() == 5  # recovery takes are not fallbacks
+    assert not table.stats()["redis_down"]
+    assert any("recovered" in ln for ln in logger.lines)
+
+    # a SECOND outage logs its own first-failure line
+    redis.down = True
+    assert table.take("t")[0]
+    failed_lines = [ln for ln in logger.lines if "failed" in ln]
+    assert len(failed_lines) == 2
+
+
+# -- e2e: disaggregated prefill/decode (ISSUE 11 acceptance) -------------------
+
+def test_disagg_fleet_corrupt_and_dead_donor_streams_bit_identical(
+    tmp_path, monkeypatch
+):
+    """The acceptance spine: a 1-prefill/2-decode echo fleet behind the
+    router. Decode-bound streams carry an X-KV-Donor stamp naming the
+    prefill replica; corrupting a KV payload mid-pull AND killing the
+    donor mid-pull both yield a COMPLETED, bit-identical client stream
+    via local-prefill fallback, every outcome lands on
+    gofr_tpu_kv_transfer_total and /admin/fleet, and no BlockPool
+    refcount leaks anywhere (all pools balance back to idle)."""
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(3, per_replica_env=[
+        {"FLEET_ROLE": "prefill"},
+        {"FLEET_ROLE": "decode"},
+        {"FLEET_ROLE": "decode"},
+    ], env={"KV_TRANSFER_TIMEOUT_S": "1"}) as replicas, chaos_router(
+        replicas,
+        # rotation state frozen after the initial probe: the donor must
+        # stay "healthy" in the router's view even once its listener is
+        # killed, so the hint keeps getting stamped and the RECEIVER's
+        # pull (not the prober) discovers the death
+        env={"FLEET_PROBE_INTERVAL_S": "30"},
+    ) as app:
+        donor = replicas[0]
+        base = f"http://127.0.0.1:{app.http_port}"
+        fleet = app.container.fleet
+        _wait(lambda: len(fleet.replica_set.in_rotation()) == 3,
+              message="3 replicas in rotation")
+        # roles ride the /admin/engine scrape, which lands AFTER the
+        # rotation entry the _wait above observed (same probe thread,
+        # separate HTTP request) — so wait for every replica's role
+        _wait(lambda: [fleet.replica_set.by_name(n).role
+                       for n in ("r0", "r1", "r2")]
+              == ["prefill", "decode", "decode"],
+              message="advertised roles scraped")
+
+        def stream_tokens(prompt, base_url=None):
+            payload = {"model": "echo", "prompt": prompt, "max_tokens": 6,
+                       "stream": True}
+            req = urllib.request.Request(
+                (base_url or base) + "/v1/completions",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=20) as resp:
+                assert resp.status == 200
+                tokens, _, raw = _read_sse_tokens(resp)
+            assert b"data: [DONE]" in raw  # completed, never truncated
+            assert len(tokens) == 6
+            return tokens
+
+        def donor_stream(prompt):
+            """Clean reference + donor warm-up in one: the donor serves
+            (and caches) the prompt itself; echo decoding is
+            deterministic across replicas, so its token stream is the
+            bit-identity baseline for the fallback streams below."""
+            return stream_tokens(prompt, base_url=donor.address)
+
+        def xfer_totals():
+            out: dict = {}
+            for r in replicas[1:]:
+                _, body, _ = _get(f"{r.address}/admin/engine")
+                for k, v in json.loads(body)["data"]["kv_transfer"].items():
+                    if isinstance(v, int):
+                        out[k] = out.get(k, 0) + v
+            return out
+
+        # scenario 0, the happy path: a donor-warmed prompt streamed
+        # through the router is pulled from the donor (outcome ok)
+        prompt0 = list(range(1, 40))
+        clean0 = donor_stream(prompt0)
+        assert stream_tokens(prompt0) == clean0
+        assert xfer_totals()["ok"] >= 1  # the donor stamp was honored
+
+        # scenario 1: payload corrupted mid-pull -> per-block CRC
+        # catches it, local-prefill fallback, bit-identical stream.
+        # Fresh prompt: the serving decode replica must actually PULL
+        # (a locally-warm prompt skips the transfer entirely).
+        prompt1 = list(range(100, 140))
+        clean1 = donor_stream(prompt1)
+        donor.chaos.corrupting_proxy(mode="flip", n=1, after_bytes=280)
+        assert stream_tokens(prompt1) == clean1
+        assert xfer_totals()["corrupt"] == 1
+        assert xfer_totals()["fallback"] == 1
+
+        # scenario 2: donor killed mid-pull — the body ends with no
+        # trailer frame, exactly what a dying donor process leaves on
+        # the wire -> detected, fallback, bit-identical
+        prompt2 = list(range(200, 250))
+        clean2 = donor_stream(prompt2)
+        donor.chaos.corrupting_proxy(mode="truncate", n=1, after_bytes=80)
+        assert stream_tokens(prompt2) == clean2
+        assert xfer_totals()["corrupt"] == 2
+
+        # scenario 3: donor wedged mid-pull (drip-feeding past the
+        # budget) -> timeout, fallback, bit-identical
+        prompt3 = list(range(300, 340))
+        clean3 = donor_stream(prompt3)
+        donor.chaos.corrupting_proxy(mode="stall", n=1, after_bytes=50,
+                                     stall_s=4.0)
+        assert stream_tokens(prompt3) == clean3
+        assert xfer_totals()["timeout"] == 1
+
+        # scenario 4: the donor is GONE entirely (listener down, the
+        # router still believes in it) -> refused pull, fallback
+        donor.stop_listener()
+        prompt4 = list(range(400, 440))
+        stream_tokens(prompt4)
+        assert xfer_totals()["timeout"] == 2
+        donor.start_listener()
+
+        # route records carry the disagg evidence
+        snap = _fleet_snapshot(app)
+        routes = [r for r in snap["routes"]
+                  if r["path"] == "/v1/completions"]
+        assert routes and all(r["role"] == "decode" for r in routes)
+        assert any(r["kv_donor"] == "r0" for r in routes)
+        # decode work landed on the decode tier while it was healthy
+        for r in routes:
+            assert r["attempts"][-1]["replica"] in ("r1", "r2")
+        # /admin/fleet surfaces each replica's role + transfer ledger
+        by_name = {r["name"]: r for r in snap["replica_set"]["replicas"]}
+        assert by_name["r0"]["role"] == "prefill"
+        _wait(lambda: (
+            (_fleet_snapshot(app)["replica_set"]["replicas"][1].get("engine")
+             or {}).get("kv_transfer") is not None
+        ), timeout=5, message="kv_transfer ledger scraped onto /admin/fleet")
+
+        # every outcome visible, fleet-wide
+        merged = xfer_totals()
+        assert merged["corrupt"] == 2 and merged["timeout"] == 2
+        assert merged["fallback"] == 4 and merged["ok"] >= 1
+
+        # zero refcount leaks fleet-wide: every pool balances to idle
+        for r in replicas:
+            _, body, _ = _get(f"{r.address}/admin/engine")
+            kv = json.loads(body)["data"]["kv_blocks"]
+            assert kv["active"] == 0 and kv["reserved"] == 0, r.name
+
+
+def test_role_routing_off_restores_mixed_behavior(tmp_path, monkeypatch):
+    """FLEET_ROLE_ROUTING=off: advertised roles are ignored, no donor
+    stamps, routing is exactly the pre-disaggregation fleet."""
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(2, per_replica_env=[
+        {"FLEET_ROLE": "prefill"}, {"FLEET_ROLE": "decode"},
+    ]) as replicas, chaos_router(
+        replicas, env={"FLEET_ROLE_ROUTING": "off"},
+    ) as app:
+        base = f"http://127.0.0.1:{app.http_port}"
+        fleet = app.container.fleet
+        assert fleet.role_routing is False
+        _wait(lambda: len(fleet.replica_set.in_rotation()) == 2,
+              message="2 replicas in rotation")
+        status, _, _ = _completion(base, [1, 2, 3])
+        assert status == 200
+        snap = _fleet_snapshot(app)
+        route = snap["routes"][0]
+        assert route["role"] is None and route["kv_donor"] is None
+        assert snap["role_routing"] is False

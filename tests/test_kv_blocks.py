@@ -547,3 +547,89 @@ def test_host_arena_sharded_write_read_fidelity():
 def test_host_arena_shard_divisibility_enforced():
     with pytest.raises(ValueError, match="tp=3 does not divide"):
         HostTokenArena(8, 8, shards=3)
+
+
+# -- cross-replica transfer pins (fleet KV handoff) ---------------------------
+
+def test_transfer_pin_release_balances_refcounts():
+    """The export path pins an entry's blocks for the wire's lifetime;
+    a normal close releases them and the pool balances back to its
+    pre-pull state."""
+    from gofr_tpu.tpu.kv_blocks import TransferPin
+
+    pool, _ = _pool()
+    blocks = pool.alloc(3)
+    before = pool.stats()
+    pin = TransferPin(pool, blocks, ttl_s=60.0)
+    assert not pin.released and not pin.expired
+    pin.release()
+    assert pin.released
+    assert pool.stats() == before
+    pool.release_blocks(blocks)
+    assert pool.stats()["free"] == 16  # nothing leaked overall
+
+
+def test_transfer_pin_ttl_guard_covers_a_dead_serving_thread():
+    """The refcount-leak regression: a pin whose owner dies mid-send
+    (release never called) must NOT leak — the named bounded-lifetime
+    timer releases it, and the blocks become evictable again."""
+    import time
+
+    from gofr_tpu.tpu.kv_blocks import TransferPin
+
+    pool, _ = _pool()
+    blocks = pool.alloc(2)
+    before = pool.stats()
+    pin = TransferPin(pool, blocks, ttl_s=0.1)
+    # the serving thread "dies" here: nobody calls release()
+    deadline = time.monotonic() + 5.0
+    while not pin.released and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert pin.expired and pin.released
+    assert pool.stats() == before
+    pool.release_blocks(blocks)  # the original refs are still exact
+
+
+def test_transfer_pin_release_is_idempotent_vs_the_timer():
+    """Late releaser after the TTL fired (or double release): a no-op,
+    never a double-free."""
+    import time
+
+    from gofr_tpu.tpu.kv_blocks import TransferPin
+
+    pool, _ = _pool()
+    blocks = pool.alloc(1)
+    pin = TransferPin(pool, blocks, ttl_s=0.05)
+    deadline = time.monotonic() + 5.0
+    while not pin.released and time.monotonic() < deadline:
+        time.sleep(0.01)
+    pin.release()  # the owner wakes up late
+    pin.release()  # and is confused
+    st = pool.stats()
+    assert st["active"] == 1  # only the caller's own alloc refs remain
+    pool.release_blocks(blocks)
+    assert pool.stats()["free"] == 16
+
+
+def test_transfer_pin_keeps_cached_entry_alive_through_eviction():
+    """The advertise→pull race the pin exists for: the entry is evicted
+    WHILE pinned — its blocks must survive until the pin drops, then
+    free."""
+    from gofr_tpu.tpu.kv_blocks import TransferPin
+
+    arena = HostTokenArena(8, 4)
+    pool = BlockPool(8, 4, arena=arena, cache_entries=4)
+    ids = np.arange(1, 9, dtype=np.int32)
+    t = pool.reserve(8)
+    t.length = 8
+    arena.write(t, 0, ids)
+    pool.cache_put(ids.tobytes(), t, {"length": 8})
+    entry = pool.cache_lookup(ids.tobytes())
+    pin = TransferPin(pool, entry.table.blocks, ttl_s=60.0)
+    pool.cache_clear()  # eviction mid-transfer
+    # the wire can still read the pinned blocks' content
+    np.testing.assert_array_equal(
+        arena.read(BlockTable(list(pin.blocks), 8)), ids
+    )
+    pin.release()
+    assert pool.stats()["free"] == 8  # eviction completed once unpinned

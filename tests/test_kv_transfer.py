@@ -1,0 +1,546 @@
+"""Cross-replica KV handoff (fleet/kvwire.py + /admin/kv + the device
+pull path): wire-format integrity units — every way a transfer stream
+can lie is DETECTED, never installed — then compile-free e2e over real
+sockets: a donor echo replica serves its cached block tables, a
+receiver pulls/verifies/aliases them, and EVERY injected failure
+(bit-flip, truncation, stall, eviction, dead donor) degrades to local
+chunked prefill with a bit-identical result and the outcome counted on
+``gofr_tpu_kv_transfer_total``."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gofr_tpu.fleet import kvwire
+from gofr_tpu.tpu.kv_blocks import (
+    BlockPool,
+    ForeignKVRejected,
+    HostPagedKV,
+    HostTokenArena,
+)
+
+
+# -- helpers -------------------------------------------------------------------
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _post(url, payload, headers=None, timeout=15):
+    send = {"Content-Type": "application/json"}
+    send.update(headers or {})
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=send, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _entry_bytes(spec, payloads):
+    return b"".join(kvwire.encode_entry(spec, payloads))
+
+
+def _spec(payloads, **extra):
+    spec = {"kind": "host-tokens", "block_tokens": 4,
+            "prompt_hash": "ab" * 16, "length": 7,
+            "n_blocks": len(payloads), "meta": {"length": 7}}
+    spec.update(extra)
+    return spec
+
+
+# -- wire format: integrity units ---------------------------------------------
+
+def test_wire_roundtrip_and_chunk_boundary_agnosticism():
+    payloads = [b"abcd" * 4, b"wxyz"]
+    raw = _entry_bytes(_spec(payloads), payloads)
+    # whole-buffer decode
+    header, got = kvwire.decode_stream([raw])
+    assert got == payloads
+    assert header["version"] == kvwire.WIRE_VERSION
+    assert header["prompt_hash"] == "ab" * 16
+    # byte-by-byte: frame boundaries never align with feed boundaries
+    decoder = kvwire.WireDecoder()
+    events = []
+    for i in range(len(raw)):
+        events.extend(decoder.feed(raw[i:i + 1]))
+    decoder.finish()
+    assert [e[0] for e in events] == ["header", "block", "block", "end"]
+    assert [e[2] for e in events if e[0] == "block"] == payloads
+
+
+def test_wire_bit_flip_fails_the_blocks_own_crc():
+    payloads = [b"abcd" * 4, b"wxyz"]
+    raw = bytearray(_entry_bytes(_spec(payloads), payloads))
+    # flip one bit inside the SECOND block's payload (the last 16 bytes
+    # are the trailer frame; the 4-byte payload sits just before it)
+    flip_at = len(raw) - 18
+    raw[flip_at] ^= 0x01
+    with pytest.raises(kvwire.ChecksumMismatch, match="CRC"):
+        kvwire.decode_stream([bytes(raw)])
+
+
+def test_wire_truncation_is_detected_by_the_missing_trailer():
+    payloads = [b"abcd" * 4, b"wxyz"]
+    raw = _entry_bytes(_spec(payloads), payloads)
+    for cut in (len(raw) - 17, len(raw) // 2, 30):
+        with pytest.raises(kvwire.Truncated):
+            kvwire.decode_stream([raw[:cut]])
+
+
+def test_wire_trailer_count_mismatch_is_truncation():
+    payloads = [b"abcd"]
+    frames = [kvwire.encode_header(_spec(payloads)),
+              kvwire.encode_block(0, payloads[0]),
+              kvwire.encode_trailer(2)]  # promises a block that never came
+    with pytest.raises(kvwire.Truncated, match="promises 2"):
+        kvwire.decode_stream(frames)
+
+
+def test_wire_mis_sized_trailer_stays_inside_the_error_contract():
+    """A CRC-valid trailer whose payload is not exactly 4 bytes must be
+    a KVWireError (corrupt), never a struct.error escaping the decoder
+    contract."""
+    import struct
+    import zlib
+
+    payloads = [b"abcd"]
+    frames = list(kvwire.encode_entry(_spec(payloads), payloads))
+    bad_payload = b"\x01\x00\x00"  # 3 bytes, CRC freshly computed
+    frames[-1] = struct.pack(
+        "<III", kvwire.END_INDEX, len(bad_payload), zlib.crc32(bad_payload)
+    ) + bad_payload
+    with pytest.raises(kvwire.ChecksumMismatch):
+        kvwire.decode_stream([b"".join(frames)])
+
+
+def test_wire_out_of_order_and_post_trailer_bytes_rejected():
+    payloads = [b"abcd", b"efgh"]
+    frames = [kvwire.encode_header(_spec(payloads)),
+              kvwire.encode_block(1, payloads[1])]  # skipped index 0
+    with pytest.raises(kvwire.ChecksumMismatch, match="out of order"):
+        kvwire.decode_stream(frames)
+    good = _entry_bytes(_spec(payloads), payloads)
+    with pytest.raises(kvwire.ChecksumMismatch, match="after the trailer"):
+        kvwire.decode_stream([good + b"x"])
+
+
+def test_wire_version_skew_refused_before_any_payload():
+    # bad magic
+    with pytest.raises(kvwire.VersionSkew, match="magic"):
+        kvwire.WireDecoder().feed(b"NOPE" + b"\x00" * 8)
+    # wrong version number
+    raw = kvwire.MAGIC + _u32(b'{"version":99}')
+    with pytest.raises(kvwire.VersionSkew, match="99"):
+        kvwire.WireDecoder().feed(raw)
+    # unparseable / non-object headers
+    for body in (b"not json", b"[1,2]"):
+        with pytest.raises(kvwire.VersionSkew):
+            kvwire.WireDecoder().feed(kvwire.MAGIC + _u32(body))
+    # arena spec divergence
+    header = {"kind": "host-tokens", "block_tokens": 8}
+    with pytest.raises(kvwire.VersionSkew, match="block_tokens"):
+        kvwire.check_spec(header, {"kind": "host-tokens", "block_tokens": 4})
+
+
+def _u32(body: bytes) -> bytes:
+    import struct
+
+    return struct.pack("<I", len(body)) + body
+
+
+def test_wire_oversized_claims_rejected():
+    import struct
+
+    head = struct.pack("<III", 0, kvwire.MAX_BLOCK_BYTES + 1, 0)
+    decoder = kvwire.WireDecoder()
+    decoder.feed(_entry_bytes(_spec([]), [])[: len(kvwire.MAGIC)])
+    with pytest.raises(kvwire.KVWireError):
+        # a frame claiming more than any block can hold is a framing
+        # error the receiver must not buffer toward
+        full = kvwire.WireDecoder()
+        full.feed(kvwire.encode_header(_spec([])))
+        full.feed(head)
+    with pytest.raises(ValueError, match="bound"):
+        kvwire.encode_block(0, b"x" * (kvwire.MAX_BLOCK_BYTES + 1))
+
+
+def test_wire_frames_beyond_header_claim_rejected_before_buffering():
+    """A donor streaming more frames than its header claims must be
+    cut off at the first excess frame — NOT buffered until a post-hoc
+    count check (that gap was an unbounded-memory hole)."""
+    payloads = [b"abcd"]
+    frames = [kvwire.encode_header(_spec(payloads)),
+              kvwire.encode_block(0, payloads[0]),
+              kvwire.encode_block(1, b"excess")]
+    with pytest.raises(kvwire.ChecksumMismatch, match="claim"):
+        kvwire.decode_stream(frames)
+    # fewer blocks than claimed (consistent trailer) is truncation
+    short = [kvwire.encode_header(_spec([b"abcd", b"efgh"])),
+             kvwire.encode_block(0, b"abcd"),
+             kvwire.encode_trailer(1)]
+    with pytest.raises(kvwire.Truncated, match="short of the header"):
+        kvwire.decode_stream(short)
+
+
+def test_wire_header_claim_bounded_by_receiver_expectation():
+    """The receiver knows how many blocks the prompt can need; a donor
+    claiming more is refused at the header."""
+    payloads = [b"abcd", b"efgh"]
+    raw = _entry_bytes(_spec(payloads), payloads)
+    with pytest.raises(kvwire.VersionSkew, match="at most 1"):
+        kvwire.decode_stream([raw], max_blocks=1)
+    header, got = kvwire.decode_stream([raw], max_blocks=2)
+    assert got == payloads
+    for bad in (None, -1, "2", 1.5, True):
+        with pytest.raises(kvwire.VersionSkew, match="n_blocks"):
+            kvwire.decode_stream(
+                [_entry_bytes(_spec(payloads, n_blocks=bad), payloads)]
+            )
+
+
+def test_untrusting_replica_never_pulls(tmp_path, monkeypatch):
+    """X-KV-Donor names a URL the replica will FETCH into its shared
+    prefix cache: client-minted it is an SSRF/cache-poisoning
+    primitive, so the device acts on it only under
+    KV_TRANSFER_TRUST_HINT=on (the FLEET_TRUST_TENANT_HEADER
+    contract). With the flag off (the production default for a
+    client-facing replica), a request carrying X-KV-Donor completes
+    normally via local prefill and NO pull ever leaves the replica —
+    zero transfer outcomes, donor serves nothing."""
+    from gofr_tpu.devtools.chaos import chaos_fleet
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(2, per_replica_env=[
+        {"FLEET_ROLE": "prefill"},
+        {"FLEET_ROLE": "decode", "KV_TRANSFER_TRUST_HINT": "off"},
+    ]) as (donor, recv):
+        prompt = list(range(1, 40))
+        _, clean = _post(donor.address + "/generate",
+                         {"tokens": prompt, "max_new_tokens": 6})
+        status, body = _post(
+            recv.address + "/generate",
+            {"tokens": prompt, "max_new_tokens": 6},
+            headers={"X-KV-Donor": donor.address},
+        )
+        assert status == 200 and body == clean
+        stats, _ = _xfer(recv)
+        assert all(stats.get(k, 0) == 0 for k in kvwire.TRANSFER_OUTCOMES)
+        donor_stats, _ = _xfer(donor)
+        assert donor_stats["served"] == 0
+
+
+def test_parse_kv_hint_accepts_only_peer_base_urls():
+    ok = kvwire.parse_kv_hint
+    assert ok("http://10.0.0.5:8000") == "http://10.0.0.5:8000"
+    assert ok("https://replica-3.fleet.local") == "https://replica-3.fleet.local"
+    assert ok(" http://r1:9000/ ") == "http://r1:9000"
+    for bad in (
+        None, "", "r1:8000", "ftp://r1", "http://", "http://r1/admin/kv",
+        "http://user:pw@r1:8000", "http://r1:8000?x=1", "http://r1:8000#f",
+        "http://r1:abc", "http://" + "a" * 300,
+    ):
+        assert ok(bad) is None, bad
+
+
+def test_prompt_hash_matches_cache_key_hash():
+    ids = np.asarray([5, 6, 7, 8], np.int32)
+    assert kvwire.prompt_hash([5, 6, 7, 8]) == kvwire.hash_of_key(ids.tobytes())
+
+
+# -- arena codec + install units ----------------------------------------------
+
+def test_host_arena_export_ingest_roundtrip():
+    arena = HostTokenArena(8, 4)
+    pool = BlockPool(8, 4, arena=arena)
+    ids = np.asarray([3, 1, 4, 1, 5, 9, 2], np.int32)  # boundary block short
+    t = pool.reserve(ids.size)
+    t.length = ids.size
+    arena.write(t, 0, ids)
+    payloads = [arena.export_block_payload(t, j) for j in range(2)]
+    assert len(payloads[0]) == 16 and len(payloads[1]) == 12  # 4 + 3 tokens
+    t2 = pool.reserve(ids.size)
+    t2.length = ids.size
+    for j, p in enumerate(payloads):
+        arena.ingest_block_payload(t2, j, p)
+    np.testing.assert_array_equal(arena.read(t2), ids)
+
+
+def test_host_arena_ingest_rejects_malformed_payloads():
+    arena = HostTokenArena(8, 4)
+    pool = BlockPool(8, 4, arena=arena)
+    t = pool.reserve(4)
+    t.length = 4
+    with pytest.raises(ForeignKVRejected, match="whole number"):
+        arena.ingest_block_payload(t, 0, b"xyz")
+    with pytest.raises(ForeignKVRejected, match="0 tokens"):
+        arena.ingest_block_payload(t, 0, b"")
+    with pytest.raises(ForeignKVRejected, match="5 tokens"):
+        arena.ingest_block_payload(t, 0, b"\x01\x00\x00\x00" * 5)
+
+
+def test_install_remote_verifies_readback_and_rolls_back():
+    """Checksums guard the wire; the readback guards the CONTENT — a
+    payload that decodes to different tokens than the prompt being
+    admitted must be rejected AND leave no trace in the pool."""
+    arena = HostTokenArena(8, 4)
+    pool = BlockPool(8, 4, arena=arena)
+    engine = HostPagedKV(pool, arena)
+    ids = np.arange(1, 8, dtype=np.int32)
+    wrong = np.asarray([9, 9, 9, 9], np.int32).tobytes()
+    before = pool.stats()
+    with pytest.raises(ForeignKVRejected, match="different token"):
+        engine.install_remote(ids, [wrong, wrong[:12]], {})
+    assert pool.stats() == before  # full rollback
+    with pytest.raises(ForeignKVRejected, match="block payloads"):
+        engine.install_remote(ids, [wrong], {})  # count mismatch
+    assert pool.stats() == before
+
+
+def test_install_remote_exhaustion_is_local_not_corrupt():
+    arena = HostTokenArena(4, 4)
+    pool = BlockPool(4, 4, arena=arena)
+    engine = HostPagedKV(pool, arena)
+    pool.alloc(4)  # nothing left
+    ids = np.arange(1, 5, dtype=np.int32)
+    assert engine.install_remote(ids, [ids.tobytes()], {}) is False
+
+
+def test_install_remote_aliases_into_the_next_admit():
+    """The point of the pull: after install, admitting the same prompt
+    is a copy-free HIT."""
+    arena = HostTokenArena(16, 4)
+    pool = BlockPool(16, 4, arena=arena)
+    engine = HostPagedKV(pool, arena)
+    ids = np.arange(10, 21, dtype=np.int32)
+    payloads = [
+        np.ascontiguousarray(ids[j * 4:(j + 1) * 4]).tobytes()
+        for j in range(3)
+    ]
+    assert engine.install_remote(ids, payloads, {}) is True
+    seq = engine.admit(ids, max_new=2)
+    assert seq.kind == "hit" and seq.aliased_blocks == 3
+    np.testing.assert_array_equal(engine.prompt_tokens(seq), ids)
+    engine.abort(seq)
+    assert engine.install_remote(ids, payloads, {}) is True  # already warm
+
+
+# -- e2e: pull, verify, ingest, fall back -------------------------------------
+
+def _xfer(rep):
+    snap = json.loads(_get(rep.address + "/admin/engine")[1])["data"]
+    return snap["kv_transfer"], snap["kv_blocks"]
+
+
+def test_transfer_ok_aliases_the_donor_prefix(tmp_path, monkeypatch):
+    """Happy path over real sockets: the receiver pulls the donor's
+    cached prompt blocks, installs them, and the request admits as a
+    prefix HIT — outcome ``ok``, donor ``served`` counted, both pools
+    balanced back to idle."""
+    from gofr_tpu.devtools.chaos import chaos_fleet
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(2, per_replica_env=[
+        {"FLEET_ROLE": "prefill"}, {"FLEET_ROLE": "decode"},
+    ]) as (donor, recv):
+        prompt = list(range(1, 40))
+        _, clean = _post(donor.address + "/generate",
+                         {"tokens": prompt, "max_new_tokens": 6})
+        hits_before = recv.app.container.tpu.runner.paged.prefix_stats["hits"]
+        status, body = _post(
+            recv.address + "/generate",
+            {"tokens": prompt, "max_new_tokens": 6},
+            headers={"X-KV-Donor": donor.address},
+        )
+        assert status == 200 and body == clean  # bit-identical
+        stats, kv = _xfer(recv)
+        assert stats["ok"] == 1 and stats["fallback"] == 0
+        paged = recv.app.container.tpu.runner.paged
+        assert paged.prefix_stats["hits"] == hits_before + 1  # aliased, not re-prefilled
+        assert kv["active"] == 0 and kv["reserved"] == 0
+        donor_stats, donor_kv = _xfer(donor)
+        assert donor_stats["served"] == 1
+        assert donor_kv["active"] == 0 and donor_kv["reserved"] == 0
+        # the raw export decodes cleanly too (wire-format sanity on a
+        # REAL http body, not a synthetic frame list)
+        _, raw = _get(
+            donor.address + "/admin/kv/" + kvwire.prompt_hash(prompt)
+        )
+        header, payloads = kvwire.decode_stream([raw])
+        assert header["length"] == len(prompt)
+        got = np.concatenate([
+            np.frombuffer(p, np.int32) for p in payloads
+        ])
+        np.testing.assert_array_equal(got, np.asarray(prompt, np.int32))
+
+
+def test_tokened_admin_plane_still_transfers(tmp_path, monkeypatch):
+    """ADMIN_TOKEN gates /admin/kv on the donor; the receiver forwards
+    the fleet-shared token on its pull, so a tokened fleet keeps
+    transferring instead of silently 401ing every pull into
+    ``timeout`` fallbacks (while the raw un-tokened curl stays 401)."""
+    from gofr_tpu.devtools.chaos import chaos_fleet
+
+    monkeypatch.chdir(tmp_path)
+    # setenv, not chaos env=: _check_admin reads config LIVE at request
+    # time, while chaos replicas swap env only at construction — the
+    # process-wide var is what a tokened fleet actually looks like
+    monkeypatch.setenv("ADMIN_TOKEN", "fleet-secret")
+    with chaos_fleet(2, per_replica_env=[
+        {"FLEET_ROLE": "prefill"}, {"FLEET_ROLE": "decode"},
+    ]) as (donor, recv):
+        prompt = list(range(1, 40))
+        _, clean = _post(donor.address + "/generate",
+                         {"tokens": prompt, "max_new_tokens": 6})
+        status, body = _post(
+            recv.address + "/generate",
+            {"tokens": prompt, "max_new_tokens": 6},
+            headers={"X-KV-Donor": donor.address},
+        )
+        assert status == 200 and body == clean  # pulled, bit-identical
+        stats = recv.app.container.tpu.kv_transfer_stats
+        assert stats["ok"] == 1 and stats["fallback"] == 0
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(donor.address + "/admin/kv/" + kvwire.prompt_hash(prompt))
+        assert err.value.code == 401
+
+
+def test_transfer_failures_all_fall_back_bit_identical(tmp_path, monkeypatch):
+    """The robustness matrix on one fleet: bit-flip → ``corrupt``,
+    truncation → ``corrupt``, donor stall → ``timeout``, evicted/never-
+    seen → ``evicted``, donor listener dead → ``timeout`` — EVERY case
+    completes via local prefill with output identical to a clean run,
+    and the receiver's pool balances to idle (no leaked blocks)."""
+    from gofr_tpu.devtools.chaos import chaos_fleet
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(2, per_replica_env=[
+        {"FLEET_ROLE": "prefill"}, {"FLEET_ROLE": "decode"},
+    ], env={"KV_TRANSFER_TIMEOUT_S": "1"}) as (donor, recv):
+        def run(prompt, expect, warm=True, **chaos):
+            if warm:
+                _, clean = _post(donor.address + "/generate",
+                                 {"tokens": prompt, "max_new_tokens": 6})
+            else:
+                # clean reference from the receiver itself (dedup vs
+                # warm: the donor may be unreachable in this case)
+                clean = None
+            if chaos:
+                donor.chaos.corrupting_proxy(**chaos)
+            status, body = _post(
+                recv.address + "/generate",
+                {"tokens": prompt, "max_new_tokens": 6},
+                headers={"X-KV-Donor": donor.address}, timeout=20,
+            )
+            assert status == 200
+            if clean is not None:
+                assert body == clean, f"{expect}: fallback not bit-identical"
+            return body
+
+        base = 0
+        stats = lambda: _xfer(recv)[0]  # noqa: E731
+
+        run(list(range(1, 40)), "corrupt",
+            mode="flip", n=1, after_bytes=280)
+        assert stats()["corrupt"] == 1 and stats()["fallback"] == 1
+
+        run(list(range(100, 140)), "corrupt",
+            mode="truncate", n=1, after_bytes=100)
+        assert stats()["corrupt"] == 2 and stats()["fallback"] == 2
+
+        run(list(range(200, 260)), "timeout",
+            mode="stall", n=1, after_bytes=50, stall_s=4.0)
+        assert stats()["timeout"] == 1 and stats()["fallback"] == 3
+
+        # never cached on the donor: 404 → evicted
+        run(list(range(500, 540)), "evicted", warm=False)
+        assert stats()["evicted"] == 1 and stats()["fallback"] == 4
+
+        donor.stop_listener()
+        run(list(range(600, 640)), "timeout", warm=False)
+        assert stats()["timeout"] == 2 and stats()["fallback"] == 5
+        assert stats()["ok"] == 0
+
+        # zero refcount leaks: the receiver's pool is idle again
+        _, kv = _xfer(recv)
+        assert kv["active"] == 0 and kv["reserved"] == 0
+        # and the counter is on /metrics with every outcome label
+        _, metrics = _get(recv.address + "/metrics")
+        text = metrics.decode()
+        for outcome, value in (("corrupt", 2), ("timeout", 2),
+                               ("evicted", 1), ("fallback", 5)):
+            assert (f'gofr_tpu_kv_transfer_total{{outcome="{outcome}"}} '
+                    f"{value}") in text
+
+
+def test_transfer_export_respects_deadline_and_disable(tmp_path, monkeypatch):
+    """The donor side honors the PR 10 deadline budget (an expired
+    budget truncates the stream — which the receiver's trailer check
+    catches), and KV_TRANSFER=off 404s both directions."""
+    from gofr_tpu.devtools.chaos import chaos_fleet
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(2, per_replica_env=[
+        {}, {"KV_TRANSFER": "off"},
+    ]) as (donor, off):
+        prompt = list(range(1, 40))
+        _post(donor.address + "/generate", {"tokens": prompt, "max_new_tokens": 2})
+        phash = kvwire.prompt_hash(prompt)
+        # a microscopic budget: the stream stops before the trailer
+        req = urllib.request.Request(
+            donor.address + f"/admin/kv/{phash}",
+            headers={"X-Request-Deadline-Ms": "1"},
+        )
+        time.sleep(0.002)  # the budget is spent before the first frame
+        with urllib.request.urlopen(req, timeout=10) as r:
+            raw = r.read()
+        with pytest.raises(kvwire.Truncated):
+            kvwire.decode_stream([raw])
+        # transfer off: the export surface does not exist
+        _post(off.address + "/generate", {"tokens": prompt, "max_new_tokens": 2})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(off.address + f"/admin/kv/{phash}")
+        assert err.value.code == 404
+        # and the off replica never pulls despite a hint
+        status, _ = _post(
+            off.address + "/generate",
+            {"tokens": list(range(50, 70)), "max_new_tokens": 2},
+            headers={"X-KV-Donor": donor.address},
+        )
+        assert status == 200
+        stats, _ = _xfer(off)
+        assert all(
+            stats[k] == 0
+            for k in ("ok", "timeout", "corrupt", "evicted", "fallback")
+        )
+        assert stats["enabled"] is False
+        # donor-side pins all released (aborted deadline stream included)
+        _, donor_kv = _xfer(donor)
+        assert donor_kv["active"] == 0 and donor_kv["reserved"] == 0
+
+
+def test_malformed_donor_hints_degrade_to_local_prefill(tmp_path, monkeypatch):
+    """A garbage X-KV-Donor header must never 4xx or stall a request —
+    it parses to None and the request serves locally with no transfer
+    accounting at all."""
+    from gofr_tpu.devtools.chaos import chaos_fleet
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(1) as (rep,):
+        for hint in ("not-a-url", "ftp://r1:80", "http://e@vil:80",
+                     "http://peer:9/path"):
+            status, _ = _post(
+                rep.address + "/generate",
+                {"tokens": [1, 2, 3], "max_new_tokens": 2},
+                headers={"X-KV-Donor": hint},
+            )
+            assert status == 200
+        stats, _ = _xfer(rep)
+        assert all(
+            stats[k] == 0
+            for k in ("ok", "timeout", "corrupt", "evicted", "fallback")
+        )

@@ -81,7 +81,11 @@ def test_scanner_sees_the_known_registrations():
             "gofr_tpu_router_outstanding_depth",
             "gofr_tpu_router_inflight_depth",
             "gofr_tpu_router_upstream_seconds"} <= names
-    assert len(names) >= 33
+    # disaggregated prefill/decode (PR 11): the cross-replica KV
+    # transfer ledger + the quota redis fail-open counter
+    assert {"gofr_tpu_kv_transfer_total",
+            "gofr_tpu_router_quota_fallback_total"} <= names
+    assert len(names) >= 35
 
 
 def test_suffix_tables_match_gofrlint():

@@ -37,7 +37,18 @@ baseline:
   reclaim within ``baseline reclaim_ms * BENCH_GATE_RECLAIM_FACTOR``
   (default 10.0 — "within one chunk" is the contract; an order of
   magnitude past baseline means the abort hook stopped reaching the
-  decode loop).
+  decode loop);
+- the disaggregated KV handoff must stay protocol-cheap: the
+  cross-replica transfer path (pull + verify + install + aliased
+  admission over real HTTP) must finish within
+  ``local_prefill_ms_p50 * BENCH_GATE_TRANSFER_FACTOR`` (default
+  10.0, loose-first — echo "prefill" is nearly free so the ratio
+  prices pure protocol overhead; a blow-up here means the wire
+  format or the pin/verify path grew a stall), every pull must take
+  the fast path (``fallbacks == 0`` — a silent fallback would make
+  the latency number a lie), and one pull's wire size must stay
+  within ``baseline * 2`` (framing bloat: checksums + headers are
+  bounded, payload is the payload).
 
 Usage::
 
@@ -70,6 +81,9 @@ def gate(bench: dict, baseline: dict) -> list[str]:
     journal_factor = float(os.environ.get("BENCH_GATE_JOURNAL_FACTOR", "5.0"))
     shed_factor = float(os.environ.get("BENCH_GATE_SHED_FACTOR", "10.0"))
     reclaim_factor = float(os.environ.get("BENCH_GATE_RECLAIM_FACTOR", "10.0"))
+    transfer_factor = float(
+        os.environ.get("BENCH_GATE_TRANSFER_FACTOR", "10.0")
+    )
 
     if bench.get("backend") != baseline.get("backend"):
         failures.append(
@@ -179,6 +193,47 @@ def gate(bench: dict, baseline: dict) -> list[str]:
                     f"{base_reclaim}ms * {reclaim_factor} "
                     f"(= {base_reclaim * reclaim_factor:.1f}ms)"
                 )
+    transfer = bench.get("transfer_microbench") or {}
+    base_transfer = baseline.get("transfer_microbench") or {}
+    if base_transfer:
+        t_p50 = _num(transfer, "transfer_ms_p50")
+        local_p50 = _num(transfer, "local_prefill_ms_p50")
+        if t_p50 is None or local_p50 is None:
+            failures.append(
+                "transfer_microbench missing from the bench artifact"
+            )
+        else:
+            if local_p50 and t_p50 > local_p50 * transfer_factor:
+                failures.append(
+                    f"kv-transfer latency regression: {t_p50}ms p50 > "
+                    f"local-prefill {local_p50}ms * {transfer_factor} "
+                    f"(= {local_p50 * transfer_factor:.2f}ms)"
+                )
+            if transfer.get("fallbacks"):
+                failures.append(
+                    "kv-transfer pulls silently fell back to local "
+                    f"prefill ({transfer['fallbacks']}/"
+                    f"{transfer.get('rounds')}) — the transfer latency "
+                    "number is not measuring the transfer path"
+                )
+            wire = _num(transfer, "wire_bytes_per_pull")
+            base_wire = _num(base_transfer, "wire_bytes_per_pull")
+            # wire bytes scale with the prompt (BENCH_TRANSFER_PROMPT):
+            # only comparable when this run used the baseline's size
+            same_prompt = (
+                _num(transfer, "prompt_tokens")
+                == _num(base_transfer, "prompt_tokens")
+            )
+            if base_wire and same_prompt:
+                if wire is None:
+                    failures.append(
+                        "wire_bytes_per_pull missing from the bench artifact"
+                    )
+                elif wire > base_wire * 2:
+                    failures.append(
+                        f"kv wire format bloated: {wire} bytes/pull > "
+                        f"baseline {base_wire} * 2"
+                    )
     return failures
 
 
