@@ -123,6 +123,16 @@ on
 local chunked prefill — a transfer can make a request faster, never
 break it.
 
+Fleet-scale hardening keys (fleet/replica.py + fleet/admission.py,
+see docs/advanced-guide/fleet.md "Fleet simulation"):
+``FLEET_PROBE_JITTER`` (0.2 — decorrelated per-replica probe jitter
+as a fraction of ``FLEET_PROBE_INTERVAL_S``; 0 restores the
+synchronized sweep, which at N=16 fires every probe of a round in one
+burst window) and ``FLEET_QUOTA_CACHE_TTL_S`` (0.05 — short-TTL local
+token-lease cache over the redis quota bucket; 0 = one redis sync
+(two pipelined round trips) per request per tenant, the Zipf hot-key
+tax the fleetsim measures).
+
 Correctness-tooling keys (devtools/sanitizer.py + tests/conftest.py,
 see docs/advanced-guide/static-analysis.md): ``GOFR_SANITIZE=1`` arms
 the runtime concurrency sanitizer under tests;

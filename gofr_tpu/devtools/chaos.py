@@ -60,9 +60,22 @@ KV_CHAOS_PATHS = ("/admin/kv/",)
 
 
 class ChaosController:
-    """Thread-safe switchboard of armed failure modes."""
+    """Thread-safe switchboard of armed failure modes.
 
-    def __init__(self) -> None:
+    ``seed`` makes scenario randomness REPLAYABLE: every randomized
+    parameter a controller mode draws (today: the corrupted bit in
+    :meth:`corrupting_proxy` ``flip``) comes from :attr:`rng`, never
+    from the global ``random`` module — and any future mode wanting
+    randomness must do the same — so a failing CI run replays locally
+    from the seed recorded in its artifact
+    (``tools/fleetsim.py --seed ...``; the fleetsim trace/fault
+    schedules themselves are derived from the same master seed)."""
+
+    def __init__(self, seed: Optional[int] = None) -> None:
+        import random
+
+        self.seed = seed
+        self.rng = random.Random(seed)
         self._lock = threading.Lock()
         self._modes: dict[str, dict[str, Any]] = {}
         self.injected: dict[str, int] = {}  # mode -> times fired
@@ -85,8 +98,18 @@ class ChaosController:
         self.arm("slow_loris", delay_s=delay_s, paths=paths)
 
     def disconnect_after(self, chunks: int,
-                         paths: tuple = DEFAULT_CHAOS_PATHS) -> None:
-        self.arm("disconnect_after", chunks=chunks, paths=paths)
+                         paths: tuple = DEFAULT_CHAOS_PATHS,
+                         shots: Optional[int] = None) -> None:
+        """``shots`` bounds how many streamed responses get cut
+        (None = every one until cleared). A bounded burst lets a resume
+        hunt SUCCEED against the same replica once the shots are spent
+        — the fleetsim uses it to exercise mid-stream splicing without
+        manufacturing unrecoverable streams."""
+        if shots is None:
+            self.arm("disconnect_after", chunks=chunks, paths=paths)
+        else:
+            self.arm("disconnect_after", chunks=chunks, paths=paths,
+                     remaining=shots)
 
     def corrupting_proxy(self, mode: str = "flip", n: int = 1,
                          after_bytes: int = 512, stall_s: float = 5.0,
@@ -104,7 +127,10 @@ class ChaosController:
           ``timeout``).
 
         Defaults target ``/admin/kv/`` only — the serving plane (where
-        the local-prefill fallback runs) stays healthy."""
+        the local-prefill fallback runs) stays healthy. The flipped
+        bit is drawn from the controller's seeded :attr:`rng` at arm
+        time: which bit of the payload dies is part of the replayable
+        incident, not fresh noise per run."""
         if mode not in ("flip", "truncate", "stall"):
             raise ValueError(
                 f"corrupting_proxy mode '{mode}' not supported — use "
@@ -113,6 +139,7 @@ class ChaosController:
         self.arm(
             "kv_corrupt", remaining=n, kind=mode,
             after_bytes=after_bytes, stall_s=stall_s, paths=paths,
+            xor_mask=1 << self.rng.randint(0, 7),
         )
 
     def clear(self, mode: Optional[str] = None) -> None:
@@ -199,6 +226,7 @@ def chaos_middleware(controller: ChaosController):
                         mode=corrupt["kind"],
                         after_bytes=int(corrupt["after_bytes"]),
                         stall_s=float(corrupt["stall_s"]),
+                        xor_mask=int(corrupt.get("xor_mask", 0x40)),
                     )
             return response
 
@@ -224,10 +252,11 @@ async def _mangle_stream(stream: Any, delay_s: float,
 
 
 async def _corrupt_stream(stream: Any, mode: str, after_bytes: int,
-                          stall_s: float) -> Any:
+                          stall_s: float, xor_mask: int = 0x40) -> Any:
     """The :meth:`ChaosController.corrupting_proxy` byte-mangler,
-    applied to one streamed response body. ``flip`` XORs one bit in
-    the first byte past ``after_bytes`` (every later chunk passes
+    applied to one streamed response body. ``flip`` XORs ``xor_mask``
+    (drawn from the controller's seeded rng at arm time) into the
+    first byte past ``after_bytes`` (every later chunk passes
     untouched — the receiver must localize the damage via its per-block
     CRC); ``truncate`` ends the body there with a CLEAN end-of-stream
     (no exception: the trailer frame is simply missing, exactly what a
@@ -244,7 +273,7 @@ async def _corrupt_stream(stream: Any, mode: str, after_bytes: int,
             if mode == "stall":
                 await asyncio.sleep(stall_s)
             elif mode == "flip" and not mangled and chunk:
-                chunk = bytes([chunk[0] ^ 0x40]) + chunk[1:]
+                chunk = bytes([chunk[0] ^ xor_mask]) + chunk[1:]
                 mangled = True
         sent += len(chunk)
         yield chunk
@@ -411,7 +440,8 @@ class ChaosReplica:
 
 
 def build_replica(name: str, env: Optional[dict[str, str]] = None,
-                  port: Optional[int] = None) -> ChaosReplica:
+                  port: Optional[int] = None,
+                  seed: Optional[int] = None) -> ChaosReplica:
     """One echo replica app: real serving surface (OpenAI routes +
     ``/generate``), chaos middleware armed, watchdog on a short leash so
     injected device stalls flip the state machine within test budgets."""
@@ -437,7 +467,7 @@ def build_replica(name: str, env: Optional[dict[str, str]] = None,
         "GRPC_PORT": str(_free_port()),
     }
     overrides.update(env or {})
-    chaos = ChaosController()
+    chaos = ChaosController(seed=seed)
     with _env_overrides(overrides):
         app = gofr_tpu.new()
         app.router.use(chaos_middleware(chaos))
@@ -464,16 +494,22 @@ def _generate_handler(ctx: Any) -> Any:
 
 @contextlib.contextmanager
 def chaos_fleet(n: int = 3, env: Optional[dict[str, str]] = None,
-                per_replica_env: Optional[list[dict[str, str]]] = None
+                per_replica_env: Optional[list[dict[str, str]]] = None,
+                seed: Optional[int] = None
                 ) -> Iterator[list[ChaosReplica]]:
-    """N echo replicas, torn down in reverse on exit."""
+    """N echo replicas, torn down in reverse on exit. ``seed`` derives
+    one replayable sub-seed per replica's :class:`ChaosController`
+    (``seed + index`` — deterministic AND distinct streams)."""
     replicas: list[ChaosReplica] = []
     try:
         for i in range(n):
             merged = dict(env or {})
             if per_replica_env and i < len(per_replica_env):
                 merged.update(per_replica_env[i])
-            replicas.append(build_replica(f"r{i}", env=merged))
+            replicas.append(build_replica(
+                f"r{i}", env=merged,
+                seed=None if seed is None else seed + i,
+            ))
         yield replicas
     finally:
         for replica in reversed(replicas):

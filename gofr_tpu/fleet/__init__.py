@@ -22,13 +22,19 @@ docs/advanced-guide/fleet.md for the full table):
   ``FLEET_CONNECT_TIMEOUT_S`` (2), ``FLEET_READ_TIMEOUT_S`` (30),
   ``FLEET_AFFINITY`` (on), ``FLEET_AFFINITY_MAX_SKEW`` (4).
 - health: ``FLEET_PROBE_INTERVAL_S`` (1), ``FLEET_PROBE_TIMEOUT_S``
-  (1), ``FLEET_PROBE_HEDGE_MS`` (0 = off), ``FLEET_OUT_AFTER`` (2),
+  (1), ``FLEET_PROBE_JITTER`` (0.2 — decorrelated per-replica jitter
+  as a fraction of the interval; 0 restores the synchronized sweep),
+  ``FLEET_PROBE_HEDGE_MS`` (0 = off), ``FLEET_OUT_AFTER`` (2),
   ``FLEET_PROBATION_PROBES`` (3).
 - breaker: ``FLEET_BREAKER_THRESHOLD`` (5),
   ``FLEET_BREAKER_COOLDOWN_S`` (5).
 - admission: ``FLEET_QUOTA_RPS`` (0 = off), ``FLEET_QUOTA_BURST``
-  (2×rps), ``FLEET_TRUST_TENANT_HEADER`` (off), ``FLEET_MAX_INFLIGHT``
-  (256), ``FLEET_SATURATION_QUEUE`` (64), ``FLEET_RETRY_AFTER_S`` (1).
+  (2×rps), ``FLEET_QUOTA_CACHE_TTL_S`` (0.05 — short-TTL local lease
+  cache over the redis bucket, the hot-key fix; 0 = a redis sync —
+  two pipelined round trips — per request),
+  ``FLEET_TRUST_TENANT_HEADER`` (off),
+  ``FLEET_MAX_INFLIGHT`` (256), ``FLEET_SATURATION_QUEUE`` (64),
+  ``FLEET_RETRY_AFTER_S`` (1).
 - drain: ``FLEET_DRAIN_TIMEOUT_S`` (10).
 - ``FLEET_ROUTES`` — the forwarded surface, comma-separated
   ``METHOD /path`` pairs (default: the OpenAI serving surface +
@@ -137,6 +143,7 @@ def wire_fleet(app: Any) -> FleetRouter:
         replicas, logger,
         probe_interval_s=_f("FLEET_PROBE_INTERVAL_S", "1"),
         probe_timeout_s=_f("FLEET_PROBE_TIMEOUT_S", "1"),
+        probe_jitter=_f("FLEET_PROBE_JITTER", "0.2"),
         hedge_ms=_f("FLEET_PROBE_HEDGE_MS", "0"),
         out_after=_i("FLEET_OUT_AFTER", "2"),
         probation_probes=_i("FLEET_PROBATION_PROBES", "3"),
@@ -149,6 +156,7 @@ def wire_fleet(app: Any) -> FleetRouter:
         redis=container.redis,
         logger=logger,
         metrics=container.metrics,
+        cache_ttl_s=_f("FLEET_QUOTA_CACHE_TTL_S", "0.05"),
     )
     fleet = FleetRouter(
         logger, container.metrics, replica_set, quota,

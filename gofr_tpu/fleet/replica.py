@@ -23,13 +23,24 @@ blocks and batcher queue depth. Probes can optionally HEDGE: when
 ``hedge_ms`` > 0 a second probe fires if the first hasn't answered in
 that window and the first reply wins — the p99 of a health check on a
 busy replica stops deciding rotation membership.
+
+Probe SCHEDULING is per-replica and jittered (``probe_jitter``, a
+fraction of the interval): each replica draws its own next-due time
+from an independent RNG, so a 16-replica fleet never fires 16 probe
+threads + 16 engine scrapes in the same instant every interval — the
+fleetsim harness measured the synchronized sweep putting every probe
+of a round inside one 50 ms burst window at N=16, and the jittered
+schedule spreading them across the whole interval (FLEETSIM artifact,
+``hardening.probe_spread``).
 """
 
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import queue
+import random
 import threading
 import time
 from typing import Any, Optional
@@ -105,8 +116,13 @@ class Replica:
 
     @property
     def outstanding(self) -> int:
-        with self._lock:
-            return self._outstanding
+        # deliberately lock-free: reading an int attribute is atomic
+        # under the GIL, and this property sits inside the router's
+        # selection loop — N replicas × every request. Taking the
+        # writer lock here measurably serialized selection against
+        # dispatch accounting at fleet scale (the fleetsim's
+        # selection microbench is the regression watch).
+        return self._outstanding
 
     def snapshot(self) -> dict[str, Any]:
         return {
@@ -134,6 +150,7 @@ class ReplicaSet:
         logger: Any,
         probe_interval_s: float = 1.0,
         probe_timeout_s: float = 1.0,
+        probe_jitter: float = 0.2,
         hedge_ms: float = 0.0,
         out_after: int = 2,
         probation_probes: int = 3,
@@ -145,6 +162,12 @@ class ReplicaSet:
         self.logger = logger
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
+        # jitter as a FRACTION of the interval (0 = the old synchronized
+        # sweep, clamped below 1 so the schedule can never stall): each
+        # replica's next probe lands uniformly in interval*(1±jitter),
+        # drawn from a per-replica RNG — de-synchronization is the
+        # thundering-herd fix that is load-bearing at N=16
+        self.probe_jitter = max(0.0, min(0.9, probe_jitter))
         self.hedge_ms = hedge_ms
         self.out_after = max(1, out_after)
         self.probation_probes = max(1, probation_probes)
@@ -152,8 +175,9 @@ class ReplicaSet:
         self.affinity_max_skew = max(0, affinity_max_skew)
         self._on_state_change = on_state_change
         self._stop = threading.Event()
-        self._rr = 0  # round-robin tie-break for equal-outstanding picks
-        self._rr_lock = threading.Lock()
+        # round-robin tie-break for equal-outstanding picks; a C-level
+        # counter, not a locked int (see candidates())
+        self._rr = itertools.count(1)
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle ------------------------------------------------------------
@@ -199,12 +223,19 @@ class ReplicaSet:
             eligible = [r for r in eligible if r.role in (role, "mixed")]
         if not eligible:
             return []
-        with self._rr_lock:
-            self._rr += 1
-            rotate = self._rr
+        # lock-free rotating tie-break: itertools.count.__next__ is a
+        # single C call (GIL-atomic), where the old lock+int pair made
+        # every selection of every request serialize on one mutex
+        rotate = next(self._rr)
+        # outstanding is SNAPSHOTTED once per selection: the sort and
+        # the affinity-skew check below must agree on one consistent
+        # view, and re-reading the live counters per comparison paid
+        # n_replicas extra attribute reads per request for a value
+        # that may shift mid-sort anyway
+        loads = {r.name: r.outstanding for r in eligible}
         order = {r.name: i for i, r in enumerate(eligible)}
         eligible.sort(
-            key=lambda r: (r.outstanding,
+            key=lambda r: (loads[r.name],
                            (order[r.name] + rotate) % len(order))
         )
         if affinity_key:
@@ -212,8 +243,8 @@ class ReplicaSet:
             preferred = next(
                 r for r in eligible if r.name == ranked[0]
             )
-            least_loaded = eligible[0].outstanding
-            if preferred.outstanding <= least_loaded + self.affinity_max_skew:
+            least_loaded = loads[eligible[0].name]
+            if loads[preferred.name] <= least_loaded + self.affinity_max_skew:
                 eligible.sort(key=lambda r: 0 if r.name == preferred.name else 1)
         return eligible
 
@@ -229,12 +260,32 @@ class ReplicaSet:
     def snapshot(self) -> dict[str, Any]:
         return {
             "probe_interval_s": self.probe_interval_s,
+            "probe_jitter": self.probe_jitter,
             "out_after": self.out_after,
             "probation_probes": self.probation_probes,
             "replicas": [r.snapshot() for r in self.replicas],
         }
 
     # -- probing --------------------------------------------------------------
+    def next_probe_delays(self, rng: random.Random,
+                          initial: bool = False) -> float:
+        """One replica's delay until its next probe. Jitter draws
+        uniformly from ``interval * (1 ± probe_jitter)`` per round —
+        each replica's independent RNG decorrelates phases over time,
+        so even replicas that START aligned drift apart. The INITIAL
+        delay spreads only across the JITTER window ``[0,
+        jitter*interval)``: a freshly booted 16-replica router must not
+        open with one synchronized probe burst, but it must also still
+        learn real rotation state within ≈ one round — replicas boot
+        optimistically healthy, and a long stagger would stretch the
+        window in which a dead replica keeps taking traffic."""
+        spread = self.probe_jitter * self.probe_interval_s
+        if self.probe_jitter <= 0.0:
+            return 0.0 if initial else self.probe_interval_s
+        if initial:
+            return rng.random() * spread
+        return self.probe_interval_s - spread + rng.random() * 2 * spread
+
     def _probe_loop(self) -> None:
         """One probe thread PER REPLICA per round: a serial sweep would
         make failure-detection latency O(n_replicas × probe_timeout) —
@@ -242,10 +293,29 @@ class ReplicaSet:
         wedged one out of rotation. A replica whose previous probe is
         still running (stuck in its connect timeout) is skipped, never
         double-probed; each replica's state machine thus stays
-        single-threaded."""
+        single-threaded. Scheduling is per-replica with decorrelated
+        jitter (:meth:`next_probe_delays`)."""
         pending: dict[str, threading.Thread] = {}
+        # per-replica RNGs, seeded off the replica name: deterministic
+        # for a given fleet spec (tests can reason about it) while still
+        # independent streams across replicas
+        rngs = {
+            r.name: random.Random(f"gofr-probe-jitter|{r.name}")
+            for r in self.replicas
+        }
+        now = time.monotonic()
+        due = {
+            r.name: now + self.next_probe_delays(rngs[r.name], initial=True)
+            for r in self.replicas
+        }
         while not self._stop.is_set():
+            now = time.monotonic()
             for replica in self.replicas:
+                if now < due[replica.name]:
+                    continue
+                due[replica.name] = now + self.next_probe_delays(
+                    rngs[replica.name]
+                )
                 previous = pending.get(replica.name)
                 if previous is not None and previous.is_alive():
                     continue
@@ -255,7 +325,10 @@ class ReplicaSet:
                 )
                 pending[replica.name] = thread
                 thread.start()
-            self._stop.wait(self.probe_interval_s)
+            wake = min(due.values()) - time.monotonic() if due else (
+                self.probe_interval_s
+            )
+            self._stop.wait(min(max(wake, 0.001), self.probe_interval_s))
         for thread in pending.values():
             thread.join(timeout=self.probe_timeout_s * 2 + 1.0)
 

@@ -291,8 +291,10 @@ class FleetRouter:
 
     @property
     def in_flight(self) -> int:
-        with self._idle:
-            return self._inflight
+        # lock-free read (int attribute reads are GIL-atomic): admin
+        # snapshots and drain logging must not contend with the
+        # admission path for the condition's mutex
+        return self._inflight
 
     def begin_drain(self) -> None:
         """Stop admitting; readiness flips to 503 (handler.py checks
@@ -387,12 +389,20 @@ class FleetRouter:
         return None
 
     def _try_acquire_slot(self) -> bool:
+        # the gauge write happens OUTSIDE the condition's mutex: the
+        # metric registry has its own lock, and nesting it under _idle
+        # put a foreign lock inside the hottest router mutex (lock-order
+        # edge + hold time — both sanitizer findings at fleet scale).
+        # Two concurrent updates may publish out of order; the depth
+        # gauge self-corrects on the next admission, which is the right
+        # trade for not serializing admission on metric bookkeeping.
         with self._idle:
             if self.max_inflight > 0 and self._inflight >= self.max_inflight:
                 return False
             self._inflight += 1
-            self._inflight_gauge.set(float(self._inflight))
-            return True
+            depth = self._inflight
+        self._inflight_gauge.set(float(depth))
+        return True
 
     # -- the forward handler ---------------------------------------------------
     def handle(self, ctx: Any) -> Response:
@@ -402,14 +412,19 @@ class FleetRouter:
         tenant = tenant_of(request, self.trust_tenant_header)
         verdict = self._admit(request, tenant)
         if verdict is not None:
+            # record construction stays OUTSIDE the ring lock: the lock
+            # guards exactly one deque.append per request, so a shed
+            # storm (the moment every request takes this path) never
+            # serializes on dict building
+            shed_record = {
+                "ts": time.time(),  # gofrlint: wall-clock — route-record display timestamp
+                "method": request.method, "path": request.path,
+                "tenant": tenant, "attempts": [], "retries": 0,
+                "status": verdict.status,
+                "outcome": f"shed:{verdict._shed_reason}",
+            }
             with self._records_lock:
-                self._records.append({
-                    "ts": time.time(),  # gofrlint: wall-clock — route-record display timestamp
-                    "method": request.method, "path": request.path,
-                    "tenant": tenant, "attempts": [], "retries": 0,
-                    "status": verdict.status,
-                    "outcome": f"shed:{verdict._shed_reason}",
-                })
+                self._records.append(shed_record)
             return verdict
         # reached here: _admit HOLDS the in-flight slot for this request
         body_json = self._body_json(request)
@@ -453,9 +468,11 @@ class FleetRouter:
     def _release(self) -> None:
         with self._idle:
             self._inflight = max(0, self._inflight - 1)
-            self._inflight_gauge.set(float(self._inflight))
-            if self._inflight == 0:
+            depth = self._inflight
+            if depth == 0:
                 self._idle.notify_all()
+        # outside the mutex on purpose — see _try_acquire_slot
+        self._inflight_gauge.set(float(depth))
 
     @staticmethod
     def _body_json(request: Any) -> Any:
@@ -1090,6 +1107,7 @@ class _StreamRelay:
         self._scanner = _SSEEventScanner()
         self._next_id = 0         # next event id the client expects
         self._saw_ids = False     # the upstream actually numbers frames
+        self._delivered = 0       # events actually forwarded to the client
         self._resumed = False     # current upstream is a continuation
         self._resumes = 0
         self._attempt_settled = False
@@ -1145,6 +1163,7 @@ class _StreamRelay:
                 # attempt (a regenerating continuation re-emits them)
                 continue
             out.append(block)
+        self._delivered += len(out)
         return out
 
     # -- per-attempt accounting ------------------------------------------------
@@ -1194,16 +1213,34 @@ class _StreamRelay:
             self._attempt_start = attempt_start
             self._is_probe = is_probe
             self._scanner = _SSEEventScanner()
-            self._resumed = True
+            # a continuation opened before ANYTHING reached the client
+            # is indistinguishable from a fresh original attempt, and
+            # must deliver like one: with _resumed set, _drain drops
+            # id-less frames (only trustworthy from the original), so
+            # an id-less continuation of a died-at-zero stream would
+            # have every frame dropped and settle as a silently EMPTY
+            # "ok" — exactly the truncation-masquerading-as-success the
+            # resume contract exists to prevent
+            self._resumed = self._saw_ids or self._delivered > 0
             self._attempt_settled = False
         return True
 
     # -- the resume hunt -------------------------------------------------------
-    def _pick_resume_target(self) -> Optional[tuple[Any, bool]]:
+    def _pick_resume_target(
+        self, tried: set[str]
+    ) -> Optional[tuple[Any, bool]]:
         """The originating replica first — it holds the generation
         journal (teacher-forced resume is nearly free there), and its
         PROBATION state counts as "coming back" rather than hard-out —
-        then any healthy candidate the breaker admits."""
+        then any healthy candidate the breaker admits. Replicas that
+        already failed THIS hunt (``tried``) are skipped on the first
+        pass and allowed back only as a last resort: the prober needs
+        out_after×interval to evict a drained replica, and during that
+        window the dead origin still LOOKS healthy — re-picking it
+        every round burned the whole resume budget on connection
+        refusals in milliseconds (the fleetsim harness surfaced exactly
+        that: drained-mid-stream requests exhausting 4 resumes in 50 ms
+        while healthy replicas sat idle)."""
         candidates: list[Any] = []
         if self._origin.state in (HEALTHY, PROBATION):
             candidates.append(self._origin)
@@ -1213,21 +1250,36 @@ class _StreamRelay:
             )
             if r.name != self._origin.name
         )
-        for replica in candidates:
-            grant = replica.breaker.try_acquire()
-            if grant:
-                return replica, grant == breaker_mod.PROBE
+        for skip_tried in (True, False) if tried else (False,):
+            for replica in candidates:
+                if skip_tried and replica.name in tried:
+                    continue
+                grant = replica.breaker.try_acquire()
+                if grant:
+                    return replica, grant == breaker_mod.PROBE
         return None
 
     def _try_resume(self) -> bool:
         router = self._router
-        if not self._saw_ids:
-            # the upstream never numbered its frames (e.g. a fan-out
+        if not self._saw_ids and self._delivered:
+            # id-less frames already reached the client (e.g. a fan-out
             # stream): without ids a continuation cannot be spliced —
-            # id-less frames would all be dropped and the truncation
-            # would masquerade as success. Keep the abort contract.
+            # its frames would all be dropped and the truncation would
+            # masquerade as success. Keep the abort contract. A stream
+            # that died before ANY event was delivered is different:
+            # resuming from 0 is trivially safe (nothing to splice
+            # against), and refusing it turned every
+            # wedge-before-first-token into a truncated client stream —
+            # the fleetsim harness surfaced exactly that cohort.
             router._stream_resumes.inc(outcome="refused")
             return False
+        # failed-attempt pacing, mirroring the forward retry loop: a
+        # decorrelated-jitter sleep between failed continuations gives
+        # the prober time to evict a dead origin (and a transient 5xx
+        # burst time to pass) instead of spending the whole resume
+        # budget inside one failure window
+        tried: set[str] = set()
+        delays = backoff_delays(router.max_resumes)
         while True:
             with self._lock:
                 if self._done:
@@ -1236,13 +1288,14 @@ class _StreamRelay:
             if remaining <= 0.05 or self._resumes >= router.max_resumes:
                 router._stream_resumes.inc(outcome="exhausted")
                 return False
-            picked = self._pick_resume_target()
+            picked = self._pick_resume_target(tried)
             if picked is None:
                 # nothing admitted right now: the origin may be mid-
                 # recovery (probation arrives within a probe interval)
                 time.sleep(min(0.1, remaining))
                 continue
             replica, is_probe = picked
+            tried.add(replica.name)
             self._resumes += 1
             self._record["resumes"] = self._resumes
             router._retries_total.inc(
@@ -1282,6 +1335,7 @@ class _StreamRelay:
                 router._req_total.inc(
                     replica=replica.name, outcome="network_error"
                 )
+                self._hunt_pause(delays)
                 continue
             status = streaming.status_code
             if status == 200:
@@ -1313,6 +1367,7 @@ class _StreamRelay:
                 router._req_total.inc(
                     replica=replica.name, outcome="upstream_5xx"
                 )
+                self._hunt_pause(delays)
                 continue
             # 4xx: the replica is healthy but refuses the resume
             # (non-resumable shape, journal gone AND determinism
@@ -1320,6 +1375,17 @@ class _StreamRelay:
             replica.breaker.record_success(probe=is_probe)
             router._stream_resumes.inc(outcome="refused")
             return False
+
+    def _hunt_pause(self, delays: Any) -> None:
+        """Sleep the hunt's next decorrelated-jitter delay, clipped to
+        the remaining deadline (a hunt never sleeps past its budget —
+        the loop head turns that into a clean ``exhausted``)."""
+        delay = next(delays, None)
+        if delay is None:
+            return
+        remaining = self._resume.deadline - time.monotonic()
+        if remaining > 0.05:
+            time.sleep(min(delay, remaining - 0.05))
 
     # -- terminal accounting ---------------------------------------------------
     def finish(self, outcome: str) -> None:

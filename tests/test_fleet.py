@@ -1509,3 +1509,296 @@ def test_role_routing_off_restores_mixed_behavior(tmp_path, monkeypatch):
         route = snap["routes"][0]
         assert route["role"] is None and route["kv_donor"] is None
         assert snap["role_routing"] is False
+
+
+# -- fleet-scale hardening (ISSUE 12) ------------------------------------------
+
+def test_token_bucket_exact_accounting_under_concurrency():
+    """Many threads, ONE tenant: the memory-mode bucket admits EXACTLY
+    its capacity — no over-admission from racing read-modify-writes, no
+    lost tokens from double refills. Refill is negligible over the test
+    window (0.001 rps), so capacity is the whole supply and the count
+    is exact, not approximate."""
+    table = QuotaTable(rate_rps=0.001, burst=48.0)
+    n_threads, per_thread = 16, 25
+    barrier = threading.Barrier(n_threads)
+    admitted = [0] * n_threads
+    denied = [0] * n_threads
+
+    def worker(w):
+        barrier.wait()
+        for _ in range(per_thread):
+            ok, retry_after = table.take("hot")
+            if ok:
+                admitted[w] += 1
+            else:
+                assert retry_after > 0
+                denied[w] += 1
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), name=f"test-quota-{w}")
+        for w in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(15)
+    assert sum(admitted) == 48
+    assert sum(admitted) + sum(denied) == n_threads * per_thread
+    stats = table.stats()
+    assert stats["admitted"] == 48
+    assert stats["denied"] == n_threads * per_thread - 48
+
+
+def test_quota_lease_cache_serves_hot_tenant_locally():
+    """The hot-key fix: with ``cache_ttl_s`` > 0 a hot tenant's takes
+    are served from a local token lease, not one redis sync each —
+    every take is either a cache hit or a sync, and syncs are rare."""
+    from gofr_tpu.devtools.fleetsim import SimRedis
+
+    redis = SimRedis()
+    table = QuotaTable(rate_rps=200.0, burst=400.0, redis=redis,
+                       cache_ttl_s=0.5)
+    for _ in range(100):
+        assert table.take("hot")[0]
+    stats = table.stats()
+    assert stats["cache_hits"] + stats["redis_syncs"] == 100
+    assert stats["redis_syncs"] <= 5  # ~1 sync per lease of ~100 tokens
+    # each sync is one read + one write pipeline round trip
+    assert redis.execs == 2 * stats["redis_syncs"]
+
+
+def test_quota_lease_leases_at_least_one_token_at_low_rates():
+    """``rate * ttl`` < 1 must still lease a WHOLE token: a fractional
+    lease can never admit, which silently disables the cache at
+    realistic per-tenant rates (the first live fleetsim runs measured
+    zero cache hits for exactly this reason)."""
+    from gofr_tpu.devtools.fleetsim import SimRedis
+
+    redis = SimRedis()
+    table = QuotaTable(rate_rps=2.0, burst=8.0, redis=redis,
+                       cache_ttl_s=0.05)
+    assert table._lease_target() == 1.0  # max(1, 2*0.05), capped burst/2
+    assert table.take("t")[0]  # sync: admits AND leases one token
+    assert table.take("t")[0]  # served from the lease, no redis trip
+    stats = table.stats()
+    assert stats["cache_hits"] == 1 and stats["redis_syncs"] == 1
+    # the hoard cap gets the SAME ≥1 floor: a sub-2.0 burst must not
+    # clamp the lease back under one token and silently re-disable the
+    # cache (min(1.0, burst/2) with burst 1.0 was exactly that hole)
+    tiny = QuotaTable(rate_rps=0.5, burst=0.0, redis=SimRedis(),
+                      cache_ttl_s=0.05)
+    assert tiny.burst == 1.0  # the burst<=0 default: max(1, 2*rate)
+    assert tiny._lease_target() == 1.0
+
+
+def test_quota_lease_concurrent_sync_merges_instead_of_stranding():
+    """Two syncs for the same tenant can race (lease expired, many
+    workers): each debits a lease batch from the shared redis bucket,
+    so the second install must MERGE the first's unused tokens, not
+    overwrite them — an overwritten lease's tokens were debited in
+    redis, never admitted, never credited: gone. Conservation is the
+    assertion: bucket + live lease + admitted == burst, exactly."""
+    from gofr_tpu.devtools.fleetsim import SimRedis
+
+    redis = SimRedis()
+    table = QuotaTable(rate_rps=100.0, burst=200.0, redis=redis,
+                       cache_ttl_s=0.5)
+    assert table.take("t")[0]   # sync 1: debits 1 + leases 50
+    first_lease = table._leases["t"].tokens
+    assert table._take_redis("t")[0]  # a racing sync: debits 1 + 50 more
+    merged = table._leases["t"].tokens
+    assert merged >= first_lease + 1.0  # both batches live, none stranded
+    stored = float(redis.hashes["fleet:quota:t"]["tokens"])
+    # refill over the test's microseconds is < 1 token
+    assert stored + merged + 2.0 == pytest.approx(200.0, abs=1.0)
+
+
+def test_quota_lease_caches_denial_with_counted_down_hint():
+    """A denied sync caches the DENIAL for the TTL window too (a
+    hammering tenant must not buy a redis trip per rejected request),
+    and the cached Retry-After counts down as the window ages instead
+    of re-serving the sync-time value."""
+    from gofr_tpu.devtools.fleetsim import SimRedis
+
+    redis = SimRedis()
+    table = QuotaTable(rate_rps=0.5, burst=1.0, redis=redis,
+                       cache_ttl_s=5.0)
+    assert table.take("t")[0]  # burns the only token
+    ok2, retry2 = table.take("t")  # sync: denied, denial cached
+    assert not ok2 and retry2 > 0
+    execs_after_denial = redis.execs
+    ok3, retry3 = table.take("t")  # cached denial: no redis trip
+    assert not ok3 and 0 < retry3 <= retry2
+    assert redis.execs == execs_after_denial
+    assert table.stats()["cache_hits"] >= 1
+
+
+def test_quota_no_phantom_lease_when_redis_dies_mid_sync():
+    """Redis failing BETWEEN the read and the write pipeline (exactly
+    what the fleetsim redis-outage scenario injects mid-run) must not
+    leave a local lease behind: its tokens were never debited
+    fleet-wide, so a whole TTL window would admit from tokens every
+    other router can also spend. The verdict must be fail-open (memory
+    bucket), with no lease and the popped credit restored."""
+    from gofr_tpu.devtools.fleetsim import SimRedis
+
+    class _DiesOnWrite(SimRedis):
+        def __init__(self):
+            super().__init__()
+            self.fail_after = None
+
+        def pipeline(self):
+            if self.fail_after is not None:
+                if self.fail_after <= 0:
+                    raise ConnectionError("injected mid-sync outage")
+                self.fail_after -= 1
+            return super().pipeline()
+
+    redis = _DiesOnWrite()
+    table = QuotaTable(rate_rps=100.0, burst=200.0, redis=redis,
+                       cache_ttl_s=0.5)
+    table._credit["t"] = 7.0  # pending give-back from an expired lease
+    redis.fail_after = 1  # the read pipeline builds; the write raises
+    ok, _ = table.take("t")
+    assert ok  # failed open to the memory bucket
+    assert "t" not in table._leases  # no phantom tokens
+    assert table._credit["t"] == 7.0  # the give-back survived, once
+    redis.fail_after = None
+    assert table.take("t")[0]  # recovery: a real sync with a real lease
+    assert table._leases["t"].tokens > 0
+    assert "t" not in table._credit  # credit consumed exactly once
+
+
+def test_quota_lease_expiry_credits_unused_tokens_back():
+    """Leased-but-unused tokens return to the fleet-wide bucket on the
+    tenant's next sync: the accounting error is bounded by one lease
+    per router per TTL window, never cumulative."""
+    from gofr_tpu.devtools.fleetsim import SimRedis
+
+    redis = SimRedis()
+    table = QuotaTable(rate_rps=100.0, burst=200.0, redis=redis,
+                       cache_ttl_s=0.5)
+    assert table.take("t")[0]  # sync: debits 1, leases 50 (rate*ttl)
+    lease = table._leases["t"]
+    assert lease.tokens >= 1.0
+    lease.expires = 0.0  # force expiry (monotonic 0 = the distant past)
+    assert table.take("t")[0]  # expiry -> credit -> sync gives it back
+    stored = float(redis.hashes["fleet:quota:t"]["tokens"])
+    new_lease = table._leases["t"].tokens
+    # bucket contents = burst - 2 takes - the live lease; the expired
+    # lease's 50 unused tokens came BACK (without the credit this would
+    # sit ~50 lower). Refill noise over the test's microseconds < 1.
+    assert stored == pytest.approx(200.0 - 2.0 - new_lease, abs=1.0)
+
+
+def test_route_records_and_outstanding_survive_concurrent_load(
+        tmp_path, monkeypatch):
+    """Satellite: the route-record ring and the outstanding/in-flight
+    bookkeeping under genuinely concurrent traffic, with a concurrent
+    snapshot reader hammering the ring the whole time. The fleet-chaos
+    CI job runs this module with GOFR_SANITIZE=1, so a lock-order
+    inversion or an over-held lock inside the selection/record path is
+    a FAILURE here, not a warning. Exactness: every request leaves
+    exactly one intact record, and every depth counter drains to 0."""
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    with chaos_fleet(2) as replicas, chaos_router(replicas) as app:
+        base = f"http://127.0.0.1:{app.http_port}"
+        fleet = app.container.fleet
+        _wait(lambda: len(fleet.replica_set.in_rotation()) == 2,
+              message="2 replicas in rotation")
+        n_threads, per_thread = 12, 6
+        errors: list = []
+        snap_stop = threading.Event()
+
+        def snapshotter():
+            while not snap_stop.is_set():
+                snap = fleet.snapshot()
+                assert isinstance(snap["routes"], list)
+                time.sleep(0.002)
+
+        def client(w):
+            for i in range(per_thread):
+                try:
+                    status, body, _ = _post(
+                        base + "/generate",
+                        {"tokens": [w + 1, i + 1], "max_new_tokens": 3},
+                        headers={"X-Session-ID": f"s{w}-{i}"}, timeout=20,
+                    )
+                    assert status == 200
+                except Exception as exc:  # collected, asserted below
+                    errors.append(exc)
+
+        snap_thread = threading.Thread(
+            target=snapshotter, name="test-fleet-snap")
+        threads = [
+            threading.Thread(target=client, args=(w,), name=f"test-load-{w}")
+            for w in range(n_threads)
+        ]
+        snap_thread.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        snap_stop.set()
+        snap_thread.join(10)
+        assert not errors
+        _wait(lambda: fleet.in_flight == 0, message="in-flight drained")
+        for r in fleet.replica_set.replicas:
+            assert r.outstanding == 0, r.name
+        records = fleet.records(limit=1024)  # the admin page shows 50
+        oks = [r for r in records if r["outcome"] == "ok"]
+        assert len(oks) == n_threads * per_thread
+        for rec in oks:
+            assert rec["attempts"] and rec["attempts"][-1]["status"] == 200
+
+
+def test_stream_dead_before_first_frame_resumes_from_zero(
+        tmp_path, monkeypatch):
+    """A stream that dies before ANY event reaches the client used to
+    get its resume REFUSED (the relay required seen event ids), so
+    every wedge-before-first-token became a truncated client stream —
+    the fleetsim harness surfaced the whole cohort. Resuming from 0 is
+    trivially safe when nothing was delivered: the relay now hunts, and
+    the client sees one complete, token-exact stream."""
+    from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+
+    monkeypatch.chdir(tmp_path)
+    prompt, n_tokens = [3, 5, 7], 12
+    expected = [prompt[i % 3] for i in range(n_tokens)]
+    with chaos_fleet(2) as replicas, chaos_router(replicas) as app:
+        base = f"http://127.0.0.1:{app.http_port}"
+        fleet = app.container.fleet
+        _wait(lambda: len(fleet.replica_set.in_rotation()) == 2,
+              message="2 replicas in rotation")
+        victim = replicas[0]
+        key = _key_for(victim.name, [r.name for r in replicas])
+        # one-shot: the next streamed response dies after ZERO chunks
+        # (headers sent, zero SSE frames — the pre-first-token wedge)
+        victim.chaos.arm("disconnect_after", chunks=0, remaining=1,
+                         paths=("/v1/",))
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps({
+                "model": "echo", "prompt": prompt, "max_tokens": n_tokens,
+                "stream": True, "seed": 5,
+            }).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Session-ID": key},
+            method="POST",
+        )
+        resp = urllib.request.urlopen(req, timeout=30)
+        assert resp.status == 200
+        tokens, ids, raw = _read_sse_tokens(resp)
+        assert b"data: [DONE]" in raw  # completed, not truncated
+        assert tokens == expected  # zero missing, zero duplicated
+        assert ids and ids[0] == 0  # the splice really started at zero
+        snap = _fleet_snapshot(app)
+        resumed = [r for r in snap["routes"] if r.get("resumes")]
+        assert resumed, snap["routes"]
+        assert resumed[0]["attempts"][-1]["resume_from"] == 0
+        _, metrics_body, _ = _get(base + "/metrics")
+        assert ('gofr_tpu_router_stream_resumes_total{outcome="resumed"}'
+                in metrics_body.decode())

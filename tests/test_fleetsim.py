@@ -1,0 +1,369 @@
+"""Fleet-scale chaos simulation harness, tier-1: deterministic
+generators (same seed ⇒ byte-identical trace AND fault schedule — the
+replay contract), the probe-jitter de-synchronization that is
+load-bearing at N=16, the quota lease cache A/B, the SLO gate's
+absolute/relative failure matrix, and a small end-to-end smoke run
+(5 replicas; the CI ``fleet-sim`` job runs the real N=16 topology via
+``tools/fleetsim.py`` and gates it against ``fleetsim_baseline.json``)."""
+
+import importlib.util
+import json
+import pathlib
+import random
+
+import pytest
+
+from gofr_tpu.devtools import fleetsim
+from gofr_tpu.devtools.fleetsim import (
+    FleetSim,
+    SimRedis,
+    TraceSpec,
+    build_scenario,
+    build_trace,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+_spec = importlib.util.spec_from_file_location(
+    "fleetsim_gate", REPO / "tools" / "fleetsim_gate.py"
+)
+fleetsim_gate = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(fleetsim_gate)
+
+
+# -- replayability: the seed IS the run ----------------------------------------
+
+def test_trace_same_seed_is_byte_identical():
+    """The replay contract: the trace is a pure function of its spec.
+    Byte-identity is asserted on the canonical JSON itself, not just
+    the digest — the digest is merely the witness the artifact
+    records."""
+    a_events, a_digest = build_trace(TraceSpec(requests=120, seed=42))
+    b_events, b_digest = build_trace(TraceSpec(requests=120, seed=42))
+    assert a_digest == b_digest
+    assert (json.dumps(a_events, sort_keys=True)
+            == json.dumps(b_events, sort_keys=True))
+    c_events, c_digest = build_trace(TraceSpec(requests=120, seed=43))
+    assert c_digest != a_digest
+
+
+def test_scenario_same_seed_is_byte_identical():
+    a_events, a_digest = build_scenario(7, n_replicas=16, n_prefill=2,
+                                        duration_s=20.0)
+    b_events, b_digest = build_scenario(7, n_replicas=16, n_prefill=2,
+                                        duration_s=20.0)
+    assert a_digest == b_digest
+    assert (json.dumps(a_events, sort_keys=True)
+            == json.dumps(b_events, sort_keys=True))
+    _, c_digest = build_scenario(8, n_replicas=16, n_prefill=2,
+                                 duration_s=20.0)
+    assert c_digest != a_digest
+
+
+def test_trace_structure_and_protected_cohort():
+    """Structural invariants the SLOs depend on: timestamps
+    non-decreasing, phases in spec order, priority tiers drawn from the
+    mix, and the priority-9 cohort riding its OWN low-volume tenant —
+    'tier 9 is never shed' must be a property of the system, not of a
+    lucky tenant draw."""
+    spec = TraceSpec(requests=200, seed=3)
+    events, _ = build_trace(spec)
+    assert len(events) >= spec.requests
+    tiers = {tier for tier, _ in spec.priority_mix}
+    phase_order = [name for name, _, _ in spec.phases]
+    last_at, last_phase_idx = 0.0, 0
+    for ev in events:
+        assert ev["at_s"] >= last_at
+        last_at = ev["at_s"]
+        idx = phase_order.index(ev["phase"])
+        assert idx >= last_phase_idx  # phases never rewind
+        last_phase_idx = idx
+        assert ev["priority"] in tiers
+        assert ev["kind"] in ("unary", "stream", "abort_stream")
+        assert (ev["abort_after"] is not None) == (
+            ev["kind"] == "abort_stream")
+        if ev["priority"] == 9:
+            assert ev["tenant"] == "t-platinum"
+        else:
+            assert ev["tenant"].startswith("t") and ev["tenant"] != (
+                "t-platinum")
+    p9 = [ev for ev in events if ev["priority"] == 9]
+    assert 0 < len(p9) < len(events) / 4  # present AND low-volume
+
+
+def test_scenario_events_are_paired_and_ordered():
+    """Every injected fault carries its own cure in the schedule: a
+    wedge has a recover, a drain a restart, an armed stream-mangler a
+    clear, the redis outage an end — the digest captures the WHOLE
+    incident timeline, so convergence is part of the replayed run."""
+    events, _ = build_scenario(11, n_replicas=16, n_prefill=2,
+                               duration_s=20.0)
+    assert events == sorted(events, key=lambda e: e["at_s"])
+    ops = [e["op"] for e in events]
+    assert "redis_down" in ops and "redis_up" in ops
+
+    def targets(op):
+        return sorted(e["replica"] for e in events if e["op"] == op)
+
+    assert targets("wedge") == targets("recover")
+    assert targets("drain") == targets("restart")
+    cleared = {(e["replica"], e["mode"]) for e in events if e["op"] == "clear"}
+    for e in events:
+        if e["op"] == "slow_loris":
+            assert (e["replica"], "slow_loris") in cleared
+        if e["op"] == "disconnect":
+            assert (e["replica"], "disconnect_after") in cleared
+    # faults target the decode tier; the prefill tier only ever gets
+    # the KV corruption (its serving plane must stay healthy so the
+    # local-prefill fallback has somewhere to run)
+    for e in events:
+        if e["op"] == "kv_corrupt":
+            assert e["replica"] < 2
+        elif "replica" in e:
+            assert e["replica"] >= 2
+
+
+# -- probe jitter: the thundering-herd fix -------------------------------------
+
+def _fire_times(jitter, n=16, rounds=40, interval=1.0):
+    """Simulate the prober's schedule without threads: accumulate each
+    replica's per-round delays exactly as ``_probe_loop`` draws them
+    (same per-replica RNG seeding), returning all fire times sorted."""
+    from gofr_tpu.fleet.replica import Replica, ReplicaSet
+
+    logger = fleetsim._NullLogger()
+    replicas = [
+        Replica(f"m{i}", "http://127.0.0.1:9", logger) for i in range(n)
+    ]
+    rset = ReplicaSet(replicas, logger, probe_interval_s=interval,
+                      probe_jitter=jitter)
+    times = []
+    for r in replicas:
+        rng = random.Random(f"gofr-probe-jitter|{r.name}")
+        t = rset.next_probe_delays(rng, initial=True)
+        for _ in range(rounds):
+            times.append(t)
+            t += rset.next_probe_delays(rng)
+    return sorted(times)
+
+
+def _max_burst(times, window):
+    best = 0
+    for i, t0 in enumerate(times):
+        n = 0
+        for t in times[i:]:
+            if t - t0 > window:
+                break
+            n += 1
+        best = max(best, n)
+    return best
+
+
+def test_probe_jitter_desynchronizes_schedule():
+    """The satellite's unit: with jitter off, every round of a
+    16-replica fleet fires as ONE instantaneous burst, forever; with
+    decorrelated jitter the phases drift apart and stay apart. Fully
+    deterministic — the per-replica RNGs are seeded off replica names,
+    exactly as the live prober seeds them."""
+    sync = [t for t in _fire_times(jitter=0.0) if t > 20.0]
+    jit = [t for t in _fire_times(jitter=0.3) if t > 20.0]
+    assert _max_burst(sync, 0.05) == 16  # the whole round, one instant
+    assert _max_burst(jit, 0.05) <= 8  # uniform expectation is ~0.8
+
+
+def test_next_probe_delays_bounds():
+    from gofr_tpu.fleet.replica import Replica, ReplicaSet
+
+    logger = fleetsim._NullLogger()
+    replicas = [Replica("m0", "http://127.0.0.1:9", logger)]
+    rset = ReplicaSet(replicas, logger, probe_interval_s=2.0,
+                      probe_jitter=0.25)
+    rng = random.Random(1)
+    for _ in range(200):
+        initial = rset.next_probe_delays(rng, initial=True)
+        assert 0.0 <= initial < 0.5  # spread over the jitter window only
+        steady = rset.next_probe_delays(rng)
+        assert 1.5 <= steady <= 2.5  # interval * (1 +/- jitter)
+    # jitter 0 restores the synchronized sweep exactly
+    plain = ReplicaSet(replicas, logger, probe_interval_s=2.0,
+                       probe_jitter=0.0)
+    assert plain.next_probe_delays(rng, initial=True) == 0.0
+    assert plain.next_probe_delays(rng) == 2.0
+    # the constructor clamps runaway jitter below 1 so the schedule
+    # can never stall (delay can never reach 0 at steady state)
+    wild = ReplicaSet(replicas, logger, probe_interval_s=2.0,
+                      probe_jitter=5.0)
+    assert wild.probe_jitter == 0.9
+
+
+def test_quota_lease_cache_ab_measure():
+    """The hardening A/B the artifact records: TTL 0 is exactly one
+    sync per request; the lease cache cuts it by an order of
+    magnitude on a hot tenant."""
+    before = fleetsim.measure_quota_trips(cache_ttl_s=0.0)
+    after = fleetsim.measure_quota_trips(cache_ttl_s=0.05)
+    assert before["syncs_per_request"] == 1.0
+    assert before["cache_hits"] == 0
+    assert after["syncs_per_request"] < 0.5
+    assert after["cache_hits"] > 0
+
+
+# -- the SLO gate --------------------------------------------------------------
+
+def _artifact(**overrides):
+    art = {
+        "kind": "FLEETSIM",
+        "schema": 1,
+        "seed": 1,
+        "replicas": 16,
+        "scenario": {
+            "injected": {"error_burst": 5, "slow_loris": 3,
+                         "disconnect_after": 2},
+        },
+        "slo": {
+            "requests": 240, "ok": 200, "client_aborted": 10, "errors": 2,
+            "ttft_p50_ms": 30.0, "ttft_p99_ms": 120.0,
+            "shed": {"total": 28, "rate": 0.1167,
+                     "by_priority": {"0": 20, "3": 8}, "p9": 0},
+            "streams": {"verified": 90, "token_exact": 90,
+                        "duplicated_tokens": 0, "missing_tokens": 0},
+            "resume": {"resumed": 3, "exhausted": 0, "refused": 0,
+                       "failures": 0},
+            "breaker_flaps": 6,
+            "pools_idle": True,
+            "converged": {"rotation": True, "pools_idle": True},
+        },
+        "hardening": {
+            "probe_spread": {"before": {"max_probes_in_window": 16},
+                             "after": {"max_probes_in_window": 4}},
+            "quota": {"before": {"syncs_per_request": 1.0},
+                      "after": {"syncs_per_request": 0.02}},
+        },
+    }
+    for path, value in overrides.items():
+        cursor, keys = art, path.split(".")
+        for key in keys[:-1]:
+            cursor = cursor[key]
+        cursor[keys[-1]] = value
+    return art
+
+
+def test_gate_passes_a_healthy_artifact():
+    assert fleetsim_gate.gate(_artifact(), _artifact()) == []
+
+
+def test_gate_absolute_invariants():
+    baseline = _artifact()
+    cases = [
+        ({"slo.streams.missing_tokens": 3}, "lost/duplicated"),
+        ({"slo.streams.duplicated_tokens": 1}, "lost/duplicated"),
+        ({"slo.streams.token_exact": 88}, "token-exact"),
+        ({"slo.resume.failures": 1, "slo.resume.refused": 1},
+         "resume success must be 100%"),
+        ({"slo.shed.p9": 2}, "never shed"),
+        ({"slo.pools_idle": False}, "idle"),
+        ({"hardening.probe_spread.after": {"max_probes_in_window": 16}},
+         "probe jitter"),
+        ({"hardening.quota.after": {"syncs_per_request": 1.0}},
+         "lease cache"),
+        # anti-vacuity: invariants only count when their chaos fired
+        ({"scenario.injected": {"error_burst": 5, "slow_loris": 3}},
+         "'disconnect_after' never fired"),
+        ({"scenario.injected": {"error_burst": 5, "disconnect_after": 2}},
+         "'slow_loris' never fired"),
+        ({"slo.resume.resumed": 0}, "vacuously true"),
+    ]
+    for overrides, needle in cases:
+        failures = fleetsim_gate.gate(_artifact(**overrides), baseline)
+        assert failures, overrides
+        assert any(needle in f for f in failures), (overrides, failures)
+
+
+def test_gate_relative_tolerances():
+    baseline = _artifact()
+    # inside tolerance: loose-first factors absorb CI noise
+    assert fleetsim_gate.gate(
+        _artifact(**{"slo.ttft_p99_ms": 400.0, "slo.errors": 6,
+                     "slo.breaker_flaps": 14}),
+        baseline,
+    ) == []
+    cases = [
+        # above BOTH the factor allowance and the 15s absolute floor
+        ({"slo.ttft_p99_ms": 16000.0}, "p99 TTFT"),
+        ({"slo.errors": 8}, "error count"),
+        ({"slo.shed.rate": 0.4}, "shed rate"),
+        ({"slo.breaker_flaps": 30}, "breaker flap"),
+        ({"replicas": 8}, "fleet shrank"),
+    ]
+    # the shed-rate floor keeps the check alive against a ZERO-shed
+    # baseline (0 * factor would disable it entirely)
+    zero_base = _artifact(**{"slo.shed.rate": 0.0, "slo.shed.total": 0})
+    assert fleetsim_gate.gate(
+        _artifact(**{"slo.shed.rate": 0.08}), zero_base) == []
+    floor_failures = fleetsim_gate.gate(
+        _artifact(**{"slo.shed.rate": 0.4}), zero_base)
+    assert floor_failures and any(
+        "shed rate" in f for f in floor_failures)
+    for overrides, needle in cases:
+        failures = fleetsim_gate.gate(_artifact(**overrides), baseline)
+        assert failures and any(needle in f for f in failures), (
+            overrides, failures)
+
+
+def test_gate_rejects_foreign_artifacts():
+    failures = fleetsim_gate.gate({"kind": "BENCH"}, _artifact())
+    assert failures and "not a FLEETSIM artifact" in failures[0]
+
+
+def test_sim_redis_speaks_the_quota_pipeline():
+    """The in-sim redis honors the exact pipelined chains
+    ``QuotaTable._take_redis`` issues, counts round trips, and raises
+    while down (the redis-outage scenario's switch)."""
+    redis = SimRedis()
+    tokens, ts = redis.pipeline().hget("k", "tokens").hget("k", "ts").execute()
+    assert tokens is None and ts is None
+    redis.pipeline().hset("k", "tokens", "3.5").hset(
+        "k", "ts", "99.0").expire("k", 60).execute()
+    assert redis.pipeline().hget("k", "tokens").execute() == ["3.5"]
+    assert redis.execs == 3
+    redis.down = True
+    with pytest.raises(ConnectionError):
+        redis.pipeline().hget("k", "tokens").execute()
+    assert redis.execs == 3  # a down backend serves nothing
+
+
+# -- end-to-end smoke ----------------------------------------------------------
+
+def test_fleetsim_smoke_small_fleet(tmp_path, monkeypatch):
+    """One real run at tier-1 scale: 5 echo replicas (1 prefill)
+    behind the real router, the full seeded trace + fault schedule,
+    and the gate's ABSOLUTE invariants asserted on the artifact. The
+    N=16 topology runs in the CI ``fleet-sim`` job — this smoke keeps
+    the harness itself honest inside plain pytest."""
+    monkeypatch.chdir(tmp_path)
+    spec = TraceSpec(requests=50, base_rps=25.0, seed=11)
+    sim = FleetSim(
+        n_replicas=5, n_prefill=1, seed=11, spec=spec,
+        quota_rps=30.0, quota_burst=60.0, workers=8,
+        measure_hardening=False,
+    )
+    artifact = sim.run()
+    # the artifact's digests ARE the replay contract
+    _, trace_digest = build_trace(TraceSpec(requests=50, base_rps=25.0,
+                                            seed=11))
+    assert artifact["trace"]["digest"] == trace_digest
+    assert artifact["seed"] == 11
+    slo = artifact["slo"]
+    assert slo["requests"] == len(build_trace(spec)[0])
+    assert slo["ok"] > 0 and slo["ttft_p99_ms"] is not None
+    # the gate's absolute chaos-correctness invariants, at tier-1 scale
+    assert slo["shed"]["p9"] == 0
+    assert slo["streams"]["duplicated_tokens"] == 0
+    assert slo["streams"]["missing_tokens"] == 0
+    assert slo["streams"]["token_exact"] == slo["streams"]["verified"]
+    assert slo["resume"]["failures"] == 0, slo["resume"]
+    assert slo["pools_idle"], artifact["scenario"]["applied"]
+    assert slo["converged"]["rotation"]
+    assert slo["errors"] <= 3, slo["error_detail"]
+    # chaos actually fired: the schedule was applied, not skipped
+    assert all(e["applied"] for e in artifact["scenario"]["applied"])
+    assert artifact["scenario"]["injected"]

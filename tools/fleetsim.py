@@ -1,0 +1,105 @@
+"""Fleet-scale chaos simulation entrypoint: boot N (default 16) echo
+host-mesh replicas behind the real fleet router, drive a seeded
+trace (session reuse, Zipf tenant skew, diurnal/burst phases,
+priority mix, streaming + mid-stream-abort clients) while a scenario
+schedule injects overlapping faults, then emit the ``FLEETSIM`` JSON
+artifact with fleet-level SLOs.
+
+Usage::
+
+    python tools/fleetsim.py [--replicas 16] [--seed 20260803]
+        [--requests 240] [--out FLEETSIM.json] [--no-hardening]
+
+The artifact prints on stdout (and writes to ``--out``). Gate it with
+``python tools/fleetsim_gate.py FLEETSIM.json fleetsim_baseline.json``.
+
+REPLAYING A FAILING CI RUN: the artifact records its seed — run
+``python tools/fleetsim.py --seed <that seed>`` locally and the trace
+AND fault schedule reproduce byte-identically (the ``trace.digest`` /
+``scenario.digest`` fields are the witness; thread interleaving is the
+only nondeterminism left).
+
+CI keeps wall time bounded by scaling ``--requests`` (trace length),
+NEVER ``--replicas`` — fleet-scale behavior (probe fan-out, quota hot
+keys, router lock contention) is the entire point of the harness.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--replicas", type=int, default=16)
+    parser.add_argument("--prefill", type=int, default=2,
+                        help="replicas advertising the prefill role")
+    parser.add_argument("--seed", type=int, default=20260803)
+    parser.add_argument("--requests", type=int, default=240)
+    parser.add_argument("--base-rps", type=float, default=12.0)
+    parser.add_argument("--quota-rps", type=float, default=4.0)
+    parser.add_argument("--workers", type=int, default=12)
+    parser.add_argument("--out", default="")
+    parser.add_argument("--no-hardening", action="store_true",
+                        help="skip the before/after micro-measures")
+    args = parser.parse_args(argv[1:])
+
+    # sanitizer-armed when the environment asks (the CI fleet-sim job
+    # sets GOFR_SANITIZE=1): rebind threading.Lock/RLock to the
+    # instrumented wrappers BEFORE the fleet builds its locks, so a
+    # lock-order cycle anywhere in the router/replica/admission path
+    # under real 16-replica load fails the run, not just the unit tier
+    from gofr_tpu.devtools import sanitizer
+
+    if sanitizer.enabled():
+        sanitizer.install()
+
+    from gofr_tpu.devtools.fleetsim import FleetSim, TraceSpec
+
+    t0 = time.monotonic()
+    sim = FleetSim(
+        n_replicas=args.replicas,
+        n_prefill=args.prefill,
+        seed=args.seed,
+        spec=TraceSpec(
+            requests=args.requests, base_rps=args.base_rps, seed=args.seed,
+        ),
+        quota_rps=args.quota_rps,
+        workers=args.workers,
+        measure_hardening=not args.no_hardening,
+        progress=lambda msg: print(msg, file=sys.stderr, flush=True),
+    )
+    artifact = sim.run()
+    artifact["wall_s"] = round(time.monotonic() - t0, 1)
+    artifact["generated_at"] = time.time()  # gofrlint: wall-clock — artifact timestamp
+    blob = json.dumps(artifact, indent=2, sort_keys=True)
+    print(blob)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob + "\n")
+    slo = artifact["slo"]
+    print(
+        f"fleetsim: {slo['requests']} requests, ok={slo['ok']} "
+        f"shed={slo['shed']['total']} errors={slo['errors']} "
+        f"p99_ttft={slo['ttft_p99_ms']}ms resume={slo['resume']} "
+        f"pools_idle={slo['pools_idle']} wall={artifact['wall_s']}s",
+        file=sys.stderr,
+    )
+    if sanitizer.enabled():
+        report = sanitizer.drain()
+        for finding in report["violations"]:
+            print(f"fleetsim: SANITIZER: {finding.get('summary')}",
+                  file=sys.stderr)
+        if report["violations"]:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
