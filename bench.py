@@ -570,6 +570,18 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
             except Exception as exc:
                 errors.append(f"shed phase: {exc}")
                 traceback.print_exc(file=sys.stderr)
+            # -- phase: pooled speculative decoding (ROADMAP 3 tentpole) -------
+            # pooled-spec vs plain pooled decode tok/s at a fixed
+            # stream count, acceptance rate, and tokens per verify
+            # dispatch — the "cheaper tokens" numbers; gated against
+            # bench_baseline.json (BENCH_GATE_SPEC_FACTOR + the
+            # absolute tokens_per_dispatch floor)
+            try:
+                result["spec_microbench"] = _measure_spec()
+                log(f"pooled spec: {result['spec_microbench']}")
+            except Exception as exc:
+                errors.append(f"spec phase: {exc}")
+                traceback.print_exc(file=sys.stderr)
             # -- phase: disaggregated KV handoff (ROADMAP 1 tentpole) ----------
             # cross-replica transfer vs local prefill on two in-process
             # echo replicas over real HTTP, plus the wire bytes one
@@ -1011,6 +1023,116 @@ def _measure_shed() -> dict:
         }
     finally:
         device.close()
+
+
+def _measure_spec() -> dict:
+    """Pooled speculative decoding vs plain pooled decode (host-side,
+    compile-free): two echo devices with a real per-dispatch cost
+    (``ECHO_STEP_MS``), the same concurrent streams, the same token
+    budget. Plain decode pays one dispatch per token; pooled spec pays
+    one verify dispatch per accepted-burst (zero-weight n-gram
+    drafting costs no dispatch), so the tok/s ratio IS the
+    tokens-per-dispatch win the adaptive-k controller settles on.
+    Gated: ``speedup >= BENCH_GATE_SPEC_FACTOR`` and
+    ``tokens_per_dispatch > 1.5`` (tools/bench_gate.py)."""
+    import threading
+
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.logging import Level
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.testutil import MockLogger
+    from gofr_tpu.tpu.device import new_device
+
+    streams = int(os.environ.get("BENCH_SPEC_STREAMS", "4"))
+    n_tok = int(os.environ.get("BENCH_SPEC_TOKENS", "64"))
+    step_ms = os.environ.get("BENCH_SPEC_STEP_MS", "2")
+    prompts = [
+        [(5 * i + 13 * s) % 241 + 1 for i in range(48)]
+        for s in range(streams)
+    ]
+    out: dict = {"streams": streams, "tokens_per_stream": n_tok}
+    for label, extra in (
+        ("plain", {"SPEC_POOLED": "off"}),
+        ("spec", {"SPEC_POOLED": "on", "SPEC_K_MAX": "4"}),
+    ):
+        overrides = {
+            "MODEL_NAME": "echo",
+            "ECHO_STEP_MS": step_ms,
+            "BATCH_TIMEOUT_MS": "1",
+            "TIMEBASE_ENABLED": "off",
+            **extra,
+        }
+        old = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+        try:
+            device = new_device(
+                EnvConfig(), MockLogger(Level.FATAL), Registry()
+            )
+        finally:
+            for k, v in old.items():
+                os.environ.pop(k, None) if v is None else (
+                    os.environ.__setitem__(k, v)
+                )
+        try:
+            device.wait_ready(30)
+            device.generate(prompts[0], max_new_tokens=2)  # warm paths
+            stats_before = dict(device.runner.spec_stats)
+            stream_errors: list = []
+
+            def run_stream(s: int) -> None:
+                # a swallowed stream failure would leave tok/s computed
+                # from tokens that were never emitted — and the gate
+                # would hold BENCH_GATE_SPEC_FACTOR against a lie
+                try:
+                    got = device.generate(prompts[s], max_new_tokens=n_tok)
+                    if len(got) != n_tok:
+                        raise RuntimeError(
+                            f"stream {s} emitted {len(got)}/{n_tok} tokens"
+                        )
+                except BaseException as exc:  # re-raised on the main thread
+                    stream_errors.append(exc)
+
+            start = time.perf_counter()
+            threads = [
+                threading.Thread(
+                    target=run_stream, args=(s,),
+                    name=f"bench-spec-{label}-{s}",
+                )
+                for s in range(streams)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - start
+            if stream_errors:
+                raise RuntimeError(
+                    f"{label} phase lost {len(stream_errors)}/{streams} "
+                    f"streams: {stream_errors[0]!r}"
+                )
+            entry: dict = {
+                "tok_per_sec": round(streams * n_tok / elapsed, 1),
+            }
+            if label == "spec":
+                with device.runner._spec_lock:
+                    stats = dict(device.runner.spec_stats)
+                cycles = stats["cycles"] - stats_before["cycles"]
+                drafted = stats["drafted"] - stats_before["drafted"]
+                accepted = stats["accepted"] - stats_before["accepted"]
+                entry["accept_rate"] = (
+                    round(accepted / drafted, 4) if drafted else None
+                )
+                entry["tokens_per_dispatch"] = (
+                    round(streams * n_tok / cycles, 3) if cycles else None
+                )
+            out[label] = entry
+        finally:
+            device.close()
+    out["speedup"] = round(
+        out["spec"]["tok_per_sec"] / max(out["plain"]["tok_per_sec"], 1e-9),
+        3,
+    )
+    return out
 
 
 def _measure_kv_transfer() -> dict:

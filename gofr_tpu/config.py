@@ -133,6 +133,22 @@ token-lease cache over the redis quota bucket; 0 = one redis sync
 (two pipelined round trips) per request per tenant, the Zipf hot-key
 tax the fleetsim measures).
 
+Pooled-speculative-decoding keys (tpu/spec_pool.py + tpu/decode_pool.py,
+see docs/advanced-guide/performance "Speculative decoding"):
+``SPEC_POOLED`` (off — ``on`` routes speculation THROUGH the
+continuous-batching pool: each greedy pooled request drafts k tokens
+per cycle and one batched ``[slots, width]`` verify dispatch commits
+the accepted prefixes, rejected tokens rolling back by length /
+paged-KV refcount; the solo ``DRAFT_MODEL_NAME`` latency mode stands
+down for pool-eligible requests), ``SPEC_NGRAM`` (on — zero-weight
+n-gram/prompt-lookup drafting from the request's own prompt+emitted
+context, no draft checkpoint), ``SPEC_K_MAX`` (4 — draft-width bound;
+the per-request adaptive-k EMA degrades toward 0 = plain decode on
+poor acceptance and is clamped under brownout level >= 1 and by the
+remaining deadline budget), ``SPEC_FAKE_ACCEPT`` (echo runner only: a
+cyclic schedule of per-cycle accept counts, e.g. "3,1,0", making
+every accept/reject/rollback branch deterministic in tier-1).
+
 Correctness-tooling keys (devtools/sanitizer.py + tests/conftest.py,
 see docs/advanced-guide/static-analysis.md): ``GOFR_SANITIZE=1`` arms
 the runtime concurrency sanitizer under tests;

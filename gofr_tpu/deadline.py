@@ -203,6 +203,39 @@ def parse_deadline(
     return None
 
 
+def clamp_spec_k(
+    k: int,
+    brownout_level: int = 0,
+    deadline: Optional["Deadline"] = None,
+    cadence_s: float = 0.0,
+) -> int:
+    """Serving clamps over a request's adaptive draft width ``k``
+    (pooled speculative decoding, ``tpu/spec_pool.py``) — one shared
+    home so the pool and the echo runner cannot drift:
+
+    - **brownout**: at level 1 cap k at 1, at level >= 2 disable
+      speculation entirely (k=0 = plain decode). Rejected draft tokens
+      are wasted target compute, and overload is exactly when waste
+      hurts the co-tenants the brownout protects;
+    - **deadline**: a verify dispatch costs about one chunk at the
+      observed cadence whatever k is, but the EMITTED value of a cycle
+      under rejection is one token — so a request whose remaining
+      budget covers fewer than ``k + 1`` cadence units speculates
+      less: k is capped at ``remaining/cadence - 1`` (never below 0).
+      A request with no deadline (or before the cadence EMA has a
+      sample) keeps its adaptive k."""
+    if k <= 0:
+        return 0
+    if brownout_level >= 2:
+        return 0
+    if brownout_level >= 1:
+        k = min(k, 1)
+    if deadline is not None and cadence_s > 0:
+        budget_chunks = int(deadline.remaining() / cadence_s)
+        k = min(k, max(budget_chunks - 1, 0))
+    return k
+
+
 # -- overload brownout ---------------------------------------------------------
 
 # brownout levels: 0 normal, 1 shed below-default-priority work,

@@ -415,6 +415,17 @@ class FleetSim:
             else {"FLEET_ROLE": "decode"}
             for i in range(self.n_replicas)
         ]
+        # one decode replica runs pooled speculative decoding
+        # (SPEC_POOLED + zero-weight n-gram drafting): echo spec output
+        # is bit-identical by construction, so the trace's token-
+        # exactness invariant now also covers spec streams — rollback,
+        # adaptive k, and the brownout clamp soak under the same chaos
+        # schedule and SLO gate as every other replica
+        spec_replica = self.n_replicas - 1
+        if spec_replica >= self.n_prefill:
+            roles[spec_replica] = dict(
+                roles[spec_replica], SPEC_POOLED="on", SPEC_K_MAX="4",
+            )
         self._progress(
             f"fleetsim: booting {self.n_replicas} replicas "
             f"({self.n_prefill} prefill) for a {duration_s:.1f}s trace "
@@ -811,6 +822,12 @@ class FleetSim:
             "seed": self.seed,
             "replicas": self.n_replicas,
             "prefill_replicas": self.n_prefill,
+            # the pooled-spec-enabled decode replica (-1 = none at this
+            # topology): its streams ride the same token-exactness gate
+            "spec_replica": (
+                self.n_replicas - 1
+                if self.n_replicas - 1 >= self.n_prefill else -1
+            ),
             "trace": {
                 "requests": len(trace),
                 "digest": trace_digest,

@@ -123,6 +123,7 @@ class FlightRecord:
         "tokens_in", "tokens_out", "batch_size", "pool_cohort",
         "prefill_chunks", "prefill_bucket", "sched_defer_s",
         "pool_reject_reason", "dispatch_ids",
+        "spec_drafted", "spec_accepted", "spec_dispatches", "spec_emitted",
         "kv_blocks", "kv_aliased_blocks", "mesh_axes",
         "deadline_s", "priority", "shed_stage",
         "wall_start", "t_start", "t_enqueue", "t_dispatch",
@@ -160,6 +161,14 @@ class FlightRecord:
         self.sched_defer_s = 0.0  # total interference-scheduler defer
         self.pool_reject_reason = ""  # why the decode pool refused (solo'd)
         self.dispatch_ids: list[int] = []  # device dispatches this rode
+        # pooled speculative decoding (tpu/spec_pool.py): draft tokens
+        # proposed/accepted and the verify dispatches + tokens they
+        # emitted — tokens_per_dispatch is THE number speculation exists
+        # to raise (1.0 = plain decode), percentiled on /admin/slo
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_dispatches = 0
+        self.spec_emitted = 0
         self.kv_blocks = 0  # paged-KV blocks reserved for this request
         self.kv_aliased_blocks = 0  # of those, admitted copy-free (prefix share)
         # serving-mesh axes this request ran on ({"tp": 2, ...}; None =
@@ -248,6 +257,22 @@ class FlightRecord:
         if not self.pool_reject_reason:
             self.pool_reject_reason = reason
 
+    def note_spec(self, drafted: int, accepted: int, emitted: int,
+                  dispatches: int = 1) -> None:
+        """One pooled-spec delivery this request rode: ``drafted``
+        draft tokens proposed, ``accepted`` of them matched the target,
+        ``emitted`` tokens delivered. ``dispatches`` is the
+        weight-stream count of the delivery — 1 for a verify cycle
+        (ONE forward whatever the width: the spec win), the pool's
+        chunk size for a plain chunk a spec-armed row rode (one stream
+        per scan step) — so tokens_per_dispatch reads 1.0 for plain
+        decode on every producer and >1.0 only for real speculation."""
+        with self._lock:
+            self.spec_drafted += drafted
+            self.spec_accepted += accepted
+            self.spec_dispatches += dispatches
+            self.spec_emitted += emitted
+
     def note_kv(self, blocks: int, aliased: int = 0) -> None:
         """Paged-KV admission accounting: ``blocks`` reserved for this
         request, ``aliased`` of them shared copy-free with the prefix
@@ -321,6 +346,14 @@ class FlightRecord:
             return None
         return self.t_done - self.t_start
 
+    @property
+    def tokens_per_dispatch(self) -> Optional[float]:
+        """Tokens emitted per target weight-stream while spec-armed
+        (1.0 = plain decode; None = never rode the spec path)."""
+        if self.spec_dispatches < 1:
+            return None
+        return self.spec_emitted / self.spec_dispatches
+
     def to_dict(self) -> dict[str, Any]:
         """The wide-event shape: every field, one flat dict. Durations in
         seconds (floats); wall timestamps in unix seconds."""
@@ -347,6 +380,9 @@ class FlightRecord:
             "sched_defer_s": self.sched_defer_s or None,
             "pool_reject_reason": self.pool_reject_reason or None,
             "dispatch_ids": list(self.dispatch_ids),
+            "spec_drafted": self.spec_drafted or None,
+            "spec_accepted": self.spec_accepted or None,
+            "tokens_per_dispatch": self.tokens_per_dispatch,
             "kv_blocks": self.kv_blocks or None,
             "kv_aliased_blocks": self.kv_aliased_blocks or None,
             "mesh_axes": self.mesh_axes,
@@ -851,5 +887,14 @@ class FlightRecorder:
             chunked = sum(1 for r in rows if r.prefill_chunks > 1)
             if chunked:
                 entry["chunked_prefills"] = chunked
+            # pooled speculative decoding: emitted tokens per verify
+            # dispatch across the window's spec-riding requests (1.0 =
+            # plain decode; the fleet SLO the spec bench gates on)
+            tpds = [
+                r.tokens_per_dispatch for r in rows
+                if r.tokens_per_dispatch is not None
+            ]
+            if tpds:
+                entry["tokens_per_dispatch"] = _percentiles(tpds)
             models[model] = entry
         return {"window_s": window_s, "models": models}

@@ -451,6 +451,37 @@ class DynamicBatcher:
         self._dispatch_pool.shutdown(wait=False)
 
 
+def verify_width(max_k: int, k_max: int) -> int:
+    """Cohort a pooled-spec verify's token width onto the pow2 ladder:
+    the dispatch carries ``max_k`` drafts + 1 pending token per row, and
+    compiling one executable per exact width would trade the compile
+    budget the bucket ladder exists to bound. The width rounds up to
+    the next power of two (clamped at ``k_max + 1``, the widest any
+    cycle can need); rows with shorter drafts pad to it and their
+    surplus positions verify as garbage — masked by the per-row
+    acceptance exactly like bucket padding is masked by lengths. The
+    whole ladder is ``log2(k_max)+1`` executables, warmed at pool
+    construction."""
+    if max_k < 0:
+        raise ValueError(f"max_k must be >= 0, got {max_k}")
+    return min(next_pow2(max_k + 1), k_max + 1)
+
+
+def verify_width_ladder(k_max: int) -> tuple[int, ...]:
+    """Every width a DISPATCHED spec cycle can need for ``k_max`` —
+    the pool warms exactly these shapes at construction. Starts at 2:
+    the worker never dispatches a zero-draft cycle (it falls back to
+    the plain chunk), so the minimum live width is one draft + the
+    pending token."""
+    widths = []
+    w = 2
+    while w < k_max + 1:
+        widths.append(w)
+        w *= 2
+    widths.append(k_max + 1)
+    return tuple(sorted(set(widths)))
+
+
 def pad_rows(rows: list[np.ndarray], target: int) -> np.ndarray:
     """Stack [n, ...] rows and pad the batch dim to ``target`` by repeating
     the last row (repeats keep shapes identical to real work, so padded and

@@ -97,7 +97,7 @@ class _Request:
     __slots__ = (
         "out_queue", "remaining", "cache_len", "stop", "stop_tokens",
         "finished", "want_lp", "want_top", "want_kv", "record",
-        "kv_reserved", "journal", "deadline",
+        "kv_reserved", "journal", "deadline", "spec", "pending",
     )
 
     def __init__(self, out_queue: "queue.Queue", remaining: int, cache_len: int,
@@ -105,7 +105,8 @@ class _Request:
                  want_lp: bool = False, want_top: bool = False,
                  want_kv: bool = False, record: Any = None,
                  kv_reserved: int = 0, journal: Any = None,
-                 deadline: Any = None):
+                 deadline: Any = None, spec: Any = None,
+                 pending: int = 0):
         self.out_queue: Optional[queue.Queue] = out_queue
         self.remaining = remaining
         self.cache_len = cache_len
@@ -138,6 +139,17 @@ class _Request:
         # the worker checks it per delivered chunk — an expired row
         # finishes with DEADLINE, freeing its slot and KV mid-flight
         self.deadline = deadline
+        # pooled speculative decoding (tpu/spec_pool.py): this
+        # request's draft source + adaptive-k controller, None when the
+        # request is ineligible (sampled/penalized/adapter/logprobs) or
+        # SPEC_POOLED is off. The worker runs spec verify cycles only
+        # while EVERY active row carries one.
+        self.spec = spec
+        # the request's feed-forward token, host-tracked: the last
+        # sampled token of its newest fetched chunk (or first_token at
+        # submit). Spec cycles rebuild the device token vector from
+        # these, so a spec cycle can follow a plain chunk exactly.
+        self.pending = int(pending)
 
 
 class _Slot:
@@ -168,6 +180,7 @@ class DecodePool:
         timeline: Any = None,
         watchdog: Any = None,
         kv: Any = None,
+        spec: Any = None,
     ):
         from gofr_tpu.models.transformer import decode_chunk_pool
 
@@ -303,6 +316,13 @@ class DecodePool:
             }
 
         self._read_slot = jax.jit(read_slot)
+        self.spec_cfg = spec
+        self._verify_pool = None
+        # consecutive no-draft spec rounds: past a small threshold the
+        # worker restores full pipelining for the (undraftable) cohort
+        self._spec_idle = 0
+        if spec is not None:
+            self._build_spec_exec(cfg, cache_shardings, repl)
         self._slots = [_Slot(i) for i in range(n_slots)]
         self._free = list(reversed(self._slots))
         self._active: dict[int, _Slot] = {}
@@ -335,6 +355,8 @@ class DecodePool:
         # warm the finish-time row read too (prefix-cache hand-back): it
         # must never compile on the serving path
         self._read_slot(self.cache, 0)["lengths"].block_until_ready()
+        if spec is not None:
+            self._warm_spec()
         self.cache = self._place(init_cache(cfg, n_slots))  # reset the warmup writes
         self._last_tokens = jnp.zeros((n_slots, 1), jnp.int32)
         if penalties == "eager":
@@ -632,6 +654,7 @@ class DecodePool:
         want_top_logprobs: bool = False,
         adapter: Optional[str] = None,
         want_kv: bool = False,
+        spec_ctx: Optional[Any] = None,
     ) -> "queue.Queue":
         """Claim a slot for a prefilled request; returns the queue its
         decoded token ids (then DONE) arrive on. Raises queue.Full when all
@@ -649,9 +672,20 @@ class DecodePool:
         The name resolves against the CURRENT bank under the lock — never
         a stale pre-checked index. Raises queue.Full when the bank is
         off/rebuilding, the name is unknown to the bank, or a penalized
-        slot is active (the chunk runs ONE executable; the mix solos)."""
+        slot is active (the chunk runs ONE executable; the mix solos).
+
+        ``spec_ctx`` (prompt token ids) arms pooled speculative decoding
+        for this request when the pool has a spec config and the request
+        is eligible — greedy, unpenalized, base weights, no logprobs
+        (the verify executable computes argmaxes, not logprob rows).
+        Ineligible requests pool normally; the worker speculates only
+        while every active row is spec-armed."""
         out: "queue.Queue" = queue.Queue()
         deadline = current_deadline()
+        spec_state = self._spec_arm(
+            spec_ctx, first_token, sampler, penalty, adapter,
+            want_logprobs, want_top_logprobs,
+        )
         with self._work:
             if self._closed:
                 self._reject("closed", count_only=True)
@@ -670,10 +704,15 @@ class DecodePool:
                                     want_kv=want_kv, record=record,
                                     kv_reserved=kv_reserved,
                                     journal=current_journal_entry(),
-                                    deadline=deadline)
+                                    deadline=deadline, spec=spec_state,
+                                    pending=first_token)
             if record is not None and kv_reserved:
                 record.note_kv(kv_reserved)
             self._apply_sampling(slot.index, sampler)
+            if spec_state is not None:
+                # a fresh request's context may draft where the current
+                # cohort's could not — re-open the spec window
+                self._spec_idle = 0
             if adapter_idx:
                 self._lora_ids[slot.index] = adapter_idx
                 self._lora_dirty = True
@@ -913,13 +952,45 @@ class DecodePool:
                     self._abandon_in_flight()
                     self._fail_active(RuntimeError("decode pool closed mid-generation"))
                     return
-                # dispatch until the pipeline is full: chunk N+1's inputs
-                # are chunk N's output futures, so this never blocks
-                while self._active and len(in_flight) < self.pipeline_depth:
-                    self._dispatch_chunk(in_flight)
-            last_fetch_done = self._fetch_and_deliver(
-                in_flight, last_fetch_done
-            )
+                # spec cycles are depth-1 by construction (the host must
+                # read the verify to roll back before the next dispatch)
+                # and never overlap plain chunks in flight
+                cycle = None
+                spec_armed = self._spec_ready()
+                if not in_flight and spec_armed:
+                    cycle = self._spec_dispatch()
+                    self._spec_idle = 0 if cycle is not None else (
+                        self._spec_idle + 1
+                    )
+                if cycle is None:
+                    # dispatch until the pipeline is full: chunk N+1's
+                    # inputs are chunk N's output futures, so this never
+                    # blocks. While a spec-armed cohort is PRODUCTIVE
+                    # the depth clamps to 1 — a filled pipeline would
+                    # never drain while rows stay active, so the spec
+                    # window (in_flight empty) could never re-open;
+                    # productive cohorts trade pipeline depth for
+                    # multi-token dispatches by design. But a cohort
+                    # whose drafts keep missing (free-form content the
+                    # n-gram source cannot predict) gets its full
+                    # pipeline back after a few dry rounds — losing
+                    # BOTH speculation and pipelining forever was the
+                    # worst of both worlds (a new submit re-opens the
+                    # window: fresh context may draft).
+                    depth = (
+                        1 if spec_armed and self._spec_idle < 4
+                        else self.pipeline_depth
+                    )
+                    while self._active and len(in_flight) < depth:
+                        self._dispatch_chunk(in_flight)
+            if cycle is not None:
+                last_fetch_done = self._spec_fetch_deliver(
+                    cycle, last_fetch_done
+                )
+            elif in_flight:
+                last_fetch_done = self._fetch_and_deliver(
+                    in_flight, last_fetch_done
+                )
 
     def _dispatch_chunk(self, in_flight: deque) -> None:
         """Dispatch ONE pipelined chunk (pool lock held): timeline
@@ -982,6 +1053,305 @@ class DecodePool:
             # decode keeps its cadence; prefill chunks take the gaps
             # between these notes
             self._sched.note_decode_chunk(len(records))
+
+    # -- pooled speculative decoding (spec cycles) ----------------------------
+    def _build_spec_exec(self, cfg: Any, cache_shardings: Any,
+                         repl: Any) -> None:
+        """Build the spec-cycle executables (constructor helper): a
+        spec cycle verifies [n_slots, width] candidate tokens (each
+        row's pending token + its drafts) in ONE target dispatch —
+        verify_chunk is already batch-generic and reads each row's
+        write offset from the cache lengths, so the pool reuses the
+        solo path's executable at pool shapes. Rejected tokens roll
+        back by LENGTH (_write_lengths): garbage KV past a row's
+        committed length is masked by attention and overwritten by
+        later steps — the same convention stale slot rows already
+        ride."""
+        from gofr_tpu.models.transformer import verify_chunk
+
+        self._verify_pool = jax.jit(
+            lambda p, t, c: verify_chunk(p, t, c, cfg),
+            donate_argnums=(2,),
+            out_shardings=(
+                (repl, dict(cache_shardings))
+                if repl is not None else None
+            ),
+        )
+        self._write_lengths = jax.jit(
+            lambda c, l: {"k": c["k"], "v": c["v"], "lengths": l},
+            donate_argnums=(0,),
+            out_shardings=(
+                dict(cache_shardings) if repl is not None else None
+            ),
+        )
+
+    def _warm_spec(self) -> None:
+        """Warm EVERY verify width the cohort ladder can produce plus
+        the lengths rollback — a spec cycle must never compile on the
+        serving path. The cache is donated through each warm and reset
+        by the constructor like the plain warmup's writes; tokens are
+        host-built exactly like a serving-path cycle (jit reshards
+        under a mesh; warm placement must match serve placement or the
+        first cycle recompiles)."""
+        from gofr_tpu.tpu.batcher import verify_width_ladder
+
+        for w in verify_width_ladder(self.spec_cfg.k_max):
+            ids, self.cache = self._verify_pool(
+                self.params,
+                jnp.asarray(np.zeros((self.n_slots, w), np.int32)),
+                self.cache,
+            )
+            ids.block_until_ready()
+        self.cache = self._write_lengths(
+            self.cache, jnp.asarray(np.zeros(self.n_slots, np.int32))
+        )
+        self.cache["lengths"].block_until_ready()
+
+    def _spec_arm(self, spec_ctx: Any, first_token: int, sampler: Any,
+                  penalty: Any, adapter: Any, want_logprobs: bool,
+                  want_top_logprobs: bool) -> Any:
+        """Build a request's draft state when pooled speculation is on
+        and the request is eligible — greedy, unpenalized, base
+        weights, no logprobs (the verify executable computes argmaxes,
+        not logprob rows). Called OUTSIDE the pool lock (it copies the
+        prompt into the draft context)."""
+        if (
+            self.spec_cfg is None or spec_ctx is None
+            or penalty is not None or adapter is not None
+            or want_logprobs or want_top_logprobs
+            or not getattr(sampler, "greedy", False)
+        ):
+            return None
+        if not self._free:
+            # overload fast-out: with no free slot visible the submit
+            # is about to reject — don't pay the O(prompt) context
+            # copies for a request that will solo anyway. The read is
+            # lock-free on purpose; in the rare race where a slot frees
+            # concurrently, the request pools WITHOUT spec state (plain
+            # pooled decode — correctness-neutral) rather than
+            # serializing every overload rejection on the pool lock.
+            return None
+        return self.spec_cfg.new_state(
+            [int(t) for t in spec_ctx], first_token
+        )
+
+    def _spec_ready(self) -> bool:
+        """Spec cycles run only while EVERY active row is spec-armed
+        (pool lock held): one executable per dispatch is the pool's
+        standing contract, and a sampled/penalized/adapter co-tenant
+        needs the plain chunk — mixed cohorts decode plain, spec rows
+        keep their draft context coherent via note_plain."""
+        if self.spec_cfg is None or not self._active:
+            return False
+        if self._pen_slots or self._lora_slots:
+            return False
+        return all(
+            slot.request is not None and slot.request.spec is not None
+            for slot in self._active.values()
+        )
+
+    def _spec_dispatch(self) -> Optional[tuple]:
+        """Draft + dispatch ONE batched verify (pool lock held): every
+        active row proposes up to its adaptive k draft tokens (brownout
+        and deadline clamped), the widths cohort onto the pow2 ladder,
+        and the target verifies all rows' pending+draft tokens in one
+        [n_slots, width] dispatch. Returns the in-flight cycle tuple, or
+        None when no row drafted anything — the plain pipelined chunk is
+        strictly better then (more steps per dispatch, no rollback)."""
+        from gofr_tpu.deadline import clamp_spec_k
+        from gofr_tpu.tpu.batcher import verify_width
+
+        cfg = self.spec_cfg
+        level = cfg.level()
+        records = [
+            (slot.index, slot.request) for slot in self._active.values()
+        ]
+        drafts: dict[int, list] = {}
+        max_k = 0
+        for index, req in records:
+            k = clamp_spec_k(
+                req.spec.adaptive.current(), level, req.deadline,
+                self._chunk_ema_s,
+            )
+            # room for the drafts + bonus inside the request's token
+            # budget and its cache row
+            k = min(k, req.remaining - 1, self.max_len - req.cache_len - 1)
+            d = req.spec.propose(k) if k > 0 else []
+            drafts[index] = d
+            max_k = max(max_k, len(d))
+        if max_k == 0:
+            return None
+        width = verify_width(max_k, cfg.k_max)
+        tokens = np.zeros((self.n_slots, width), np.int32)
+        for index, req in records:
+            tokens[index, 0] = req.pending
+            row = drafts[index]
+            tokens[index, 1 : 1 + len(row)] = row
+        drec = None
+        if self._timeline is not None:
+            drec = self._timeline.begin(
+                "spec_verify", batch_size=len(records), tokens=width,
+            )
+            drec.mark_running()
+            for _, req in records:
+                if req.record is not None:
+                    req.record.note_dispatch_id(drec.dispatch_id)
+            self._pending_chunk_drec = drec
+        dispatch_start = _perf_counter()
+        next_dev, self.cache = self._verify_pool(
+            self.params, jnp.asarray(tokens), self.cache
+        )
+        try:
+            next_dev.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        self._pending_chunk_drec = None
+        if self._sched is not None:
+            self._sched.note_decode_chunk(len(records))
+        return records, drafts, next_dev, width, dispatch_start, drec
+
+    def _spec_fetch_deliver(
+        self, cycle: tuple, last_fetch_done: float
+    ) -> float:
+        """Fetch one spec verify outside the lock (watchdogged exactly
+        like a plain chunk fetch), then deliver + roll back under it."""
+        records, drafts, next_dev, width, dispatch_start, drec = cycle
+        watch = (
+            self._watchdog.watch(
+                "spec_verify", drec.dispatch_id if drec else 0
+            )
+            if self._watchdog is not None else contextlib.nullcontext()
+        )
+        try:
+            with watch:
+                next_ids = np.asarray(next_dev)
+            fetch_done = _perf_counter()
+            # depth-1 dispatch: the span IS the inter-delivery interval
+            elapsed = fetch_done - max(dispatch_start, last_fetch_done)
+            with self._work:
+                self._spec_deliver(records, drafts, next_ids, width,
+                                   elapsed, drec)
+        except BaseException:
+            if self._timeline is not None and drec is not None:
+                self._timeline.finish(drec, status="error")
+            raise
+        if self._timeline is not None and drec is not None:
+            self._timeline.finish(drec)
+        return fetch_done
+
+    def _spec_deliver(
+        self, records: list, drafts: dict, next_ids: np.ndarray,
+        width: int, elapsed: float, drec: Any,
+    ) -> None:
+        """Acceptance + rollback for one fetched verify (pool lock
+        held): per row, the longest draft prefix matching the target's
+        argmaxes commits (plus the bonus token — the target's own
+        continuation, so output never depends on draft quality); the
+        rejected tail rolls back by writing every row's committed
+        length back into the cache lengths vector (one dispatch), and
+        the pending-token vector is rebuilt host-side so the next
+        dispatch — spec or plain — feeds forward correctly."""
+        if elapsed > 0:
+            self._chunk_ema_s = (
+                elapsed if self._chunk_ema_s <= 0
+                else 0.8 * self._chunk_ema_s + 0.2 * elapsed
+            )
+        delivered_total = drafted_total = accepted_total = 0
+        for index, req in records:
+            if req is None or req.finished:
+                continue
+            d = drafts[index]
+            row = next_ids[index]
+            n_acc = 0
+            while n_acc < len(d) and d[n_acc] == int(row[n_acc]):
+                n_acc += 1
+            burst = [int(row[j]) for j in range(n_acc + 1)]
+            delivered = self._spec_deliver_one(index, req, burst, n_acc,
+                                               len(d))
+            delivered_total += delivered
+            drafted_total += len(d)
+            accepted_total += min(n_acc, len(d))
+        lengths = np.zeros(self.n_slots, np.int32)
+        pendings = np.zeros((self.n_slots, 1), np.int32)
+        for index, slot in self._active.items():
+            req = slot.request
+            if req is not None:
+                lengths[index] = req.cache_len
+                pendings[index, 0] = req.pending
+        # ONE rollback dispatch: garbage KV past each row's committed
+        # length is dead (attention masks it; later steps overwrite it)
+        self.cache = self._write_lengths(self.cache, jnp.asarray(lengths))
+        self._last_tokens = jnp.asarray(pendings)
+        if self._sched is not None and not self._active:
+            self._sched.note_decode_idle()
+        if self._depth_gauge:
+            self._depth_gauge.set(len(self._active))
+        if drec is not None:
+            drec.tokens = delivered_total
+        self._account_chunk(delivered_total, elapsed, drec, steps=1)
+        # per-ROW semantics on the shared gauge: one verify serves
+        # len(records) rows, and the echo mirror publishes per-request
+        # values — dividing keeps "1.0 = plain decode" true for both
+        # producers (batch totals would read cohort size as spec win)
+        self.spec_cfg.note_cycle(
+            drafted_total, accepted_total, delivered_total,
+            dispatches=len(records),
+        )
+
+    def _spec_deliver_one(self, index: int, req: "_Request", burst: list,
+                          n_acc: int, drafted: int) -> int:
+        """One row's share of a verify cycle (pool lock held): burst
+        put (stop-token truncated), cache/budget bookkeeping, draft
+        state commit, terminal finish — the spec mirror of
+        _deliver_one. Returns the tokens actually delivered."""
+        cancelled = req.stop is not None and req.stop.is_set()
+        expired = (
+            not cancelled
+            and req.deadline is not None and req.deadline.expired()
+        )
+        hit_stop_token = False
+        emit: list = []
+        if not cancelled and not expired and req.out_queue is not None:
+            for t in burst:
+                if t in req.stop_tokens:
+                    hit_stop_token = True
+                    break
+                emit.append(t)
+            if emit:
+                req.out_queue.put(list(emit))
+        # committed tokens: everything emitted (the stop token itself is
+        # never emitted nor committed — the request ends at it)
+        committed = len(emit)
+        req.cache_len += committed
+        req.remaining -= committed
+        req.spec.commit(emit, drafted, n_acc)
+        req.pending = req.spec.pending
+        if req.record is not None:
+            req.record.note_spec(drafted, n_acc, len(emit))
+        if (
+            cancelled
+            or expired
+            or hit_stop_token
+            or req.remaining <= 0
+            or req.cache_len >= self.max_len
+        ):
+            if expired:
+                self._account_expiry(req)
+            self._finish_request(index, req, cancelled, expired=expired)
+        return len(emit)
+
+    def _account_expiry(self, req: "_Request") -> None:
+        """Deadline-expiry accounting for a finishing row (pool lock
+        held) — one home for the plain-chunk and spec-cycle deliver
+        paths, so the stage/cause/journal semantics cannot drift."""
+        if self._deadline_counter is not None:
+            self._deadline_counter.inc(stage="decode")
+        if self._cancel_counter is not None:
+            self._cancel_counter.inc(cause="deadline")
+        if req.record is not None:
+            req.record.note_shed("decode")
+        if req.journal is not None:
+            req.journal.note_interrupted("deadline exceeded mid-decode")
 
     def _run_executable(self, records: list) -> tuple:
         """ONE device dispatch (pool lock held): RNG advance and the
@@ -1143,6 +1513,24 @@ class DecodePool:
             if burst:
                 req.out_queue.put(burst)
                 delivered = len(burst)  # only tokens a request received
+            if req.spec is not None:
+                # a spec-armed row rode a plain chunk (mixed cohort /
+                # no-draft cycle): keep its draft context and pending
+                # token coherent so the next spec cycle drafts from the
+                # real stream. A continuing row always consumed the full
+                # chunk (shorter takes finish below), so the last
+                # delivered token IS the device's feed-forward token.
+                req.spec.note_plain(burst)
+                req.pending = req.spec.pending
+                if req.record is not None:
+                    # the chunk streamed weights once per scan step:
+                    # plain chunks ridden while spec-armed count at
+                    # ~1.0 tokens/stream, so the request's
+                    # tokens_per_dispatch reflects its REAL mix, not
+                    # just its verify cycles
+                    req.record.note_spec(
+                        0, 0, delivered, dispatches=self.chunk
+                    )
         req.remaining -= take
         if (
             cancelled
@@ -1152,21 +1540,18 @@ class DecodePool:
             or req.cache_len >= self.max_len
         ):
             if expired:
-                if self._deadline_counter is not None:
-                    self._deadline_counter.inc(stage="decode")
-                if self._cancel_counter is not None:
-                    self._cancel_counter.inc(cause="deadline")
-                if req.record is not None:
-                    req.record.note_shed("decode")
-                if req.journal is not None:
-                    req.journal.note_interrupted("deadline exceeded mid-decode")
+                self._account_expiry(req)
             self._finish_request(index, req, cancelled, expired=expired)
         return delivered
 
     def _account_chunk(self, delivered: int, elapsed: float,
-                       drec: Any) -> None:
+                       drec: Any, steps: Optional[int] = None) -> None:
         """Roofline accounting for one delivered chunk (pool lock
-        held): MFU/MBU gauges, token counter, dispatch-record stamps."""
+        held): MFU/MBU gauges, token counter, dispatch-record stamps.
+        ``steps`` overrides the weight-stream count: a plain chunk
+        streams the weights once per scan step (``self.chunk``); a spec
+        verify is ONE forward over all positions — weights stream once,
+        which is the entire point of speculation."""
         if self._mfu_gauge is not None and delivered:
             from gofr_tpu.tpu.flops import mfu
 
@@ -1187,7 +1572,8 @@ class DecodePool:
             # streamed weights+KV once per step, whatever fraction of the
             # emitted tokens was useful
             value = mbu(
-                self._bytes_per_step * self.chunk, elapsed, self._peak_bw
+                self._bytes_per_step * (steps or self.chunk), elapsed,
+                self._peak_bw,
             )
             self._mbu_gauge.set(value, model=self._model, op="decode")
             if drec is not None:
@@ -1329,6 +1715,14 @@ class DecodePool:
                 # chunk of decode costs right now (0 = not yet observed)
                 "chunk_cadence_s": self._chunk_ema_s,
                 "kv": self._kv.stats() if self._kv is not None else None,
+                # pooled speculative decoding: armed + its width bound
+                # (per-request accept/width state lives on the flight
+                # records and the spec gauges)
+                "spec": (
+                    {"k_max": self.spec_cfg.k_max,
+                     "ngram": self.spec_cfg.ngram}
+                    if self.spec_cfg is not None else None
+                ),
             }
 
     def close(self) -> None:

@@ -24,6 +24,14 @@ Config keys (SURVEY.md §2 #22 TPU-native additions):
   DRAFT_TOKENS tokens per cycle and the target verifies them in one
   forward (output bit-identical to plain greedy; latency mode, so greedy
   requests bypass the continuous-batching pool)
+- ``SPEC_POOLED`` / ``SPEC_NGRAM`` / ``SPEC_K_MAX`` /
+  ``SPEC_FAKE_ACCEPT``: POOLED speculative decoding (tpu/spec_pool.py)
+  — speculation through the continuous-batching pool with zero-weight
+  n-gram drafting, batched multi-row verify, length/refcount rollback,
+  and a per-request adaptive-k controller (brownout + deadline
+  clamped); pooled-spec output stays bit-identical to plain pooled
+  decode. When both SPEC_POOLED and DRAFT_MODEL_NAME are set, the
+  pooled mode wins for pool-eligible requests
 - ``LORA_ADAPTERS``: "name=path,..." named LoRA adapter artifacts
   (models/lora.py::export_adapter, orbax-saved) served over the shared
   base; requests select one via generate(adapter=...). Adapter requests
@@ -513,6 +521,37 @@ class TPUDevice:
             # draft — strictly slower than plain decode. A stale
             # DRAFT_TOKENS without a draft model is ignored.
             raise ValueError("DRAFT_TOKENS must be >= 2")
+        # pooled speculative decoding (tpu/spec_pool.py): SPEC_POOLED
+        # routes speculation THROUGH the continuous-batching pool (the
+        # solo DRAFT_MODEL_NAME latency mode bypasses it) with
+        # zero-weight n-gram drafting (SPEC_NGRAM) bounded at SPEC_K_MAX
+        # drafts per cycle; SPEC_FAKE_ACCEPT scripts the echo runner's
+        # per-cycle accept counts for deterministic tier-1 coverage
+        self._spec_pooled = (
+            config.get_or_default("SPEC_POOLED", "off").strip().lower()
+            == "on"
+        )
+        self._spec_ngram = (
+            config.get_or_default("SPEC_NGRAM", "on").strip().lower()
+            != "off"
+        )
+        self._spec_k_max = int(config.get_or_default("SPEC_K_MAX", "4"))
+        if self._spec_k_max < 1:
+            raise ValueError("SPEC_K_MAX must be >= 1")
+        raw_fake = config.get_or_default("SPEC_FAKE_ACCEPT", "").strip()
+        from gofr_tpu.tpu.spec_pool import parse_fake_accept
+
+        self._spec_fake_accept = (
+            parse_fake_accept(raw_fake) if raw_fake else None
+        )
+        if self._spec_pooled and not (
+            self._spec_ngram or self._spec_fake_accept
+        ):
+            raise ValueError(
+                "SPEC_POOLED=on needs a draft source: keep SPEC_NGRAM=on "
+                "(zero-weight prompt-lookup drafting) or script "
+                "SPEC_FAKE_ACCEPT (echo runner)"
+            )
         # LORA_ADAPTERS="name=path,name2=path2": named adapter sets
         # (orbax artifacts from models/lora.py::export_adapter) served
         # over ONE shared base — requests pick one with {"adapter": name}
@@ -931,6 +970,12 @@ class TPUDevice:
             metrics=self.metrics,
         )
         self._wire_paged_kv()
+        if self._spec_pooled and hasattr(self.runner, "enable_pooled_spec"):
+            # echo runner: the compile-free pooled-spec mirror (tier-1);
+            # the only consumer of the SPEC_FAKE_ACCEPT schedule
+            self.runner.enable_pooled_spec(
+                self._build_spec_cfg(include_fake=True)
+            )
         if (
             self._prefill_chunk_cfg
             and hasattr(self.runner, "_can_chunk_prefill")
@@ -990,7 +1035,21 @@ class TPUDevice:
                 timeline=self.timeline,
                 watchdog=self.watchdog,
                 kv=self.kv_pool,
+                # the real pool speculates only with a real draft
+                # source: n-gram. A fake-schedule-only config (echo
+                # tier-1 scaffolding) must not clamp a transformer
+                # pool's pipeline depth while drafting nothing.
+                spec=(
+                    self._build_spec_cfg(include_fake=False)
+                    if self._spec_pooled and self._spec_ngram else None
+                ),
             )
+            if self._spec_pooled and not self._spec_ngram:
+                self.logger.warnf(
+                    "SPEC_POOLED=on is inert for the decode pool: "
+                    "SPEC_NGRAM=off leaves it no draft source "
+                    "(SPEC_FAKE_ACCEPT drives only the echo runner)"
+                )
             if getattr(self.runner, "adapters", None):
                 self._boot_progress(
                     "warming pooled multi-LoRA bank", kind="lora_bank"
@@ -1007,6 +1066,26 @@ class TPUDevice:
             cohort=self._batch_cohort,
             timeline=self.timeline,
             watchdog=self.watchdog,
+        )
+
+    def _build_spec_cfg(self, include_fake: bool) -> Any:
+        """One PoolSpecConfig per stack build (SPEC_POOLED=on): draft
+        width bound, draft source, the live brownout probe, and the
+        shared accept-ratio / tokens-per-dispatch gauges.
+        ``include_fake`` gates the SPEC_FAKE_ACCEPT schedule to the
+        echo runner — the fake source drafts against a known TRUE
+        continuation, which only echo's position-indexed decode has; on
+        the real pool it would silently draft nothing forever while
+        still clamping the pipeline depth."""
+        from gofr_tpu.tpu.spec_pool import PoolSpecConfig
+
+        return PoolSpecConfig(
+            k_max=self._spec_k_max,
+            ngram=self._spec_ngram,
+            fake_schedule=self._spec_fake_accept if include_fake else None,
+            brownout_level=self.brownout.level,
+            metrics=self.metrics,
+            model=self.model_name,
         )
 
     def _wire_paged_kv(self) -> None:
@@ -2482,6 +2561,24 @@ class _EchoRunner:
         # PoolFailure), so a wedge-recovery rebuild interrupts streams
         # instead of leaving them emitting beside the new stack
         self._closed = False
+        # pooled speculative decoding (SPEC_POOLED): attached by the
+        # device via enable_pooled_spec — the compile-free mirror of the
+        # decode pool's spec cycles (draft, one verify "dispatch" per
+        # burst, paged-KV rollback, adaptive k), so the whole control
+        # flow runs in tier-1. spec_stats shares the transformer
+        # runner's shape so the device's acceptance gauge reads both.
+        self.spec_pooled: Optional[Any] = None
+        self.spec_stats = {"cycles": 0, "drafted": 0, "accepted": 0}
+        self._spec_lock = threading.Lock()
+
+    def enable_pooled_spec(self, cfg: Any) -> None:
+        """Arm pooled speculative decoding (a
+        :class:`~gofr_tpu.tpu.spec_pool.PoolSpecConfig`): generate()
+        then decodes in verify cycles — k drafted tokens verified per
+        per-cycle "dispatch" (one ``ECHO_STEP_MS`` sleep models the
+        target forward; zero-weight drafting costs nothing), rejected
+        tokens rolled back through the paged-KV length contract."""
+        self.spec_pooled = cfg
 
     def close(self) -> None:
         self._closed = True
@@ -2621,50 +2718,16 @@ class _EchoRunner:
         lps: list[float] = []
         tops: list = []
         try:
-            # resume_from > 0: a journal-resumed request — emission
-            # starts at that position (echo decode is position-indexed,
-            # so positions resume_from.. are bit-identical to an
-            # uninterrupted run's)
-            for i in range(resume_from, max_new_tokens):
-                if stop is not None and stop.is_set():
-                    break
-                if self._closed:
-                    raise RuntimeError(
-                        "echo runner closed mid-generation (engine "
-                        "recovering)"
-                    )
-                if deadline is not None and deadline.expired():
-                    # per-step expiry — the echo mirror of the pool's
-                    # per-chunk row check: the raise below unwinds
-                    # through the abort path, releasing the sequence's
-                    # KV blocks within this very step
-                    if self._deadline_counter is not None:
-                        self._deadline_counter.inc(stage="decode")
-                    if self._cancel_counter is not None:
-                        self._cancel_counter.inc(cause="deadline")
-                    if record is not None:
-                        record.note_shed("decode")
-                    from gofr_tpu.errors import DeadlineExceeded
-
-                    raise DeadlineExceeded(
-                        f"request deadline exceeded mid-decode (after "
-                        f"{len(out)} tokens)", stage="decode",
-                    )
-                token = int(src[i % src.size])
-                if token in stop_tokens:
-                    break
-                out.append(token)
-                if seq is not None:
-                    # each decoded token lands in the sequence's KV
-                    # (COW first if the boundary block is shared)
-                    self.paged.append(seq, token)
-                if logprobs:
-                    lps.append(0.0)
-                    tops.append([(token, 0.0)])
-                if on_token:
-                    on_token((token, 0.0) if logprobs else token)
-                if self.step_s:
-                    time.sleep(self.step_s)
+            if self.spec_pooled is not None:
+                self._generate_spec(
+                    src, seq, out, lps, tops, max_new_tokens, resume_from,
+                    stop, stop_tokens, on_token, logprobs, deadline, record,
+                )
+            else:
+                self._generate_plain(
+                    src, seq, out, lps, tops, max_new_tokens, resume_from,
+                    stop, stop_tokens, on_token, logprobs, deadline, record,
+                )
         except BaseException:
             if seq is not None:
                 self.paged.abort(seq)
@@ -2685,6 +2748,169 @@ class _EchoRunner:
         if top_logprobs:
             return out, lps, tops
         return (out, lps) if logprobs else out
+
+    def _shed_decode(self, deadline: Any, record: Any, emitted: int):
+        """Mid-decode deadline expiry (plain step or spec cycle): same
+        accounting as the pool's per-chunk row check, then the
+        504-mapped raise — it unwinds through the abort path, releasing
+        the sequence's KV blocks within this very step."""
+        if self._deadline_counter is not None:
+            self._deadline_counter.inc(stage="decode")
+        if self._cancel_counter is not None:
+            self._cancel_counter.inc(cause="deadline")
+        if record is not None:
+            record.note_shed("decode")
+        from gofr_tpu.errors import DeadlineExceeded
+
+        raise DeadlineExceeded(
+            f"request deadline exceeded mid-decode (after "
+            f"{emitted} tokens)", stage="decode",
+        )
+
+    def _generate_plain(
+        self, src: np.ndarray, seq: Any, out: list, lps: list, tops: list,
+        max_new_tokens: int, resume_from: int, stop: Any,
+        stop_tokens: frozenset, on_token: Any, logprobs: bool,
+        deadline: Any, record: Any,
+    ) -> None:
+        """One token per "dispatch" (``ECHO_STEP_MS`` sleep) — the
+        pre-spec decode loop, and the baseline pooled-spec must stay
+        bit-identical to. resume_from > 0: a journal-resumed request —
+        emission starts at that position (echo decode is
+        position-indexed, so positions resume_from.. are bit-identical
+        to an uninterrupted run's)."""
+        for i in range(resume_from, max_new_tokens):
+            if stop is not None and stop.is_set():
+                break
+            if self._closed:
+                raise RuntimeError(
+                    "echo runner closed mid-generation (engine "
+                    "recovering)"
+                )
+            if deadline is not None and deadline.expired():
+                # per-step expiry — the echo mirror of the pool's
+                # per-chunk row check
+                self._shed_decode(deadline, record, len(out))
+            token = int(src[i % src.size])
+            if token in stop_tokens:
+                break
+            out.append(token)
+            if seq is not None:
+                # each decoded token lands in the sequence's KV
+                # (COW first if the boundary block is shared)
+                self.paged.append(seq, token)
+            if logprobs:
+                lps.append(0.0)
+                tops.append([(token, 0.0)])
+            if on_token:
+                on_token((token, 0.0) if logprobs else token)
+            if self.step_s:
+                time.sleep(self.step_s)
+
+    def _generate_spec(
+        self, src: np.ndarray, seq: Any, out: list, lps: list, tops: list,
+        max_new_tokens: int, resume_from: int, stop: Any,
+        stop_tokens: frozenset, on_token: Any, logprobs: bool,
+        deadline: Any, record: Any,
+    ) -> None:
+        """Pooled-spec decode cycles, compile-free (the tier-1 mirror of
+        ``DecodePool``'s spec mode): per cycle the request's draft
+        source proposes k tokens (zero-weight n-gram over its own
+        prompt+emitted context, or the deterministic ``SPEC_FAKE_ACCEPT``
+        schedule), the drafts land SPECULATIVELY in the paged KV (COW on
+        shared boundaries — the write-then-maybe-reject shape is the
+        point), ONE verify "dispatch" (one ``ECHO_STEP_MS`` sleep, vs
+        the plain loop's one per token) accepts the longest matching
+        prefix plus the bonus token, and the rejected tail rolls back
+        through the block-table length contract. Emission is
+        position-indexed off ``src`` exactly like the plain loop, so the
+        output is bit-identical whatever the drafts proposed — draft
+        quality moves only tokens-per-dispatch. Adaptive k: per-request
+        acceptance EMA, clamped by brownout level and the remaining
+        deadline budget (deadline.clamp_spec_k)."""
+        from gofr_tpu.deadline import clamp_spec_k
+
+        cfg = self.spec_pooled
+        # draft context = prompt + whatever a prior (interrupted)
+        # incarnation already emitted: a journal resume must draft from
+        # the same stream state an uninterrupted run would have
+        ctx = [int(t) for t in src] + [
+            int(src[j % src.size]) for j in range(resume_from)
+        ]
+        state = cfg.new_state(ctx[:-1], ctx[-1])
+        i = resume_from
+        while i < max_new_tokens:
+            if stop is not None and stop.is_set():
+                break
+            if self._closed:
+                raise RuntimeError(
+                    "echo runner closed mid-generation (engine recovering)"
+                )
+            if deadline is not None and deadline.expired():
+                self._shed_decode(deadline, record, len(out))
+            k = clamp_spec_k(
+                state.adaptive.current(), cfg.level(), deadline,
+                self.step_s,
+            )
+            # room for k drafts + the bonus within the request budget
+            k = min(k, max_new_tokens - i - 1)
+            truth = [int(src[(i + j) % src.size]) for j in range(k + 1)]
+            drafts = state.propose(k, truth=truth[:k]) if k > 0 else []
+            k_eff = len(drafts)
+            base_len = seq.table.length if seq is not None else 0
+            if seq is not None:
+                for t in drafts:
+                    # speculative KV writes: the drafts land BEFORE the
+                    # verify (COW fires here if the boundary is shared);
+                    # rejection rolls them back below
+                    self.paged.append(seq, t)
+            # ONE verify dispatch for the whole burst — this sleep vs
+            # the plain loop's per-token sleep IS the spec win
+            if self.step_s:
+                time.sleep(self.step_s)
+            n_acc = 0
+            while n_acc < k_eff and drafts[n_acc] == truth[n_acc]:
+                n_acc += 1
+            # accepted drafts + the bonus token, stop-token truncated
+            # (the stop token ends the stream and is not emitted)
+            burst = truth[: n_acc + 1]
+            stopped = False
+            for j, t in enumerate(burst):
+                if t in stop_tokens:
+                    burst = burst[:j]
+                    stopped = True
+                    break
+            if seq is not None:
+                # rollback: keep only the accepted prefix of the
+                # speculative writes (blocks stay reserved — see
+                # HostPagedKV.rollback), then land the bonus token
+                self.paged.rollback(
+                    seq, base_len + min(len(burst), n_acc)
+                )
+                if len(burst) > n_acc:
+                    self.paged.append(seq, burst[-1])
+            cancelled = False
+            for t in burst:
+                out.append(t)
+                if logprobs:
+                    lps.append(0.0)
+                    tops.append([(t, 0.0)])
+                if on_token:
+                    on_token((t, 0.0) if logprobs else t)
+                if stop is not None and stop.is_set():
+                    cancelled = True
+                    break
+            state.commit(burst, k_eff, n_acc)
+            cfg.note_cycle(k_eff, n_acc, len(burst))
+            with self._spec_lock:
+                self.spec_stats["cycles"] += 1
+                self.spec_stats["drafted"] += k_eff
+                self.spec_stats["accepted"] += n_acc
+            if record is not None:
+                record.note_spec(k_eff, n_acc, len(burst))
+            i += len(burst)
+            if stopped or cancelled:
+                break
 
 
 class _MLPRunner:
@@ -3438,9 +3664,17 @@ class _TransformerRunner:
         # (unseeded, k >= 2) uses canonical speculative sampling — the
         # emitted sequence is distributed exactly as the target's warped
         # distribution, whatever the draft proposes.
+        # SPEC_POOLED opts the deployment into pooled speculation
+        # instead: the solo draft-and-verify latency mode stands down
+        # and eligible requests speculate THROUGH the pool below (the
+        # pool builds their n-gram draft state from spec_ctx)
+        pool_spec = (
+            decode_pool is not None
+            and getattr(decode_pool, "spec_cfg", None) is not None
+        )
         spec_ok = (
             self.spec is not None and presence is None
-            and not logprobs and adapter is None
+            and not logprobs and adapter is None and not pool_spec
         )
         # seed the prefix cache with the finish-time conversation KV (base
         # requests on an unsharded-batch cache): a follow-up turn then
@@ -3495,6 +3729,7 @@ class _TransformerRunner:
                     stop_tokens=stop_tokens, penalty=penalty,
                     want_logprobs=logprobs, want_top_logprobs=top_logprobs,
                     adapter=adapter, want_kv=seed_kv,
+                    spec_ctx=ids if pool_spec else None,
                 )
             except (queue_mod.Full, RuntimeError) as exc:
                 from gofr_tpu.tpu.decode_pool import _POOL_DEBUG

@@ -945,6 +945,32 @@ class HostPagedKV:
             )
             seq.table.length += 1
 
+    def rollback(self, seq: PagedSequence, n_tokens: int) -> None:
+        """Speculative-decode reject: roll the sequence's valid length
+        back to ``n_tokens`` (the committed prefix — accepted drafts +
+        the bonus). The rejected tokens are un-emitted by construction:
+        every reader honors ``length``, so the stale content past it is
+        dead the moment this returns, and the next append overwrites it
+        in place. The BLOCKS stay in the table — they are the capacity
+        the request reserved at admission, and releasing them here
+        would let a concurrent admission steal them and starve this
+        (already admitted) request at its next append, breaking the
+        no-mid-decode-exhaustion contract. They release at
+        :meth:`finish` via ``trim`` exactly like any other unused
+        reservation — the leak invariant the rollback tests pin."""
+        if n_tokens < seq.prompt_len:
+            raise ValueError(
+                f"rollback to {n_tokens} would cut into the "
+                f"{seq.prompt_len}-token prompt"
+            )
+        with self.pool.lock:
+            if n_tokens > seq.table.length:
+                raise ValueError(
+                    f"rollback to {n_tokens} past the sequence's "
+                    f"{seq.table.length}-token length"
+                )
+            seq.table.length = n_tokens
+
     # -- completion ----------------------------------------------------------
     def finish(self, seq: PagedSequence, store: bool = True) -> None:
         """Request done: trim the unused reservation (those blocks admit

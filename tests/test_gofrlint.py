@@ -201,6 +201,20 @@ def test_gfl005_deadline_family_covered():
         == ["GFL005"]
 
 
+def test_gfl005_spec_family_covered():
+    """The pooled-speculative-decoding family (tpu/spec_pool.py): the
+    _ratio and _per_dispatch gauge suffixes pass; suffix drift within
+    the family still fails."""
+    assert lint('m.gauge("gofr_tpu_spec_accept_ratio", "a")\n') == []
+    assert lint(
+        'm.gauge("gofr_tpu_spec_tokens_per_dispatch", "t")\n'
+    ) == []
+    assert rules_of(lint('m.gauge("gofr_tpu_spec_accept", "a")\n')) == \
+        ["GFL005"]
+    assert rules_of(lint('m.gauge("gofr_tpu_spec_tokens", "t")\n')) == \
+        ["GFL005"]
+
+
 def test_gfl005_router_family_covered():
     """The gofr_tpu_router_* family (fleet/router.py) rides the same
     convention: the suffix table must keep accepting its gauges (_state,

@@ -86,6 +86,32 @@ def test_reserve_ensure_trim():
 
 # -- aliasing + copy-on-write -------------------------------------------------
 
+def test_rollback_then_finish_releases_every_rejected_block():
+    """The spec reject path end to end at the allocator level: drafts
+    appended across block boundaries, rolled back, the sequence
+    finished — blocks for rejected tokens must all come back (at
+    finish, via trim: live sequences keep their reserved capacity so
+    an admitted request can never starve mid-decode)."""
+    arena = HostTokenArena(16, 2)
+    pool = BlockPool(16, 2, arena=arena)
+    engine = HostPagedKV(pool, arena, lcp_min=4)
+    seq = engine.admit(np.asarray([1, 2, 3], np.int32), 8)
+    capacity = len(seq.table.blocks)
+    for t in (10, 11, 12, 13, 14):  # spec drafts across 3 boundaries
+        engine.append(seq, t)
+    engine.rollback(seq, 4)  # keep one draft, reject four
+    # live rollback retains capacity (reserved at admission)...
+    assert len(seq.table.blocks) == capacity
+    assert seq.table.length == 4
+    engine.finish(seq, store=False)
+    # ...and finish returns EVERYTHING the request held, rejected-draft
+    # blocks included (admission cached the prompt entry by design —
+    # purging it must balance the pool to empty)
+    assert pool.stats()["active"] == 0
+    pool.cache_clear()
+    assert pool.stats()["free"] == pool.stats()["total"]
+
+
 def test_alias_shares_blocks_and_survives_donor_release():
     pool, arena = _pool(bt=4)
     donor = pool.reserve(8)
@@ -279,7 +305,7 @@ def test_fuzzed_alloc_alias_cow_evict_invariants():
                     continue
                 assert list(engine.prompt_tokens(seq)) == list(prompt)
                 live.append((seq, np.asarray(prompt, np.int32), max_new))
-            elif op < 0.75 and live:  # append (COW path)
+            elif op < 0.65 and live:  # append (COW path)
                 i = rng.randrange(len(live))
                 seq, toks, budget = live[i]
                 if budget <= 0:  # reservation cap: appends never allocate
@@ -289,6 +315,24 @@ def test_fuzzed_alloc_alias_cow_evict_invariants():
                 engine.append(seq, t)
                 live[i] = (seq, np.append(toks, t).astype(np.int32),
                            budget - 1)
+            elif op < 0.8 and live:  # speculative drafts + rollback
+                i = rng.randrange(len(live))
+                seq, toks, budget = live[i]
+                if budget <= 0:
+                    continue
+                k = rng.randint(1, budget)
+                drafts = [int((next_tok + j) % 251) for j in range(k)]
+                next_tok += k
+                base = seq.table.length
+                for t in drafts:
+                    engine.append(seq, t)  # speculative writes (COW too)
+                keep = rng.randint(0, k)  # verify keeps a prefix
+                engine.rollback(seq, base + keep)
+                live[i] = (
+                    seq,
+                    np.append(toks, drafts[:keep]).astype(np.int32),
+                    budget - keep,
+                )
             elif live:  # finish (store or abort)
                 i = rng.randrange(len(live))
                 seq, toks, _ = live.pop(i)

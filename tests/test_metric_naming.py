@@ -25,6 +25,7 @@ _HISTOGRAM_SUFFIXES = ("_seconds", "_bytes", "_size")
 _GAUGE_SUFFIXES = (
     "_seconds", "_bytes", "_total", "_depth", "_ratio", "_entries",
     "_active", "_acceptance", "_state", "_blocks", "_size", "_level",
+    "_per_dispatch",
 )
 # roofline utilization gauges: the suffix IS the (well-known) metric name
 _GAUGE_ALLOWLIST = {"gofr_tpu_mfu", "gofr_tpu_mbu"}
@@ -85,6 +86,10 @@ def test_scanner_sees_the_known_registrations():
     # transfer ledger + the quota redis fail-open counter
     assert {"gofr_tpu_kv_transfer_total",
             "gofr_tpu_router_quota_fallback_total"} <= names
+    # pooled speculative decoding (tpu/spec_pool.py): the accept-ratio
+    # EMA and tokens-per-dispatch gauges stay scan-visible
+    assert {"gofr_tpu_spec_accept_ratio",
+            "gofr_tpu_spec_tokens_per_dispatch"} <= names
     assert len(names) >= 35
 
 

@@ -192,3 +192,129 @@ def test_spec_generation_seeds_conversation_kv(plain):
     finally:
         dev.close()
         _restore(old)
+
+
+# -- pooled speculative decoding (SPEC_POOLED, tpu/spec_pool.py) ---------------
+
+@pytest.fixture(scope="module")
+def pooled_plain():
+    dev, old = _device(DECODE_SLOTS="4", DECODE_CHUNK="4")
+    yield dev
+    dev.close()
+    _restore(old)
+
+
+@pytest.fixture(scope="module")
+def pooled_spec():
+    dev, old = _device(SPEC_POOLED="on", SPEC_K_MAX="4",
+                       DECODE_SLOTS="4", DECODE_CHUNK="4")
+    yield dev
+    dev.close()
+    _restore(old)
+
+
+def test_pooled_spec_bit_identical_to_plain_pool(pooled_plain, pooled_spec):
+    """The tentpole invariant on the real executables: speculation
+    through the continuous-batching pool emits exactly the plain pooled
+    stream — n-gram drafts only move tokens-per-dispatch."""
+    for prompt, n in (([1, 2, 3], 12), ([7] * 30, 24), ([42], 8),
+                      ([5, 6], 17)):
+        assert pooled_spec.generate(prompt, max_new_tokens=n) == \
+            pooled_plain.generate(prompt, max_new_tokens=n), (prompt, n)
+
+
+def test_pooled_spec_cycles_fire_and_are_observable(pooled_spec):
+    from gofr_tpu.telemetry import FlightRecord, activate_record
+
+    record = FlightRecord("tiny", "test")
+    activate_record(record)
+    try:
+        pooled_spec.generate([7] * 30, max_new_tokens=24)
+    finally:
+        activate_record(None)
+    assert record.spec_dispatches > 0
+    assert record.tokens_per_dispatch > 1.0
+    text = pooled_spec.metrics.expose()
+    assert 'gofr_tpu_spec_accept_ratio{model="tiny"}' in text
+    assert 'gofr_tpu_spec_tokens_per_dispatch{model="tiny"}' in text
+    assert pooled_spec.decode_pool.occupancy()["spec"] == {
+        "k_max": 4, "ngram": True,
+    }
+
+
+def test_pooled_spec_concurrent_streams(pooled_plain, pooled_spec):
+    """Co-tenant rows share one batched verify; every stream still
+    emits its own plain-pool sequence."""
+    prompts = ([1, 2, 3], [7] * 30, [42, 9], [5, 6])
+    want = [pooled_plain.generate(p, max_new_tokens=14) for p in prompts]
+    results = [None] * len(prompts)
+
+    def run(i):
+        results[i] = pooled_spec.generate(prompts[i], max_new_tokens=14)
+
+    threads = [
+        threading.Thread(target=run, args=(i,))
+        for i in range(len(prompts))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == want
+
+
+def test_pooled_spec_mixed_cohort_with_sampled_co_tenant(
+    pooled_plain, pooled_spec
+):
+    """An unseeded sampled co-tenant is pool-eligible but NOT
+    spec-eligible: the cohort decodes plain chunks while it is active,
+    and the greedy stream's output must not move."""
+    from gofr_tpu.ops.sampling import Sampler
+
+    want = pooled_plain.generate([1, 2, 3], max_new_tokens=12)
+    results = {}
+
+    def greedy():
+        results["g"] = pooled_spec.generate([1, 2, 3], max_new_tokens=12)
+
+    def sampled():
+        results["s"] = pooled_spec.generate(
+            [9, 8], max_new_tokens=12, sampler=Sampler(temperature=1.0)
+        )
+
+    ts = [threading.Thread(target=greedy), threading.Thread(target=sampled)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results["g"] == want
+    assert len(results["s"]) == 12
+
+
+def test_pooled_spec_stop_tokens(pooled_plain, pooled_spec):
+    full = pooled_plain.generate([7] * 30, max_new_tokens=16)
+    stop_tok = full[7]
+    want = full[: full.index(stop_tok)]
+    assert pooled_spec.generate([7] * 30, max_new_tokens=16,
+                                stop_tokens=[stop_tok]) == want
+
+
+def test_pooled_spec_stands_down_solo_draft_mode():
+    """SPEC_POOLED + DRAFT_MODEL_NAME: pooled speculation wins for
+    pool-eligible requests (the solo latency mode would bypass the
+    pool), and output still matches plain pooled decode."""
+    plain_dev, old1 = _device(DECODE_SLOTS="2", DECODE_CHUNK="4")
+    both_dev, old2 = _device(DRAFT_MODEL_NAME="tiny", DRAFT_TOKENS="4",
+                             SPEC_POOLED="on", DECODE_SLOTS="2",
+                             DECODE_CHUNK="4")
+    try:
+        want = plain_dev.generate([5, 6], max_new_tokens=10)
+        before = dict(both_dev.runner.spec_stats)
+        assert both_dev.generate([5, 6], max_new_tokens=10) == want
+        # the solo draft engine never ran — the pool speculated instead
+        assert both_dev.runner.spec_stats == before
+    finally:
+        plain_dev.close()
+        both_dev.close()
+        _restore(old2)
+        _restore(old1)

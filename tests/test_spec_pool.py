@@ -1,0 +1,551 @@
+"""Pooled speculative decoding, compile-free (tier-1): the whole
+control flow — zero-weight n-gram drafting, the deterministic
+SPEC_FAKE_ACCEPT schedule, batched-verify accounting, paged-KV
+rollback, the adaptive-k controller with its brownout/deadline clamps,
+and journal resume — driven through the echo runner, plus the unit
+surface of tpu/spec_pool.py. Output bit-identity with the plain decode
+loop is the anchor invariant: speculation may only move
+tokens-per-dispatch, never a single emitted token."""
+
+import os
+import threading
+
+import pytest
+
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.deadline import Deadline, activate_deadline, clamp_spec_k
+from gofr_tpu.logging import Level
+from gofr_tpu.metrics import Registry
+from gofr_tpu.testutil import MockLogger
+from gofr_tpu.tpu.batcher import verify_width, verify_width_ladder
+from gofr_tpu.tpu.device import new_device
+from gofr_tpu.tpu.spec_pool import (
+    AdaptiveK,
+    FakeDraft,
+    NgramDraft,
+    PoolSpecConfig,
+    SpecRequestState,
+    parse_fake_accept,
+)
+
+
+def _device(**env):
+    defaults = {"MODEL_NAME": "echo", "BATCH_MAX_SIZE": "4",
+                "BATCH_TIMEOUT_MS": "1"}
+    defaults.update(env)
+    old = {k: os.environ.get(k) for k in defaults}
+    os.environ.update(defaults)
+    try:
+        return new_device(EnvConfig(), MockLogger(Level.INFO), Registry()), old
+    except BaseException:
+        _restore(old)
+        raise
+
+
+def _restore(old):
+    for k, v in old.items():
+        os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+# -- n-gram drafting -----------------------------------------------------------
+
+def test_ngram_proposes_continuation_of_most_recent_match():
+    d = NgramDraft([1, 2, 3, 9, 1, 2, 3], n_max=3)
+    # trailing [1,2,3] matched at the start; continuation there was [9,...]
+    assert d.propose(2) == [9, 1]
+
+
+def test_ngram_prefers_longer_grams():
+    # trailing [5, 6]: the 2-gram match (-> 7) must win over the more
+    # recent 1-gram match of [6] (-> 8)
+    d = NgramDraft([5, 6, 7, 6, 8, 5, 6], n_max=3)
+    assert d.propose(1) == [7]
+
+
+def test_ngram_miss_returns_empty_and_extend_learns():
+    d = NgramDraft([1, 2, 3, 4], n_max=3)
+    assert d.propose(3) == []
+    d.extend([1, 2])  # now the tail [1, 2] has an earlier occurrence
+    assert d.propose(2) == [3, 4]
+
+
+def test_ngram_k_zero_and_tiny_context():
+    assert NgramDraft([1, 2, 3]).propose(0) == []
+    assert NgramDraft([7]).propose(4) == []
+
+
+def test_ngram_validates_bounds():
+    with pytest.raises(ValueError):
+        NgramDraft([1], n_max=0)
+    with pytest.raises(ValueError):
+        NgramDraft([1], n_max=1, n_min=2)
+
+
+# -- fake-accept schedule ------------------------------------------------------
+
+def test_parse_fake_accept():
+    assert parse_fake_accept("3,1,0") == (3, 1, 0)
+    assert parse_fake_accept(" 2 ") == (2,)
+    with pytest.raises(ValueError):
+        parse_fake_accept("-1")
+    with pytest.raises(ValueError):
+        parse_fake_accept(",")
+
+
+def test_fake_draft_follows_schedule():
+    f = FakeDraft((2, 0))
+    truth = [10, 11, 12]
+    assert f.propose_against(truth, 3) == [10, 11, 13]  # 2 right, 1 wrong
+    assert f.propose_against(truth, 3) == [11, 12, 13]  # 0 right
+    assert f.propose_against(truth, 3) == [10, 11, 13]  # schedule cycles
+
+
+# -- adaptive k ----------------------------------------------------------------
+
+def test_adaptive_k_starts_optimistic_and_tracks_acceptance():
+    a = AdaptiveK(4)
+    assert a.current() == 4  # optimistic first cycle
+    for _ in range(20):
+        a.observe(4, 4)
+    assert a.current() == 4
+    for _ in range(20):
+        a.observe(4, 2)  # 50% acceptance settles around k=2
+    assert 1 <= a.current() <= 2
+
+
+def test_adaptive_k_degrades_to_plain_and_probes():
+    a = AdaptiveK(4)
+    for _ in range(30):
+        a.observe(4, 0)
+    ks = [a.current() for _ in range(16)]
+    assert ks.count(0) >= 12  # degraded: mostly plain decode
+    assert 1 in ks  # ...with a periodic probe so recovery is possible
+
+
+def test_adaptive_k_recovers_after_probe_success():
+    a = AdaptiveK(4)
+    for _ in range(30):
+        a.observe(4, 0)
+    for _ in range(20):
+        a.observe(1, 1)  # probes start accepting
+    assert a.current() >= 1
+
+
+def test_adaptive_k_validates():
+    with pytest.raises(ValueError):
+        AdaptiveK(0)
+
+
+# -- serving clamps ------------------------------------------------------------
+
+def test_clamp_spec_k_brownout_levels():
+    assert clamp_spec_k(4, brownout_level=0) == 4
+    assert clamp_spec_k(4, brownout_level=1) == 1
+    assert clamp_spec_k(4, brownout_level=2) == 0
+    assert clamp_spec_k(0, brownout_level=0) == 0
+
+
+def test_clamp_spec_k_deadline_budget():
+    generous = Deadline(10.0)
+    assert clamp_spec_k(4, deadline=generous, cadence_s=0.1) == 4
+    tight = Deadline(0.25)  # ~2 chunks of budget -> at most 1 draft
+    assert clamp_spec_k(4, deadline=tight, cadence_s=0.1) <= 1
+    spent = Deadline(0.0)
+    assert clamp_spec_k(4, deadline=spent, cadence_s=0.1) == 0
+    # no cadence sample yet: the clamp stays out of the way
+    assert clamp_spec_k(4, deadline=tight, cadence_s=0.0) == 4
+
+
+# -- verify width cohorts ------------------------------------------------------
+
+def test_verify_width_ladder():
+    assert verify_width_ladder(4) == (2, 4, 5)
+    assert verify_width_ladder(1) == (2,)
+    assert verify_width(0, 4) == 1
+    assert verify_width(1, 4) == 2
+    assert verify_width(3, 4) == 4
+    assert verify_width(4, 4) == 5  # clamped at k_max + 1
+    with pytest.raises(ValueError):
+        verify_width(-1, 4)
+
+
+def test_widths_cover_every_dispatched_k():
+    # the worker never dispatches a zero-draft cycle, so the ladder
+    # covers k >= 1 (width 1 would be a dead boot-time compile)
+    ladder = verify_width_ladder(7)
+    for k in range(1, 8):
+        assert verify_width(k, 7) in ladder
+        assert verify_width(k, 7) >= k + 1
+
+
+# -- spec request state --------------------------------------------------------
+
+def test_spec_state_commit_and_tokens_per_dispatch():
+    s = SpecRequestState([1, 2, 3], pending=4, k_max=4)
+    s.commit([5, 6, 7], drafted=4, accepted=2)
+    assert s.pending == 7
+    assert s.draft.context == [1, 2, 3, 4, 5, 6, 7]
+    s.note_plain([8])
+    assert s.pending == 8
+    assert s.tokens_per_dispatch == 2.0  # 4 tokens over 2 dispatches
+    assert s.drafted == 4 and s.accepted == 2
+
+
+# -- echo runner: bit-identity -------------------------------------------------
+
+PROMPTS = ([5, 6, 7, 8], [9], [3, 1, 4, 1, 5, 9, 2, 6], list(range(40)))
+LENS = (17, 6, 1, 33)
+
+
+def _outputs(dev):
+    return [
+        dev.generate(p, max_new_tokens=n) for p, n in zip(PROMPTS, LENS)
+    ]
+
+
+def test_spec_ngram_bit_identical_to_plain():
+    plain_dev, old = _device(SPEC_POOLED="off")
+    try:
+        want = _outputs(plain_dev)
+    finally:
+        plain_dev.close()
+        _restore(old)
+    spec_dev, old = _device(SPEC_POOLED="on", SPEC_K_MAX="4")
+    try:
+        assert _outputs(spec_dev) == want
+        stats = spec_dev.runner.spec_stats
+        assert stats["cycles"] > 0
+        assert stats["drafted"] >= stats["accepted"] > 0
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+@pytest.mark.parametrize("schedule", ["0", "3,1,0,2", "1", "0,0,4"])
+def test_spec_fake_schedule_bit_identical(schedule):
+    """Every accept/reject mix — full rollback included — emits exactly
+    the plain stream."""
+    plain_dev, old = _device(SPEC_POOLED="off")
+    try:
+        want = _outputs(plain_dev)
+    finally:
+        plain_dev.close()
+        _restore(old)
+    spec_dev, old = _device(SPEC_POOLED="on", SPEC_FAKE_ACCEPT=schedule)
+    try:
+        assert _outputs(spec_dev) == want
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+def test_spec_seeded_sampler_bit_identical():
+    """Seeded sampling rides the same spec cycles on echo (the runner
+    is sampler-agnostic) — output must still match the plain path."""
+    from gofr_tpu.ops.sampling import Sampler
+
+    plain_dev, old = _device(SPEC_POOLED="off")
+    try:
+        want = plain_dev.generate(
+            [5, 6, 7], max_new_tokens=12,
+            sampler=Sampler(temperature=0.7, seed=42),
+        )
+    finally:
+        plain_dev.close()
+        _restore(old)
+    spec_dev, old = _device(SPEC_POOLED="on")
+    try:
+        got = spec_dev.generate(
+            [5, 6, 7], max_new_tokens=12,
+            sampler=Sampler(temperature=0.7, seed=42),
+        )
+        assert got == want
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+def test_spec_respects_stop_tokens_mid_burst():
+    plain_dev, old = _device(SPEC_POOLED="off")
+    try:
+        full = plain_dev.generate([5, 6, 7, 8], max_new_tokens=12)
+        stop_tok = full[6]
+        want = plain_dev.generate([5, 6, 7, 8], max_new_tokens=12,
+                                  stop_tokens=[stop_tok])
+    finally:
+        plain_dev.close()
+        _restore(old)
+    spec_dev, old = _device(SPEC_POOLED="on")
+    try:
+        got = spec_dev.generate([5, 6, 7, 8], max_new_tokens=12,
+                                stop_tokens=[stop_tok])
+        assert got == want == full[: full.index(stop_tok)]
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+def test_spec_cancellation_stops_emission():
+    spec_dev, old = _device(SPEC_POOLED="on")
+    try:
+        stop = threading.Event()
+        seen = []
+
+        def on_token(t):
+            seen.append(t)
+            if len(seen) >= 3:
+                stop.set()
+
+        out = spec_dev.generate([1, 2, 3, 4], max_new_tokens=64,
+                                on_token=on_token, stop=stop)
+        assert 3 <= len(out) < 64
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+# -- journal resume ------------------------------------------------------------
+
+def test_spec_resume_from_matches_uninterrupted_tail():
+    spec_dev, old = _device(SPEC_POOLED="on")
+    try:
+        full = spec_dev.generate([4, 5, 6], max_new_tokens=15)
+        tail = spec_dev.generate([4, 5, 6], max_new_tokens=15,
+                                 resume_from=7)
+        assert tail == full[7:]
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+def test_spec_resume_under_fake_full_reject():
+    spec_dev, old = _device(SPEC_POOLED="on", SPEC_FAKE_ACCEPT="0")
+    try:
+        full = spec_dev.generate([4, 5, 6], max_new_tokens=10)
+        assert spec_dev.generate(
+            [4, 5, 6], max_new_tokens=10, resume_from=4
+        ) == full[4:]
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+# -- paged-KV rollback ---------------------------------------------------------
+
+def test_spec_rollback_releases_all_blocks_at_finish():
+    """Full-reject schedule + tiny blocks: every cycle writes drafts
+    into the paged KV and rolls them back; at finish the pool must
+    balance — nothing active, no refcount drift (the leak invariant
+    extended to the rollback path)."""
+    spec_dev, old = _device(SPEC_POOLED="on", SPEC_FAKE_ACCEPT="0,2,1",
+                            KV_BLOCKS="64", KV_BLOCK_TOKENS="4")
+    try:
+        pool = spec_dev.runner.kv_pool
+        out = spec_dev.generate([5, 6, 7, 8], max_new_tokens=21)
+        assert len(out) == 21
+        st = pool.stats()
+        assert st["active"] == 0  # only cache entries hold blocks
+        assert st["free"] + st["cached"] == st["total"]
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+def test_spec_rollback_abort_returns_to_baseline():
+    spec_dev, old = _device(SPEC_POOLED="on", KV_BLOCKS="64",
+                            KV_BLOCK_TOKENS="4", PREFIX_CACHE="0")
+    try:
+        pool = spec_dev.runner.kv_pool
+        spec_dev.runner.paged.pool.cache_clear()
+        baseline = pool.stats()["free"]
+        stop = threading.Event()
+
+        def on_token(t, _n=[0]):
+            _n[0] += 1
+            if _n[0] >= 5:
+                stop.set()
+
+        spec_dev.generate([1, 2, 3, 4, 5], max_new_tokens=64,
+                          on_token=on_token, stop=stop)
+        # cancelled: the aborted sequence releases EVERYTHING it held
+        # beyond the prompt's cache entry — speculative writes included
+        # (the admission path cached the prompt itself; live refs = 0)
+        st = pool.stats()
+        assert st["active"] == 0
+        assert st["free"] + st["cached"] == st["total"]
+        assert st["free"] >= baseline - st["cached"]
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+def test_spec_rollback_exercises_cow_on_shared_boundary():
+    """A cached conversation shares blocks with the next admission;
+    speculative appends must COW the shared boundary before writing
+    drafts — and a full reject must leave the donor entry intact."""
+    spec_dev, old = _device(SPEC_POOLED="on", SPEC_FAKE_ACCEPT="0",
+                            KV_BLOCKS="64", KV_BLOCK_TOKENS="8")
+    try:
+        pool = spec_dev.runner.kv_pool
+        first = spec_dev.generate([5, 6, 7], max_new_tokens=6)
+        cows = pool.stats()["cow_copies"]
+        second = spec_dev.generate([5, 6, 7], max_new_tokens=6)
+        assert second == first  # exact repeat, through aliased blocks
+        assert pool.stats()["cow_copies"] > cows
+        assert pool.stats()["active"] == 0
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+def test_hostpagedkv_rollback_contract():
+    import numpy as np
+
+    from gofr_tpu.tpu.kv_blocks import (
+        BlockPool,
+        HostPagedKV,
+        HostTokenArena,
+    )
+
+    arena = HostTokenArena(32, 4)
+    pool = BlockPool(32, 4, arena=arena)
+    eng = HostPagedKV(pool, arena, lcp_min=4)
+    seq = eng.admit(np.arange(1, 7, dtype=np.int32), 8)
+    for t in (10, 11, 12):
+        eng.append(seq, t)
+    blocks_before = list(seq.table.blocks)
+    eng.rollback(seq, 7)  # reject 11, 12
+    # length rolled back, capacity kept (an admitted request must never
+    # re-allocate mid-decode)
+    assert seq.table.length == 7
+    assert seq.table.blocks == blocks_before
+    eng.append(seq, 13)
+    assert list(arena.read(seq.table)) == [1, 2, 3, 4, 5, 6, 10, 13]
+    with pytest.raises(ValueError):
+        eng.rollback(seq, 3)  # below the prompt
+    with pytest.raises(ValueError):
+        eng.rollback(seq, 99)  # past the length
+    eng.abort(seq)
+    assert pool.stats()["active"] == 0
+
+
+# -- observability -------------------------------------------------------------
+
+def test_spec_metrics_and_flight_record():
+    from gofr_tpu.telemetry import FlightRecord, activate_record
+
+    spec_dev, old = _device(SPEC_POOLED="on")
+    try:
+        record = FlightRecord("echo", "test")
+        activate_record(record)
+        try:
+            spec_dev.generate([5, 6, 7, 8], max_new_tokens=17)
+        finally:
+            activate_record(None)
+        assert record.spec_dispatches > 0
+        assert record.spec_drafted >= record.spec_accepted > 0
+        assert record.tokens_per_dispatch > 1.0
+        d = record.to_dict()
+        assert d["spec_drafted"] == record.spec_drafted
+        assert d["tokens_per_dispatch"] == record.tokens_per_dispatch
+        text = spec_dev.metrics.expose()
+        assert 'gofr_tpu_spec_accept_ratio{model="echo"}' in text
+        assert 'gofr_tpu_spec_tokens_per_dispatch{model="echo"}' in text
+        # the solo-path acceptance gauge reads the shared spec_stats too
+        assert 'gofr_tpu_spec_acceptance{model="echo"}' in text
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+def test_slo_reports_tokens_per_dispatch_percentiles():
+    from gofr_tpu.telemetry import FlightRecorder, activate_record
+
+    recorder = FlightRecorder()
+    for tpd_tokens in (2, 4, 6):
+        record = recorder.start("echo", "generate")
+        record.note_spec(4, tpd_tokens - 1, tpd_tokens)
+        recorder.finish(record)
+    activate_record(None)  # start() binds the contextvar — don't leak it
+    slo = recorder.slo(window_s=60.0)
+    tpd = slo["models"]["echo"]["tokens_per_dispatch"]
+    assert tpd["p50"] == 4.0
+    assert tpd["p99"] >= tpd["p50"] >= 2.0
+
+
+# -- brownout + deadline interaction ------------------------------------------
+
+def test_brownout_level_disables_speculation():
+    spec_dev, old = _device(SPEC_POOLED="on")
+    try:
+        cfg = spec_dev.runner.spec_pooled
+        cfg.brownout_level = lambda: 2  # force hard brownout
+        stats = spec_dev.runner.spec_stats
+        before = dict(stats)
+        out = spec_dev.generate([5, 6, 7, 8], max_new_tokens=9)
+        assert len(out) == 9
+        with spec_dev.runner._spec_lock:
+            drafted = stats["drafted"] - before["drafted"]
+            cycles = stats["cycles"] - before["cycles"]
+        assert drafted == 0  # level 2: plain decode, one token per cycle
+        assert cycles == 9
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+def test_spec_deadline_expires_mid_decode():
+    from gofr_tpu.errors import DeadlineExceeded
+
+    spec_dev, old = _device(SPEC_POOLED="on", ECHO_STEP_MS="20")
+    try:
+        token = activate_deadline(Deadline(0.12))
+        try:
+            with pytest.raises(DeadlineExceeded) as err:
+                spec_dev.generate([1, 2, 3], max_new_tokens=512)
+            assert err.value.stage in ("decode", "admission")
+        finally:
+            activate_deadline(None)
+            del token
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+def test_fake_schedule_never_reaches_the_real_pool():
+    """SPEC_FAKE_ACCEPT is echo scaffolding: the fake source drafts
+    against a known TRUE continuation, which the real pool does not
+    have — handed to the pool it would draft nothing forever while
+    still clamping pipeline depth. The device must strip it from the
+    pool's config (and a state without any source must draft nothing
+    rather than fall through to a half-armed one)."""
+    spec_dev, old = _device(SPEC_POOLED="on", SPEC_FAKE_ACCEPT="2,0")
+    try:
+        # the echo runner keeps the schedule...
+        assert spec_dev.runner.spec_pooled.fake_schedule == (2, 0)
+        # ...and the pool-facing build strips it
+        pool_cfg = spec_dev._build_spec_cfg(include_fake=False)
+        assert pool_cfg.fake_schedule is None
+        state = pool_cfg.new_state([1, 2, 3, 1, 2], 3)
+        assert state.propose(3) != []  # n-gram still drafts
+    finally:
+        spec_dev.close()
+        _restore(old)
+
+
+def test_state_without_a_draft_source_drafts_nothing():
+    s = SpecRequestState([1, 2, 3, 1, 2], pending=3, k_max=4,
+                         ngram=False)
+    assert s.propose(4) == []
+
+
+def test_spec_config_validation():
+    with pytest.raises(ValueError):
+        PoolSpecConfig(k_max=0)
+    # a typo must fail at construction (_device restores the env when
+    # the boot raises)
+    with pytest.raises(ValueError):
+        _device(SPEC_POOLED="on", SPEC_K_MAX="0")
+    # SPEC_POOLED without any draft source is a config error
+    with pytest.raises(ValueError):
+        _device(SPEC_POOLED="on", SPEC_NGRAM="off")

@@ -38,6 +38,13 @@ baseline:
   (default 10.0 — "within one chunk" is the contract; an order of
   magnitude past baseline means the abort hook stopped reaching the
   decode loop);
+- pooled speculative decoding must keep earning its dispatches:
+  pooled-spec decode tok/s must stay ``>= plain pooled decode *
+  BENCH_GATE_SPEC_FACTOR`` (default 1.5 — the ROADMAP's "cheaper
+  tokens" floor), ``tokens_per_dispatch`` must stay above the
+  ABSOLUTE 1.5 floor (a verify that stops carrying multiple tokens
+  has silently become plain decode, whatever the baseline said), and
+  the echo n-gram acceptance must stay above zero;
 - the disaggregated KV handoff must stay protocol-cheap: the
   cross-replica transfer path (pull + verify + install + aliased
   admission over real HTTP) must finish within
@@ -84,6 +91,7 @@ def gate(bench: dict, baseline: dict) -> list[str]:
     transfer_factor = float(
         os.environ.get("BENCH_GATE_TRANSFER_FACTOR", "10.0")
     )
+    spec_factor = float(os.environ.get("BENCH_GATE_SPEC_FACTOR", "1.5"))
 
     if bench.get("backend") != baseline.get("backend"):
         failures.append(
@@ -192,6 +200,35 @@ def gate(bench: dict, baseline: dict) -> list[str]:
                     f"abandoned-stream reclaim regression: {reclaim}ms > "
                     f"{base_reclaim}ms * {reclaim_factor} "
                     f"(= {base_reclaim * reclaim_factor:.1f}ms)"
+                )
+    spec = bench.get("spec_microbench") or {}
+    base_spec = baseline.get("spec_microbench") or {}
+    if base_spec:
+        speedup = _num(spec, "speedup")
+        tpd = _num(spec.get("spec") or {}, "tokens_per_dispatch")
+        if speedup is None or tpd is None:
+            failures.append("spec_microbench missing from the bench artifact")
+        else:
+            if speedup < spec_factor:
+                failures.append(
+                    f"pooled-spec speedup regression: {speedup}x < "
+                    f"{spec_factor}x over plain pooled decode (the whole "
+                    "point of speculation is cheaper tokens)"
+                )
+            # absolute floor, not baseline-relative: a verify dispatch
+            # that stops carrying multiple tokens has silently become
+            # plain decode whatever the baseline said
+            if tpd <= 1.5:
+                failures.append(
+                    f"pooled-spec tokens_per_dispatch collapsed: {tpd} "
+                    "<= 1.5 (speculation is no longer batching verifies)"
+                )
+            accept = _num(spec.get("spec") or {}, "accept_rate")
+            if accept is not None and accept <= 0.0:
+                failures.append(
+                    "pooled-spec acceptance hit zero — the draft source "
+                    "is proposing garbage (or the verify rejects "
+                    "everything)"
                 )
     transfer = bench.get("transfer_microbench") or {}
     base_transfer = baseline.get("transfer_microbench") or {}
