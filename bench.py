@@ -550,6 +550,16 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
             except Exception as exc:
                 errors.append(f"journal phase: {exc}")
                 traceback.print_exc(file=sys.stderr)
+            # -- phase: journal WAL persistence (crash durability) -------------
+            # the per-token price of the disk-backed journal (surviving
+            # kill -9 / power loss) vs the in-memory baseline; gated
+            # loose-first via BENCH_GATE_WAL_FACTOR
+            try:
+                result["journal_wal_microbench"] = _measure_journal_wal()
+                log(f"journal wal: {result['journal_wal_microbench']}")
+            except Exception as exc:
+                errors.append(f"journal-wal phase: {exc}")
+                traceback.print_exc(file=sys.stderr)
             # -- phase: recovery MTTR (self-healing tentpole) ------------------
             # wedge -> serving wall time on an in-process echo engine:
             # the trajectory records RESILIENCE, not just speed — the
@@ -931,6 +941,75 @@ def _measure_journal() -> dict:
         "per_token_us": round(overhead / (n_req * n_tok) * 1e6, 4),
         "per_request_us": round(overhead / n_req * 1e6, 2),
     }
+
+
+def _measure_journal_wal() -> dict:
+    """Journal persistence (journal_wal.py): the SAME loop as
+    ``_measure_journal`` with the disk-backed WAL armed, under each
+    fsync policy — the per-token price of surviving ``kill -9``
+    (``interrupt``: flush-only appends) and of surviving power loss
+    (``always``: fsync per record). ``wal_factor`` is WAL-on over
+    in-memory per-token cost; the gate holds ``per_token_us_wal``
+    against bench_baseline.json (``BENCH_GATE_WAL_FACTOR``)."""
+    import shutil
+    import tempfile
+
+    from gofr_tpu.journal_wal import JournalWAL
+    from gofr_tpu.telemetry import GenerationJournal, request_key
+
+    n_req = int(os.environ.get("BENCH_JOURNAL_REQUESTS", "200"))
+    n_tok = int(os.environ.get("BENCH_JOURNAL_TOKENS", "64"))
+    prompt = [(7 * i) % 251 + 1 for i in range(48)]
+
+    def run(wal) -> float:
+        journal = GenerationJournal(capacity=256, max_tokens=8192, wal=wal)
+        start = time.perf_counter()
+        for _ in range(n_req):
+            key = request_key("echo", prompt, n_tok, None)
+            entry = journal.start(key, "echo", n_tok, seeded=False,
+                                  deterministic=True)
+            for token in range(n_tok):
+                entry.append(token)
+            journal.finish(entry)
+        return time.perf_counter() - start
+
+    mem_s = run(None)
+    out: dict = {
+        "requests": n_req,
+        "tokens_per_request": n_tok,
+        "per_token_us_mem": round(mem_s / (n_req * n_tok) * 1e6, 4),
+    }
+    for policy, key in (("interrupt", "per_token_us_wal"),
+                        ("always", "per_token_us_wal_fsync")):
+        wal_dir = tempfile.mkdtemp(prefix=f"bench-wal-{policy}-")
+        wal = JournalWAL(wal_dir, segment_bytes=1 << 20, retain=2,
+                         fsync=policy)
+        try:
+            if policy == "always":
+                # fsync-per-record is measured at a reduced request
+                # count: the point is the per-token number, not minutes
+                # of fsync on a CI disk
+                nonlocal_req = max(10, n_req // 10)
+                journal = GenerationJournal(capacity=256, max_tokens=8192,
+                                            wal=wal)
+                start = time.perf_counter()
+                for _ in range(nonlocal_req):
+                    k = request_key("echo", prompt, n_tok, None)
+                    entry = journal.start(k, "echo", n_tok, seeded=False,
+                                          deterministic=True)
+                    for token in range(n_tok):
+                        entry.append(token)
+                    journal.finish(entry)
+                elapsed = time.perf_counter() - start
+                out[key] = round(elapsed / (nonlocal_req * n_tok) * 1e6, 4)
+            else:
+                out[key] = round(run(wal) / (n_req * n_tok) * 1e6, 4)
+        finally:
+            wal.close()
+            shutil.rmtree(wal_dir, ignore_errors=True)
+    mem_per_tok = max(out["per_token_us_mem"], 1e-6)
+    out["wal_factor"] = round(out["per_token_us_wal"] / mem_per_tok, 2)
+    return out
 
 
 def _measure_shed() -> dict:

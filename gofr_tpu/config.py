@@ -57,7 +57,12 @@ admission: ``FLEET_QUOTA_RPS`` (0 = off), ``FLEET_QUOTA_BURST``,
 ``FLEET_SATURATION_QUEUE`` (64), ``FLEET_RETRY_AFTER_S`` (1); drain:
 ``FLEET_DRAIN_TIMEOUT_S`` (10); resumable streams: ``FLEET_RESUME``
 (on — mid-stream failover for deterministic SSE), ``FLEET_MAX_RESUMES``
-(4 continuation attempts per stream).
+(4 continuation attempts per stream); HA: ``FLEET_ROUTER_ID`` (defaults
+to a per-process id) labels one of N side-by-side router instances —
+the router tier has no single point of failure: quota is redis-backed
+(shared), affinity/KV-locality is stateless rendezvous hashing, and
+the in-flight cap, route records, breaker and prober verdicts are
+explicitly PER-INSTANCE (N routers = N x ``FLEET_MAX_INFLIGHT``).
 
 Self-healing keys (tpu/recovery.py + telemetry.py, see
 docs/advanced-guide/fleet.md "Wedge-recovery runbook"):
@@ -70,6 +75,26 @@ durable generation journal: prompt hash + sampling params + emitted
 token ids per request, the substrate of bit-identical stream resume),
 ``JOURNAL_CAPACITY`` (256 interrupted entries retained),
 ``JOURNAL_MAX_TOKENS`` (8192 tokens recorded per entry).
+
+Crash-durability keys (journal_wal.py + tools/supervisor.py, see
+docs/advanced-guide/fleet.md "Process-death recovery"):
+``JOURNAL_DIR`` (unset = in-memory journal only) arms the disk-backed
+segmented WAL behind the generation journal — a SIGKILLed replica
+rehydrates its resumable entries at next boot and serves
+``X-Resume-From`` for its own pre-crash streams bit-identically;
+``JOURNAL_FSYNC`` (``interrupt`` — flush every record to the OS, which
+survives process death, and fsync on interruption/rotation/close;
+``always`` fsyncs per record for the power-loss threat model at a
+measured per-token cost — see the bench's journal_wal_microbench;
+``off`` never fsyncs); ``JOURNAL_SEGMENT_BYTES`` (1 MiB) rotates
+segments — live entries carry across via rotation checkpoints — and
+``JOURNAL_SEGMENTS`` (4) bounds retention. Recovery refuses torn and
+corrupt tail records (CRC-framed, kvwire discipline) rather than
+installing them. Run the replica under ``tools/supervisor.py`` (or an
+equivalent init) so a crashed process respawns; the fleet prober
+detects the reborn process by its changed ready ``boot_id`` and walks
+it back through probation as ``restarting`` (visible on
+``/admin/fleet`` and ``gofr_tpu_router_replica_restarts_total``).
 
 Deadline-aware-serving keys (gofr_tpu/deadline.py, see
 docs/advanced-guide/fleet.md "Deadlines & brownout"):

@@ -100,6 +100,12 @@ class MiniMySQL:
         self.port = self._sock.getsockname()[1]
         self._uri = f"file:minimysql_{id(self)}?mode=memory&cache=shared"
         self._anchor = sqlite3.connect(self._uri, uri=True)  # keeps db alive
+        # statement serialization: shared-cache sqlite raises
+        # SQLITE_LOCKED on concurrent writers where a real MySQL blocks
+        # on row locks — the fake must present MySQL's serializing
+        # behavior, not sqlite's. Held around execute+fetch only; the
+        # socket writes stay outside (GFL004).
+        self._db_lock = threading.Lock()
         self._closed = False
         self._threads: list[threading.Thread] = []
         self._accept_thread = threading.Thread(
@@ -266,35 +272,26 @@ class MiniMySQL:
             # cache hit: fast_auth_success, then the caller's OK
             return True, self._send(conn, seq, b"\x01\x03")
         # cache miss: demand the non-TLS RSA public-key exchange (ignores
-        # the scramble token, exactly like a real server on a cold cache)
-        from cryptography.hazmat.primitives import hashes, serialization
-        from cryptography.hazmat.primitives.asymmetric import padding, rsa
+        # the scramble token, exactly like a real server on a cold cache).
+        # Stdlib RSA (datasource/_rsa.py): the fake must run in containers
+        # without the `cryptography` package, and the CLIENT under test
+        # exercises its own preferred implementation either way.
+        from gofr_tpu.datasource import _rsa
 
         if self._rsa_key is None:
-            self._rsa_key = rsa.generate_private_key(
-                public_exponent=65537, key_size=2048
-            )
+            self._rsa_key = _rsa.generate_key(1024)
         seq = self._send(conn, seq, b"\x01\x04")  # perform_full_authentication
         pkt = self._read_packet(conn)
         if pkt is None or pkt[1] != b"\x02":  # client asks for the RSA key
             return False, seq if pkt is None else pkt[0] + 1
-        pem = self._rsa_key.public_key().public_bytes(
-            serialization.Encoding.PEM,
-            serialization.PublicFormat.SubjectPublicKeyInfo,
-        )
+        pem = self._rsa_key.public_pem()
         seq = self._send(conn, pkt[0] + 1, b"\x01" + pem)
         pkt = self._read_packet(conn)
         if pkt is None:
             return False, seq
         seq = pkt[0] + 1
         try:
-            plain = self._rsa_key.decrypt(
-                pkt[1],
-                padding.OAEP(
-                    mgf=padding.MGF1(hashes.SHA1()),
-                    algorithm=hashes.SHA1(), label=None,
-                ),
-            )
+            plain = self._rsa_key.decrypt_oaep_sha1(pkt[1])
         except Exception:
             return False, seq
         return xor_rotating(plain, scramble) == self.password.encode() + b"\x00", seq
@@ -317,14 +314,15 @@ class MiniMySQL:
                 continue
             sql = _mysql_to_sqlite(payload[1:].decode("utf-8", "replace"))
             try:
-                cur = db.execute(sql)
-                rows = cur.fetchall()
-                columns = [d[0] for d in cur.description] if cur.description else []
+                with self._db_lock:
+                    cur = db.execute(sql)
+                    rows = cur.fetchall()
+                    columns = [d[0] for d in cur.description] if cur.description else []
+                    affected = cur.rowcount if cur.rowcount >= 0 else 0
             except sqlite3.Error as exc:
                 self._send(conn, seq, self._err(1064, str(exc)))
                 continue
             if not columns:  # DML/DDL -> OK with affected rows
-                affected = cur.rowcount if cur.rowcount >= 0 else 0
                 self._send(conn, seq, self._ok(affected))
                 continue
             seq = self._send(conn, seq, encode_lenenc_int(len(columns)))

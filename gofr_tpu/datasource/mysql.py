@@ -87,12 +87,20 @@ def xor_rotating(data: bytes, key: bytes) -> bytes:
 
 def rsa_encrypt_password(password: str, scramble: bytes, pem: bytes) -> bytes:
     """Non-TLS full auth: RSA-OAEP(SHA1)-encrypt the nonce-whitened
-    NUL-terminated password with the server's public key."""
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import padding as _pad
+    NUL-terminated password with the server's public key. Prefers the
+    audited ``cryptography`` implementation; containers without it
+    (the serving image ships no OpenSSL bindings) fall back to the
+    stdlib OAEP in ``datasource/_rsa.py`` — same bytes on the wire."""
+    plain = xor_rotating(password.encode() + b"\x00", scramble)
+    try:
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import padding as _pad
+    except ImportError:
+        from gofr_tpu.datasource import _rsa
+
+        return _rsa.oaep_encrypt(_rsa.load_public_key(pem), plain)
 
     key = serialization.load_pem_public_key(pem)
-    plain = xor_rotating(password.encode() + b"\x00", scramble)
     return key.encrypt(
         plain,
         _pad.OAEP(mgf=_pad.MGF1(hashes.SHA1()), algorithm=hashes.SHA1(), label=None),

@@ -492,6 +492,152 @@ def _generate_handler(ctx: Any) -> Any:
     return {"tokens": out, "count": len(out)}
 
 
+class SubprocessReplica:
+    """A replica in its OWN OS process — the only honest substrate for
+    the ``kill -9`` fault. Runs ``gofr_tpu.devtools.replica_proc``
+    under a :class:`~gofr_tpu.devtools.supervise.Supervisor` (so the
+    kill is followed by a respawn on the SAME port, rehydrating the
+    journal WAL when ``JOURNAL_DIR`` is set) and presents the same
+    ``name``/``address`` surface as :class:`ChaosReplica` so
+    ``chaos_router`` fronts both kinds interchangeably."""
+
+    def __init__(self, name: str, env: Optional[dict[str, str]] = None,
+                 port: Optional[int] = None, supervise: bool = True,
+                 backoff_s: float = 0.2, backoff_max_s: float = 1.0,
+                 max_restarts_in_window: int = 10):
+        import sys
+
+        from gofr_tpu.config import environ_snapshot
+        from gofr_tpu.devtools.supervise import Supervisor
+
+        self.name = name
+        self.port = port or _free_port()
+        child_env = environ_snapshot()
+        # the child must import gofr_tpu whatever the caller's cwd is
+        # (tests chdir into tmp dirs): prepend the package's parent to
+        # PYTHONPATH explicitly instead of relying on an installed copy
+        import gofr_tpu as _pkg
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(_pkg.__file__)
+        ))
+        existing = child_env.get("PYTHONPATH", "")
+        child_env["PYTHONPATH"] = (
+            repo_root + (os.pathsep + existing if existing else "")
+        )
+        child_env.update({
+            "HTTP_PORT": str(self.port),
+            "GRPC_PORT": str(_free_port()),
+            "MODEL_NAME": "echo",
+            "LOG_LEVEL": "FATAL",
+            "BATCH_MAX_SIZE": "4",
+            "BATCH_TIMEOUT_MS": "1",
+            "WATCHDOG_DISPATCH_TIMEOUT_S": "0.2",
+            "RECOVERY_BACKOFF_S": "0.1",
+            "TIMEBASE_ENABLED": "off",
+            "KV_TRANSFER_TRUST_HINT": "on",
+        })
+        child_env.update(env or {})
+        argv = [sys.executable, "-m", "gofr_tpu.devtools.replica_proc"]
+        self.supervisor = Supervisor(
+            argv, env=child_env, backoff_s=backoff_s,
+            backoff_max_s=backoff_max_s,
+            max_restarts_in_window=max_restarts_in_window,
+        ) if supervise else None
+        self._argv, self._env = argv, child_env
+        self._bare_proc = None
+
+    @property
+    def address(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "SubprocessReplica":
+        import subprocess
+
+        if self.supervisor is not None:
+            self.supervisor.start()
+        else:
+            self._bare_proc = subprocess.Popen(
+                self._argv, env=self._env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        return self
+
+    @property
+    def pid(self) -> Optional[int]:
+        if self.supervisor is not None:
+            return self.supervisor.pid
+        return self._bare_proc.pid if self._bare_proc is not None else None
+
+    def wait_ready(self, timeout_s: float = 30.0) -> None:
+        """Block until the child's readiness answers 200 (cold boot or
+        post-kill respawn)."""
+        import time
+        import urllib.request
+
+        deadline = time.monotonic() + timeout_s
+        last: Optional[str] = None
+        while time.monotonic() < deadline:
+            try:
+                req = urllib.request.Request(
+                    self.address + "/.well-known/ready"
+                )
+                with urllib.request.urlopen(req, timeout=2) as resp:
+                    if resp.status == 200:
+                        return
+                    last = f"ready {resp.status}"
+            except Exception as exc:
+                last = f"{type(exc).__name__}: {exc}"
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"subprocess replica {self.name} never became ready: {last}"
+        )
+
+    def kill9(self) -> Optional[int]:
+        """SIGKILL the child process (the process-death fault). With a
+        supervisor, a fresh process respawns on the same port after the
+        backoff; without one, the address stays dead."""
+        if self.supervisor is not None:
+            return self.supervisor.kill9()
+        import os as _os
+        import signal as _signal
+
+        if self._bare_proc is not None and self._bare_proc.poll() is None:
+            pid = self._bare_proc.pid
+            _os.kill(pid, _signal.SIGKILL)
+            return pid
+        return None
+
+    def close(self) -> None:
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        elif self._bare_proc is not None:
+            try:
+                self._bare_proc.terminate()
+                self._bare_proc.wait(timeout=5)
+            except Exception:
+                try:
+                    self._bare_proc.kill()
+                    self._bare_proc.wait(timeout=5)
+                except Exception:
+                    pass
+
+
+@contextlib.contextmanager
+def subprocess_replica(name: str = "sp0",
+                       env: Optional[dict[str, str]] = None,
+                       supervise: bool = True,
+                       **kw: Any) -> Iterator[SubprocessReplica]:
+    """One started-and-ready subprocess replica, torn down on exit."""
+    replica = SubprocessReplica(name, env=env, supervise=supervise, **kw)
+    replica.start()
+    try:
+        replica.wait_ready()
+        yield replica
+    finally:
+        replica.close()
+
+
 @contextlib.contextmanager
 def chaos_fleet(n: int = 3, env: Optional[dict[str, str]] = None,
                 per_replica_env: Optional[list[dict[str, str]]] = None,

@@ -216,13 +216,22 @@ def _trace_event(
 
 def build_scenario(
     seed: int, n_replicas: int, n_prefill: int, duration_s: float,
+    process_kill: bool = False, n_routers: int = 1,
 ) -> tuple[list[dict[str, Any]], str]:
     """The deterministic fault schedule: explicit paired events (every
     arm has its clear, every wedge its recover) so the digest captures
     the WHOLE incident timeline. The wedge/disconnect victim is AIMED
     at the hottest session's home replica, the rest draw from the
     seed; faults overlap by construction (wedge recovery overlaps the
-    drain window, the redis outage overlaps both)."""
+    drain window, the redis outage overlaps both).
+
+    ``process_kill=True`` layers REAL process death on top of the
+    default schedule: two SIGKILLs of the fleet's subprocess-mode
+    replica (its supervisor respawns it; the journal WAL rehydrates),
+    and — when ``n_routers >= 2`` — a hard router-listener kill with a
+    late restart, so a whole router-tier instance dies mid-trace and
+    clients prove the fleet has no single point of failure by failing
+    over to a sibling router."""
     from gofr_tpu.fleet.replica import affinity_order
 
     rng = random.Random(f"fleetsim-scenario|{seed}")
@@ -276,6 +285,18 @@ def build_scenario(
             "at_s": round(0.58 * t, 3), "op": "kv_corrupt",
             "replica": donor, "mode": "flip", "n": 2,
         })
+    if process_kill:
+        # process death layered on the default chaos: the kill at 0.35t
+        # lands inside the peak phase and the second inside the burst,
+        # so the SIGKILLed replica's respawn + WAL rehydration happen
+        # under live traffic both times
+        events.append({"at_s": round(0.35 * t, 3), "op": "process_kill"})
+        events.append({"at_s": round(0.68 * t, 3), "op": "process_kill"})
+        if n_routers >= 2:
+            events.append({"at_s": round(0.45 * t, 3), "op": "router_kill",
+                           "router": 0})
+            events.append({"at_s": round(0.80 * t, 3),
+                           "op": "router_restart", "router": 0})
     events.sort(key=lambda e: e["at_s"])
     return events, _digest(events)
 
@@ -384,7 +405,14 @@ class FleetSim:
         echo_step_ms: int = 2,
         measure_hardening: bool = True,
         progress: Any = None,
+        n_routers: int = 1,
+        scenario: str = "default",
     ):
+        if scenario not in ("default", "process_kill"):
+            raise ValueError(
+                f"fleetsim scenario '{scenario}' not one of "
+                "default | process_kill"
+            )
         self.n_replicas = n_replicas
         self.n_prefill = min(n_prefill, max(0, n_replicas - 2))
         self.seed = seed
@@ -396,6 +424,15 @@ class FleetSim:
         self.echo_step_ms = echo_step_ms
         self.measure_hardening = measure_hardening
         self._progress = progress or (lambda msg: None)
+        # router HA: N router instances front the same fleet; the load
+        # workers spread across them and FAIL OVER on connection-level
+        # errors — a dead router must cost a retry, not a request
+        self.n_routers = max(1, n_routers)
+        # "process_kill" adds a subprocess-mode replica (real OS
+        # process under a Supervisor, journal WAL armed) and layers
+        # SIGKILL + router-death events onto the default schedule
+        self.scenario = scenario
+        self._sp: Optional[Any] = None
         self._results: list[dict[str, Any]] = []
         self._results_lock = threading.Lock()
         self._chaos_log: list[dict[str, Any]] = []
@@ -403,12 +440,21 @@ class FleetSim:
 
     # -- the run ---------------------------------------------------------------
     def run(self) -> dict[str, Any]:
-        from gofr_tpu.devtools.chaos import chaos_fleet, chaos_router
+        import contextlib as _contextlib
+        import tempfile
+
+        from gofr_tpu.devtools.chaos import (
+            SubprocessReplica,
+            chaos_fleet,
+            chaos_router,
+        )
 
         trace, trace_digest = build_trace(self.spec)
         duration_s = trace[-1]["at_s"] if trace else 0.0
         scenario, scenario_digest = build_scenario(
-            self.seed, self.n_replicas, self.n_prefill, duration_s
+            self.seed, self.n_replicas, self.n_prefill, duration_s,
+            process_kill=self.scenario == "process_kill",
+            n_routers=self.n_routers,
         )
         roles = [
             {"FLEET_ROLE": "prefill"} if i < self.n_prefill
@@ -436,26 +482,63 @@ class FleetSim:
             env={"ECHO_STEP_MS": str(self.echo_step_ms),
                  "KV_TRANSFER_TIMEOUT_S": "1"},
             per_replica_env=roles,
-        ) as replicas, chaos_router(
-            replicas, env=self._router_env()
-        ) as app:
-            fleet = app.container.fleet
-            fleet.quota._redis = self.redis  # outage-able, trip-counted
-            base = f"http://127.0.0.1:{app.http_port}"
-            self._await(
-                lambda: len(fleet.replica_set.in_rotation())
-                == self.n_replicas,
-                timeout=30, message="all replicas in rotation",
-            )
+        ) as replicas, _contextlib.ExitStack() as stack:
+            members = list(replicas)
+            if self.scenario == "process_kill":
+                # the kill victim is a REAL OS process: supervised, WAL
+                # armed, advertised as one more decode replica
+                journal_dir = stack.enter_context(
+                    tempfile.TemporaryDirectory(prefix="fleetsim-wal-")
+                )
+                sp = SubprocessReplica(
+                    f"r{self.n_replicas}",
+                    env={
+                        "ECHO_STEP_MS": str(self.echo_step_ms),
+                        "JOURNAL_DIR": journal_dir,
+                        "FLEET_ROLE": "decode",
+                        "KV_TRANSFER_TIMEOUT_S": "1",
+                    },
+                    backoff_s=0.2, backoff_max_s=0.5,
+                )
+                sp.start()
+                stack.callback(sp.close)
+                sp.wait_ready(30)
+                self._sp = sp
+                members.append(sp)
+            routers = [
+                stack.enter_context(
+                    chaos_router(members, env=self._router_env(i))
+                )
+                for i in range(self.n_routers)
+            ]
+            self._routers = routers
+            for router_app in routers:
+                # one shared quota backend across ALL router instances:
+                # outage-able, trip-counted — the redis-backed half of
+                # the router-HA story
+                router_app.container.fleet.quota._redis = self.redis
+            fleet = routers[-1].container.fleet
+            bases = [
+                f"http://127.0.0.1:{router_app.http_port}"
+                for router_app in routers
+            ]
+            for router_app in routers:
+                self._await(
+                    lambda: len(
+                        router_app.container.fleet.replica_set.in_rotation()
+                    ) == len(members),
+                    timeout=30, message="all replicas in rotation",
+                )
             self._warm_donors(replicas, trace)
             self._progress("fleetsim: driving load + chaos")
-            self._drive(base, trace, scenario, replicas)
+            self._drive(bases, trace, scenario, replicas, routers)
             self._progress("fleetsim: waiting for fleet convergence")
-            converged = self._converge(fleet, replicas)
+            converged = self._converge(fleet, members)
             artifact = self._collect(
-                base, fleet, replicas, trace, trace_digest, scenario,
+                bases, routers, members, trace, trace_digest, scenario,
                 scenario_digest, duration_s, converged,
             )
+        self._sp = None
         if self.measure_hardening:
             self._progress("fleetsim: measuring hardening before/after")
             artifact["hardening"] = hardening_report()
@@ -464,8 +547,9 @@ class FleetSim:
             )
         return artifact
 
-    def _router_env(self) -> dict[str, str]:
+    def _router_env(self, index: int = 0) -> dict[str, str]:
         return {
+            "FLEET_ROUTER_ID": f"router-{index}",
             # 0.25s keeps eviction sub-second (OUT_AFTER=2) while the
             # probe plane stays ~128 req/s at N=16 — at 0.1s the probe
             # fan-out alone starved the data plane on the 2-core CI box
@@ -521,8 +605,9 @@ class FleetSim:
                 pass  # warm-up is best-effort; cold donors just fall back
 
     # -- load + chaos drivers --------------------------------------------------
-    def _drive(self, base: str, trace: list[dict], scenario: list[dict],
-               replicas: list) -> None:
+    def _drive(self, bases: list[str], trace: list[dict],
+               scenario: list[dict], replicas: list,
+               routers: list) -> None:
         start = time.monotonic()
         cursor = {"i": 0}
         cursor_lock = threading.Lock()
@@ -539,7 +624,7 @@ class FleetSim:
                 delay = start + ev["at_s"] - time.monotonic()
                 if delay > 0:
                     time.sleep(delay)
-                result = self._do_request(base, ev)
+                result = self._do_request(bases, ev)
                 with self._results_lock:
                     self._results.append(result)
 
@@ -551,7 +636,7 @@ class FleetSim:
         ]
         chaos_thread = threading.Thread(
             target=self._run_scenario,
-            args=(start, scenario, replicas, len(trace),
+            args=(start, scenario, replicas, routers, len(trace),
                   trace[-1]["at_s"] if trace else 0.0),
             name="gofr-fleetsim-chaos", daemon=True,
         )
@@ -563,7 +648,7 @@ class FleetSim:
         chaos_thread.join(timeout=60)
 
     def _run_scenario(self, start: float, scenario: list[dict],
-                      replicas: list, n_trace: int,
+                      replicas: list, routers: list, n_trace: int,
                       duration_s: float) -> None:
         """Apply the fault schedule. Each event waits for its wall-clock
         mark AND for the load to have dispatched the matching FRACTION
@@ -582,8 +667,11 @@ class FleetSim:
             want_i = int(n_trace * ev["at_s"] / max(duration_s, 0.001))
             self._await_dispatched(min(want_i, n_trace))
             try:
-                self._apply_chaos(ev, replicas)
-                self._chaos_log.append(dict(ev, applied=True))
+                note = self._apply_chaos(ev, replicas, routers)
+                entry = dict(ev, applied=True)
+                if note:
+                    entry.update(note)
+                self._chaos_log.append(entry)
             except Exception as exc:
                 self._chaos_log.append(dict(ev, applied=False, error=str(exc)))
         # terminal safety: whatever the schedule left armed comes off
@@ -591,6 +679,8 @@ class FleetSim:
             r.chaos.clear()
             r.recover()
             r.start_listener()
+        for router_app in routers:
+            self._restart_router(router_app)
         with self.redis._lock:
             self.redis.down = False
 
@@ -605,8 +695,34 @@ class FleetSim:
                     return
             time.sleep(0.02)
 
-    def _apply_chaos(self, ev: dict, replicas: list) -> None:
+    @staticmethod
+    def _restart_router(router_app: Any) -> None:
+        from gofr_tpu.http.server import HTTPServer
+
+        if router_app.http_server is None:
+            router_app.http_server = HTTPServer(
+                router_app.router, router_app.http_port, router_app.logger
+            )
+            router_app.http_server.run_in_thread()
+
+    def _apply_chaos(self, ev: dict, replicas: list,
+                     routers: list) -> Optional[dict]:
         op = ev["op"]
+        if op == "process_kill":
+            # SIGKILL the subprocess victim: its supervisor respawns it
+            # (None = the previous kill's respawn has not finished yet;
+            # the event still counts as applied, the log records it)
+            pid = self._sp.kill9() if self._sp is not None else None
+            return {"pid": pid}
+        if op == "router_kill":
+            router_app = routers[ev["router"]]
+            if router_app.http_server is not None:
+                router_app.http_server.shutdown()
+                router_app.http_server = None
+            return None
+        if op == "router_restart":
+            self._restart_router(routers[ev["router"]])
+            return None
         target = replicas[ev["replica"]] if "replica" in ev else None
         if op == "error_burst":
             target.chaos.error_burst(ev["n"], status=ev["status"])
@@ -656,25 +772,42 @@ class FleetSim:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, resp.read()
 
-    def _do_request(self, base: str, ev: dict) -> dict[str, Any]:
+    def _do_request(self, bases: list[str], ev: dict) -> dict[str, Any]:
+        """One trace event against the router tier. The worker spreads
+        requests across the N router instances and FAILS OVER on a
+        connection-level error (refused, reset, a stream severed by a
+        dying router): no-single-point-of-failure means a dead router
+        costs the client one retry against a sibling — deterministic
+        requests replay bit-identically, so a from-scratch retry is
+        sound. App-level verdicts (HTTP status) never fail over: a 429
+        from router A would be a 429 from router B too (shared quota)."""
         out: dict[str, Any] = {
             "i": ev["i"], "kind": ev["kind"], "priority": ev["priority"],
             "tenant": ev["tenant"], "phase": ev["phase"],
             "outcome": "error", "status": 0, "ttft_ms": None,
+            "router_failovers": 0,
         }
         t0 = time.monotonic()
-        try:
-            if ev["kind"] == "abort_stream":
-                self._do_abort_stream(base, ev, out)
-            elif ev["kind"] == "stream":
-                self._do_stream(base, ev, out, t0)
-            else:
-                self._do_unary(base, ev, out, t0)
-        except urllib.error.HTTPError as exc:
-            self._note_http_error(exc, out)
-        except Exception as exc:
-            out["outcome"] = "error"
-            out["error"] = f"{type(exc).__name__}: {exc}"
+        first = ev["i"] % len(bases)
+        order = bases[first:] + bases[:first]
+        for attempt, base in enumerate(order):
+            try:
+                if ev["kind"] == "abort_stream":
+                    self._do_abort_stream(base, ev, out)
+                elif ev["kind"] == "stream":
+                    self._do_stream(base, ev, out, t0)
+                else:
+                    self._do_unary(base, ev, out, t0)
+                break
+            except urllib.error.HTTPError as exc:
+                self._note_http_error(exc, out)
+                break
+            except Exception as exc:
+                if attempt + 1 < len(order):
+                    out["router_failovers"] += 1
+                    continue
+                out["outcome"] = "error"
+                out["error"] = f"{type(exc).__name__}: {exc}"
         out["elapsed_ms"] = round((time.monotonic() - t0) * 1000, 2)
         return out
 
@@ -771,13 +904,13 @@ class FleetSim:
         out["frames_before_abort"] = len(frames)
 
     # -- convergence + collection ----------------------------------------------
-    def _converge(self, fleet: Any, replicas: list) -> dict[str, Any]:
+    def _converge(self, fleet: Any, members: list) -> dict[str, Any]:
         rotation_ok = self._await(
-            lambda: len(fleet.replica_set.in_rotation()) == self.n_replicas,
+            lambda: len(fleet.replica_set.in_rotation()) == len(members),
             timeout=30, message="rotation recovered",
         )
         pools_ok = self._await(
-            lambda: all(self._pool_idle(r) for r in replicas),
+            lambda: all(self._pool_idle(r) for r in members),
             timeout=30, message="pools idle",
         )
         return {"rotation": rotation_ok, "pools_idle": pools_ok}
@@ -796,25 +929,37 @@ class FleetSim:
         return int(kv.get("active") or 0) == 0
 
     def _collect(
-        self, base: str, fleet: Any, replicas: list, trace: list,
+        self, bases: list[str], routers: list, members: list, trace: list,
         trace_digest: str, scenario: list, scenario_digest: str,
         duration_s: float, converged: dict,
     ) -> dict[str, Any]:
         with self._results_lock:
             results = list(self._results)
-        try:
-            req = urllib.request.Request(base + "/metrics")
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                metrics_text = resp.read().decode("utf-8")
-        except Exception:
-            metrics_text = ""
-        quota_stats = fleet.quota.stats()
-        decisions = max(
-            1, quota_stats["admitted"] + quota_stats["denied"]
-        )
+        metrics_text = ""
+        for base in bases:
+            # summed across router instances: resume outcomes and
+            # breaker flaps are per-instance views of one fleet
+            try:
+                req = urllib.request.Request(base + "/metrics")
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    metrics_text += resp.read().decode("utf-8") + "\n"
+            except Exception:
+                continue
+        admitted = denied = 0
+        quota_stats: dict[str, Any] = {}
+        for router_app in routers:
+            stats = router_app.container.fleet.quota.stats()
+            admitted += stats["admitted"]
+            denied += stats["denied"]
+            quota_stats = stats  # representative knobs; counts summed below
+        quota_stats = dict(quota_stats, admitted=admitted, denied=denied)
+        decisions = max(1, admitted + denied)
         injected: dict[str, int] = {}
-        for r in replicas:
-            for mode, n in r.chaos.injected.items():
+        for r in members:
+            chaos = getattr(r, "chaos", None)
+            if chaos is None:
+                continue  # subprocess replicas carry no in-proc chaos
+            for mode, n in chaos.injected.items():
                 injected[mode] = injected.get(mode, 0) + n
         return {
             "kind": "FLEETSIM",
@@ -822,6 +967,9 @@ class FleetSim:
             "seed": self.seed,
             "replicas": self.n_replicas,
             "prefill_replicas": self.n_prefill,
+            "routers": self.n_routers,
+            "scenario_mode": self.scenario,
+            "process_kill": self._process_kill_block(),
             # the pooled-spec-enabled decode replica (-1 = none at this
             # topology): its streams ride the same token-exactness gate
             "spec_replica": (
@@ -847,6 +995,36 @@ class FleetSim:
                 ),
                 "stats": quota_stats,
             },
+        }
+
+    def _process_kill_block(self) -> Optional[dict[str, Any]]:
+        """The process-death evidence: kills applied, supervisor
+        respawns, and the victim's WAL rehydration count (scraped off
+        its /admin/engine journal block)."""
+        if self._sp is None:
+            return None
+        rehydrated = None
+        try:
+            req = urllib.request.Request(self._sp.address + "/admin/engine")
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                data = json.loads(resp.read().decode("utf-8"))["data"]
+            rehydrated = (data.get("journal") or {}).get("rehydrated")
+        except Exception:
+            pass
+        kills = [
+            e for e in self._chaos_log
+            if e.get("op") == "process_kill" and e.get("applied")
+        ]
+        router_kills = [
+            e for e in self._chaos_log
+            if e.get("op") == "router_kill" and e.get("applied")
+        ]
+        return {
+            "victim": self._sp.name,
+            "replica_kills": len([e for e in kills if e.get("pid")]),
+            "router_kills": len(router_kills),
+            "supervisor_restarts": self._sp.supervisor.restarts,
+            "victim_rehydrated": rehydrated,
         }
 
     def _slo(self, results: list[dict], metrics_text: str,
@@ -903,6 +1081,9 @@ class FleetSim:
             "resume": dict(resumes, failures=(
                 resumes["exhausted"] + resumes["refused"]
             )),
+            "router_failovers": sum(
+                r.get("router_failovers", 0) for r in results
+            ),
             "breaker_flaps": int(_parse_metric_total(
                 metrics_text, "gofr_tpu_router_breaker_transitions_total"
             )),

@@ -166,6 +166,10 @@ def wire_fleet(app: Any) -> FleetRouter:
         read_timeout_s=read_t,
         max_inflight=_i("FLEET_MAX_INFLIGHT", "256"),
         retry_after_s=_f("FLEET_RETRY_AFTER_S", "1"),
+        # N routers run side by side (router HA): the id labels THIS
+        # instance's /admin/fleet view; everything cross-instance is
+        # redis-backed or stateless (see FleetRouter.router_id)
+        router_id=config.get_or_default("FLEET_ROUTER_ID", ""),
     )
     if (config.get_or_default("FLEET_RESUME", "on") or "").lower() in (
         "off", "0", "false", "no"

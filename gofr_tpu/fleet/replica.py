@@ -95,6 +95,14 @@ class Replica:
         self.engine: Optional[dict[str, Any]] = None
         self.last_kv_rejects: Optional[int] = None  # prober-only state
         self.kv_starved = False  # KV-only component of `saturated`
+        # restart awareness: the last boot_id the ready probe reported.
+        # A CHANGED id means a new process answers at this address (the
+        # supervisor respawned it after a crash/SIGKILL) — a first-class
+        # `restarting` passage through probation: cold caches, empty
+        # pools, and (with JOURNAL_DIR) a WAL rehydration behind it.
+        self.boot_id: Optional[str] = None
+        self.restarts = 0
+        self.restarting = False
         # disaggregated serving: the role the replica ADVERTISES on
         # /admin/engine (FLEET_ROLE). "mixed" — the default, and what a
         # replica that advertises nothing gets — serves every tier, so
@@ -136,6 +144,9 @@ class Replica:
             "ok_streak": self.ok_streak,
             "fail_streak": self.fail_streak,
             "last_probe_error": self.last_probe_error or None,
+            "boot_id": self.boot_id,
+            "restarts": self.restarts,
+            "restarting": self.restarting,
             "breaker": self.breaker.snapshot(),
             "engine": self.engine,
         }
@@ -174,6 +185,9 @@ class ReplicaSet:
         self.saturation_queue = saturation_queue
         self.affinity_max_skew = max(0, affinity_max_skew)
         self._on_state_change = on_state_change
+        # fired when a probe detects a REBORN process (boot_id changed);
+        # the router counts it on gofr_tpu_router_replica_restarts_total
+        self._on_restart: Optional[Any] = None
         self._stop = threading.Event()
         # round-robin tie-break for equal-outstanding picks; a C-level
         # counter, not a locked int (see candidates())
@@ -345,10 +359,11 @@ class ReplicaSet:
         """One probe round for ``replica``: readiness decides rotation,
         the piggybacked engine scrape updates saturation. Returns the
         readiness verdict (also applied to the state machine)."""
-        ok, detail, recovering = self._ready_probe(replica)
+        ok, detail, recovering, boot_id = self._ready_probe(replica)
         replica.probes += 1
         replica.last_probe_error = "" if ok else detail
-        self._apply_probe(replica, ok, recovering=recovering)
+        self._apply_probe(replica, ok, recovering=recovering,
+                          boot_id=boot_id)
         if ok:
             self._scrape_engine(replica)
         else:
@@ -356,7 +371,9 @@ class ReplicaSet:
             replica.engine = None
         return ok
 
-    def _ready_probe(self, replica: Replica) -> tuple[bool, str, bool]:
+    def _ready_probe(
+        self, replica: Replica
+    ) -> tuple[bool, str, bool, Optional[str]]:
         if self.hedge_ms and self.hedge_ms > 0:
             return self._hedged_ready(replica)
         return self._ready_once(replica)
@@ -381,7 +398,22 @@ class ReplicaSet:
             "recovering", "waiting_backoff"
         )
 
-    def _ready_once(self, replica: Replica) -> tuple[bool, str, bool]:
+    @staticmethod
+    def _ready_boot_id(body: bytes) -> Optional[str]:
+        """The ready 200 body's process identity (None on replicas that
+        predate it — restart detection then simply stays off)."""
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not isinstance(payload, dict):
+            return None
+        boot_id = payload.get("boot_id")
+        return boot_id if isinstance(boot_id, str) and boot_id else None
+
+    def _ready_once(
+        self, replica: Replica
+    ) -> tuple[bool, str, bool, Optional[str]]:
         try:
             resp = replica.client.request(
                 "GET", "/.well-known/ready",
@@ -390,20 +422,24 @@ class ReplicaSet:
                 retries=0,
             )
         except Exception as exc:
-            return False, str(exc), False
+            return False, str(exc), False, None
         if resp.status_code == 200:
-            return True, "", False
+            return True, "", False, self._ready_boot_id(resp.body)
         detail = resp.body.decode("utf-8", "replace")[:200]
         return (
             False, f"ready {resp.status_code}: {detail}",
-            self._recovering_verdict(resp.body),
+            self._recovering_verdict(resp.body), None,
         )
 
-    def _hedged_ready(self, replica: Replica) -> tuple[bool, str, bool]:
+    def _hedged_ready(
+        self, replica: Replica
+    ) -> tuple[bool, str, bool, Optional[str]]:
         """Hedged readiness read: fire a second probe if the first is
         slower than ``hedge_ms``; first answer wins. The loser's reply
         is discarded (its connection closes with its thread)."""
-        results: "queue.Queue[tuple[bool, str, bool]]" = queue.Queue()
+        results: "queue.Queue[tuple[bool, str, bool, Optional[str]]]" = (
+            queue.Queue()
+        )
 
         def attempt() -> None:
             results.put(self._ready_once(replica))
@@ -423,7 +459,7 @@ class ReplicaSet:
         try:
             return results.get(timeout=self.probe_timeout_s * 2 + 1.0)
         except queue.Empty:
-            return False, "hedged probe timed out", False
+            return False, "hedged probe timed out", False, None
 
     def _scrape_engine(self, replica: Replica) -> None:
         """Saturation signals off ``GET /admin/engine``: paged-KV free
@@ -493,7 +529,8 @@ class ReplicaSet:
         replica.saturated = replica.kv_starved or queue_full
 
     def _apply_probe(self, replica: Replica, ok: bool,
-                     recovering: bool = False) -> None:
+                     recovering: bool = False,
+                     boot_id: Optional[str] = None) -> None:
         """The probation state machine. Runs on the prober thread only
         (plus tests), so plain attribute writes are safe.
 
@@ -501,9 +538,34 @@ class ReplicaSet:
         wedge-recovery incident — the replica is coming back, not
         hard-down. It parks in PROBATION (no traffic, but the router's
         stream-resume path may target it, and re-entry needs only the
-        usual ok-probe streak) instead of dropping to OUT."""
+        usual ok-probe streak) instead of dropping to OUT.
+
+        ``boot_id``: the ready 200 body's process identity. A CHANGED
+        id means a supervisor respawned the process (connection-refused
+        then reborn): a first-class ``restarting`` passage — even a
+        replica that never visibly failed a probe (killed and restarted
+        inside one probe interval) re-enters through the probation
+        window, because the NEW process has cold caches, empty pools,
+        and possibly a WAL rehydration behind its ready verdict. The
+        restart is counted (``on_restart`` hook → the router's
+        gofr_tpu_router_replica_restarts_total) and ``restarting``
+        stays visible on /admin/fleet until the replica walks back to
+        HEALTHY."""
         was = replica.state
         if ok:
+            reborn = (
+                boot_id is not None
+                and replica.boot_id is not None
+                and boot_id != replica.boot_id
+            )
+            if boot_id is not None:
+                replica.boot_id = boot_id
+            if reborn:
+                replica.restarts += 1
+                replica.restarting = True
+                replica.state = PROBATION
+                replica.ok_streak = 0
+                self._note_restart(replica)
             replica.ok_streak += 1
             replica.fail_streak = 0
             if replica.state == OUT:
@@ -512,6 +574,8 @@ class ReplicaSet:
             if (replica.state == PROBATION
                     and replica.ok_streak >= self.probation_probes):
                 replica.state = HEALTHY
+            if replica.state == HEALTHY:
+                replica.restarting = False
         else:
             replica.fail_streak += 1
             replica.ok_streak = 0
@@ -532,3 +596,11 @@ class ReplicaSet:
                 self._on_state_change(replica, was, replica.state)
             except Exception:  # gofrlint: disable=GFL006 — hook must not kill the prober
                 pass
+
+    def _note_restart(self, replica: Replica) -> None:
+        if self._on_restart is None:
+            return
+        try:
+            self._on_restart(replica)
+        except Exception:  # gofrlint: disable=GFL006 — hook must not kill the prober
+            pass

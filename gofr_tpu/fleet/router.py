@@ -159,6 +159,7 @@ class FleetRouter:
         max_inflight: int = 256,
         retry_after_s: float = 1.0,
         record_capacity: int = 256,
+        router_id: str = "",
     ):
         self.logger = logger
         self.metrics = metrics
@@ -170,6 +171,23 @@ class FleetRouter:
         self.read_timeout_s = read_timeout_s
         self.max_inflight = max_inflight
         self.retry_after_s = retry_after_s
+        # router HA (no single point of failure): N router processes run
+        # side by side over the same FLEET_REPLICAS. The router's state
+        # is shardable by construction — quota is redis-backed (shared,
+        # with per-instance short-TTL leases), affinity/KV-locality is
+        # stateless rendezvous hashing (every router picks the same
+        # replica), and the rest is EXPLICITLY per-instance: the
+        # in-flight cap bounds THIS process (N routers = N * cap), the
+        # route-record ring, breaker verdicts, and prober state are this
+        # instance's local view, and a stream relay lives and dies with
+        # the connection it serves (a router death mid-stream is the
+        # CLIENT's retry — deterministic streams replay bit-identically
+        # through any sibling). router_id (FLEET_ROUTER_ID, defaulting
+        # to the process boot id) labels /admin/fleet so operators can
+        # tell N instances apart.
+        from gofr_tpu.telemetry import BOOT_ID
+
+        self.router_id = router_id or f"router-{BOOT_ID}"
         # resumable streams: journal delivered SSE event ids and splice
         # a failover continuation into a broken deterministic stream
         # instead of truncating (FLEET_RESUME / FLEET_MAX_RESUMES —
@@ -243,6 +261,14 @@ class FleetRouter:
             "upstream attempt latency per replica (success or failure)",
             labels=("replica",),
         )
+        self._replica_restarts = m.counter(
+            "gofr_tpu_router_replica_restarts_total",
+            "replica processes observed REBORN by the prober (ready "
+            "boot_id changed): a supervisor respawned the process after "
+            "a crash/SIGKILL; the replica re-enters through probation "
+            "as `restarting`",
+            labels=("replica",),
+        )
         self._stream_resumes = m.counter(
             "gofr_tpu_router_stream_resumes_total",
             "mid-stream failover outcomes on resumable (deterministic) "
@@ -265,6 +291,7 @@ class FleetRouter:
             )
             replica.breaker._on_transition = self._breaker_hook(replica.name)
         self.replica_set._on_state_change = self._rotation_hook
+        self.replica_set._on_restart = self._restart_hook
 
     def _breaker_hook(self, name: str) -> Any:
         def hook(was: str, to: str) -> None:
@@ -282,6 +309,14 @@ class FleetRouter:
         self.logger.infof(
             "fleet replica %s: %s -> %s (%s)",
             replica.name, was, now, replica.last_probe_error or "ready",
+        )
+
+    def _restart_hook(self, replica: Any) -> None:
+        self._replica_restarts.inc(replica=replica.name)
+        self.logger.infof(
+            "fleet replica %s: process restarted (boot_id %s, restart #%s)"
+            " — restarting via probation",
+            replica.name, replica.boot_id, replica.restarts,
         )
 
     # -- lifecycle -------------------------------------------------------------
@@ -939,8 +974,11 @@ class FleetRouter:
         ]
 
     def snapshot(self) -> dict[str, Any]:
-        """``GET /admin/fleet``: the whole front door on one page."""
+        """``GET /admin/fleet``: the whole front door on one page. The
+        view is THIS router instance's (in-flight, records, breaker and
+        rotation verdicts are per-instance by design — see router_id)."""
         return {
+            "router_id": self.router_id,
             "draining": self._draining,
             "in_flight": self.in_flight,
             "max_inflight": self.max_inflight,

@@ -104,9 +104,11 @@ def ready_handler(ctx: Context) -> Response:
                 "detail": f"router draining, {fleet.in_flight} in flight",
             }).encode("utf-8"),
         )
+    from gofr_tpu.telemetry import BOOT_ID
+
     tpu = ctx.container.tpu
     if tpu is None:
-        status, state = 200, {"state": "ready"}
+        status, state = 200, {"state": "ready", "boot_id": BOOT_ID}
     elif not tpu.ready():
         status, state = 503, dict(tpu.boot_status)
         # a recovery rebuild clears readiness too: carry the incident
@@ -137,7 +139,10 @@ def ready_handler(ctx: Context) -> Response:
             # as coming back (probation) rather than hard-out
             _attach_recovery_evidence(tpu, state)
         else:
-            status, state = 200, {"state": "ready"}
+            # boot_id rides the READY verdict: the prober detects a
+            # supervisor-restarted process (new id, same address) and
+            # routes it through the restarting/probation path
+            status, state = 200, {"state": "ready", "boot_id": BOOT_ID}
     return Response(
         status=status,
         headers={"Content-Type": "application/json"},

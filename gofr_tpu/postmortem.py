@@ -114,7 +114,12 @@ class PostmortemStore:
         self.snapshots = max(1, snapshots)
         self.logger = logger
         self._lock = threading.Lock()
-        self._last_auto = 0.0
+        # None = no automatic bundle written yet. NOT 0.0: monotonic
+        # time starts near zero at HOST boot (Linux), so a zero anchor
+        # silently rate-limited every automatic bundle for the
+        # machine's first min_interval_s of uptime — exactly the
+        # early-boot wedges whose evidence matters most
+        self._last_auto: Optional[float] = None
 
     # -- triggers -------------------------------------------------------------
     def watch_engine(self, engine: Any) -> None:
@@ -209,13 +214,18 @@ class PostmortemStore:
         failing is itself logged, nothing more (the process is usually
         already in trouble here)."""
         now = time.monotonic()
-        prev = None
+        consumed = False
+        prev: Optional[float] = None
         if not force:
             with self._lock:
-                if now - self._last_auto < self.min_interval_s:
+                if (
+                    self._last_auto is not None
+                    and now - self._last_auto < self.min_interval_s
+                ):
                     return None
                 prev = self._last_auto
                 self._last_auto = now
+                consumed = True
         try:
             bundle = self.bundle(reason, detail)
             path = self._write_atomic(bundle)
@@ -226,7 +236,7 @@ class PostmortemStore:
                 )
             return path
         except Exception as exc:
-            if prev is not None:
+            if consumed:
                 with self._lock:
                     if self._last_auto == now:  # nobody else stamped since
                         self._last_auto = prev

@@ -44,9 +44,17 @@ from __future__ import annotations
 import contextvars
 import threading
 import time
+import uuid
 import weakref
 from collections import deque
 from typing import Any, Optional
+
+# process identity, regenerated on every interpreter start: the fleet
+# prober compares it across probes to tell "the same process recovered"
+# from "a NEW process answers at this address" — the supervisor-restart
+# signature a reborn replica walks probation under (fleet/replica.py).
+# Served on the ready 200 body and /admin/engine.
+BOOT_ID = uuid.uuid4().hex[:16]
 
 _current_record: contextvars.ContextVar[Optional["FlightRecord"]] = (
     contextvars.ContextVar("gofr_flight_record", default=None)
@@ -445,7 +453,7 @@ class JournalEntry:
     __slots__ = (
         "key", "model", "max_new_tokens", "seeded", "deterministic",
         "tokens", "status", "reason", "t_start", "t_interrupted",
-        "prior", "truncated", "max_tokens",
+        "prior", "truncated", "max_tokens", "wal_id", "_wal",
     )
 
     def __init__(self, key: str, model: str, max_new_tokens: int,
@@ -468,14 +476,25 @@ class JournalEntry:
         self.reason = ""
         self.t_start = time.perf_counter()
         self.t_interrupted: Optional[float] = None
+        # write-ahead log attachment (journal_wal.py): when the journal
+        # runs durable, every append streams through to disk so a
+        # SIGKILLed process rehydrates this entry at next boot
+        self.wal_id = 0
+        self._wal: Any = None
 
     def append(self, token: int) -> None:
         if len(self.tokens) >= self.max_tokens:
             # a bounded record can no longer prove bit-identity past its
             # cap — the entry stays for forensics but refuses resume
+            if not self.truncated and self._wal is not None:
+                # retire the on-disk record too: a rehydrated truncated
+                # entry could not prove the tokens past its cap either
+                self._wal.retire(self.wal_id)
             self.truncated = True
             return
         self.tokens.append(int(token))
+        if self._wal is not None:
+            self._wal.append_tokens(self.wal_id, (token,))
 
     def note_interrupted(self, reason: str) -> None:
         """Stamp WHY (pool failure, batcher close, recovery teardown);
@@ -508,7 +527,7 @@ class GenerationJournal:
     WHEN to resume."""
 
     def __init__(self, capacity: int = 256, max_tokens: int = 8192,
-                 metrics: Any = None):
+                 metrics: Any = None, wal: Any = None):
         self.capacity = max(1, capacity)
         self.max_tokens = max(1, max_tokens)
         self._lock = threading.Lock()
@@ -519,6 +538,11 @@ class GenerationJournal:
         self._active = 0
         self.interruptions = 0
         self.completions = 0
+        # optional write-ahead log (journal_wal.JournalWAL): every
+        # lifecycle transition and emitted token streams to disk, and
+        # rehydrate() reinstates a SIGKILLed process's resumable entries
+        self.wal = wal
+        self.rehydrated = 0
         self._resumes = (
             metrics.counter(
                 "gofr_tpu_journal_resumes_total",
@@ -539,9 +563,44 @@ class GenerationJournal:
             key, model, max_new_tokens, seeded, deterministic,
             max_tokens=self.max_tokens, prior=prior,
         )
+        if self.wal is not None:
+            entry._wal = self.wal
+            entry.wal_id = self.wal.open_entry(
+                key, model, max_new_tokens, seeded, deterministic,
+                prior=prior,
+            )
         with self._lock:
             self._active += 1
         return entry
+
+    def rehydrate(self) -> int:
+        """Reinstate the WAL's recovered entries as interrupted,
+        resumable ones — called once at boot, before serving. Returns
+        the count (also on :attr:`rehydrated` and ``stats()``). The
+        restarted process then serves ``X-Resume-From`` for its own
+        pre-crash streams exactly as if the engine had merely wedged."""
+        if self.wal is None:
+            return 0
+        count = 0
+        for state in self.wal.recover():
+            entry = JournalEntry(
+                state["key"], state["model"], int(state["mnt"]),
+                seeded=bool(state["seeded"]),
+                deterministic=bool(state["det"]),
+                max_tokens=self.max_tokens,
+                prior=state.get("tokens") or (),
+            )
+            entry.wal_id = int(state["id"])
+            entry._wal = self.wal
+            self.wal.adopt(entry.wal_id, state)
+            self.interrupt(entry, state.get("reason") or "process death")
+            count += 1
+        # interrupt() counted these as live interruptions; recovery
+        # evidence must stay distinguishable from in-process failures
+        with self._lock:
+            self.interruptions -= count
+        self.rehydrated = count
+        return count
 
     def finish(self, entry: JournalEntry) -> None:
         """Clean completion: the entry retires (its stream reached the
@@ -549,6 +608,8 @@ class GenerationJournal:
         if entry.status != "active":
             return
         entry.status = "done"
+        if entry._wal is not None and not entry.truncated:
+            entry._wal.finish(entry.wal_id)
         with self._lock:
             self._active = max(0, self._active - 1)
             self.completions += 1
@@ -561,6 +622,9 @@ class GenerationJournal:
         entry.status = "interrupted"
         entry.note_interrupted(reason)
         entry.t_interrupted = time.perf_counter()
+        if entry._wal is not None and not entry.truncated:
+            entry._wal.interrupt(entry.wal_id, entry.reason)
+        evictions: list[JournalEntry] = []
         with self._lock:
             self._active = max(0, self._active - 1)
             self.interruptions += 1
@@ -576,6 +640,15 @@ class GenerationJournal:
                         pass  # already claimed
                     if not bucket:
                         self._interrupted.pop(evicted.key, None)
+                evictions.append(evicted)
+        for evicted in evictions:
+            if evicted._wal is not None and evicted.status == "interrupted":
+                # capacity eviction: the on-disk record retires too, or
+                # recovery would resurrect an entry the live journal
+                # already refused to keep. OUTSIDE the journal lock: a
+                # WAL write is disk I/O (fsync on rotation), and the
+                # lock sits on the per-token serving path
+                evicted._wal.retire(evicted.wal_id)
 
     # -- resume (device-side, driven by the router/client) ---------------------
     def claim(self, key: str, min_tokens: int = 0) -> Optional[JournalEntry]:
@@ -584,6 +657,7 @@ class GenerationJournal:
         that many — a shorter record cannot prove them). Returns None
         when nothing matches; the caller then falls back to full
         deterministic replay."""
+        claimed: Optional[JournalEntry] = None
         with self._lock:
             bucket = self._interrupted.get(key)
             if not bucket:
@@ -599,8 +673,16 @@ class GenerationJournal:
                 except ValueError:
                     pass
                 entry.status = "resumed"
-                return entry
-        return None
+                claimed = entry
+                break
+        if claimed is not None and claimed._wal is not None:
+            # the resumed CONTINUATION opens its own entry (the resume
+            # generate passes journal_key/journal_prior), so this record
+            # retires — a second crash resumes from the continuation's
+            # entry, which holds the union of tokens. OUTSIDE the
+            # journal lock: the WAL write is disk I/O
+            claimed._wal.claim(claimed.wal_id)
+        return claimed
 
     def note_resume(self, mode: str) -> None:
         """Count one resume by mode (teacher_forced | replayed)."""
@@ -614,14 +696,17 @@ class GenerationJournal:
 
     def stats(self) -> dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "active": self._active,
                 "interrupted": len(self._interrupted_order),
                 "capacity": self.capacity,
                 "max_tokens_per_entry": self.max_tokens,
                 "interruptions": self.interruptions,
                 "completions": self.completions,
+                "rehydrated": self.rehydrated,
             }
+        out["wal"] = self.wal.stats() if self.wal is not None else None
+        return out
 
 
 def _percentiles(samples: list[float]) -> dict[str, float]:

@@ -277,14 +277,37 @@ class TPUDevice:
         # rebuild from after a wedge (see generate/generate_stream)
         from gofr_tpu.telemetry import GenerationJournal
 
+        self.journal_wal = None
+        if self._journal_enabled and self._journal_dir:
+            # crash-durable journal: the WAL rehydrates this process's
+            # pre-crash resumable entries BEFORE serving starts, so an
+            # X-Resume-From that raced the restart finds them waiting
+            from gofr_tpu.journal_wal import JournalWAL
+
+            self.journal_wal = JournalWAL(
+                self._journal_dir,
+                segment_bytes=self._journal_segment_bytes,
+                retain=self._journal_segments,
+                fsync=self._journal_fsync,
+                logger=logger,
+            )
         self.journal = (
             GenerationJournal(
                 capacity=self._journal_capacity,
                 max_tokens=self._journal_max_tokens,
                 metrics=metrics,
+                wal=self.journal_wal,
             )
             if self._journal_enabled else None
         )
+        if self.journal is not None and self.journal_wal is not None:
+            rehydrated = self.journal.rehydrate()
+            if rehydrated and logger is not None:
+                logger.infof(
+                    "journal WAL: rehydrated %s resumable entr%s from %s",
+                    rehydrated, "y" if rehydrated == 1 else "ies",
+                    self._journal_dir,
+                )
         # overload brownout controller: graded shed off host-side
         # signals (batcher queue depth, KV-block utilization); the
         # signal callables read through getattr because the batcher and
@@ -752,6 +775,27 @@ class TPUDevice:
         )
         if self._journal_max_tokens < 1:
             raise ValueError("JOURNAL_MAX_TOKENS must be >= 1")
+        # journal durability (journal_wal.py): JOURNAL_DIR arms the
+        # disk-backed WAL — a SIGKILLed replica rehydrates its
+        # resumable entries at next boot (unset = in-memory only, the
+        # pre-WAL behavior); JOURNAL_FSYNC picks the durability/latency
+        # trade (interrupt | always | off), JOURNAL_SEGMENT_BYTES /
+        # JOURNAL_SEGMENTS bound the on-disk footprint via rotation +
+        # retention (live entries carry across on rotation checkpoints)
+        self._journal_dir = config.get_or_default("JOURNAL_DIR", "")
+        self._journal_fsync = config.get_or_default(
+            "JOURNAL_FSYNC", "interrupt"
+        )
+        self._journal_segment_bytes = int(
+            config.get_or_default("JOURNAL_SEGMENT_BYTES", str(1 << 20))
+        )
+        if self._journal_segment_bytes < 4096:
+            raise ValueError("JOURNAL_SEGMENT_BYTES must be >= 4096")
+        self._journal_segments = int(
+            config.get_or_default("JOURNAL_SEGMENTS", "4")
+        )
+        if self._journal_segments < 1:
+            raise ValueError("JOURNAL_SEGMENTS must be >= 1")
         # overload brownout (gofr_tpu/deadline.py BrownoutController):
         # thresholds arm the graded shed — queue depth and/or KV-block
         # utilization; both 0 (the default) keeps the controller inert.
@@ -2003,9 +2047,13 @@ class TPUDevice:
         every field reads host-side state, so the endpoint answers even
         while the engine is wedged."""
         from gofr_tpu.postmortem import runtime_versions
+        from gofr_tpu.telemetry import BOOT_ID
 
         snap: dict[str, Any] = {
             "engine": self.engine.snapshot(),
+            # process identity: changes exactly when the PROCESS was
+            # replaced (supervisor restart), not when the engine rebuilt
+            "boot_id": BOOT_ID,
             "model": self.model_name,
             "platform": self.platform,
             "device_kind": str(self.device_kind),
@@ -2411,6 +2459,8 @@ class TPUDevice:
         self.watchdog.close()
         self.engine.transition("closed")
         self._teardown_stack()
+        if self.journal_wal is not None:
+            self.journal_wal.close()
 
 
 def new_device(config: Any, logger: Any, metrics: Any) -> TPUDevice:

@@ -367,3 +367,123 @@ def test_fleetsim_smoke_small_fleet(tmp_path, monkeypatch):
     # chaos actually fired: the schedule was applied, not skipped
     assert all(e["applied"] for e in artifact["scenario"]["applied"])
     assert artifact["scenario"]["injected"]
+
+
+# -- process death (router HA + supervised subprocess victim) ------------------
+
+def test_process_kill_scenario_is_deterministic_and_layered():
+    """process_kill layers SIGKILL + router-death events ON TOP of the
+    default schedule (so the existing chaos anti-vacuity checks stay
+    armed), deterministically: same seed ⇒ identical digest, and the
+    router events appear only when a second router exists to fail over
+    to."""
+    base_events, base_digest = build_scenario(
+        7, n_replicas=16, n_prefill=2, duration_s=20.0)
+    a_events, a_digest = build_scenario(
+        7, n_replicas=16, n_prefill=2, duration_s=20.0,
+        process_kill=True, n_routers=2)
+    b_events, b_digest = build_scenario(
+        7, n_replicas=16, n_prefill=2, duration_s=20.0,
+        process_kill=True, n_routers=2)
+    assert a_digest == b_digest
+    assert (json.dumps(a_events, sort_keys=True)
+            == json.dumps(b_events, sort_keys=True))
+    assert a_digest != base_digest
+    ops = [e["op"] for e in a_events]
+    assert ops.count("process_kill") == 2
+    assert ops.count("router_kill") == 1 and ops.count("router_restart") == 1
+    kill_at = next(e["at_s"] for e in a_events if e["op"] == "router_kill")
+    restart_at = next(
+        e["at_s"] for e in a_events if e["op"] == "router_restart")
+    assert restart_at > kill_at  # the dead router comes back for converge
+    # every default-schedule fault survives the layering
+    base_ops = [e["op"] for e in base_events]
+    for op in set(base_ops):
+        assert ops.count(op) >= base_ops.count(op)
+    # a single-router fleet schedules no router death (nothing to fail
+    # over to — the kill would just truncate the whole trace)
+    solo_events, _ = build_scenario(
+        7, n_replicas=16, n_prefill=2, duration_s=20.0,
+        process_kill=True, n_routers=1)
+    solo_ops = [e["op"] for e in solo_events]
+    assert "router_kill" not in solo_ops
+    assert solo_ops.count("process_kill") == 2
+
+
+def test_gate_process_kill_invariants():
+    healthy_block = {
+        "victim": "r16", "replica_kills": 2, "router_kills": 1,
+        "supervisor_restarts": 2, "victim_rehydrated": 1,
+    }
+    healthy = _artifact(**{
+        "scenario_mode": "process_kill",
+        "routers": 2,
+        "process_kill": healthy_block,
+        "slo.router_failovers": 5,
+    })
+    baseline = _artifact()
+    assert fleetsim_gate.gate(healthy, baseline) == []
+    cases = [
+        ({"process_kill": None}, "no process_kill evidence"),
+        ({"process_kill": dict(healthy_block, replica_kills=0)},
+         "no replica SIGKILL landed"),
+        ({"process_kill": dict(healthy_block, supervisor_restarts=0)},
+         "never respawned"),
+        ({"process_kill": dict(healthy_block, victim_rehydrated=None)},
+         "rehydration cannot be verified"),
+        ({"process_kill": dict(healthy_block, router_kills=0)},
+         "router kill never applied"),
+        ({"slo.router_failovers": 0}, "no-single-point-of-failure"),
+    ]
+    for overrides, needle in cases:
+        broken = _artifact(**{
+            "scenario_mode": "process_kill", "routers": 2,
+            "process_kill": dict(healthy_block),
+            "slo.router_failovers": 5,
+        })
+        for path, value in overrides.items():
+            cursor, keys = broken, path.split(".")
+            for key in keys[:-1]:
+                cursor = cursor[key]
+            cursor[keys[-1]] = value
+        failures = fleetsim_gate.gate(broken, baseline)
+        assert failures and any(needle in f for f in failures), (
+            overrides, failures)
+    # a default-scenario artifact is never held to process-kill checks
+    assert fleetsim_gate.gate(_artifact(), baseline) == []
+
+
+def test_fleetsim_smoke_process_kill_two_routers(tmp_path, monkeypatch):
+    """The router-HA acceptance at tier-1 scale: 5 in-process replicas
+    + 1 SUPERVISED SUBPROCESS replica behind TWO router instances; the
+    schedule SIGKILLs the subprocess victim twice and hard-kills router
+    0 mid-trace — and the absolute SLOs hold: zero token loss, 100%
+    resume success, pools idle, clients failed over between routers,
+    the supervisor respawned the victim. The CI ``fleet-sim`` job runs
+    the same scenario at N=16."""
+    monkeypatch.chdir(tmp_path)
+    spec = TraceSpec(requests=60, base_rps=12.0, seed=13)
+    sim = FleetSim(
+        n_replicas=5, n_prefill=1, seed=13, spec=spec,
+        quota_rps=30.0, quota_burst=60.0, workers=8,
+        n_routers=2, scenario="process_kill",
+        measure_hardening=False,
+    )
+    artifact = sim.run()
+    assert artifact["routers"] == 2
+    assert artifact["scenario_mode"] == "process_kill"
+    slo = artifact["slo"]
+    block = artifact["process_kill"]
+    assert block["replica_kills"] >= 1
+    assert block["supervisor_restarts"] >= 1
+    assert block["victim_rehydrated"] is not None
+    assert block["router_kills"] == 1
+    assert slo["router_failovers"] >= 1  # clients rode the sibling router
+    # the existing correctness SLOs hold THROUGH process death
+    assert slo["streams"]["duplicated_tokens"] == 0
+    assert slo["streams"]["missing_tokens"] == 0
+    assert slo["streams"]["token_exact"] == slo["streams"]["verified"]
+    assert slo["resume"]["failures"] == 0, slo["resume"]
+    assert slo["shed"]["p9"] == 0
+    assert slo["pools_idle"], artifact["scenario"]["applied"]
+    assert slo["errors"] <= 3, slo["error_detail"]

@@ -1,0 +1,371 @@
+"""Crash-durable generation journal (gofr_tpu/journal_wal.py), tier-1:
+frame integrity, lifecycle persistence, rotation/retention with
+checkpoint carry-over, the truncation fuzz (a segment cut at EVERY byte
+must never install a corrupt entry and never lose an intact earlier
+one), bit-flip refusal, and the process-death resume e2e — a second
+echo device over the same ``JOURNAL_DIR`` rehydrates the first one's
+interrupted stream and serves ``resume_from`` bit-identically.
+"""
+
+import os
+import struct
+
+import pytest
+
+from gofr_tpu.journal_wal import (
+    FSYNC_POLICIES,
+    K_OPEN,
+    K_TOKENS,
+    MAGIC,
+    WALError,
+    JournalWAL,
+    _frame,
+    _iter_frames,
+)
+from gofr_tpu.telemetry import GenerationJournal
+
+PROMPT = [5, 6, 7]
+
+
+def _wal(tmp_path, name="wal", **kw):
+    kw.setdefault("segment_bytes", 1 << 20)
+    return JournalWAL(str(tmp_path / name), **kw)
+
+
+def _segment_paths(wal):
+    return [
+        os.path.join(wal.directory, f)
+        for f in sorted(os.listdir(wal.directory))
+        if f.startswith("wal-")
+    ]
+
+
+# -- framing -------------------------------------------------------------------
+
+def test_frame_roundtrip_and_refusals():
+    header = MAGIC + struct.pack("<I", 1)
+    body = _frame(K_OPEN, b'{"x":1}') + _frame(K_TOKENS, b"\x01\x00\x00\x00")
+    frames = list(_iter_frames(header + body))
+    assert [k for k, _ in frames] == [K_OPEN, K_TOKENS]
+    with pytest.raises(WALError):
+        list(_iter_frames(b"XXXX" + struct.pack("<I", 1) + body))  # bad magic
+    with pytest.raises(WALError):
+        list(_iter_frames(MAGIC + struct.pack("<I", 9) + body))  # bad version
+    # a flipped KIND byte is a CRC failure (the CRC covers the kind),
+    # never a reinterpretation of the payload under the wrong schema
+    mutated = bytearray(header + body)
+    mutated[len(header)] = K_TOKENS
+    with pytest.raises(WALError):
+        list(_iter_frames(bytes(mutated)))
+
+
+def test_fsync_policy_validation(tmp_path):
+    for policy in FSYNC_POLICIES:
+        _wal(tmp_path, f"p-{policy}", fsync=policy).close()
+    with pytest.raises(ValueError):
+        _wal(tmp_path, "p-bad", fsync="sometimes")
+
+
+# -- lifecycle persistence -----------------------------------------------------
+
+def test_lifecycle_persists_and_rehydrates(tmp_path):
+    wal = _wal(tmp_path)
+    journal = GenerationJournal(capacity=8, max_tokens=64, wal=wal)
+    done = journal.start("k-done", "echo", 16, seeded=False,
+                         deterministic=True)
+    for t in range(5):
+        done.append(t)
+    journal.finish(done)
+    hurt = journal.start("k-hurt", "echo", 16, seeded=True,
+                         deterministic=True)
+    for t in (100, 101, 102):
+        hurt.append(t)
+    journal.interrupt(hurt, "pool failure")
+    live = journal.start("k-live", "echo", 16, seeded=False,
+                         deterministic=True)
+    live.append(200)
+    live.append(201)
+    # `live` gets NO terminal record: the SIGKILL signature. The WAL is
+    # deliberately not closed either — flushed frames must be enough.
+
+    wal2 = _wal(tmp_path)
+    j2 = GenerationJournal(capacity=8, max_tokens=64, wal=wal2)
+    assert j2.rehydrate() == 2
+    assert j2.stats()["rehydrated"] == 2
+    assert j2.stats()["wal"]["recovered_entries"] == 2
+    c = j2.claim("k-hurt", 0)
+    assert c is not None and c.tokens == [100, 101, 102]
+    assert c.reason == "pool failure"
+    c = j2.claim("k-live", 0)
+    assert c is not None and c.tokens == [200, 201]
+    assert "process death" in c.reason
+    assert j2.claim("k-done", 0) is None  # finished: not resumable
+
+    # the claims above were WAL-recorded: a THIRD boot finds nothing
+    j3 = GenerationJournal(capacity=8, max_tokens=64, wal=_wal(tmp_path))
+    assert j3.rehydrate() == 0
+
+
+def test_truncated_entry_retires_on_disk_too(tmp_path):
+    journal = GenerationJournal(capacity=8, max_tokens=4,
+                                wal=_wal(tmp_path))
+    entry = journal.start("k-trunc", "echo", 16, seeded=False,
+                          deterministic=True)
+    for t in range(6):
+        entry.append(t)
+    assert entry.truncated
+    journal.interrupt(entry, "wedge")
+    j2 = GenerationJournal(capacity=8, max_tokens=4, wal=_wal(tmp_path))
+    assert j2.rehydrate() == 0  # an unprovable record never rehydrates
+
+
+def test_capacity_eviction_retires_on_disk(tmp_path):
+    journal = GenerationJournal(capacity=2, max_tokens=64,
+                                wal=_wal(tmp_path))
+    for i in range(4):
+        e = journal.start(f"k{i}", "echo", 8, seeded=True,
+                          deterministic=True)
+        e.append(i)
+        journal.interrupt(e, "wedge")
+    j2 = GenerationJournal(capacity=8, max_tokens=64, wal=_wal(tmp_path))
+    assert j2.rehydrate() == 2
+    assert j2.claim("k0", 0) is None and j2.claim("k1", 0) is None
+    assert j2.claim("k2", 0) is not None and j2.claim("k3", 0) is not None
+
+
+# -- rotation + retention ------------------------------------------------------
+
+def test_rotation_checkpoint_carries_live_entries(tmp_path):
+    wal = _wal(tmp_path, segment_bytes=4096, retain=2)
+    journal = GenerationJournal(capacity=8, max_tokens=4096, wal=wal)
+    keeper = journal.start("k-keeper", "echo", 4096, seeded=True,
+                           deterministic=True)
+    keeper.append(7)
+    journal.interrupt(keeper, "early wedge")
+    # churn enough finished traffic to rotate several times: the
+    # keeper's records live only in segments retention has DELETED —
+    # rotation checkpoints must carry it across
+    for i in range(40):
+        e = journal.start(f"churn{i}", "echo", 4096, seeded=False,
+                          deterministic=True)
+        for t in range(64):
+            e.append(t)
+        journal.finish(e)
+    assert len(_segment_paths(wal)) <= 2
+    assert wal.stats()["segments"] <= 2
+    j2 = GenerationJournal(capacity=8, max_tokens=4096, wal=_wal(tmp_path))
+    assert j2.rehydrate() == 1
+    c = j2.claim("k-keeper", 0)
+    assert c is not None and c.tokens == [7] and c.reason == "early wedge"
+
+
+def test_rotation_mid_entry_never_duplicates_tokens(tmp_path):
+    """Regression: a rotation triggered BY a token append must not
+    replay that batch twice (the checkpoint written at rotation must
+    snapshot the mirror from BEFORE the triggering frame). One live
+    entry, enough single-token appends to force several rotations:
+    recovery returns exactly the appended sequence."""
+    wal = _wal(tmp_path, segment_bytes=4096, retain=8)
+    journal = GenerationJournal(capacity=8, max_tokens=4096, wal=wal)
+    entry = journal.start("k-rot", "echo", 4096, seeded=False,
+                          deterministic=True)
+    n = 600  # several 4 KiB rotations of ~13B token frames
+    for t in range(n):
+        entry.append(t)
+    assert len(_segment_paths(wal)) > 1  # rotation actually happened
+    j2 = GenerationJournal(capacity=8, max_tokens=4096, wal=_wal(tmp_path))
+    assert j2.rehydrate() == 1
+    c = j2.claim("k-rot", 0)
+    assert c is not None
+    assert c.tokens == list(range(n))  # exact: no loss, no duplication
+
+
+# -- the truncation fuzz (satellite) -------------------------------------------
+
+def _build_fuzz_segment(tmp_path, name="fuzz"):
+    """One small segment with interleaved entries and recorded byte
+    offsets: (wal_dir, truth, completion_offsets). ``truth`` maps key ->
+    (final tokens, resumable); ``completion_offsets`` maps key -> the
+    segment size after its LAST record landed (an entry is 'intact' for
+    cuts at/after that offset)."""
+    wal = JournalWAL(str(tmp_path / name), segment_bytes=1 << 20)
+    journal = GenerationJournal(capacity=16, max_tokens=256, wal=wal)
+    offsets = {}
+
+    def size():
+        return os.path.getsize(_segment_paths(wal)[0])
+
+    a = journal.start("ka", "echo", 32, seeded=False, deterministic=True)
+    b = journal.start("kb", "echo", 32, seeded=True, deterministic=True)
+    for t in range(4):
+        a.append(10 + t)
+        b.append(20 + t)
+    journal.interrupt(a, "wedge-a")
+    offsets["ka"] = size()
+    c = journal.start("kc", "echo", 32, seeded=False, deterministic=True)
+    c.append(30)
+    journal.finish(b)
+    offsets["kb"] = size()
+    c.append(31)
+    offsets["kc"] = size()  # c stays open: resumable via process death
+    truth = {
+        "ka": ([10, 11, 12, 13], True),
+        "kb": ([20, 21, 22, 23], False),
+        "kc": ([30, 31], True),
+    }
+    return wal, truth, offsets
+
+
+def test_truncation_fuzz_every_cut_point(tmp_path):
+    """Cut the segment at EVERY byte offset (frame boundaries and
+    mid-frame alike): recovery must never raise, never install tokens
+    that are not a true prefix, and never lose an entry whose records
+    all landed before the cut."""
+    wal, truth, offsets = _build_fuzz_segment(tmp_path)
+    seg = _segment_paths(wal)[0]
+    with open(seg, "rb") as f:
+        data = f.read()
+    cut_dir = tmp_path / "cut"
+    os.makedirs(cut_dir, exist_ok=True)
+    cut_seg = os.path.join(str(cut_dir), os.path.basename(seg))
+    for cut in range(len(data) + 1):
+        with open(cut_seg, "wb") as f:
+            f.write(data[:cut])
+        recovered = JournalWAL(str(cut_dir)).recover()
+        by_key = {}
+        for state in recovered:
+            assert state["key"] not in by_key, f"duplicate entry at cut {cut}"
+            by_key[state["key"]] = state
+        for key, state in by_key.items():
+            tokens, _ = truth[key]
+            got = state["tokens"]
+            assert got == tokens[:len(got)], (
+                f"cut {cut}: {key} recovered non-prefix tokens {got}"
+            )
+        for key, (tokens, resumable) in truth.items():
+            if cut < offsets[key]:
+                continue  # records partially lost: absence is legal
+            if resumable:
+                assert key in by_key, f"cut {cut}: intact entry {key} lost"
+                assert by_key[key]["tokens"] == tokens, (
+                    f"cut {cut}: intact entry {key} lost tokens"
+                )
+            else:
+                assert key not in by_key, (
+                    f"cut {cut}: finished entry {key} resurrected"
+                )
+    # the full-length 'cut' is the clean recovery
+    with open(cut_seg, "wb") as f:
+        f.write(data)
+    full = JournalWAL(str(cut_dir))
+    assert {s["key"] for s in full.recover()} == {"ka", "kc"}
+    assert full.torn_segments == 0
+
+
+def test_bitflip_fuzz_never_installs_corrupt_tokens(tmp_path):
+    """Flip every byte of the segment (one at a time): recovery must
+    never raise and never install a token list that is not a true
+    prefix of the entry's real stream — a flipped byte is refused at
+    its frame, not absorbed."""
+    wal, truth, _ = _build_fuzz_segment(tmp_path, name="flip")
+    seg = _segment_paths(wal)[0]
+    with open(seg, "rb") as f:
+        data = bytearray(f.read())
+    flip_dir = tmp_path / "flip-out"
+    os.makedirs(flip_dir, exist_ok=True)
+    flip_seg = os.path.join(str(flip_dir), os.path.basename(seg))
+    for i in range(len(data)):
+        mutated = bytearray(data)
+        mutated[i] ^= 0x40
+        with open(flip_seg, "wb") as f:
+            f.write(bytes(mutated))
+        for state in JournalWAL(str(flip_dir)).recover():
+            tokens, _ = truth.get(state["key"], ([], True))
+            got = state["tokens"]
+            assert got == tokens[:len(got)], (
+                f"flip at {i}: corrupt tokens installed for {state['key']}"
+            )
+
+
+# -- process-death resume e2e (echo device) ------------------------------------
+
+def _echo_device(tmp_path, registry=None, **env):
+    from gofr_tpu.config import EnvConfig
+    from gofr_tpu.logging import Level
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.testutil import MockLogger
+    from gofr_tpu.tpu.device import new_device
+
+    cfg = {
+        "MODEL_NAME": "echo",
+        "JOURNAL_DIR": str(tmp_path / "journal"),
+        "WATCHDOG_DISPATCH_TIMEOUT_S": "0.2",
+        "RECOVERY_BACKOFF_S": "0.05",
+    }
+    cfg.update(env)
+    old = {k: os.environ.get(k) for k in cfg}
+    os.environ.update(cfg)
+    try:
+        return new_device(
+            EnvConfig(), MockLogger(Level.FATAL), registry or Registry()
+        )
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+
+def test_process_death_resume_rehydrates_bit_identical(tmp_path):
+    """The tentpole invariant, device-level: an interrupted stream's
+    WAL records survive the first device's death (close() writes no
+    terminal record for interrupted entries), a SECOND device over the
+    same JOURNAL_DIR rehydrates them at construction, and
+    ``resume_from`` continues teacher-forced and bit-identical."""
+    from gofr_tpu.metrics import Registry
+
+    device = _echo_device(tmp_path)
+    try:
+        full = device.generate(PROMPT, max_new_tokens=12)
+        key = device._journal_key(PROMPT, 12, None, device.default_stop_ids,
+                                  None)
+        entry = device.journal.start(key, "echo", 12, seeded=False,
+                                     deterministic=True)
+        for token in full[:7]:
+            entry.append(token)
+        device.journal.interrupt(entry, "injected wedge")
+        assert device.engine_snapshot()["journal"]["wal"]["segments"] >= 1
+    finally:
+        device.close()
+
+    registry = Registry()
+    reborn = _echo_device(tmp_path, registry)
+    try:
+        stats = reborn.journal.stats()
+        assert stats["rehydrated"] == 1
+        assert stats["interrupted"] == 1
+        resumed = list(reborn.generate_stream(PROMPT, max_new_tokens=12,
+                                              resume_from=5))
+        assert full[:5] + resumed == full  # zero missing, zero duplicated
+        modes = registry.counter(
+            "gofr_tpu_journal_resumes_total", labels=("mode",)
+        ).data()
+        assert modes.get(("teacher_forced",)) == 1.0
+        # the claim was durably recorded: a THIRD boot has nothing left
+        assert reborn.engine_snapshot()["journal"]["wal"]["live_entries"] == 0
+    finally:
+        reborn.close()
+
+    third = _echo_device(tmp_path)
+    try:
+        assert third.journal.stats()["rehydrated"] == 0
+    finally:
+        third.close()
+
+
+def test_wal_disabled_without_journal_dir(tmp_path):
+    device = _echo_device(tmp_path, JOURNAL_DIR="")
+    try:
+        assert device.journal_wal is None
+        assert device.journal.stats()["wal"] is None
+        device.generate(PROMPT, max_new_tokens=4)
+    finally:
+        device.close()

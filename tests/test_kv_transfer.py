@@ -8,7 +8,6 @@ chunked prefill with a bit-identical result and the outcome counted on
 ``gofr_tpu_kv_transfer_total``."""
 
 import json
-import time
 import urllib.error
 import urllib.request
 
@@ -490,14 +489,20 @@ def test_transfer_export_respects_deadline_and_disable(tmp_path, monkeypatch):
         prompt = list(range(1, 40))
         _post(donor.address + "/generate", {"tokens": prompt, "max_new_tokens": 2})
         phash = kvwire.prompt_hash(prompt)
-        # a microscopic budget: the stream stops before the trailer
+        # a small budget made DETERMINISTIC by the chaos clock: the
+        # slow-loris delays each export chunk 50ms, so the per-block
+        # deadline check inside the export generator is guaranteed to
+        # see the 5ms budget spent after the header frame — the old
+        # shape (1ms budget + a 2ms sleep) raced the server streaming
+        # the whole single-block entry inside the budget and flaked
+        donor.chaos.slow_loris(0.05, paths=("/admin/kv/",))
         req = urllib.request.Request(
             donor.address + f"/admin/kv/{phash}",
-            headers={"X-Request-Deadline-Ms": "1"},
+            headers={"X-Request-Deadline-Ms": "5"},
         )
-        time.sleep(0.002)  # the budget is spent before the first frame
         with urllib.request.urlopen(req, timeout=10) as r:
             raw = r.read()
+        donor.chaos.clear("slow_loris")
         with pytest.raises(kvwire.Truncated):
             kvwire.decode_stream([raw])
         # transfer off: the export surface does not exist

@@ -30,6 +30,12 @@ baseline:
   per-token cheap: ``journal_microbench.per_token_us <= baseline *
   BENCH_GATE_JOURNAL_FACTOR`` (default 5.0 — the journal append is a
   GIL-atomic list append; a regression here taxes EVERY stream);
+- journal PERSISTENCE (the crash-durable WAL, journal_wal.py) must
+  stay a bounded tax on top of that:
+  ``journal_wal_microbench.per_token_us_wal <= baseline *
+  BENCH_GATE_WAL_FACTOR`` (default 10.0, loose-first — a WAL append
+  is a buffered write + flush; a blow-up means the frame/rotation
+  path grew a stall or an fsync leaked into the default policy);
 - deadline-aware serving must stay fast at saying no:
   ``shed_microbench.shed_p50_us <= baseline *
   BENCH_GATE_SHED_FACTOR`` (default 10.0, loose-first — the shed path
@@ -86,6 +92,7 @@ def gate(bench: dict, baseline: dict) -> list[str]:
     kv_factor = float(os.environ.get("BENCH_GATE_KV_FACTOR", "3.0"))
     mesh_factor = float(os.environ.get("BENCH_GATE_MESH_FACTOR", "5.0"))
     journal_factor = float(os.environ.get("BENCH_GATE_JOURNAL_FACTOR", "5.0"))
+    wal_factor = float(os.environ.get("BENCH_GATE_WAL_FACTOR", "10.0"))
     shed_factor = float(os.environ.get("BENCH_GATE_SHED_FACTOR", "10.0"))
     reclaim_factor = float(os.environ.get("BENCH_GATE_RECLAIM_FACTOR", "10.0"))
     transfer_factor = float(
@@ -174,6 +181,21 @@ def gate(bench: dict, baseline: dict) -> list[str]:
                 f"journal per-token overhead regression: {per_token}us > "
                 f"{base_token}us * {journal_factor} "
                 f"(= {base_token * journal_factor:.3f}us)"
+            )
+    wal = bench.get("journal_wal_microbench") or {}
+    base_wal = baseline.get("journal_wal_microbench") or {}
+    if base_wal:
+        per_token = _num(wal, "per_token_us_wal")
+        base_token = _num(base_wal, "per_token_us_wal")
+        if per_token is None:
+            failures.append(
+                "journal_wal_microbench missing from the bench artifact"
+            )
+        elif base_token and per_token > base_token * wal_factor:
+            failures.append(
+                f"journal WAL per-token overhead regression: {per_token}us "
+                f"> {base_token}us * {wal_factor} "
+                f"(= {base_token * wal_factor:.2f}us)"
             )
     shed = bench.get("shed_microbench") or {}
     base_shed = baseline.get("shed_microbench") or {}

@@ -45,6 +45,15 @@ def main(argv: list[str]) -> int:
     parser.add_argument("--base-rps", type=float, default=12.0)
     parser.add_argument("--quota-rps", type=float, default=4.0)
     parser.add_argument("--workers", type=int, default=12)
+    parser.add_argument("--routers", type=int, default=1,
+                        help="router instances fronting the fleet (>= 2 "
+                        "proves the no-single-point-of-failure story: "
+                        "clients fail over when one dies)")
+    parser.add_argument("--scenario", default="default",
+                        choices=("default", "process_kill"),
+                        help="process_kill layers SIGKILLed subprocess "
+                        "replicas (supervisor respawn + WAL rehydration) "
+                        "and a router-tier death onto the default chaos")
     parser.add_argument("--out", default="")
     parser.add_argument("--no-hardening", action="store_true",
                         help="skip the before/after micro-measures")
@@ -72,6 +81,8 @@ def main(argv: list[str]) -> int:
         ),
         quota_rps=args.quota_rps,
         workers=args.workers,
+        n_routers=args.routers,
+        scenario=args.scenario,
         measure_hardening=not args.no_hardening,
         progress=lambda msg: print(msg, file=sys.stderr, flush=True),
     )

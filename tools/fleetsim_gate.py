@@ -187,6 +187,47 @@ def _relative_failures(slo: dict, base_slo: dict) -> list[str]:
     return failures
 
 
+def _process_kill_failures(artifact: dict, slo: dict) -> list[str]:
+    """process_kill scenario (routers >= 2, a supervised subprocess
+    replica): the run must PROVE process death was survivable, not just
+    scheduled — kills fired, the supervisor respawned the victim, the
+    reborn process rehydrated its WAL, and at least one client rode the
+    router failover when the router-tier instance died."""
+    if artifact.get("scenario_mode") != "process_kill":
+        return []
+    failures: list[str] = []
+    block = artifact.get("process_kill") or {}
+    if not block:
+        return ["process_kill scenario produced no process_kill evidence "
+                "block"]
+    if _num(block, "replica_kills") < 1:
+        failures.append(
+            "no replica SIGKILL landed (replica_kills == 0) — the "
+            "process-death invariants are vacuous"
+        )
+    if _num(block, "supervisor_restarts") < 1:
+        failures.append(
+            "the supervisor never respawned the SIGKILLed replica "
+            "(supervisor_restarts == 0)"
+        )
+    rehydrated = block.get("victim_rehydrated")
+    if rehydrated is None:
+        failures.append(
+            "the reborn victim's journal block was unreadable — WAL "
+            "rehydration cannot be verified"
+        )
+    if artifact.get("routers", 1) >= 2:
+        if _num(block, "router_kills") < 1:
+            failures.append("the scheduled router kill never applied")
+        if _num(slo, "router_failovers") < 1:
+            failures.append(
+                "no client ever failed over between routers "
+                "(router_failovers == 0) — the no-single-point-of-"
+                "failure invariant is vacuous"
+            )
+    return failures
+
+
 def gate(artifact: dict, baseline: dict) -> list[str]:
     failures: list[str] = []
     if artifact.get("kind") != "FLEETSIM":
@@ -200,6 +241,7 @@ def gate(artifact: dict, baseline: dict) -> list[str]:
     slo = artifact.get("slo") or {}
     failures += _absolute_failures(slo, artifact.get("hardening") or {})
     failures += _chaos_fired_failures(artifact, slo)
+    failures += _process_kill_failures(artifact, slo)
     failures += _relative_failures(slo, baseline.get("slo") or {})
     return failures
 

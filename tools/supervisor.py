@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Process supervisor CLI: keep a serving command alive across crashes.
+
+    python tools/supervisor.py [options] -- <command> [args...]
+
+Respawns the child when it exits, with bounded exponential backoff;
+more than ``--max-restarts`` exits inside ``--crash-window`` seconds is
+a CRASH LOOP — the supervisor stops respawning and exits 1 so the
+orchestration layer above (systemd, k8s, an operator) sees the page
+instead of a silently burning restart treadmill. SIGTERM/SIGINT forward
+to the child and stop supervision (clean exit 0).
+
+Pairs with the journal WAL: a replica run as
+
+    JOURNAL_DIR=/var/lib/gofr/journal python tools/supervisor.py -- \\
+        python examples/http-server/main.py
+
+survives ``kill -9`` — the respawned process rehydrates its resumable
+streams at boot and the fleet router walks it back into rotation
+through the ``restarting`` probation path.
+See docs/advanced-guide/fleet.md "Process-death recovery".
+"""
+
+import argparse
+import signal
+import sys
+import time
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="restart-on-exit process supervisor with bounded "
+        "backoff and a crash-loop verdict",
+    )
+    parser.add_argument("--backoff", type=float, default=0.5,
+                        help="initial restart backoff seconds (default 0.5)")
+    parser.add_argument("--backoff-max", type=float, default=10.0,
+                        help="backoff ceiling seconds (default 10)")
+    parser.add_argument("--crash-window", type=float, default=30.0,
+                        help="crash-loop detection window seconds "
+                        "(default 30)")
+    parser.add_argument("--max-restarts", type=int, default=5,
+                        help="exits tolerated inside the window before the "
+                        "crash-loop verdict (default 5)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="-- command to supervise")
+    args = parser.parse_args()
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given (use: supervisor.py [options] -- cmd)")
+
+    sys.path.insert(0, ".")
+    from gofr_tpu.devtools.supervise import CRASH_LOOP, Supervisor
+
+    class _StderrLogger:
+        @staticmethod
+        def _emit(fmt, *fmt_args):
+            print(fmt % fmt_args, file=sys.stderr, flush=True)
+
+        infof = warnf = errorf = _emit
+
+    supervisor = Supervisor(
+        command,
+        backoff_s=args.backoff,
+        backoff_max_s=args.backoff_max,
+        crash_window_s=args.crash_window,
+        max_restarts_in_window=args.max_restarts,
+        logger=_StderrLogger(),
+        stdout=None,  # inherit: the child's output is the operator's
+        stderr=None,
+    )
+
+    def handle_signal(signum, _frame):
+        supervisor.logger.infof(
+            "supervisor: signal %s — stopping child", signum
+        )
+        supervisor.stop()
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, handle_signal)
+    signal.signal(signal.SIGINT, handle_signal)
+    supervisor.start()
+    try:
+        while supervisor.verdict is None:
+            time.sleep(0.2)
+    finally:
+        if supervisor.verdict == CRASH_LOOP:
+            code = supervisor.last_exit_code
+            supervisor.logger.errorf(
+                "supervisor: crash-loop verdict (last exit %s)", code
+            )
+            supervisor.stop()
+            return 1
+        supervisor.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
