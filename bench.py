@@ -603,6 +603,18 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
             except Exception as exc:
                 errors.append(f"kv-transfer phase: {exc}")
                 traceback.print_exc(file=sys.stderr)
+            # -- phase: fleet tracing overhead ---------------------------------
+            # what the hop-correlation layer costs per request (header
+            # sanitize + stamp, on the router hot path) and what one
+            # /admin/fleet/trace assembly costs off it; gated
+            # loose-first against bench_baseline.json
+            # (BENCH_GATE_TRACE_FACTOR)
+            try:
+                result["trace_microbench"] = _measure_trace()
+                log(f"fleet trace: {result['trace_microbench']}")
+            except Exception as exc:
+                errors.append(f"trace phase: {exc}")
+                traceback.print_exc(file=sys.stderr)
             engine_live = _scrape_engine(base)
             if engine_live.get("kv_blocks") is not None:
                 result["kv_blocks"] = engine_live["kv_blocks"]
@@ -1305,6 +1317,73 @@ def _measure_kv_transfer() -> dict:
         "wire_bytes_per_pull": wire_bytes,
         "pulls_ok": stats.get("ok", 0),
         "fallbacks": stats.get("fallback", 0),
+    }
+
+
+def _measure_trace() -> dict:
+    """Fleet-tracing overhead (host-side, compile-free):
+
+    - **stamp cost** — what the hop-correlation layer adds to EVERY
+      routed request on the router hot path: sanitize the inbound
+      request id, mint the ``X-Gofr-Hop`` value, and parse it back the
+      way replica admission does;
+    - **assemble cost** — one ``/admin/fleet/trace/<id>`` timeline
+      assembly (pure join + latency decomposition over an
+      already-scraped 3-attempt route record with flight and transfer
+      evidence): the off-hot-path read side.
+
+    Gated loose-first vs bench_baseline.json
+    (``BENCH_GATE_TRACE_FACTOR``)."""
+    from gofr_tpu.fleet import trace as fleet_trace
+    from gofr_tpu.telemetry import format_hop, parse_hop, sanitize_request_id
+
+    n = int(os.environ.get("BENCH_TRACE_ROUNDS", "2000"))
+    start = time.perf_counter()
+    for i in range(n):
+        rid = sanitize_request_id(f"req-bench-{i:08d}")
+        hop = format_hop("router-0", i % 3, 0)
+        parsed = parse_hop(hop)
+        if rid is None or parsed is None:
+            raise RuntimeError("hop stamp round-trip failed")
+    stamp_us = (time.perf_counter() - start) / n * 1e6
+    route = {
+        "request_id": "req-bench", "router_id": "router-0",
+        "ts": 1000.0, "method": "POST", "path": "/v1/completions",
+        "tenant": "t0", "status": 200, "outcome": "ok", "retries": 2,
+        "resumes": 1, "stream": True, "resumable": True, "role": "decode",
+        "kv_donor": "r0", "elapsed_ms": 180.0,
+        "attempts": [
+            {"replica": "r1", "status": 503, "error": "saturated",
+             "elapsed_ms": 12.0},
+            {"replica": "r2", "status": 0, "error": "timeout",
+             "elapsed_ms": 30.0},
+            {"replica": "r3", "status": 200, "error": None,
+             "elapsed_ms": 120.0},
+        ],
+    }
+    flights = {
+        "r3": [{
+            "request_id": "req-bench",
+            "origin": {"router": "router-0", "attempt": 2, "resume_from": 0},
+            "queue_wait_s": 0.004, "ttft_s": 0.021, "status": 200,
+        }],
+    }
+    transfers = [{
+        "replica": "r3", "side": "receiver", "donor": "r0",
+        "outcome": "ok", "request_id": "req-bench", "elapsed_ms": 3.0,
+    }]
+    start = time.perf_counter()
+    for _ in range(n):
+        timeline = fleet_trace.assemble(
+            "req-bench", route, flights=flights, transfers=transfers,
+        )
+    assemble_us = (time.perf_counter() - start) / n * 1e6
+    if timeline["partial"] or timeline["latency"]["stream_ms"] is None:
+        raise RuntimeError(f"bench timeline did not assemble fully: {timeline}")
+    return {
+        "rounds": n,
+        "stamp_us": round(stamp_us, 3),
+        "assemble_us": round(assemble_us, 2),
     }
 
 

@@ -66,6 +66,11 @@ class App:
         self.container = Container(self.config)
         self.logger = self.container.logger
         self.tracer = init_tracer(self.config, self.logger)
+        # exporter drops become a counter an alert can watch (the
+        # exporter exists before the registry, so it is attached here)
+        attach = getattr(self.tracer.exporter, "attach_metrics", None)
+        if attach is not None:
+            attach(self.container.metrics)
         self._cmd_app = cmd_app
         self._cmd_routes: list[tuple[str, Handler]] = []
         self._grpc_registrations: list[tuple[Any, Any]] = []

@@ -62,7 +62,10 @@ to a per-process id) labels one of N side-by-side router instances —
 the router tier has no single point of failure: quota is redis-backed
 (shared), affinity/KV-locality is stateless rendezvous hashing, and
 the in-flight cap, route records, breaker and prober verdicts are
-explicitly PER-INSTANCE (N routers = N x ``FLEET_MAX_INFLIGHT``).
+explicitly PER-INSTANCE (N routers = N x ``FLEET_MAX_INFLIGHT``);
+tracing: ``FLEET_TRACE_SCRAPE_TIMEOUT_S`` (1 — per-replica evidence
+scrape budget for ``GET /admin/fleet/trace/<id>``; replicas that miss
+it show as ``evidence_gaps`` on a partial trace).
 
 Self-healing keys (tpu/recovery.py + telemetry.py, see
 docs/advanced-guide/fleet.md "Wedge-recovery runbook"):

@@ -483,8 +483,16 @@ def _generate_handler(ctx: Any) -> Any:
     router's ``X-KV-Donor`` stamp the same way the OpenAI admission path
     does, so disaggregated-transfer e2es drive the real pull path."""
     from gofr_tpu.fleet.kvwire import activate_kv_hint, parse_kv_hint
+    from gofr_tpu.telemetry import activate_origin, origin_from_headers
 
     activate_kv_hint(parse_kv_hint(ctx.request.header("X-KV-Donor")))
+    # fleet origin, same as the OpenAI admission gate: stamp the
+    # router's request id + hop block onto any flight record this
+    # generation starts, so fleet-trace e2es work over /generate too
+    activate_origin(origin_from_headers(
+        ctx.request.header("X-Gofr-Request-Id"),
+        ctx.request.header("X-Gofr-Hop"),
+    ))
     body = ctx.bind() if ctx.request.body else {}
     tokens = body.get("tokens") or [1, 2, 3]
     max_new = int(body.get("max_new_tokens") or 8)

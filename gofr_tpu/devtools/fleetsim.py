@@ -407,6 +407,8 @@ class FleetSim:
         progress: Any = None,
         n_routers: int = 1,
         scenario: str = "default",
+        replay: Optional[dict[str, Any]] = None,
+        capture_out: str = "",
     ):
         if scenario not in ("default", "process_kill"):
             raise ValueError(
@@ -432,6 +434,14 @@ class FleetSim:
         # process under a Supervisor, journal WAL armed) and layers
         # SIGKILL + router-death events onto the default schedule
         self.scenario = scenario
+        # replay: a TRACE_CAPTURE artifact (tools/trace_capture.py)
+        # drives the run INSTEAD of build_trace — captured production
+        # traffic reruns through the same harness, chaos, and SLO gate
+        self.replay = replay
+        # capture_out: scrape this run's OWN route/flight records into
+        # a TRACE_CAPTURE file before teardown (the CI round trip:
+        # sim -> capture -> replay, digests asserted at every step)
+        self.capture_out = capture_out
         self._sp: Optional[Any] = None
         self._results: list[dict[str, Any]] = []
         self._results_lock = threading.Lock()
@@ -449,7 +459,15 @@ class FleetSim:
             chaos_router,
         )
 
-        trace, trace_digest = build_trace(self.spec)
+        if self.replay is not None:
+            # a captured window replays verbatim: the events ARE the
+            # schedule, and the digest re-derived here must match the
+            # capture's own (the determinism witness survives the hop
+            # through the file)
+            trace = [dict(ev) for ev in self.replay["events"]]
+            trace_digest = _digest(trace)
+        else:
+            trace, trace_digest = build_trace(self.spec)
         duration_s = trace[-1]["at_s"] if trace else 0.0
         scenario, scenario_digest = build_scenario(
             self.seed, self.n_replicas, self.n_prefill, duration_s,
@@ -538,6 +556,13 @@ class FleetSim:
                 bases, routers, members, trace, trace_digest, scenario,
                 scenario_digest, duration_s, converged,
             )
+            if self.replay is not None:
+                artifact["trace"]["replay_of"] = self.replay.get("digest")
+            if self.capture_out:
+                self._progress(
+                    f"fleetsim: capturing served trace -> {self.capture_out}"
+                )
+                artifact["capture"] = self._capture(routers, members)
         self._sp = None
         if self.measure_hardening:
             self._progress("fleetsim: measuring hardening before/after")
@@ -996,6 +1021,47 @@ class FleetSim:
                 "stats": quota_stats,
             },
         }
+
+    def _capture(self, routers: list, members: list) -> dict[str, Any]:
+        """Scrape this run's OWN route + flight records into a
+        TRACE_CAPTURE file (tools/trace_capture.py schema): the run's
+        served traffic becomes a replayable regression trace, and the
+        CI round trip (sim -> capture -> --replay) asserts the digest
+        at every hop."""
+        from gofr_tpu.devtools.trace_capture import capture_artifact
+
+        routes: list[dict[str, Any]] = []
+        for router_app in routers:
+            routes.extend(router_app.container.fleet.records(limit=5000))
+        flights: list[dict[str, Any]] = []
+        for member in members:
+            app = getattr(member, "app", None)
+            if app is not None:  # in-process replica: read directly
+                flights.extend(app.container.telemetry.records(limit=5000))
+                continue
+            try:  # subprocess replica: over the wire
+                req = urllib.request.Request(
+                    member.address + "/admin/requests?limit=1000"
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    data = json.loads(resp.read().decode("utf-8"))
+                if isinstance(data, dict) and isinstance(
+                    data.get("data"), dict
+                ):
+                    data = data["data"]
+                flights.extend(data.get("requests") or [])
+            except Exception:
+                continue  # a dead victim's flights are simply absent
+        artifact = capture_artifact(
+            routes, flights, self.seed,
+            source={"fleetsim_seed": self.seed, "routers": len(routers),
+                    "replicas": len(members)},
+        )
+        with open(self.capture_out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return {"path": self.capture_out, "requests": artifact["requests"],
+                "digest": artifact["digest"], "dropped": artifact["dropped"]}
 
     def _process_kill_block(self) -> Optional[dict[str, Any]]:
         """The process-death evidence: kills applied, supervisor

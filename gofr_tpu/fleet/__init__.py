@@ -36,6 +36,10 @@ docs/advanced-guide/fleet.md for the full table):
   ``FLEET_MAX_INFLIGHT`` (256), ``FLEET_SATURATION_QUEUE`` (64),
   ``FLEET_RETRY_AFTER_S`` (1).
 - drain: ``FLEET_DRAIN_TIMEOUT_S`` (10).
+- tracing: ``FLEET_TRACE_SCRAPE_TIMEOUT_S`` (1 — per-replica budget
+  for the evidence scrapes behind ``GET /admin/fleet/trace/<id>``; a
+  replica that cannot answer within it becomes an ``evidence_gaps``
+  entry on a partial trace, not a stalled request).
 - ``FLEET_ROUTES`` — the forwarded surface, comma-separated
   ``METHOD /path`` pairs (default: the OpenAI serving surface +
   ``/generate`` + ``/infer``).
@@ -207,9 +211,17 @@ def wire_fleet(app: Any) -> FleetRouter:
                 f"FLEET_ROUTES entry '{entry}' must be 'METHOD /path'"
             )
         app.add_route(method.upper(), pattern, fleet.handle)
-    from gofr_tpu.handler import fleet_admin_handler
+    from gofr_tpu.handler import (
+        fleet_admin_handler,
+        fleet_overview_handler,
+        fleet_trace_handler,
+    )
 
     app.get("/admin/fleet", fleet_admin_handler)
+    # fleet-wide causal trace for one request id (fleet/trace.py) and
+    # the fleet rollup built from the prober's piggybacked scrapes
+    app.get("/admin/fleet/trace/{id}", fleet_trace_handler)
+    app.get("/admin/fleet/overview", fleet_overview_handler)
     container.fleet = fleet
     replica_set.start()
     logger.infof(

@@ -496,6 +496,13 @@ class ReplicaSet:
             data.get("kv_transfer")
             if isinstance(data.get("kv_transfer"), dict) else None
         )
+        # overload-brownout level (0 normal): piggybacked for the
+        # /admin/fleet/overview rollup — a fleet-wide brownout is an
+        # incident headline, not something to scrape N replicas for
+        brownout = data.get("brownout")
+        engine["brownout_level"] = (
+            brownout.get("level") if isinstance(brownout, dict) else None
+        )
         kv = data.get("kv_blocks") or {}
         engine["kv_free"] = kv.get("free")
         engine["kv_cached"] = kv.get("cached")

@@ -38,6 +38,7 @@ Request path, in order:
 from __future__ import annotations
 
 import json
+import secrets
 import threading
 import time
 from collections import deque
@@ -54,8 +55,16 @@ from gofr_tpu.fleet.replica import (
 )
 from gofr_tpu.http.response import Response
 from gofr_tpu.service import ServiceCallError, _encode_query, backoff_delays
+from gofr_tpu.telemetry import format_hop, sanitize_request_id
+from gofr_tpu.tracing import current_span
 
 _JSON = "application/json"
+
+
+def mint_request_id() -> str:
+    """A fresh fleet-wide request id (the router mints one when the
+    client supplied none, or supplied garbage)."""
+    return "req-" + secrets.token_hex(8)
 
 
 class _ResumeSpec:
@@ -269,6 +278,17 @@ class FleetRouter:
             "as `restarting`",
             labels=("replica",),
         )
+        self._hop_seconds = m.histogram(
+            "gofr_tpu_router_hop_seconds",
+            "per-hop latency decomposition of one routed request: "
+            "router (admission + selection overhead before the first "
+            "upstream dispatch), upstream (one buffered attempt's "
+            "round trip), stream (one streaming attempt's body "
+            "duration), resume (a mid-stream failover continuation's "
+            "splice latency) — the metric behind the per-stage "
+            "breakdown /admin/fleet/trace/<id> shows for one request",
+            labels=("stage",),
+        )
         self._stream_resumes = m.counter(
             "gofr_tpu_router_stream_resumes_total",
             "mid-stream failover outcomes on resumable (deterministic) "
@@ -370,22 +390,26 @@ class FleetRouter:
 
     # -- admission -------------------------------------------------------------
     def _shed(self, status: int, reason: str, retry_after_s: float,
-              detail: str) -> Response:
+              detail: str, request_id: str = "") -> Response:
         self._shed_total.inc(reason=reason)
+        # the request id rides the shed body AND header: a 429/503 the
+        # router refused is otherwise untraceable — no route forward,
+        # no replica record, just a log line the client needs to quote
         body = json.dumps({"error": {
             "message": detail, "reason": reason,
             "retry_after_s": round(retry_after_s, 3),
+            "request_id": request_id or None,
         }}).encode("utf-8")
-        response = Response(
-            status=status,
-            headers={"Content-Type": _JSON,
-                     "Retry-After": str(max(1, int(retry_after_s + 0.999)))},
-            body=body,
-        )
+        headers = {"Content-Type": _JSON,
+                   "Retry-After": str(max(1, int(retry_after_s + 0.999)))}
+        if request_id:
+            headers["X-Gofr-Request-Id"] = request_id
+        response = Response(status=status, headers=headers, body=body)
         response._shed_reason = reason
         return response
 
-    def _admit(self, request: Any, tenant: str) -> Optional[Response]:
+    def _admit(self, request: Any, tenant: str,
+               request_id: str = "") -> Optional[Response]:
         """None = admitted AND the in-flight slot is HELD (the caller
         must ``_release()``); a Response = the shed verdict. Ordering:
         router-state sheds first, then the slot (check-and-increment
@@ -398,21 +422,25 @@ class FleetRouter:
             return self._shed(
                 503, "draining", self.retry_after_s,
                 "router is draining; retry against another front door",
+                request_id=request_id,
             )
         if self.replica_set.all_saturated():
             return self._shed(
                 429, "kv_exhausted", self.retry_after_s,
                 "every replica reports KV/queue saturation",
+                request_id=request_id,
             )
         if not self.replica_set.in_rotation():
             return self._shed(
                 503, "no_replicas", self.retry_after_s,
                 "no replica in rotation",
+                request_id=request_id,
             )
         if not self._try_acquire_slot():
             return self._shed(
                 429, "inflight", self.retry_after_s,
                 "router at its in-flight cap",
+                request_id=request_id,
             )
         ok, retry_after = self.quota.take(tenant)
         if not ok:
@@ -420,6 +448,7 @@ class FleetRouter:
             return self._shed(
                 429, "quota", retry_after,
                 f"tenant '{tenant}' over its request quota",
+                request_id=request_id,
             )
         return None
 
@@ -445,7 +474,19 @@ class FleetRouter:
         (sync: runs on the container's handler pool)."""
         request = ctx.request
         tenant = tenant_of(request, self.trust_tenant_header)
-        verdict = self._admit(request, tenant)
+        # the fleet-wide correlation id: honor a sanitized client
+        # X-Request-ID (length-bounded, charset-restricted — garbage
+        # degrades to a minted id, never to a 4xx), else mint. The id
+        # echoes on EVERY response — sheds included — and keys the
+        # route record, every replica FlightRecord this request causes,
+        # and /admin/fleet/trace/<id>. A client-supplied X-Gofr-Hop is
+        # NOT consulted: hop provenance is the router's to assert (same
+        # trust discipline as X-KV-Donor).
+        request_id = sanitize_request_id(
+            request.header("X-Request-ID")
+            or request.header("X-Gofr-Request-Id")
+        ) or mint_request_id()
+        verdict = self._admit(request, tenant, request_id)
         if verdict is not None:
             # record construction stays OUTSIDE the ring lock: the lock
             # guards exactly one deque.append per request, so a shed
@@ -453,6 +494,7 @@ class FleetRouter:
             # serializes on dict building
             shed_record = {
                 "ts": time.time(),  # gofrlint: wall-clock — route-record display timestamp
+                "request_id": request_id,
                 "method": request.method, "path": request.path,
                 "tenant": tenant, "attempts": [], "retries": 0,
                 "status": verdict.status,
@@ -488,11 +530,14 @@ class FleetRouter:
             and _deterministic_body(body_json)
         )
         try:
-            return self._forward(
+            response = self._forward(
                 request, tenant, affinity, wants_stream,
                 executor=ctx.container.handler_executor,
                 resumable=resumable, role=role, kv_hash=kv_hash,
+                request_id=request_id,
             )
+            response.headers["X-Gofr-Request-Id"] = request_id
+            return response
         finally:
             # streaming responses decrement in their own finally instead
             # (the handler returns before the body is pulled); _forward
@@ -611,8 +656,15 @@ class FleetRouter:
     def _forward(self, request: Any, tenant: str, affinity: str,
                  wants_stream: bool, executor: Any = None,
                  resumable: bool = False, role: Optional[str] = None,
-                 kv_hash: str = "") -> Response:
+                 kv_hash: str = "", request_id: str = "") -> Response:
         start = time.monotonic()
+        # the router's SERVER span, captured ONCE: every attempt — and
+        # every relay continuation, which re-reads the same headers dict
+        # from a pool thread where the span contextvar is gone — stamps
+        # this traceparent explicitly, so failover hops parent to the
+        # ORIGINAL request span instead of starting fresh traces (the
+        # service client's setdefault respects an existing stamp)
+        span = current_span()
         # the effective budget is the TIGHTER of the router's own
         # forwarding deadline and the client's end-to-end deadline —
         # retrying past what the client will wait for is pure waste
@@ -625,6 +677,8 @@ class FleetRouter:
         headers = self._forward_headers(request)
         record: dict[str, Any] = {
             "ts": time.time(),  # gofrlint: wall-clock — route-record display timestamp
+            "request_id": request_id,
+            "router_id": self.router_id,
             "method": request.method,
             "path": request.path,
             "tenant": tenant,
@@ -642,6 +696,9 @@ class FleetRouter:
             "attempts": [],
             "outcome": "error",
             "status": 0,
+            # monotonic start for the END-TO-END elapsed stamped at
+            # finish ("_"-prefixed: stripped from the admin surface)
+            "_start_mono": start,
         }
         # the donor is decided ONCE per request (the prefill replica
         # rendezvous-owning the prompt's KV), then stamped per attempt
@@ -674,6 +731,22 @@ class FleetRouter:
                 headers["X-KV-Donor"] = donor.address
             else:
                 headers.pop("X-KV-Donor", None)
+            # hop provenance, re-stamped per attempt: which router,
+            # which failover attempt (0-based), resume 0 (continuations
+            # re-stamp their own index in _StreamRelay._try_resume).
+            # Client copies of these headers never reach here — they
+            # are not in _FORWARD_HEADERS — so the replica can trust
+            # the stamp the way it trusts X-KV-Donor.
+            headers["X-Gofr-Request-Id"] = request_id
+            headers["X-Gofr-Hop"] = format_hop(self.router_id, attempts, 0)
+            if span is not None:
+                headers["traceparent"] = span.traceparent()
+            if attempts == 0:
+                # router-overhead stage: admission, body parse, and
+                # selection paid before the FIRST upstream dispatch
+                self._hop_seconds.observe(
+                    time.monotonic() - start, stage="router"
+                )
             if record["attempts"]:
                 # a retry is now CERTAIN (a replica was found and will
                 # be attempted): count it against the attempt it redoes
@@ -721,6 +794,7 @@ class FleetRouter:
         self._finish_record(record, 502)
         body = json.dumps({"error": {
             "message": f"fleet forward failed after {attempts} attempt(s): {detail}",
+            "request_id": request_id or None,
         }}).encode("utf-8")
         return Response(
             status=502,
@@ -838,6 +912,7 @@ class FleetRouter:
         entry["status"] = status
         entry["elapsed_ms"] = round(elapsed * 1000, 1)
         self._upstream_seconds.observe(elapsed, replica=replica.name)
+        self._hop_seconds.observe(elapsed, stage="upstream")
         self._finish_attempt(replica)
         if status >= 500:
             replica.breaker.record_failure()
@@ -864,6 +939,7 @@ class FleetRouter:
         entry["reason"] = reason
         entry["elapsed_ms"] = round(elapsed * 1000, 1)
         self._upstream_seconds.observe(elapsed, replica=replica.name)
+        self._hop_seconds.observe(elapsed, stage="upstream")
         self._finish_attempt(replica)
         replica.breaker.record_failure()
         self._req_total.inc(replica=replica.name, outcome="network_error")
@@ -945,6 +1021,13 @@ class FleetRouter:
     def _finish_record(self, record: dict[str, Any], status: int) -> None:
         record["status"] = status
         record["retries"] = max(0, len(record["attempts"]) - 1)
+        start_mono = record.get("_start_mono")
+        if start_mono is not None and "elapsed_ms" not in record:
+            # end-to-end router-side latency: the minuend the trace
+            # assembly decomposes into router/queue/TTFT/stream stages
+            record["elapsed_ms"] = round(
+                (time.monotonic() - start_mono) * 1000, 1
+            )
         # outcome follows the status CLASS — a forwarded 429 or 404 is
         # not a successful route, and an operator triaging overload
         # from these records must see it agree with the shed metrics
@@ -965,13 +1048,25 @@ class FleetRouter:
             self._records.append(record)
 
     # -- admin surface ---------------------------------------------------------
-    def records(self, limit: int = 50) -> list[dict[str, Any]]:
+    def records(self, limit: int = 50,
+                request_id: Optional[str] = None) -> list[dict[str, Any]]:
+        """Most-recent-first route records. ``request_id`` filters to
+        the records that carried that id (the whole ring is scanned
+        then — an id lookup must not miss a match because 50 newer
+        requests landed)."""
         with self._records_lock:
-            recent = list(self._records)[-limit:]
-        return [
-            {k: v for k, v in r.items() if not k.startswith("_")}
-            for r in reversed(recent)
-        ]
+            recent = (
+                list(self._records) if request_id is not None
+                else list(self._records)[-limit:]
+            )
+        out = []
+        for r in reversed(recent):
+            if request_id is not None and r.get("request_id") != request_id:
+                continue
+            out.append({k: v for k, v in r.items() if not k.startswith("_")})
+            if len(out) >= limit:
+                break
+        return out
 
     def snapshot(self) -> dict[str, Any]:
         """``GET /admin/fleet``: the whole front door on one page. The
@@ -1032,6 +1127,7 @@ class _StreamFinalizer:
         elapsed = time.monotonic() - self._attempt_start
         entry["elapsed_ms"] = round(elapsed * 1000, 1)
         router._upstream_seconds.observe(elapsed, replica=replica.name)
+        router._hop_seconds.observe(elapsed, stage="stream")
         router._finish_attempt(replica)
         if outcome == "upstream_error":
             entry["error"] = "stream aborted mid-body"
@@ -1220,6 +1316,7 @@ class _StreamRelay:
         elapsed = time.monotonic() - self._attempt_start
         self._entry["elapsed_ms"] = round(elapsed * 1000, 1)
         router._upstream_seconds.observe(elapsed, replica=replica.name)
+        router._hop_seconds.observe(elapsed, stage="stream")
         router._finish_attempt(replica)
         if outcome == "upstream_error":
             self._entry["error"] = detail or "stream aborted mid-body"
@@ -1341,6 +1438,17 @@ class _StreamRelay:
             )
             headers = dict(self._resume.headers)
             headers["X-Resume-From"] = str(self._next_id)
+            # hop provenance for the continuation: same router, the
+            # attempt index this entry lands at, and the event id it
+            # resumes from — the replica's FlightRecord origin block
+            # then distinguishes "a fresh attempt" from "a splice".
+            # traceparent rides _resume.headers untouched (stamped once
+            # in _forward), so the continuation parents to the ORIGINAL
+            # request span even from this pool thread.
+            headers["X-Gofr-Hop"] = format_hop(
+                router.router_id, len(self._record["attempts"]),
+                self._next_id,
+            )
             # a budgeted continuation gets the remaining budget, never
             # the original attempt's stale stamp; an opted-out stream
             # stays opted out
@@ -1388,6 +1496,9 @@ class _StreamRelay:
                     replica.breaker.record_success(probe=is_probe)
                     return False
                 router._stream_resumes.inc(outcome="resumed")
+                router._hop_seconds.observe(
+                    time.monotonic() - attempt_start, stage="resume"
+                )
                 return True
             # non-200: drain bounded, close, judge
             try:

@@ -257,7 +257,10 @@ def _query_flag(ctx: Context, name: str) -> Any:
 def requests_admin_handler(ctx: Context) -> Any:
     """GET /admin/requests: recent flight records, newest first.
     ``?slow=``/``?errored=`` filter (the side buffer keeps flagged
-    requests visible after ring eviction); ``?limit=`` bounds the page."""
+    requests visible after ring eviction); ``?request_id=``/
+    ``?trace_id=`` match exactly (the jump from an id in a log line or
+    a router route record to the flight records that carried it);
+    ``?limit=`` bounds the page."""
     from gofr_tpu.errors import InvalidParamError
 
     _check_admin(ctx)
@@ -271,6 +274,8 @@ def requests_admin_handler(ctx: Context) -> Any:
         slow=_query_flag(ctx, "slow"),
         errored=_query_flag(ctx, "errored"),
         limit=limit,
+        request_id=ctx.param("request_id") or None,
+        trace_id=ctx.param("trace_id") or None,
     )
     return {"requests": records, "count": len(records)}
 
@@ -456,7 +461,142 @@ def fleet_admin_handler(ctx: Context) -> Any:
     fleet = getattr(ctx.container, "fleet", None)
     if fleet is None:
         raise HTTPError(503, "fleet not configured (set FLEET_REPLICAS)")
-    return fleet.snapshot()
+    from gofr_tpu.errors import InvalidParamError
+
+    snapshot = fleet.snapshot()
+    request_id = ctx.param("request_id") or ctx.param("trace_id") or None
+    try:
+        limit = int(ctx.param("limit") or "0")
+    except ValueError:
+        raise InvalidParamError('"limit" must be an integer') from None
+    if request_id:
+        snapshot["routes"] = fleet.records(
+            request_id=request_id, limit=limit or 50
+        )
+    elif limit > 0:
+        # trace capture pages deeper than the default view
+        snapshot["routes"] = fleet.records(limit=limit)
+    return snapshot
+
+
+def fleet_trace_handler(ctx: Context) -> Any:
+    """GET /admin/fleet/trace/{id}: ONE causal timeline for a request id
+    across every process it touched — the router's route record joined
+    with each attempt's replica-side flight record (matched on the
+    ``origin`` block the X-Gofr-Hop header stamped) and the KV-transfer
+    ledger entries from donor and receiver, plus a latency decomposition
+    (router overhead / replica queue / device TTFT / stream). A replica
+    that is down or mid-restart degrades the trace to
+    ``partial: true`` with the gap named — never a 500."""
+    from gofr_tpu.errors import HTTPError, InvalidParamError
+    from gofr_tpu.fleet import trace as fleet_trace
+    from gofr_tpu.telemetry import sanitize_request_id
+
+    _check_admin(ctx)
+    fleet = getattr(ctx.container, "fleet", None)
+    if fleet is None:
+        raise HTTPError(503, "fleet not configured (set FLEET_REPLICAS)")
+    request_id = sanitize_request_id(ctx.request.path_param("id"))
+    if request_id is None:
+        raise InvalidParamError(
+            '"id" must be a request id ([A-Za-z0-9._-], <= 64 chars)'
+        )
+    routes = fleet.records(limit=10, request_id=request_id)
+    if not routes:
+        raise HTTPError(
+            404,
+            f"no route record for request id '{request_id}' "
+            "(expired from the ring, or served by another router)",
+        )
+    route = routes[0]  # newest first: the latest routing of this id
+    timeout_s = float(
+        ctx.container.config.get_or_default("FLEET_TRACE_SCRAPE_TIMEOUT_S", "1")
+    )
+    evidence = fleet_trace.gather_evidence(
+        fleet, request_id, route, timeout_s=timeout_s
+    )
+    return fleet_trace.assemble(request_id, route, **evidence)
+
+
+def fleet_overview_handler(ctx: Context) -> Any:
+    """GET /admin/fleet/overview: the fleet-wide ops rollup — one page
+    built from evidence the router already holds (replica snapshots and
+    the prober's piggybacked engine scrapes) plus the router's own
+    timebase trends. No fan-out scrape on request: a replica that
+    stopped answering shows its last-scraped state, it does not stall
+    the overview. The per-process ``/admin/overview`` stays the
+    deep-dive; this is the incident headline across N replicas."""
+    from gofr_tpu.errors import HTTPError
+
+    _check_admin(ctx)
+    container = ctx.container
+    fleet = getattr(container, "fleet", None)
+    if fleet is None:
+        raise HTTPError(503, "fleet not configured (set FLEET_REPLICAS)")
+    states: dict[str, int] = {}
+    restarts = 0
+    kv_free = kv_total = 0
+    kv_seen = False
+    transfers: dict[str, int] = {}
+    brownout_max = 0
+    replicas = []
+    for replica in fleet.replica_set.replicas:
+        snap = replica.snapshot()
+        state = snap.get("state") or "unknown"
+        states[state] = states.get(state, 0) + 1
+        restarts += int(snap.get("restarts") or 0)
+        engine = snap.get("engine") or {}
+        if isinstance(engine.get("kv_free"), int) and isinstance(
+            engine.get("kv_total"), int
+        ):
+            kv_seen = True
+            kv_free += engine["kv_free"]
+            kv_total += engine["kv_total"]
+        ledger = engine.get("kv_transfer") or {}
+        for outcome, count in ledger.items():
+            # outcome counters only: skip the recents lists and the
+            # `enabled` bool (bool IS an int to isinstance)
+            if isinstance(count, int) and not isinstance(count, bool):
+                transfers[outcome] = transfers.get(outcome, 0) + count
+        level = engine.get("brownout_level")
+        if isinstance(level, int):
+            brownout_max = max(brownout_max, level)
+        replicas.append({
+            "name": snap.get("name"),
+            "state": state,
+            "role": snap.get("role"),
+            "outstanding": snap.get("outstanding"),
+            "saturated": snap.get("saturated"),
+            "restarts": snap.get("restarts"),
+            "queue_depth": engine.get("queue_depth"),
+            "kv_free": engine.get("kv_free"),
+            "kv_total": engine.get("kv_total"),
+            "brownout_level": level,
+        })
+    timebase = container.timebase
+    return {
+        "ts": time.time(),  # gofrlint: wall-clock — overview response timestamp (display)
+        "router_id": fleet.router_id,
+        "replicas": replicas,
+        "states": states,
+        "restarts_total": restarts,
+        "kv_utilization": (
+            round(1.0 - kv_free / kv_total, 4)
+            if kv_seen and kv_total else None
+        ),
+        "kv_free": kv_free if kv_seen else None,
+        "kv_total": kv_total if kv_seen else None,
+        "kv_transfers": transfers,
+        "brownout_level_max": brownout_max,
+        "req_per_sec": _trend(
+            timebase.rate_total("gofr_tpu_router_requests_total")
+        ),
+        "upstream_p95_s": _trend(
+            timebase.hist_quantile_trend("gofr_tpu_router_upstream_seconds", 0.95)
+        ),
+        "in_flight": fleet.in_flight,
+        "draining": fleet.draining,
+    }
 
 
 def kv_export_handler(ctx: Context) -> Response:
@@ -498,7 +638,17 @@ def kv_export_handler(ctx: Context) -> Response:
     deadline = parse_deadline(
         ctx.request.header("X-Request-Deadline-Ms"), default_s
     )
-    export = tpu.kv_export(prompt_hash)
+    # the requesting id (the receiver forwards its own origin id):
+    # lands in the donor's served ledger so /admin/fleet/trace/<id>
+    # can show which donor streamed this request's warm blocks
+    from gofr_tpu.telemetry import sanitize_request_id
+
+    export = tpu.kv_export(
+        prompt_hash,
+        request_id=sanitize_request_id(
+            ctx.request.header("X-Gofr-Request-Id")
+        ) or "",
+    )
     if export is None:
         raise HTTPError(
             404,

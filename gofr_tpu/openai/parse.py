@@ -56,6 +56,16 @@ def _admit_request(ctx: Any, max_tokens: int) -> int:
     from gofr_tpu.fleet.kvwire import activate_kv_hint, parse_kv_hint
 
     activate_kv_hint(parse_kv_hint(ctx.request.header("X-KV-Donor")))
+    # fleet origin: the router-stamped request id + hop block travel the
+    # same way — a contextvar the FlightRecord reads at start, so the
+    # replica-side record joins the router's route record on
+    # /admin/fleet/trace/<id>. Garbage headers degrade to no origin.
+    from gofr_tpu.telemetry import activate_origin, origin_from_headers
+
+    activate_origin(origin_from_headers(
+        ctx.request.header("X-Gofr-Request-Id"),
+        ctx.request.header("X-Gofr-Hop"),
+    ))
     brownout = getattr(ctx.tpu, "brownout", None)
     if brownout is not None:
         admitted, max_tokens, level = brownout.admit(priority, max_tokens)

@@ -82,6 +82,102 @@ def current_record() -> Optional["FlightRecord"]:
     return _current_record.get()
 
 
+# -- fleet-wide request origin (cross-process hop correlation) ---------------
+#
+# The fleet router stamps every forward with ``X-Gofr-Request-Id`` (the
+# fleet-wide correlation id, minted once — or honored from a sanitized
+# client ``X-Request-ID``) and ``X-Gofr-Hop`` (which router, which
+# failover attempt, which resume continuation). Replicas parse both at
+# admission into a contextvar — the same pattern the deadline and the
+# KV-donor hint ride — and every FlightRecord born under it carries an
+# ``origin`` block, so ``GET /admin/fleet/trace/<id>`` can join the
+# router's route record with the replica-side flight records it caused.
+
+# request ids are operator-facing correlation keys that end up in log
+# lines, URLs and admin queries: bound length, restrict charset, and
+# treat anything else as absent (garbage degrades to a minted id, never
+# to a 4xx — same discipline as parse_kv_hint)
+REQUEST_ID_MAX_LEN = 64
+_REQUEST_ID_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-"
+)
+
+_current_origin: contextvars.ContextVar[Optional[dict]] = (
+    contextvars.ContextVar("gofr_request_origin", default=None)
+)
+
+
+def sanitize_request_id(raw: Any) -> Optional[str]:
+    """Validate a request id off the wire: non-empty, at most
+    ``REQUEST_ID_MAX_LEN`` chars, charset ``[A-Za-z0-9._-]``. Returns
+    the id or None — callers mint their own on None, never reject."""
+    if not raw or not isinstance(raw, str):
+        return None
+    value = raw.strip()
+    if not value or len(value) > REQUEST_ID_MAX_LEN:
+        return None
+    if not all(c in _REQUEST_ID_CHARS for c in value):
+        return None
+    return value
+
+
+def format_hop(router_id: str, attempt: int, resume_from: int = 0) -> str:
+    """The ``X-Gofr-Hop`` wire value the router stamps per forward."""
+    return f"router={router_id};attempt={int(attempt)};resume={int(resume_from)}"
+
+
+def parse_hop(raw: Any) -> Optional[dict]:
+    """Parse an ``X-Gofr-Hop`` header (``router=<id>;attempt=<n>;
+    resume=<n>``) into ``{"router", "attempt", "resume_from"}``.
+    Malformed input returns None — hop metadata is telemetry, never a
+    reason to fail a request."""
+    if not raw or not isinstance(raw, str) or len(raw) > 256:
+        return None
+    fields: dict[str, str] = {}
+    for part in raw.strip().split(";"):
+        key, sep, value = part.partition("=")
+        if sep:
+            fields[key.strip()] = value.strip()
+    router = sanitize_request_id(fields.get("router", ""))
+    if router is None:
+        return None
+    try:
+        attempt = int(fields.get("attempt", ""))
+        resume_from = int(fields.get("resume", "0"))
+    except ValueError:
+        return None
+    if attempt < 0 or resume_from < 0:
+        return None
+    return {"router": router, "attempt": attempt, "resume_from": resume_from}
+
+
+def activate_origin(origin: Optional[dict]) -> Any:
+    """Bind the request's fleet origin (``{"request_id", "router",
+    "attempt", "resume_from"}``; None clears) so the FlightRecord born
+    downstream stamps it. Returns the contextvar reset token."""
+    return _current_origin.set(origin)
+
+
+def current_origin() -> Optional[dict]:
+    """The in-flight request's fleet origin block, if the router
+    stamped one (None on direct, router-less requests)."""
+    return _current_origin.get()
+
+
+def origin_from_headers(request_id_raw: Any, hop_raw: Any) -> Optional[dict]:
+    """Build the origin block from the two router-stamped headers.
+    Either header alone still yields a (partial) origin; both absent or
+    garbage yields None."""
+    request_id = sanitize_request_id(request_id_raw)
+    hop = parse_hop(hop_raw)
+    if request_id is None and hop is None:
+        return None
+    origin: dict[str, Any] = {"request_id": request_id or ""}
+    if hop is not None:
+        origin.update(hop)
+    return origin
+
+
 def exemplar_provider() -> Optional[dict]:
     """Default metrics exemplar provider (metrics.py Histogram): the
     correlating ids of the CURRENT observation — the active request's
@@ -127,7 +223,8 @@ class FlightRecord:
     against ONE record, and ``+=`` is a read-modify-write."""
 
     __slots__ = (
-        "trace_id", "model", "endpoint", "status", "error", "stream",
+        "trace_id", "request_id", "origin",
+        "model", "endpoint", "status", "error", "stream",
         "tokens_in", "tokens_out", "batch_size", "pool_cohort",
         "prefill_chunks", "prefill_bucket", "sched_defer_s",
         "pool_reject_reason", "dispatch_ids",
@@ -155,6 +252,19 @@ class FlightRecord:
         stream: bool = False,
     ):
         self.trace_id = trace_id
+        # fleet origin: the router-stamped request id + hop block, read
+        # off the origin contextvar exactly like the deadline below —
+        # this is what lets /admin/fleet/trace/<id> find the replica
+        # flight records one routed request caused
+        origin = current_origin()
+        self.request_id = origin.get("request_id", "") if origin else ""
+        self.origin = None
+        if origin and "router" in origin:
+            self.origin = {
+                "router": origin.get("router"),
+                "attempt": origin.get("attempt"),
+                "resume_from": origin.get("resume_from"),
+            }
         self.model = model
         self.endpoint = endpoint
         self.status = "in_flight"
@@ -374,6 +484,8 @@ class FlightRecord:
         return {
             "event": "request_flight",
             "trace_id": self.trace_id,
+            "request_id": self.request_id or None,
+            "origin": self.origin,
             "model": self.model,
             "endpoint": self.endpoint,
             "status": self.status,
@@ -918,10 +1030,14 @@ class FlightRecorder:
         slow: Optional[bool] = None,
         errored: Optional[bool] = None,
         limit: int = 100,
+        request_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> list[dict[str, Any]]:
         """Most-recent-first record dicts. ``slow=True``/``errored=True``
-        filter; the side buffer is merged in so flagged requests stay
-        visible after ring eviction."""
+        filter; ``request_id``/``trace_id`` match exactly (the jump from
+        an id in a log line to the records that carried it); the side
+        buffer is merged in so flagged requests stay visible after ring
+        eviction."""
         with self._lock:
             merged: list[FlightRecord] = list(self._ring)
             seen = {id(r) for r in merged}
@@ -932,6 +1048,10 @@ class FlightRecorder:
             if slow is not None and self.is_slow(record) != slow:
                 continue
             if errored is not None and (record.status != "ok") != errored:
+                continue
+            if request_id is not None and record.request_id != request_id:
+                continue
+            if trace_id is not None and record.trace_id != trace_id:
                 continue
             out.append(record.to_dict())
             if len(out) >= limit:

@@ -113,6 +113,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -486,6 +487,13 @@ class TPUDevice:
             "fallback": 0, "served": 0,
         }
         self._kv_transfer_lock = threading.Lock()
+        # per-transfer evidence ledgers (bounded rings, both served on
+        # /admin/engine under `kv_transfer`): the donor's recent serves
+        # and the receiver's recent pulls, each stamped with the fleet
+        # request id that caused it — the donor-transfer leg
+        # /admin/fleet/trace/<id> joins into its causal timeline
+        self._kv_served_ledger: deque = deque(maxlen=64)
+        self._kv_pull_ledger: deque = deque(maxlen=64)
 
 
     def _parse_serving_config(self, config: Any) -> None:
@@ -1215,6 +1223,8 @@ class TPUDevice:
     def kv_transfer_snapshot(self) -> dict:
         with self._kv_transfer_lock:
             out: dict[str, Any] = dict(self.kv_transfer_stats)
+            out["served_recent"] = [dict(e) for e in self._kv_served_ledger]
+            out["pulls_recent"] = [dict(e) for e in self._kv_pull_ledger]
         out["enabled"] = self.kv_transfer_enabled
         return out
 
@@ -1225,7 +1235,8 @@ class TPUDevice:
                 self.kv_transfer_stats.get(outcome, 0) + 1
             )
 
-    def kv_export(self, prompt_hash: str) -> Optional[tuple]:
+    def kv_export(self, prompt_hash: str,
+                  request_id: str = "") -> Optional[tuple]:
         """Donor side of a KV pull: locate the cached entry whose key
         hashes to ``prompt_hash`` and PIN its blocks for the transfer
         (a concurrent admission evicting the entry mid-send must not
@@ -1296,6 +1307,12 @@ class TPUDevice:
         })
         with self._kv_transfer_lock:
             self.kv_transfer_stats["served"] += 1
+            self._kv_served_ledger.append({
+                "ts": time.time(),  # gofrlint: wall-clock — ledger display timestamp
+                "prompt_hash": prompt_hash,
+                "request_id": request_id or None,
+                "n_blocks": nb,
+            })
         return spec, BlockTable(blocks, length), arena, pin
 
     def prefetch_kv(self, tokens: Any) -> None:
@@ -1328,7 +1345,26 @@ class TPUDevice:
         with store.pool.lock:
             if store.pool.cache_lookup(ids.tobytes()) is not None:
                 return  # already warm locally — no pull, no fallback
+        pull_start = time.perf_counter()
         outcome = self._pull_kv(hint, ids, store)
+        # receiver-side transfer ledger: which donor, what outcome, how
+        # long, for which fleet request — the receiving half of the
+        # transfer evidence /admin/fleet/trace/<id> assembles
+        from gofr_tpu.fleet.kvwire import prompt_hash as _phash
+        from gofr_tpu.telemetry import current_origin
+
+        origin = current_origin()
+        with self._kv_transfer_lock:
+            self._kv_pull_ledger.append({
+                "ts": time.time(),  # gofrlint: wall-clock — ledger display timestamp
+                "donor": hint,
+                "prompt_hash": _phash(ids),
+                "outcome": outcome,
+                "request_id": (origin or {}).get("request_id") or None,
+                "elapsed_ms": round(
+                    (time.perf_counter() - pull_start) * 1000, 1
+                ),
+            })
         if outcome == "ok":
             self._note_transfer("ok")
             return
@@ -1367,6 +1403,14 @@ class TPUDevice:
             headers = {
                 "X-Request-Deadline-Ms": str(max(1, int(budget * 1000)))
             }
+            # forward the originating fleet request id so the DONOR's
+            # served ledger carries it too (both halves of the transfer
+            # then join on one id in the assembled trace)
+            from gofr_tpu.telemetry import current_origin
+
+            origin = current_origin()
+            if origin and origin.get("request_id"):
+                headers["X-Gofr-Request-Id"] = origin["request_id"]
             if self._kv_admin_token:
                 headers["Authorization"] = f"Bearer {self._kv_admin_token}"
             streaming = client.stream(

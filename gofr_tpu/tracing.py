@@ -129,9 +129,23 @@ class ZipkinExporter:
         self.flush_interval = flush_interval
         self._queue: "queue.Queue[Optional[Span]]" = queue.Queue(maxsize=max_queue)
         self.post_failures = 0  # rejected/unreachable collector posts
+        self._drop_counter: Any = None  # attach_metrics wires it
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="gofr-zipkin", daemon=True)
         self._thread.start()
+
+    def attach_metrics(self, metrics: Any) -> None:
+        """Count exporter drops on the metrics registry: a dead/refusing
+        collector silently eating spans was only visible by reading
+        ``post_failures`` off the object — now an alert can watch it.
+        Called after the container builds its registry (the exporter is
+        constructed before metrics exist at init_tracer time)."""
+        self._drop_counter = metrics.counter(
+            "gofr_tpu_trace_export_failures_total",
+            "zipkin span batches dropped: the collector POST failed "
+            "(unreachable, refused, or timed out) — spans in the batch "
+            "are lost; see also ZipkinExporter.post_failures",
+        )
 
     def export(self, span: Span) -> None:
         try:
@@ -190,6 +204,8 @@ class ZipkinExporter:
             # tracing must never take the app down — but a dead
             # collector should be diagnosable, so count the failures
             self.post_failures += 1
+            if self._drop_counter is not None:
+                self._drop_counter.inc()
 
 
 class Tracer:

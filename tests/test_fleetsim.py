@@ -487,3 +487,45 @@ def test_fleetsim_smoke_process_kill_two_routers(tmp_path, monkeypatch):
     assert slo["shed"]["p9"] == 0
     assert slo["pools_idle"], artifact["scenario"]["applied"]
     assert slo["errors"] <= 3, slo["error_detail"]
+
+
+# -- capture -> replay round trip ---------------------------------------------
+
+def test_fleetsim_capture_then_replay_round_trip(tmp_path, monkeypatch):
+    """Production traffic becomes a regression suite: a small live run
+    scrapes its OWN route + flight records into a TRACE_CAPTURE
+    artifact (seeded anonymization), and a second run driven by
+    ``replay=`` replays those exact events — trace digest equal to the
+    capture's, ``replay_of`` stamped, and the replay itself digest-
+    stable (the determinism the CI fleet-sim smoke leans on)."""
+    from gofr_tpu.devtools.trace_capture import load_capture
+
+    monkeypatch.chdir(tmp_path)
+    cap_path = tmp_path / "capture.json"
+    spec = TraceSpec(requests=30, base_rps=25.0, seed=21)
+    sim = FleetSim(
+        n_replicas=3, n_prefill=1, seed=21, spec=spec,
+        quota_rps=30.0, quota_burst=60.0, workers=6,
+        measure_hardening=False, capture_out=str(cap_path),
+    )
+    artifact = sim.run()
+    block = artifact["capture"]
+    assert block["path"] == str(cap_path)
+    assert block["requests"] > 0
+    capture = load_capture(str(cap_path))  # digest verified on load
+    assert capture["digest"] == block["digest"]
+    # raw tenant names never leak into the capture (t0/t1... are the
+    # sim's real tenant ids; captured events carry seeded hashes)
+    blob = json.dumps(capture["events"])
+    assert '"t0"' not in blob and '"t1"' not in blob
+
+    replay_sim = FleetSim(
+        n_replicas=3, n_prefill=1, seed=21,
+        quota_rps=30.0, quota_burst=60.0, workers=6,
+        measure_hardening=False, replay=capture,
+    )
+    replayed = replay_sim.run()
+    assert replayed["trace"]["digest"] == capture["digest"]
+    assert replayed["trace"]["replay_of"] == capture["digest"]
+    assert replayed["slo"]["requests"] == len(capture["events"])
+    assert replayed["slo"]["ok"] > 0

@@ -229,6 +229,21 @@ def test_gfl005_router_family_covered():
         ["GFL005"]
 
 
+def test_gfl005_trace_family_covered():
+    """The fleet-tracing family (PR 16): the per-hop latency histogram
+    (router.py) and the zipkin exporter drop counter (tracing.py) pass;
+    suffix drift within the family still fails."""
+    assert lint('m.histogram("gofr_tpu_router_hop_seconds", "h")\n') == []
+    assert lint(
+        'm.counter("gofr_tpu_trace_export_failures_total", "z")\n'
+    ) == []
+    assert rules_of(lint('m.histogram("gofr_tpu_router_hop", "h")\n')) == \
+        ["GFL005"]
+    assert rules_of(
+        lint('m.counter("gofr_tpu_trace_export_failures", "z")\n')
+    ) == ["GFL005"]
+
+
 # -- GFL006: swallowed exceptions ---------------------------------------------
 
 def test_gfl006_bare_except_everywhere():

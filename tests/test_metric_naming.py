@@ -90,6 +90,11 @@ def test_scanner_sees_the_known_registrations():
     # EMA and tokens-per-dispatch gauges stay scan-visible
     assert {"gofr_tpu_spec_accept_ratio",
             "gofr_tpu_spec_tokens_per_dispatch"} <= names
+    # fleet-wide tracing (PR 16): the per-hop latency decomposition
+    # histogram (router.py) and the zipkin exporter drop counter
+    # (tracing.py attach_metrics)
+    assert {"gofr_tpu_router_hop_seconds",
+            "gofr_tpu_trace_export_failures_total"} <= names
     assert len(names) >= 35
 
 

@@ -61,7 +61,15 @@ baseline:
   the fast path (``fallbacks == 0`` — a silent fallback would make
   the latency number a lie), and one pull's wire size must stay
   within ``baseline * 2`` (framing bloat: checksums + headers are
-  bounded, payload is the payload).
+  bounded, payload is the payload);
+- fleet tracing must stay cheap on both sides: the per-request hop
+  stamp (request-id sanitize + ``X-Gofr-Hop`` mint + parse-back, paid
+  on the router hot path) within ``baseline stamp_us *
+  BENCH_GATE_TRACE_FACTOR`` and one ``/admin/fleet/trace`` timeline
+  assembly within ``baseline assemble_us`` times the same factor
+  (default 10.0, loose-first — stamping is string work that must stay
+  microseconds; a blow-up means the correlation layer started taxing
+  every routed request).
 
 Usage::
 
@@ -99,6 +107,7 @@ def gate(bench: dict, baseline: dict) -> list[str]:
         os.environ.get("BENCH_GATE_TRANSFER_FACTOR", "10.0")
     )
     spec_factor = float(os.environ.get("BENCH_GATE_SPEC_FACTOR", "1.5"))
+    trace_factor = float(os.environ.get("BENCH_GATE_TRACE_FACTOR", "10.0"))
 
     if bench.get("backend") != baseline.get("backend"):
         failures.append(
@@ -293,6 +302,24 @@ def gate(bench: dict, baseline: dict) -> list[str]:
                         f"kv wire format bloated: {wire} bytes/pull > "
                         f"baseline {base_wire} * 2"
                     )
+    trace = bench.get("trace_microbench") or {}
+    base_trace = baseline.get("trace_microbench") or {}
+    if base_trace:
+        for key, what in (
+            ("stamp_us", "per-request hop stamp"),
+            ("assemble_us", "trace assembly"),
+        ):
+            got, base = _num(trace, key), _num(base_trace, key)
+            if got is None:
+                failures.append(
+                    f"trace_microbench.{key} missing from the bench artifact"
+                )
+            elif base and got > base * trace_factor:
+                failures.append(
+                    f"fleet-tracing {what} regression: {got}us > "
+                    f"{base}us * {trace_factor} "
+                    f"(= {base * trace_factor:.2f}us)"
+                )
     return failures
 
 

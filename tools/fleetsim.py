@@ -9,6 +9,14 @@ Usage::
 
     python tools/fleetsim.py [--replicas 16] [--seed 20260803]
         [--requests 240] [--out FLEETSIM.json] [--no-hardening]
+        [--replay capture.json] [--capture-out capture.json]
+
+``--replay`` drives a ``TRACE_CAPTURE`` artifact (from
+``tools/trace_capture.py`` or a prior ``--capture-out``) through the
+harness instead of a synthetic trace — captured production traffic
+reruns deterministically under the same absolute SLO gate.
+``--capture-out`` scrapes THIS run's served traffic into such an
+artifact before teardown (the CI round trip chains the two).
 
 The artifact prints on stdout (and writes to ``--out``). Gate it with
 ``python tools/fleetsim_gate.py FLEETSIM.json fleetsim_baseline.json``.
@@ -57,6 +65,12 @@ def main(argv: list[str]) -> int:
     parser.add_argument("--out", default="")
     parser.add_argument("--no-hardening", action="store_true",
                         help="skip the before/after micro-measures")
+    parser.add_argument("--replay", default="",
+                        help="TRACE_CAPTURE file to drive instead of a "
+                        "synthetic trace (see tools/trace_capture.py)")
+    parser.add_argument("--capture-out", default="",
+                        help="write this run's served traffic as a "
+                        "TRACE_CAPTURE file before teardown")
     args = parser.parse_args(argv[1:])
 
     # sanitizer-armed when the environment asks (the CI fleet-sim job
@@ -70,6 +84,17 @@ def main(argv: list[str]) -> int:
         sanitizer.install()
 
     from gofr_tpu.devtools.fleetsim import FleetSim, TraceSpec
+
+    replay = None
+    if args.replay:
+        from gofr_tpu.devtools.trace_capture import load_capture
+
+        replay = load_capture(args.replay)
+        print(
+            f"fleetsim: replaying {replay['requests']} captured events "
+            f"(digest {replay['digest'][:16]}…)",
+            file=sys.stderr, flush=True,
+        )
 
     t0 = time.monotonic()
     sim = FleetSim(
@@ -85,6 +110,8 @@ def main(argv: list[str]) -> int:
         scenario=args.scenario,
         measure_hardening=not args.no_hardening,
         progress=lambda msg: print(msg, file=sys.stderr, flush=True),
+        replay=replay,
+        capture_out=args.capture_out,
     )
     artifact = sim.run()
     artifact["wall_s"] = round(time.monotonic() - t0, 1)
