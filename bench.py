@@ -615,6 +615,17 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
             except Exception as exc:
                 errors.append(f"trace phase: {exc}")
                 traceback.print_exc(file=sys.stderr)
+            # -- phase: dispatch cost model overhead ---------------------------
+            # what predict (begin) + residual accounting (finish) adds
+            # to every dispatch record — the tax the residual
+            # watchtower levies on the hot path; gated loose-first
+            # against bench_baseline.json (BENCH_GATE_COSTMODEL_FACTOR)
+            try:
+                result["costmodel_microbench"] = _measure_costmodel()
+                log(f"costmodel: {result['costmodel_microbench']}")
+            except Exception as exc:
+                errors.append(f"costmodel phase: {exc}")
+                traceback.print_exc(file=sys.stderr)
             engine_live = _scrape_engine(base)
             if engine_live.get("kv_blocks") is not None:
                 result["kv_blocks"] = engine_live["kv_blocks"]
@@ -1022,6 +1033,51 @@ def _measure_journal_wal() -> dict:
     mem_per_tok = max(out["per_token_us_mem"], 1e-6)
     out["wal_factor"] = round(out["per_token_us_wal"] / mem_per_tok, 2)
     return out
+
+
+def _measure_costmodel() -> dict:
+    """Dispatch cost-model overhead (tpu/costmodel.py): the same
+    begin/finish loop through a DispatchTimeline with and without the
+    cost model wired — what roofline prediction (begin) plus residual
+    EMA accounting + anomaly verdicts (finish) add to each dispatch
+    record. Host-side and compile-free; the loop's predictions are
+    healthy (zero anomalies) because that is the hot path's steady
+    state — anomaly emission is by design rare. The gate holds
+    ``per_dispatch_us`` against bench_baseline.json
+    (``BENCH_GATE_COSTMODEL_FACTOR``)."""
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.tpu.costmodel import CostModel
+    from gofr_tpu.tpu.introspect import DispatchTimeline
+
+    n = int(os.environ.get("BENCH_COSTMODEL_DISPATCHES", "5000"))
+
+    def run(costmodel) -> float:
+        timeline = DispatchTimeline(
+            capacity=512, metrics=Registry(), costmodel=costmodel
+        )
+        start = time.perf_counter()
+        for i in range(n):
+            drec = timeline.begin(
+                "prefill", bucket=64, batch_size=(i % 4) + 1, tokens=64
+            )
+            drec.mark_running()
+            timeline.finish(drec)
+        return time.perf_counter() - start
+
+    baseline_s = run(None)
+    costmodel = CostModel(metrics=Registry())
+    costmodel.calibrate("cpu", "cpu")
+    # a synthetic sheet generous enough that instantaneous begin/finish
+    # never trips the anomaly floor — steady-state cost, not event cost
+    costmodel.install_synthetic("prefill", 5.0)
+    modeled_s = run(costmodel)
+    return {
+        "dispatches": n,
+        "per_dispatch_us": round(modeled_s / n * 1e6, 4),
+        "baseline_per_dispatch_us": round(baseline_s / n * 1e6, 4),
+        "overhead_us": round(max(modeled_s - baseline_s, 0.0) / n * 1e6, 4),
+        "anomalies": costmodel.ring.total(),  # MUST stay 0 (healthy loop)
+    }
 
 
 def _measure_shed() -> dict:

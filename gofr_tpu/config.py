@@ -177,6 +177,25 @@ remaining deadline budget), ``SPEC_FAKE_ACCEPT`` (echo runner only: a
 cyclic schedule of per-cycle accept counts, e.g. "3,1,0", making
 every accept/reject/rollback branch deterministic in tier-1).
 
+Dispatch-cost-model keys (tpu/costmodel.py, see
+docs/advanced-guide/observability.md "Cost model & anomalies"):
+``COSTMODEL`` (on — per-dispatch roofline prediction + residual
+accounting + the anomaly surface; off removes the whole layer),
+``COSTMODEL_PROFILE`` (path to a cost-profile JSON; default the
+committed ``gofr_tpu/tpu/cost_profile.json`` — ``tools/costcal.py``
+owns the fit), ``COSTMODEL_HLO`` (``auto`` — harvest
+``cost_analysis()`` sheets by recompiling prefill buckets at warmup on
+TPU only; ``on`` forces it, ``off`` skips it — tier-1/CPU never pays
+the recompiles), ``COSTMODEL_ANOMALY_FACTOR`` (4 — observed past this
+multiple of predicted flags ``slow_dispatch``),
+``COSTMODEL_MIN_ANOMALY_MS`` (50 — absolute excess floor both anomaly
+causes must ALSO clear; the no-false-positive guarantee for
+microsecond dispatches), ``COSTMODEL_EMA_ALPHA`` (0.2) /
+``COSTMODEL_EMA_BAND`` (2.5) govern the per-family residual EMA and
+its ``ema_drift`` verdict (latched per excursion), and
+``ANOMALY_RING_SIZE`` (256) bounds the typed-event ring behind
+``GET /admin/anomalies``.
+
 Correctness-tooling keys (devtools/sanitizer.py + tests/conftest.py,
 see docs/advanced-guide/static-analysis.md): ``GOFR_SANITIZE=1`` arms
 the runtime concurrency sanitizer under tests;

@@ -338,6 +338,64 @@ def dispatches_admin_handler(ctx: Context) -> Any:
     return {"dispatches": records, "count": len(records)}
 
 
+def costmodel_admin_handler(ctx: Context) -> Any:
+    """GET /admin/costmodel: the dispatch cost model on one page — the
+    calibration in force (profile row + provenance), every cost sheet
+    (HLO-harvested or synthetic, source labeled), per-family residual
+    EMAs, anomaly thresholds, ring stats, and the anomaly-rate trend
+    from the timebase ring. Host-side reads only."""
+    from gofr_tpu.errors import HTTPError
+
+    _check_admin(ctx)
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    costmodel = getattr(ctx.tpu, "costmodel", None)
+    if costmodel is None:
+        raise HTTPError(503, "cost model disabled (set COSTMODEL=on)")
+    out = costmodel.snapshot()
+    out["anomalies_per_sec"] = _trend(
+        ctx.container.timebase.rate_total("gofr_tpu_dispatch_anomalies_total")
+    )
+    return out
+
+
+def anomalies_admin_handler(ctx: Context) -> Any:
+    """GET /admin/anomalies: the anomaly surface — typed events the cost
+    model raised when a dispatch blew past its prediction
+    (``slow_dispatch``) or a family's residual EMA left the band
+    (``ema_drift``), newest first. ``?kind=`` / ``?cause=`` filter;
+    ``?limit=`` bounds the page (default 100). A healthy engine serves
+    an EMPTY list — every entry here is a regression with a dispatch id
+    attached."""
+    from gofr_tpu.errors import HTTPError, InvalidParamError
+    from gofr_tpu.tpu.costmodel import ANOMALY_CAUSES
+
+    _check_admin(ctx)
+    if ctx.tpu is None:
+        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
+    costmodel = getattr(ctx.tpu, "costmodel", None)
+    if costmodel is None:
+        raise HTTPError(503, "cost model disabled (set COSTMODEL=on)")
+    try:
+        limit = int(ctx.param("limit") or "100")
+    except ValueError:
+        raise InvalidParamError('"limit" must be an integer') from None
+    if limit < 1:
+        raise InvalidParamError('"limit" must be >= 1')
+    cause = ctx.param("cause") or None
+    if cause is not None and cause not in ANOMALY_CAUSES:
+        raise InvalidParamError(
+            f'"cause" must be one of {", ".join(ANOMALY_CAUSES)}'
+        )
+    kind = ctx.param("kind") or None
+    events = costmodel.ring.events(limit=limit, kind=kind, cause=cause)
+    return {
+        "anomalies": events,
+        "count": len(events),
+        "stats": costmodel.ring.stats(),
+    }
+
+
 def timeseries_admin_handler(ctx: Context) -> Any:
     """GET /admin/timeseries: retained metric history from the timebase
     ring. ``?metric=`` (required) names a registered metric;
@@ -421,6 +479,17 @@ def overview_admin_handler(ctx: Context) -> Any:
     out["platform"] = tpu.platform
     out["watchdog"] = tpu.watchdog.snapshot()
     out["dispatches"] = tpu.timeline.stats()
+    costmodel = getattr(tpu, "costmodel", None)
+    if costmodel is not None:
+        # cost-model headline: sheet count, worst residual EMA, anomaly
+        # totals + rate trend (zero on a healthy engine — any other
+        # number is the page's loudest line)
+        out["costmodel"] = costmodel.overview()
+        out["anomalies_per_sec"] = _trend(
+            timebase.rate_total("gofr_tpu_dispatch_anomalies_total")
+        )
+    else:
+        out["costmodel"] = None
     batcher = getattr(tpu, "batcher", None)
     out["queue_depth"] = batcher._depth() if batcher is not None else None
     pool = getattr(tpu, "decode_pool", None)
@@ -539,6 +608,8 @@ def fleet_overview_handler(ctx: Context) -> Any:
     kv_seen = False
     transfers: dict[str, int] = {}
     brownout_max = 0
+    anomalies_total = 0
+    anomalies_seen = False
     replicas = []
     for replica in fleet.replica_set.replicas:
         snap = replica.snapshot()
@@ -561,6 +632,10 @@ def fleet_overview_handler(ctx: Context) -> Any:
         level = engine.get("brownout_level")
         if isinstance(level, int):
             brownout_max = max(brownout_max, level)
+        anomalies = engine.get("anomalies")
+        if isinstance(anomalies, int) and not isinstance(anomalies, bool):
+            anomalies_seen = True
+            anomalies_total += anomalies
         replicas.append({
             "name": snap.get("name"),
             "state": state,
@@ -572,6 +647,10 @@ def fleet_overview_handler(ctx: Context) -> Any:
             "kv_free": engine.get("kv_free"),
             "kv_total": engine.get("kv_total"),
             "brownout_level": level,
+            # cost-model residual watchtower, per replica: which box is
+            # blowing its predictions (scraped off /admin/engine)
+            "anomalies": anomalies,
+            "worst_residual_ema": engine.get("worst_residual_ema"),
         })
     timebase = container.timebase
     return {
@@ -588,6 +667,7 @@ def fleet_overview_handler(ctx: Context) -> Any:
         "kv_total": kv_total if kv_seen else None,
         "kv_transfers": transfers,
         "brownout_level_max": brownout_max,
+        "anomalies_total": anomalies_total if anomalies_seen else None,
         "req_per_sec": _trend(
             timebase.rate_total("gofr_tpu_router_requests_total")
         ),

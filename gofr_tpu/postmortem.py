@@ -69,7 +69,8 @@ SCHEMA = "gofr-postmortem/1"
 # config keys worth carrying in the fingerprint: every framework prefix
 # (the bundle must reproduce the serving shape, not the whole shell env)
 CONFIG_PREFIXES = (
-    "ADMIN_", "APP_", "BATCH_", "BENCH_", "COMPILE_", "DECODE_",
+    "ADMIN_", "ANOMALY_", "APP_", "BATCH_", "BENCH_", "COMPILE_",
+    "COSTMODEL_", "DECODE_",
     "DISPATCH_", "ECHO_", "FLIGHT_", "GEN_", "GRPC_", "HANDLER_", "HTTP_",
     "LOG_", "METRICS_", "MODEL_", "POSTMORTEM_", "PREFILL_", "PREFIX_",
     "SCHED_", "SPEC_", "TIMEBASE_", "TOKENIZER", "TPU_", "TRACER_",
@@ -279,6 +280,19 @@ class PostmortemStore:
             timeline = getattr(tpu, "timeline", None)
             if timeline is not None:
                 out["dispatches"] = timeline.records(limit=1_000_000)
+            costmodel = getattr(tpu, "costmodel", None)
+            if costmodel is not None:
+                # the residual watchtower's state at death: calibration,
+                # sheets, per-family residual EMAs, and the full anomaly
+                # ring — "was the engine already blowing its predictions
+                # before it wedged" is the first postmortem question
+                try:
+                    out["costmodel"] = costmodel.snapshot()
+                    out["anomalies"] = costmodel.ring.events(
+                        limit=costmodel.ring.capacity
+                    )
+                except Exception as exc:
+                    out["costmodel"] = {"error": repr(exc)}
         return out
 
     def _write_atomic(self, bundle: dict[str, Any]) -> str:

@@ -213,18 +213,27 @@ class TimebaseSampler:
         }
 
     def rate_total(
-        self, metric: str, window: Optional[float] = None
+        self,
+        metric: str,
+        window: Optional[float] = None,
+        labels: Optional[dict] = None,
     ) -> list[list[float]]:
         """Counter rate summed across every label-set — the "req/s"
-        shape of a labeled counter. Empty list when unknown."""
+        shape of a labeled counter. ``labels`` restricts the sum to
+        matching subsets (same semantics as ``series()``: the cost-model
+        rollup sums one anomaly ``cause`` across kinds). Empty list when
+        unknown."""
         snaps = self.snapshots(window=window)
         points: list[tuple[float, float, float]] = []
         for snap in snaps:
             entry = snap["metrics"].get(metric)
             if entry is None:
                 continue
+            label_names = tuple(entry["label_names"])
             total = sum(
-                self._scalar(entry["kind"], v) for v in entry["series"].values()
+                self._scalar(entry["kind"], v)
+                for key, v in entry["series"].items()
+                if self._match(label_names, key, labels)
             )
             points.append((snap["ts"], snap["mono"], total))
         return _rate_of(points)

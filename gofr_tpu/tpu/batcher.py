@@ -359,6 +359,13 @@ class DynamicBatcher:
                         [item.payload for item in batch]
                     )
                 self._finish_record(drec)  # before the error-sweep below
+                if drec is not None and drec.anomaly:
+                    # the cost model flagged this dispatch on finish():
+                    # pin it onto every rider's wide event so the slow
+                    # request resolves to the /admin/anomalies entry
+                    for item in batch:
+                        if item.record is not None:
+                            item.record.note_anomaly(drec.dispatch_id)
             except Exception as exc:
                 self._finish_record(drec, status="error")
                 span.set_tag("error", exc)

@@ -1450,6 +1450,12 @@ class DecodePool:
             raise
         if self._timeline is not None and drec is not None:
             self._timeline.finish(drec)
+            if drec.anomaly:
+                # cost-model flag landed on finish(): pin the anomalous
+                # chunk onto every rider's wide event
+                for _, req in records:
+                    if req is not None and req.record is not None:
+                        req.record.note_anomaly(drec.dispatch_id)
         if _POOL_DEBUG:
             import sys
 
@@ -1570,11 +1576,18 @@ class DecodePool:
 
             # bandwidth view of the same interval: a full chunk of steps
             # streamed weights+KV once per step, whatever fraction of the
-            # emitted tokens was useful
-            value = mbu(
-                self._bytes_per_step * (steps or self.chunk), elapsed,
-                self._peak_bw,
-            )
+            # emitted tokens was useful. Where a harvested cost sheet
+            # exists for the chunk family, its HLO bytes-accessed replaces
+            # the weights+KV approximation (source labeled on the record).
+            chunk_bytes = self._bytes_per_step * (steps or self.chunk)
+            costmodel = getattr(self._timeline, "costmodel", None)
+            if costmodel is not None and drec is not None:
+                hlo = costmodel.hlo_bytes(
+                    "decode_chunk", bucket=drec.bucket, batch=drec.batch_size
+                )
+                if hlo:
+                    chunk_bytes = hlo
+            value = mbu(chunk_bytes, elapsed, self._peak_bw)
             self._mbu_gauge.set(value, model=self._model, op="decode")
             if drec is not None:
                 drec.mbu = value

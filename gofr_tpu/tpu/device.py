@@ -263,11 +263,30 @@ class TPUDevice:
         # the stall watchdog — constructed BEFORE any boot work so the
         # probe itself is already observable
         self.engine = EngineState(metrics=metrics, logger=logger)
+        # dispatch cost model (tpu/costmodel.py): built BEFORE the
+        # timeline so every record — the probe's included — flows
+        # through its predict/observe hooks; calibration coefficients
+        # resolve at probe time (the device kind is known then)
+        self.costmodel = None
+        if self._costmodel_enabled:
+            from gofr_tpu.tpu.costmodel import CostModel
+
+            self.costmodel = CostModel(
+                metrics=metrics,
+                logger=logger,
+                profile_path=self._costmodel_profile,
+                anomaly_factor=self._costmodel_factor,
+                min_anomaly_ms=self._costmodel_floor_ms,
+                ema_alpha=self._costmodel_ema_alpha,
+                ema_band=self._costmodel_ema_band,
+                ring_size=self._anomaly_ring_size,
+            )
         self.timeline = DispatchTimeline(
             capacity=int(
                 config.get_or_default("DISPATCH_TIMELINE_SIZE", "512")
             ),
             metrics=metrics,
+            costmodel=self.costmodel,
         )
         self.watchdog = StallWatchdog(
             self.engine, metrics=metrics, logger=logger,
@@ -506,6 +525,45 @@ class TPUDevice:
         self._echo_step_ms = float(config.get_or_default("ECHO_STEP_MS", "0"))
         if self._echo_step_ms < 0:
             raise ValueError("ECHO_STEP_MS must be >= 0")
+        # dispatch cost model (tpu/costmodel.py): COSTMODEL=off disables
+        # prediction/residual/anomaly accounting entirely; the rest are
+        # the anomaly thresholds and the calibrated-profile override
+        self._costmodel_enabled = (
+            config.get_or_default("COSTMODEL", "on").strip().lower() != "off"
+        )
+        self._costmodel_profile = (
+            config.get_or_default("COSTMODEL_PROFILE", "").strip() or None
+        )
+        self._costmodel_factor = float(
+            config.get_or_default("COSTMODEL_ANOMALY_FACTOR", "4.0")
+        )
+        if self._costmodel_factor <= 1.0:
+            raise ValueError("COSTMODEL_ANOMALY_FACTOR must be > 1")
+        self._costmodel_floor_ms = float(
+            config.get_or_default("COSTMODEL_MIN_ANOMALY_MS", "50")
+        )
+        if self._costmodel_floor_ms < 0:
+            raise ValueError("COSTMODEL_MIN_ANOMALY_MS must be >= 0")
+        self._costmodel_ema_alpha = float(
+            config.get_or_default("COSTMODEL_EMA_ALPHA", "0.2")
+        )
+        self._costmodel_ema_band = float(
+            config.get_or_default("COSTMODEL_EMA_BAND", "2.5")
+        )
+        self._anomaly_ring_size = int(
+            config.get_or_default("ANOMALY_RING_SIZE", "256")
+        )
+        if self._anomaly_ring_size < 1:
+            raise ValueError("ANOMALY_RING_SIZE must be >= 1")
+        hlo_raw = (
+            config.get_or_default("COSTMODEL_HLO", "auto").strip().lower()
+        )
+        if hlo_raw not in ("auto", "on", "off"):
+            raise ValueError(
+                f"COSTMODEL_HLO '{hlo_raw}' not supported — use auto "
+                "(harvest on TPU only), on, or off"
+            )
+        self._costmodel_hlo = hlo_raw
         raw_max_seq = config.get("MODEL_MAX_SEQ")
         self._max_seq_cfg = int(raw_max_seq) if raw_max_seq else None
         # MODEL_KV_DTYPE=f8 stores the KV cache in float8_e4m3fn — half the
@@ -915,6 +973,11 @@ class TPUDevice:
             str(self.device_kind), self.platform, quant=self.quant
         ) * n_chips
         self.peak_hbm_bw = device_peak_hbm_bw(str(self.device_kind), self.platform) * n_chips
+        if self.costmodel is not None:
+            # roofline coefficients resolve against the PROBED kind:
+            # the committed profile row (fit provenance) or the labeled
+            # nominal fallback — /admin/costmodel shows which
+            self.costmodel.calibrate(str(self.device_kind), self.platform)
 
     def _boot(self) -> None:
         del self.boot_timeline[:]
@@ -1044,6 +1107,18 @@ class TPUDevice:
                 self._prefill_chunk_cfg,
             )
         self.runner.warmup(progress=self._boot_progress)
+        if self.costmodel is not None:
+            if self.model_name == "echo":
+                # compile-free synthetic cost table: one echo run_batch
+                # costs one ECHO_STEP_MS sleep whatever the bucket or
+                # batch — the tier-1 predict→observe→alert loop runs
+                # entirely off these sheets (no XLA, no cost_analysis)
+                self.costmodel.install_synthetic("prefill", self._echo_step_ms)
+                self.costmodel.install_synthetic(
+                    "decode_chunk", self._echo_step_ms
+                )
+            elif self._hlo_harvest_enabled():
+                self._harvest_cost_sheets()
         # continuous batching: concurrent decodes share one fixed-shape
         # dispatch per chunk; seeded requests bypass it (device.generate
         # routes them solo — the per-request key sequence must reproduce).
@@ -1119,6 +1194,59 @@ class TPUDevice:
             timeline=self.timeline,
             watchdog=self.watchdog,
         )
+
+    def _hlo_harvest_enabled(self) -> bool:
+        """COSTMODEL_HLO gate: the AOT lower+compile the harvest needs is
+        NOT linked to the jit cache, so it costs one extra compile per
+        family — paid by default only on TPU (where the persistent
+        compilation cache usually absorbs it), never on the CPU tier-1
+        tiny-model path unless forced with COSTMODEL_HLO=on."""
+        if self._costmodel_hlo == "on":
+            return True
+        return self._costmodel_hlo == "auto" and self.platform == "tpu"
+
+    def _harvest_cost_sheets(self) -> None:
+        """Harvest ``cost_analysis()`` / ``memory_analysis()`` off each
+        warmed prefill executable family into CostSheets (the compiled
+        bucket x padded-batch shape IS the cost, whatever slice of it a
+        given dispatch fills). Prefill only: the decode pool compiles
+        its own pooled shapes — pricing them off the solo runner's b=1
+        decode executable would predict garbage and page people."""
+        runner = self.runner
+        fn = getattr(runner, "_prefill", None)
+        params = getattr(runner, "params", None)
+        zero_cache = getattr(runner, "_zero_cache", None)
+        if fn is None or params is None or zero_cache is None:
+            return
+        b = next_pow2(runner.max_batch)
+        harvested = 0
+        for bucket in getattr(runner, "buckets", ()) or ():
+            self._boot_progress(
+                f"harvesting cost sheet for prefill bucket {bucket}",
+                kind="cost_sheet", bucket=bucket,
+            )
+            try:
+                tokens = jnp.zeros((b, bucket), jnp.int32)
+                lengths = jnp.ones((b,), jnp.int32)
+                compiled = fn.lower(
+                    params, tokens, zero_cache(b), lengths
+                ).compile()
+                sheet = self.costmodel.harvest("prefill", bucket, b, compiled)
+                if sheet is not None:
+                    harvested += 1
+            except Exception as exc:
+                # a backend that can't lower/compile AOT loses the sheet
+                # for this family only — prediction falls back to "no
+                # prediction" there, never a boot failure
+                self.logger.warnf(
+                    "costmodel: HLO harvest failed for prefill bucket "
+                    "%s: %r", bucket, exc,
+                )
+        if harvested:
+            self.logger.infof(
+                "costmodel: harvested %d HLO cost sheet%s",
+                harvested, "" if harvested == 1 else "s",
+            )
 
     def _build_spec_cfg(self, include_fake: bool) -> Any:
         """One PoolSpecConfig per stack build (SPEC_POOLED=on): draft
@@ -2068,8 +2196,26 @@ class TPUDevice:
                 if drec is not None:
                     # per-dispatch utilization: THIS dispatch's elapsed
                     # (the steady-state window smooths the gauge; the
-                    # record describes one dispatch)
-                    drec.mfu = mfu(n_params, tokens, elapsed, self.peak_flops)
+                    # record describes one dispatch). Where an HLO cost
+                    # sheet exists its flops replace the 2·N·tokens
+                    # floor — compiled truth over approximation, source
+                    # labeled on the record (cost_source)
+                    hlo_flops = (
+                        self.costmodel.hlo_flops(
+                            "prefill", drec.bucket, drec.batch_size
+                        )
+                        if self.costmodel is not None else None
+                    )
+                    if hlo_flops:
+                        from gofr_tpu.tpu.flops import mfu_from_flops
+
+                        drec.mfu = mfu_from_flops(
+                            hlo_flops, elapsed, self.peak_flops
+                        )
+                    else:
+                        drec.mfu = mfu(
+                            n_params, tokens, elapsed, self.peak_flops
+                        )
         return results
 
     def _note_cache_event(self, cache: str, event: str) -> None:
@@ -2129,6 +2275,14 @@ class TPUDevice:
             # interrupted (resumable), resume outcomes
             "journal": self.journal.stats() if self.journal is not None else None,
             "dispatches": self.timeline.stats(),
+            # cost-model headline (tpu/costmodel.py): calibration
+            # source, sheet count, worst family residual EMA, anomaly
+            # total — the fleet prober piggybacks this onto
+            # /admin/fleet/overview; /admin/costmodel has the full sheet
+            "costmodel": (
+                self.costmodel.overview()
+                if self.costmodel is not None else None
+            ),
             # overload-brownout state: live level, the signals behind
             # it, thresholds, shed count (deadline-aware serving)
             "brownout": self.brownout.snapshot(),

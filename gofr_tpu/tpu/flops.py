@@ -160,6 +160,23 @@ def mfu(n_params: int, tokens: float, seconds: float, peak: float) -> float:
     return (2.0 * n_params * tokens) / seconds / peak
 
 
+def mfu_from_flops(flops: float, seconds: float, peak: float) -> float:
+    """MFU from an exact FLOP count — the HLO-derived path: where the
+    cost model harvested a sheet (``compiled.cost_analysis()``), its
+    flops replace the 2·N·tokens floor above (the approximation stays
+    the fallback; DispatchRecord.cost_source labels which one a record
+    used)."""
+    if seconds <= 0 or peak <= 0:
+        return 0.0
+    return flops / seconds / peak
+
+
+def mbu_from_bytes(bytes_accessed: float, seconds: float, peak_bw: float) -> float:
+    """MBU from an exact bytes-accessed count (HLO cost sheet) — same
+    contract as :func:`mfu_from_flops`, for the bandwidth axis."""
+    return mbu(bytes_accessed, seconds, peak_bw)
+
+
 def train_mfu(n_params: int, tokens: float, seconds: float, peak: float) -> float:
     """Training MFU: 6·N·tokens (forward 2N + backward 4N) / seconds /
     aggregate peak. Rematerialized forwards are NOT counted (standard MFU

@@ -95,7 +95,8 @@ class DispatchRecord:
     __slots__ = (
         "dispatch_id", "kind", "bucket", "batch_size", "padded_tokens",
         "tokens", "detail", "status", "wall_start", "t_queued", "t_running",
-        "t_done", "mfu", "mbu",
+        "t_done", "mfu", "mbu", "predicted_ms", "residual_ratio",
+        "cost_source", "anomaly",
     )
 
     def __init__(
@@ -127,6 +128,14 @@ class DispatchRecord:
         self.t_done: Optional[float] = None
         self.mfu: Optional[float] = None
         self.mbu: Optional[float] = None
+        # cost-model fields (tpu/costmodel.py): the roofline prediction
+        # stamped at begin, the observed/predicted residual stamped at
+        # finish, the sheet source behind them (hlo | synthetic), and
+        # the anomaly cause when this dispatch was flagged
+        self.predicted_ms: Optional[float] = None
+        self.residual_ratio: Optional[float] = None
+        self.cost_source: Optional[str] = None
+        self.anomaly: Optional[str] = None
 
     def mark_running(self) -> None:
         """Device execution begins (after any scheduler-interleave wait)."""
@@ -160,6 +169,10 @@ class DispatchRecord:
             "duration_s": self.duration,
             "mfu": self.mfu,
             "mbu": self.mbu,
+            "predicted_ms": self.predicted_ms,
+            "residual_ratio": self.residual_ratio,
+            "cost_source": self.cost_source,
+            "anomaly": self.anomaly,
         }
 
 
@@ -172,7 +185,15 @@ class DispatchTimeline:
     mark in place and is idempotent (error paths and success paths may
     both reach it)."""
 
-    def __init__(self, capacity: int = 512, metrics: Any = None):
+    def __init__(
+        self, capacity: int = 512, metrics: Any = None, costmodel: Any = None
+    ):
+        # the dispatch cost model (tpu/costmodel.py), when wired: begin
+        # stamps each record's roofline prediction, finish runs residual
+        # and anomaly accounting — this timeline is the SINGLE
+        # predict→observe chokepoint every dispatcher already flows
+        # through (batcher, chunked prefill, decode pool, spec verify)
+        self.costmodel = costmodel
         self._ids = itertools.count(1)
         self._ring: "deque[DispatchRecord]" = deque(maxlen=max(1, capacity))
         self._lock = threading.Lock()
@@ -208,6 +229,8 @@ class DispatchTimeline:
             padded_tokens=padded_tokens, tokens=tokens, detail=detail,
             queued_at=queued_at,
         )
+        if self.costmodel is not None:
+            self.costmodel.annotate(record)
         with self._lock:
             self._ring.append(record)
             self._by_kind[kind] = self._by_kind.get(kind, 0) + 1
@@ -226,6 +249,8 @@ class DispatchTimeline:
             self._in_flight.pop(record.dispatch_id, None)
         if self._dur is not None:
             self._dur.observe(record.duration or 0.0, kind=record.kind)
+        if self.costmodel is not None:
+            self.costmodel.observe(record)
 
     # -- read side (admin API) ------------------------------------------------
     def records(

@@ -244,6 +244,22 @@ def test_gfl005_trace_family_covered():
     ) == ["GFL005"]
 
 
+def test_gfl005_costmodel_family_covered():
+    """The dispatch cost-model family (tpu/costmodel.py): the residual
+    EMA gauge (``_ratio``) and the anomaly counter (``_total``) pass;
+    suffix drift within the family still fails."""
+    assert lint('m.gauge("gofr_tpu_dispatch_residual_ratio", "r")\n') == []
+    assert lint(
+        'm.counter("gofr_tpu_dispatch_anomalies_total", "a")\n'
+    ) == []
+    assert rules_of(
+        lint('m.gauge("gofr_tpu_dispatch_residual", "r")\n')
+    ) == ["GFL005"]
+    assert rules_of(
+        lint('m.counter("gofr_tpu_dispatch_anomalies", "a")\n')
+    ) == ["GFL005"]
+
+
 # -- GFL006: swallowed exceptions ---------------------------------------------
 
 def test_gfl006_bare_except_everywhere():

@@ -95,6 +95,10 @@ def test_scanner_sees_the_known_registrations():
     # (tracing.py attach_metrics)
     assert {"gofr_tpu_router_hop_seconds",
             "gofr_tpu_trace_export_failures_total"} <= names
+    # dispatch cost model (tpu/costmodel.py): the per-family residual
+    # EMA gauge and the anomaly counter stay scan-visible
+    assert {"gofr_tpu_dispatch_residual_ratio",
+            "gofr_tpu_dispatch_anomalies_total"} <= names
     assert len(names) >= 35
 
 

@@ -397,11 +397,28 @@ def _get(base, path, headers=None):
         return json.loads(resp.read())["data"]
 
 
+def _wait_snapshots(app, n=2, timeout=10.0):
+    """Deterministic deflake: the sampler thread's first ticks can land
+    arbitrarily late on a loaded CI host, so a fixed sleep of a few
+    intervals flakes — poll until the ring actually holds ``n``
+    snapshots (generous ceiling, returns the moment it's true)."""
+    timebase = app.container.timebase
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if timebase.stats()["snapshots"] >= n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"timebase never reached {n} snapshots within {timeout}s "
+        f"(stats: {timebase.stats()})"
+    )
+
+
 def test_timeseries_endpoint_serves_series_and_rates(echo_app):
     app, base, _ = echo_app
     _post(base, {"messages": [{"role": "user", "content": "hi"}],
                  "max_tokens": 2, "temperature": 0})
-    time.sleep(0.15)  # >= 2 sampler intervals
+    _wait_snapshots(app, n=2)
     out = _get(base, "/admin/timeseries?metric=gofr_http_requests_total")
     assert out["kind"] == "counter"
     assert out["series"], "no series for a counter that was incremented"
@@ -437,7 +454,7 @@ def test_overview_is_one_page_ops_rollup(echo_app):
     app, base, _ = echo_app
     _post(base, {"messages": [{"role": "user", "content": "roll"}],
                  "max_tokens": 2, "temperature": 0})
-    time.sleep(0.15)
+    _wait_snapshots(app, n=2)
     out = _get(base, "/admin/overview")
     assert out["engine"]["state"] == "serving"
     assert out["model"] == "echo"
@@ -456,10 +473,13 @@ def test_stall_leaves_black_box_bundle_and_history(echo_app):
     the OpenMetrics exposition carries an exemplar resolving to an
     /admin/requests row."""
     app, base, pm_dir = echo_app
-    # warm traffic before the incident anchors the rate series
+    # warm traffic before the incident anchors the rate series: wait
+    # for two MORE snapshots so the warm request's counter bump is
+    # bracketed in the ring (same deflake discipline as _wait_snapshots)
+    before = app.container.timebase.stats()["snapshots"]
     _post(base, {"messages": [{"role": "user", "content": "warm"}],
                  "max_tokens": 2, "temperature": 0})
-    time.sleep(0.12)
+    _wait_snapshots(app, n=before + 2)
     tpu = app.container.tpu
     stall_start = time.time()
     # supervisor off for the duration: this test pins the postmortem
@@ -522,7 +542,9 @@ def test_stall_leaves_black_box_bundle_and_history(echo_app):
     while tpu.engine.state != "serving" and time.time() < deadline:
         time.sleep(0.02)
     assert tpu.engine.state == "serving"
-    time.sleep(0.12)
+    _wait_snapshots(
+        app, n=app.container.timebase.stats()["snapshots"] + 2
+    )
     out = _get(base, "/admin/timeseries?metric=gofr_http_requests_total")
     rates = [p for s in out["series"] for p in s["rate"]]
     assert rates, "no rate points derived"

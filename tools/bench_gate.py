@@ -69,7 +69,15 @@ baseline:
   assembly within ``baseline assemble_us`` times the same factor
   (default 10.0, loose-first — stamping is string work that must stay
   microseconds; a blow-up means the correlation layer started taxing
-  every routed request).
+  every routed request);
+- the dispatch cost model (tpu/costmodel.py) must stay a dict lookup
+  plus a handful of float ops on the dispatch path:
+  ``costmodel_microbench.per_dispatch_us <= baseline *
+  BENCH_GATE_COSTMODEL_FACTOR`` (default 10.0, loose-first — predict
+  at begin + residual EMA at finish ride EVERY dispatch record), and
+  the microbench's healthy loop must report ``anomalies == 0`` (an
+  anomaly raised by steady-state traffic means the watchtower's
+  false-positive floor broke).
 
 Usage::
 
@@ -108,6 +116,9 @@ def gate(bench: dict, baseline: dict) -> list[str]:
     )
     spec_factor = float(os.environ.get("BENCH_GATE_SPEC_FACTOR", "1.5"))
     trace_factor = float(os.environ.get("BENCH_GATE_TRACE_FACTOR", "10.0"))
+    costmodel_factor = float(
+        os.environ.get("BENCH_GATE_COSTMODEL_FACTOR", "10.0")
+    )
 
     if bench.get("backend") != baseline.get("backend"):
         failures.append(
@@ -319,6 +330,29 @@ def gate(bench: dict, baseline: dict) -> list[str]:
                     f"fleet-tracing {what} regression: {got}us > "
                     f"{base}us * {trace_factor} "
                     f"(= {base * trace_factor:.2f}us)"
+                )
+    costmodel = bench.get("costmodel_microbench") or {}
+    base_costmodel = baseline.get("costmodel_microbench") or {}
+    if base_costmodel:
+        got = _num(costmodel, "per_dispatch_us")
+        base = _num(base_costmodel, "per_dispatch_us")
+        if got is None:
+            failures.append(
+                "costmodel_microbench missing from the bench artifact"
+            )
+        else:
+            if base and got > base * costmodel_factor:
+                failures.append(
+                    f"cost-model per-dispatch overhead regression: {got}us "
+                    f"> {base}us * {costmodel_factor} "
+                    f"(= {base * costmodel_factor:.2f}us)"
+                )
+            anomalies = _num(costmodel, "anomalies")
+            if anomalies:
+                failures.append(
+                    f"cost-model microbench raised {anomalies} anomalies on "
+                    "a healthy steady-state loop — the false-positive floor "
+                    "(COSTMODEL_MIN_ANOMALY_MS) is broken"
                 )
     return failures
 

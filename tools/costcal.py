@@ -1,0 +1,296 @@
+#!/usr/bin/env python3
+"""costcal — fit the dispatch cost model's roofline coefficients.
+
+The cost model (gofr_tpu/tpu/costmodel.py) predicts dispatch latency as
+``max(flops/eff_flops, bytes/eff_bw) * 1e3 + overhead_ms`` with
+per-device-kind *effective* coefficients shipped in the committed
+``gofr_tpu/tpu/cost_profile.json``. This tool owns those numbers:
+
+  fit     fit coefficients from one or more dispatch-records artifacts
+          (the shape ``--synth`` writes: a header naming the device kind
+          plus DispatchRecord dicts carrying flops/bytes per dispatch)
+  check   CI smoke: refit from the committed r02-derived records and
+          assert the committed profile row reproduces within tolerance
+          (a drifted fit means someone edited one side only)
+  synth   regenerate the committed ``hw/r02/dispatch_records.json``
+          deterministically from the r02 bench summary (BENCH_r02.json
+          kept no raw dispatch timeline, so the committed calibration
+          window is derived: roofline-consistent dispatch durations for
+          the r02 serving shape, seeded noise — provenance in-band)
+
+Fit procedure (deterministic, no solver): each record is classified
+compute- or bandwidth-bound by NOMINAL peaks (tpu/flops.py tables), then
+ordinary least squares per class — ``ms`` against ``flops`` (or
+``bytes``) — yields ``eff = 1e3 / slope`` and the shared ``overhead_ms``
+from the record-weighted intercepts.
+
+Usage:
+  python tools/costcal.py --fit hw/r02/dispatch_records.json [more.json]
+  python tools/costcal.py --check [--tolerance 0.1]
+  python tools/costcal.py --synth hw/r02/dispatch_records.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+from typing import Any
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PROFILE = os.path.join(REPO, "gofr_tpu", "tpu", "cost_profile.json")
+DEFAULT_RECORDS = os.path.join(REPO, "hw", "r02", "dispatch_records.json")
+
+# -- r02 synthesis constants --------------------------------------------------
+# BENCH_r02.json: model=small, prompt_len=48, clients=8 on a v5e-class
+# chip. The "true" efficiencies the synthesized window encodes — chosen
+# inside the published envelope (prefill compute-bound at ~0.35 of bf16
+# peak, decode streaming at ~0.55 of HBM peak) and reproduced by --fit.
+SYNTH_SEED = 20260807
+SYNTH_DEVICE_KIND = "v5e"
+SYNTH_EFF_FLOPS = 6.9e13   # 0.35 x 197 TFLOP/s
+SYNTH_EFF_BW = 4.5e11      # 0.55 x 819 GB/s
+SYNTH_OVERHEAD_MS = 0.35
+SYNTH_N_PARAMS = 191_382_528  # transformer_param_count(SMALL)
+SYNTH_WEIGHT_BYTES = 2 * SYNTH_N_PARAMS  # bf16 weights streamed per step
+
+
+def _load_records(paths: list[str]) -> tuple[dict[str, Any], list[dict[str, Any]]]:
+    header: dict[str, Any] = {}
+    records: list[dict[str, Any]] = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as fh:
+            artifact = json.load(fh)
+        if isinstance(artifact, list):
+            records.extend(artifact)
+            continue
+        if not header:
+            header = {k: v for k, v in artifact.items() if k != "records"}
+        records.extend(artifact.get("records") or [])
+    return header, records
+
+
+def _observed_ms(record: dict[str, Any]) -> float | None:
+    if record.get("observed_ms") is not None:
+        return float(record["observed_ms"])
+    if record.get("duration_s") is not None:
+        return float(record["duration_s"]) * 1e3
+    return None
+
+
+def _ols(points: list[tuple[float, float]]) -> tuple[float, float] | None:
+    """Least-squares (slope, intercept) of y on x; None when degenerate."""
+    n = len(points)
+    if n < 2:
+        return None
+    sx = sum(x for x, _ in points)
+    sy = sum(y for _, y in points)
+    sxx = sum(x * x for x, _ in points)
+    sxy = sum(x * y for x, y in points)
+    denom = n * sxx - sx * sx
+    if denom <= 0:
+        return None
+    slope = (n * sxy - sx * sy) / denom
+    return slope, (sy - slope * sx) / n
+
+
+def fit(paths: list[str]) -> dict[str, Any]:
+    """Fit one profile row from dispatch-records artifacts."""
+    from gofr_tpu.tpu.flops import device_peak_flops, device_peak_hbm_bw
+
+    header, records = _load_records(paths)
+    device_kind = str(header.get("device_kind") or "unknown")
+    platform = str(header.get("platform") or "tpu")
+    peak_flops = device_peak_flops(device_kind, platform)
+    peak_bw = device_peak_hbm_bw(device_kind, platform)
+    compute: list[tuple[float, float]] = []
+    bandwidth: list[tuple[float, float]] = []
+    skipped = 0
+    for record in records:
+        ms = _observed_ms(record)
+        flops = float(record.get("flops") or 0.0)
+        nbytes = float(record.get("bytes_accessed") or 0.0)
+        if ms is None or ms <= 0 or (flops <= 0 and nbytes <= 0):
+            skipped += 1
+            continue
+        # classify by NOMINAL roofline terms: which side of the roofline
+        # this record's shape sits on is a property of the hardware
+        # ratio, not of the efficiencies being fitted
+        t_flops = flops / peak_flops if peak_flops > 0 else 0.0
+        t_bw = nbytes / peak_bw if peak_bw > 0 else 0.0
+        if t_flops >= t_bw:
+            compute.append((flops, ms))
+        else:
+            bandwidth.append((nbytes, ms))
+    row: dict[str, Any] = {
+        "device_kind": device_kind,
+        "platform": platform,
+        "n_records": len(records) - skipped,
+        "n_skipped": skipped,
+        "n_compute_bound": len(compute),
+        "n_bandwidth_bound": len(bandwidth),
+    }
+    intercepts: list[tuple[float, int]] = []
+    for name, points, nominal in (
+        ("eff_flops", compute, peak_flops),
+        ("eff_bw", bandwidth, peak_bw),
+    ):
+        fitted = _ols(points)
+        if fitted is None or fitted[0] <= 0:
+            # too few (or colinear) records on this side of the roofline:
+            # a labeled nominal-efficiency default, never a silent zero
+            row[name] = nominal * 0.5
+            row[f"{name}_source"] = "default"
+            continue
+        slope, intercept = fitted
+        row[name] = 1e3 / slope
+        row[f"{name}_source"] = "fit"
+        intercepts.append((max(0.0, intercept), len(points)))
+    total = sum(n for _, n in intercepts)
+    row["overhead_ms"] = (
+        sum(c * n for c, n in intercepts) / total if total else 0.0
+    )
+    return row
+
+
+def check(profile_path: str, records_paths: list[str], tolerance: float) -> int:
+    """Refit from the committed records and compare against the
+    committed profile row for the same device kind. Returns exit code."""
+    with open(profile_path, "r", encoding="utf-8") as fh:
+        profile = json.load(fh)
+    row = fit(records_paths)
+    kind = row["device_kind"].lower()
+    committed = None
+    for needle, candidate in (profile.get("device_kinds") or {}).items():
+        if needle.lower() in kind or kind in needle.lower():
+            committed = candidate
+            break
+    if committed is None:
+        print(f"costcal check: no committed row matches device kind {kind!r}")
+        return 1
+    failures = []
+    for coeff in ("eff_flops", "eff_bw", "overhead_ms"):
+        want = float(committed.get(coeff) or 0.0)
+        got = float(row.get(coeff) or 0.0)
+        scale = max(abs(want), 1e-12)
+        rel = abs(got - want) / scale
+        status = "ok" if rel <= tolerance else "DRIFT"
+        print(
+            f"costcal check: {kind} {coeff}: committed={want:.6g} "
+            f"refit={got:.6g} rel_err={rel:.4f} [{status}]"
+        )
+        if rel > tolerance:
+            failures.append(coeff)
+    if failures:
+        print(
+            f"costcal check FAILED: {', '.join(failures)} drifted past "
+            f"tolerance {tolerance} — refit with --fit and recommit "
+            "cost_profile.json (or restore the records artifact)"
+        )
+        return 1
+    print(
+        f"costcal check ok: {row['n_records']} records reproduce the "
+        f"committed {kind} coefficients within {tolerance:.0%}"
+    )
+    return 0
+
+
+def synth(out_path: str) -> dict[str, Any]:
+    """Regenerate the committed r02-derived calibration window: the r02
+    serving shape (model=small, prompt 48 -> bucket 64, batch 8) priced
+    by the synthesis coefficients, with seeded multiplicative noise."""
+    rng = random.Random(SYNTH_SEED)
+    records: list[dict[str, Any]] = []
+
+    def price(flops: float, nbytes: float) -> float:
+        roofline_s = max(flops / SYNTH_EFF_FLOPS, nbytes / SYNTH_EFF_BW)
+        ms = roofline_s * 1e3 + SYNTH_OVERHEAD_MS
+        return ms * rng.gauss(1.0, 0.03)
+
+    # prefill dispatches: 2·N·tokens over the padded (bucket x batch)
+    # shape; activations add a weight-stream-scale byte term (prefill is
+    # firmly compute-bound for every bucket here)
+    for bucket in (64, 128, 256):
+        for batch in (1, 2, 4, 8):
+            for _ in range(8):
+                tokens = bucket * batch
+                flops = 2.0 * SYNTH_N_PARAMS * tokens
+                nbytes = SYNTH_WEIGHT_BYTES + 6_000.0 * tokens
+                records.append({
+                    "kind": "prefill",
+                    "bucket": bucket,
+                    "batch_size": batch,
+                    "tokens": tokens,
+                    "flops": flops,
+                    "bytes_accessed": nbytes,
+                    "observed_ms": round(price(flops, nbytes), 5),
+                })
+    # decode chunks: each scan step streams weights + the KV working
+    # set once (bandwidth-bound — per-token flops are 2·N·batch)
+    kv_bytes_per_slot = 2 * 8 * 4 * 128 * 2048  # layers*kv_heads*hd*seq, bf16
+    for steps in (4, 8):
+        for slots in (1, 2, 4, 8):
+            for _ in range(8):
+                flops = 2.0 * SYNTH_N_PARAMS * slots * steps
+                nbytes = steps * (
+                    SYNTH_WEIGHT_BYTES + slots * kv_bytes_per_slot
+                )
+                records.append({
+                    "kind": "decode_chunk",
+                    "bucket": 0,
+                    "batch_size": slots,
+                    "tokens": slots * steps,
+                    "flops": flops,
+                    "bytes_accessed": nbytes,
+                    "observed_ms": round(price(flops, nbytes), 5),
+                })
+    artifact = {
+        "schema": "gofr-costmodel-records/1",
+        "device_kind": SYNTH_DEVICE_KIND,
+        "platform": "tpu",
+        "derived_from": (
+            "BENCH_r02.json summary (model=small, prompt_len=48, "
+            "clients=8) — r02 kept no raw dispatch timeline; durations "
+            "are roofline-consistent with seeded noise "
+            f"(tools/costcal.py --synth, seed {SYNTH_SEED})"
+        ),
+        "records": records,
+    }
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(artifact, fh, indent=1)
+        fh.write("\n")
+    print(f"costcal synth: wrote {len(records)} records to {out_path}")
+    return artifact
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--fit", nargs="+", metavar="RECORDS",
+                        help="fit a profile row from records artifacts")
+    parser.add_argument("--check", action="store_true",
+                        help="CI smoke: committed records reproduce the "
+                             "committed profile")
+    parser.add_argument("--synth", metavar="OUT",
+                        help="regenerate the r02-derived records artifact")
+    parser.add_argument("--profile", default=DEFAULT_PROFILE)
+    parser.add_argument("--records", nargs="+", default=[DEFAULT_RECORDS])
+    parser.add_argument("--tolerance", type=float, default=0.1)
+    args = parser.parse_args(argv)
+    sys.path.insert(0, REPO)
+    if args.synth:
+        synth(args.synth)
+        return 0
+    if args.fit:
+        print(json.dumps(fit(args.fit), indent=1))
+        return 0
+    if args.check:
+        return check(args.profile, args.records, args.tolerance)
+    parser.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
