@@ -626,6 +626,18 @@ def _run(result, errors, model, clients, n_requests, prompt_len,
             except Exception as exc:
                 errors.append(f"costmodel phase: {exc}")
                 traceback.print_exc(file=sys.stderr)
+            # -- phase: SLO + tenant metering overhead -------------------------
+            # what the bounded tenant sketch adds to every flight
+            # record and what one burn-window evaluation costs off the
+            # hot path; the all-ok loop must raise zero burn alerts;
+            # gated loose-first against bench_baseline.json
+            # (BENCH_GATE_SLO_FACTOR)
+            try:
+                result["slo_microbench"] = _measure_slo()
+                log(f"slo: {result['slo_microbench']}")
+            except Exception as exc:
+                errors.append(f"slo phase: {exc}")
+                traceback.print_exc(file=sys.stderr)
             engine_live = _scrape_engine(base)
             if engine_live.get("kv_blocks") is not None:
                 result["kv_blocks"] = engine_live["kv_blocks"]
@@ -1077,6 +1089,60 @@ def _measure_costmodel() -> dict:
         "baseline_per_dispatch_us": round(baseline_s / n * 1e6, 4),
         "overhead_us": round(max(modeled_s - baseline_s, 0.0) / n * 1e6, 4),
         "anomalies": costmodel.ring.total(),  # MUST stay 0 (healthy loop)
+    }
+
+
+def _measure_slo() -> dict:
+    """SLO + tenant-metering overhead (slo.py, telemetry.TenantLedger):
+    the same flight start/finish loop with and without the bounded
+    tenant sketch wired — what per-tenant usage metering adds to every
+    request record — plus the wall cost of one SloEngine burn-window
+    evaluation over the populated flight ring (the off-hot-path sweep
+    the gofr-slo thread runs every SLO_EVAL_INTERVAL_S). The loop is
+    all-ok traffic, so burn alerts MUST stay zero — a healthy run that
+    pages is the one regression this phase exists to catch. Gated
+    loose-first vs bench_baseline.json (``BENCH_GATE_SLO_FACTOR`` on
+    ``per_request_us``; ``burn_alerts`` is a hard zero)."""
+    from gofr_tpu.metrics import Registry
+    from gofr_tpu.slo import SloEngine
+    from gofr_tpu.telemetry import (
+        FlightRecorder,
+        TenantLedger,
+        activate_tenant,
+    )
+
+    n = int(os.environ.get("BENCH_SLO_REQUESTS", "5000"))
+
+    def run(tenants):
+        recorder = FlightRecorder(capacity=512, tenants=tenants)
+        start = time.perf_counter()
+        for i in range(n):
+            # 300 distinct tenants through 256 slots: the eviction
+            # path (min-weight roll into ~other) is ON the measured
+            # loop, not just the happy dict hit
+            activate_tenant(f"bench-t{i % 300}")
+            record = recorder.start("echo", "/bench", tokens_in=8)
+            record.tokens_out = 4
+            recorder.finish(record, status="ok")
+        elapsed = time.perf_counter() - start
+        return elapsed, recorder
+
+    baseline_s, _ = run(None)
+    tenants = TenantLedger(size=256, metrics=Registry())
+    metered_s, recorder = run(tenants)
+    engine = SloEngine(recorder, metrics=Registry(), interval_s=1.0)
+    eval_start = time.perf_counter()
+    engine.evaluate()
+    evaluate_ms = (time.perf_counter() - eval_start) * 1e3
+    activate_tenant(None)  # don't leak a tenant into later phases
+    return {
+        "requests": n,
+        "per_request_us": round(metered_s / n * 1e6, 4),
+        "baseline_per_request_us": round(baseline_s / n * 1e6, 4),
+        "overhead_us": round(max(metered_s - baseline_s, 0.0) / n * 1e6, 4),
+        "evaluate_ms": round(evaluate_ms, 3),
+        "tenants_tracked": tenants.stats()["tracked"],
+        "burn_alerts": engine.ring.total(),  # MUST stay 0 (healthy loop)
     }
 
 

@@ -46,6 +46,8 @@ from gofr_tpu.handler import (
     ready_handler,
     requests_admin_handler,
     slo_admin_handler,
+    slo_budget_handler,
+    tenants_admin_handler,
     timeseries_admin_handler,
 )
 from gofr_tpu.http.middleware import (
@@ -170,6 +172,12 @@ class App:
                         make_endpoint(requests_admin_handler, self.container))
         self.router.add("GET", "/admin/slo",
                         make_endpoint(slo_admin_handler, self.container))
+        # SLO engine (slo.py): error budgets + burn-rate alerting; and
+        # the bounded per-tenant usage sketch (telemetry.TenantLedger)
+        self.router.add("GET", "/admin/slo/budget",
+                        make_endpoint(slo_budget_handler, self.container))
+        self.router.add("GET", "/admin/tenants",
+                        make_endpoint(tenants_admin_handler, self.container))
         # engine introspection (tpu/introspect.py): the layer below the
         # flight recorder — engine state, boot/compile timeline, and the
         # device dispatch timeline

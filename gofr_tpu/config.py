@@ -196,6 +196,34 @@ its ``ema_drift`` verdict (latched per excursion), and
 ``ANOMALY_RING_SIZE`` (256) bounds the typed-event ring behind
 ``GET /admin/anomalies``.
 
+SLO & tenant-metering keys (slo.py + telemetry.py TenantLedger, see
+docs/advanced-guide/observability.md "SLOs, budgets & tenants"):
+``SLO_TARGETS`` (default
+``availability=0.999;shed_rate=0.05;tier=9:availability=0.9995``) —
+semicolon-separated ``[scope:]metric=target`` objectives; metrics:
+``availability`` (good fraction), ``shed_rate`` (allowed shed
+fraction, global-only), ``ttft_p95_ms`` / ``ttft_p99_ms`` /
+``tpot_p95_ms`` / ``tpot_p99_ms`` (millisecond percentile bounds);
+scopes ``model=<name>:``, ``tier=<n>:``, ``tier>=<n>:``. Burn-rate
+alerting is multi-window: the fast page fires past
+``SLO_BURN_FAST_RATE`` (14.4) on BOTH ``SLO_BURN_FAST_S`` (300) and
+``SLO_BURN_FAST_LONG_S`` (3600); the slow ticket past
+``SLO_BURN_SLOW_RATE`` (6) on both ``SLO_BURN_SLOW_S`` (21600) and
+``SLO_BURN_SLOW_LONG_S`` (259200, also the budget-ledger window);
+``SLO_EVAL_INTERVAL_S`` (15) paces the evaluator thread and ``SLO``
+(on) removes the layer entirely. Windows clip silently to what the
+flight-record ring and ``TIMEBASE_WINDOW_S`` retain. Verdicts land in
+the anomaly ring (``slo_fast_burn``/``slo_slow_burn``), on
+``gofr_tpu_slo_burn_rate{objective,window}`` /
+``gofr_tpu_slo_budget_remaining{objective}`` /
+``gofr_tpu_slo_burn_alerts_total``, and on ``GET /admin/slo/budget``.
+``TENANT_LEDGER_SIZE`` (256) bounds the space-saving top-K sketch
+behind ``GET /admin/tenants`` — per-tenant usage (requests, tokens,
+sheds, deadline misses) is EXACT for the top-K heavy hitters and
+aggregated into ``~other`` beyond, so 5k distinct API keys add zero
+Prometheus series (only ``gofr_tpu_tenants_tracked_entries`` and
+``gofr_tpu_tenant_overflow_total`` exist).
+
 Correctness-tooling keys (devtools/sanitizer.py + tests/conftest.py,
 see docs/advanced-guide/static-analysis.md): ``GOFR_SANITIZE=1`` arms
 the runtime concurrency sanitizer under tests;

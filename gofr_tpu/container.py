@@ -18,7 +18,8 @@ from gofr_tpu.datasource.health import DOWN, UP, Health
 from gofr_tpu.logging import new_logger
 from gofr_tpu.metrics import Registry
 from gofr_tpu.postmortem import PostmortemStore
-from gofr_tpu.telemetry import FlightRecorder, exemplar_provider
+from gofr_tpu.slo import DEFAULT_TARGETS, SloEngine
+from gofr_tpu.telemetry import FlightRecorder, TenantLedger, exemplar_provider
 from gofr_tpu.timebase import TimebaseSampler
 
 
@@ -41,6 +42,13 @@ class Container:
                 else None
             ),
         )
+        # bounded per-tenant usage metering (space-saving sketch behind
+        # /admin/tenants): exact for the top-K heavy hitters, aggregated
+        # into ~other beyond — NEVER a per-tenant Prometheus series
+        self.tenants = TenantLedger(
+            size=int(config.get_or_default("TENANT_LEDGER_SIZE", "256")),
+            metrics=self.metrics,
+        )
         # request flight recorder: per-request inference telemetry backing
         # /admin/requests and /admin/slo plus the wide-event request log
         self.telemetry = FlightRecorder(
@@ -50,6 +58,7 @@ class Container:
                 config.get_or_default("FLIGHT_SLOW_MS", "2000")
             ) / 1000.0,
             logger=self.logger,
+            tenants=self.tenants,
         )
         # telemetry timebase: the metric history ring behind
         # /admin/timeseries and /admin/overview (and the trend data every
@@ -97,6 +106,45 @@ class Container:
             self._wire_redis()
             self._wire_sql()
             self._wire_tpu()
+        # SLO engine: error budgets + multi-window burn-rate alerting over
+        # the flight recorder and the timebase's shed counters. Wired
+        # AFTER the device so its verdicts land in the SAME anomaly ring
+        # as the dispatch cost model (one /admin/anomalies surface);
+        # router/bare processes get the engine's own host-side ring. A
+        # malformed SLO_TARGETS fails the boot with the clause named — an
+        # objective silently not alerting is the one failure mode this
+        # layer must not have.
+        self.slo: Optional[SloEngine] = None
+        if config.get_or_default("SLO", "on") != "off":
+            costmodel = getattr(self.tpu, "costmodel", None)
+            self.slo = SloEngine(
+                self.telemetry,
+                timebase=self.timebase,
+                metrics=self.metrics,
+                logger=self.logger,
+                targets=config.get_or_default("SLO_TARGETS", DEFAULT_TARGETS),
+                ring=getattr(costmodel, "ring", None),
+                fast_s=float(config.get_or_default("SLO_BURN_FAST_S", "300")),
+                fast_long_s=float(
+                    config.get_or_default("SLO_BURN_FAST_LONG_S", "3600")
+                ),
+                slow_s=float(
+                    config.get_or_default("SLO_BURN_SLOW_S", "21600")
+                ),
+                slow_long_s=float(
+                    config.get_or_default("SLO_BURN_SLOW_LONG_S", "259200")
+                ),
+                fast_rate=float(
+                    config.get_or_default("SLO_BURN_FAST_RATE", "14.4")
+                ),
+                slow_rate=float(
+                    config.get_or_default("SLO_BURN_SLOW_RATE", "6")
+                ),
+                interval_s=float(
+                    config.get_or_default("SLO_EVAL_INTERVAL_S", "15")
+                ),
+                start=True,
+            )
 
     # -- conditional wiring (parity: container.go:48-86) ---------------------
     def _wire_redis(self) -> None:
@@ -209,6 +257,8 @@ class Container:
         return self._handler_pool
 
     def close(self) -> None:
+        if self.slo is not None:
+            self.slo.close()  # stops the gofr-slo evaluator thread
         if self.fleet is not None:
             try:
                 self.fleet.close()  # stops the health-prober thread

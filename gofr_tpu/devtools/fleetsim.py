@@ -1155,7 +1155,55 @@ class FleetSim:
             )),
             "converged": converged,
             "pools_idle": bool(converged.get("pools_idle")),
+            "tenants": _tenant_slo_lines(results),
         }
+
+
+# per-tenant availability targets in the artifact's SLO block: the
+# protected tier-9 cohort carries the tighter target the serving
+# default SLO_TARGETS declares for tier 9; everyone else the global one
+TENANT_SLO_TARGETS = {"t-platinum": 0.9995}
+TENANT_SLO_DEFAULT_TARGET = 0.999
+
+
+def _tenant_slo_lines(results: list[dict]) -> list[dict[str, Any]]:
+    """Per-tenant SLO lines for the artifact: availability + remaining
+    error budget per tenant, computed the SLO engine's way (sheds and
+    errors burn; client aborts are the CLIENT's verdict and leave the
+    eligible set). The gate pins the protected cohort on these lines —
+    "t-platinum never exhausts its budget under default chaos" is a CI
+    invariant, not a dashboard hope."""
+    by_tenant: dict[str, dict[str, int]] = {}
+    for r in results:
+        row = by_tenant.setdefault(
+            r["tenant"], {"requests": 0, "ok": 0, "sheds": 0, "errors": 0,
+                          "client_aborted": 0}
+        )
+        row["requests"] += 1
+        outcome = r["outcome"]
+        if outcome == "ok":
+            row["ok"] += 1
+        elif outcome == "shed":
+            row["sheds"] += 1
+        elif outcome == "client_aborted":
+            row["client_aborted"] += 1
+        else:  # error / bad_count / stream_mismatch
+            row["errors"] += 1
+    lines = []
+    for tenant, row in sorted(by_tenant.items()):
+        target = TENANT_SLO_TARGETS.get(tenant, TENANT_SLO_DEFAULT_TARGET)
+        budget = 1.0 - target
+        eligible = row["requests"] - row["client_aborted"]
+        bad = row["errors"] + row["sheds"]
+        bad_fraction = bad / eligible if eligible else 0.0
+        lines.append(dict(
+            row,
+            tenant=tenant,
+            availability=round(1.0 - bad_fraction, 6),
+            target=target,
+            budget_remaining=round(1.0 - bad_fraction / budget, 4),
+        ))
+    return lines
 
 
 def _sse_tokens(raw: bytes) -> list[int]:

@@ -199,6 +199,10 @@ def wire_fleet(app: Any) -> FleetRouter:
         # ONLY behind an authenticating gateway that stamps X-Tenant:
         # trusted from arbitrary clients it makes quotas mintable
         fleet.trust_tenant_header = True
+    # the container's bounded tenant sketch: the router meters its own
+    # admissions and shed verdicts per tenant (/admin/tenants answers
+    # on the front door too)
+    fleet.tenants = getattr(container, "tenants", None)
     routes = config.get_or_default("FLEET_ROUTES", DEFAULT_ROUTES)
     for entry in routes.split(","):
         entry = entry.strip()

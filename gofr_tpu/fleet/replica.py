@@ -514,6 +514,16 @@ class ReplicaSet:
         engine["worst_residual_ema"] = (
             cm.get("worst_residual_ema") if isinstance(cm, dict) else None
         )
+        # SLO + tenant headlines ride the same scrape: the fleet
+        # overview aggregates burn/budget/top-talkers router-side with
+        # zero extra endpoints (same piggyback discipline as costmodel)
+        engine["slo"] = (
+            data.get("slo") if isinstance(data.get("slo"), dict) else None
+        )
+        engine["tenants"] = (
+            data.get("tenants")
+            if isinstance(data.get("tenants"), dict) else None
+        )
         kv = data.get("kv_blocks") or {}
         engine["kv_free"] = kv.get("free")
         engine["kv_cached"] = kv.get("cached")

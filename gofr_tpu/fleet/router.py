@@ -214,6 +214,10 @@ class FleetRouter:
         # fleet serve less than it does without it.
         self.role_routing = True
         self.trust_tenant_header = False  # FLEET_TRUST_TENANT_HEADER
+        # the container's bounded per-tenant usage sketch (wire_fleet
+        # sets it post-construction, like the flags above): the router
+        # meters its own admissions and shed verdicts per tenant
+        self.tenants: Optional[Any] = None
         self._records: deque = deque(maxlen=record_capacity)
         self._records_lock = threading.Lock()
         self._inflight = 0
@@ -390,15 +394,22 @@ class FleetRouter:
 
     # -- admission -------------------------------------------------------------
     def _shed(self, status: int, reason: str, retry_after_s: float,
-              detail: str, request_id: str = "") -> Response:
+              detail: str, request_id: str = "", tenant: str = "") -> Response:
         self._shed_total.inc(reason=reason)
+        if tenant and self.tenants is not None:
+            # a router shed never reaches a replica's flight recorder,
+            # so the tenant ledger meters it at the verdict
+            self.tenants.shed(tenant)
         # the request id rides the shed body AND header: a 429/503 the
         # router refused is otherwise untraceable — no route forward,
-        # no replica record, just a log line the client needs to quote
+        # no replica record, just a log line the client needs to quote.
+        # The HASHED tenant id rides next to it: the subject of a quota
+        # verdict should be able to quote itself to /admin/tenants.
         body = json.dumps({"error": {
             "message": detail, "reason": reason,
             "retry_after_s": round(retry_after_s, 3),
             "request_id": request_id or None,
+            "tenant": tenant or None,
         }}).encode("utf-8")
         headers = {"Content-Type": _JSON,
                    "Retry-After": str(max(1, int(retry_after_s + 0.999)))}
@@ -422,25 +433,25 @@ class FleetRouter:
             return self._shed(
                 503, "draining", self.retry_after_s,
                 "router is draining; retry against another front door",
-                request_id=request_id,
+                request_id=request_id, tenant=tenant,
             )
         if self.replica_set.all_saturated():
             return self._shed(
                 429, "kv_exhausted", self.retry_after_s,
                 "every replica reports KV/queue saturation",
-                request_id=request_id,
+                request_id=request_id, tenant=tenant,
             )
         if not self.replica_set.in_rotation():
             return self._shed(
                 503, "no_replicas", self.retry_after_s,
                 "no replica in rotation",
-                request_id=request_id,
+                request_id=request_id, tenant=tenant,
             )
         if not self._try_acquire_slot():
             return self._shed(
                 429, "inflight", self.retry_after_s,
                 "router at its in-flight cap",
-                request_id=request_id,
+                request_id=request_id, tenant=tenant,
             )
         ok, retry_after = self.quota.take(tenant)
         if not ok:
@@ -448,8 +459,12 @@ class FleetRouter:
             return self._shed(
                 429, "quota", retry_after,
                 f"tenant '{tenant}' over its request quota",
-                request_id=request_id,
+                request_id=request_id, tenant=tenant,
             )
+        if self.tenants is not None:
+            # admitted: one request on the router's own tenant ledger
+            # (replica-side ledgers add tokens when the flight finishes)
+            self.tenants.observe(tenant, requests=1)
         return None
 
     def _try_acquire_slot(self) -> bool:

@@ -260,7 +260,9 @@ def requests_admin_handler(ctx: Context) -> Any:
     requests visible after ring eviction); ``?request_id=``/
     ``?trace_id=`` match exactly (the jump from an id in a log line or
     a router route record to the flight records that carried it);
-    ``?limit=`` bounds the page."""
+    ``?tenant=`` filters by the hashed tenant id (the one a 429 shed
+    body echoes and ``/admin/tenants`` ranks); ``?limit=`` bounds the
+    page."""
     from gofr_tpu.errors import InvalidParamError
 
     _check_admin(ctx)
@@ -276,6 +278,7 @@ def requests_admin_handler(ctx: Context) -> Any:
         limit=limit,
         request_id=ctx.param("request_id") or None,
         trace_id=ctx.param("trace_id") or None,
+        tenant=ctx.param("tenant") or None,
     )
     return {"requests": records, "count": len(records)}
 
@@ -296,6 +299,52 @@ def slo_admin_handler(ctx: Context) -> Any:
     return ctx.container.telemetry.slo(window_s=window)
 
 
+def slo_budget_handler(ctx: Context) -> Any:
+    """GET /admin/slo/budget: the error-budget ledger — every declared
+    objective (``SLO_TARGETS``) with its windowed burn rates, remaining
+    budget over the long window, latched alert states, and the most
+    recent burn-alert evidence from the anomaly ring. ``/admin/slo``
+    stays the raw-percentile view; this page answers "are we inside
+    the promise, and how fast are we spending it"."""
+    from gofr_tpu.errors import HTTPError
+
+    _check_admin(ctx)
+    slo = getattr(ctx.container, "slo", None)
+    if slo is None:
+        raise HTTPError(503, "slo engine disabled (set SLO=on)")
+    return slo.budget()
+
+
+def tenants_admin_handler(ctx: Context) -> Any:
+    """GET /admin/tenants: bounded-cardinality per-tenant usage — the
+    space-saving sketch's top-K heavy hitters by token volume (exact
+    counts), everything beyond aggregated into ``~other``. ``?tenant=``
+    looks one tenant up (404 when it is not tracked — it may have been
+    folded into ``~other``); ``?limit=`` bounds the ranking (default
+    50). Tenant ids are the hashed form the admission gate derives
+    (``key-<sha256 prefix>``), never raw API keys."""
+    from gofr_tpu.errors import EntityNotFoundError, InvalidParamError
+
+    _check_admin(ctx)
+    ledger = ctx.container.tenants
+    tenant = ctx.param("tenant") or None
+    if tenant is not None:
+        entry = ledger.get(tenant)
+        if entry is None:
+            raise EntityNotFoundError(
+                f"tenant '{tenant}' is not tracked (unseen, or folded "
+                "into ~other by the top-K sketch)"
+            )
+        return {"tenant": entry, "stats": ledger.stats()}
+    try:
+        limit = int(ctx.param("limit") or "50")
+    except ValueError:
+        raise InvalidParamError('"limit" must be an integer') from None
+    if limit < 1:
+        raise InvalidParamError('"limit" must be >= 1')
+    return ledger.snapshot(k=limit)
+
+
 def engine_admin_handler(ctx: Context) -> Any:
     """GET /admin/engine: one-call engine introspection snapshot — state
     machine + transition history, boot timeline (per-stage compile wall
@@ -308,7 +357,15 @@ def engine_admin_handler(ctx: Context) -> Any:
     _check_admin(ctx)
     if ctx.tpu is None:
         raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
-    return ctx.tpu.engine_snapshot()
+    snap = ctx.tpu.engine_snapshot()
+    # SLO + tenant headlines ride the same snapshot: the fleet prober
+    # piggybacks this page, so the router aggregates fleet-wide burn and
+    # tenant pressure with ZERO extra scrape endpoints
+    slo = getattr(ctx.container, "slo", None)
+    if slo is not None:
+        snap["slo"] = slo.headline()
+    snap["tenants"] = ctx.container.tenants.overview()
+    return snap
 
 
 def dispatches_admin_handler(ctx: Context) -> Any:
@@ -360,22 +417,30 @@ def costmodel_admin_handler(ctx: Context) -> Any:
 
 
 def anomalies_admin_handler(ctx: Context) -> Any:
-    """GET /admin/anomalies: the anomaly surface — typed events the cost
-    model raised when a dispatch blew past its prediction
-    (``slow_dispatch``) or a family's residual EMA left the band
-    (``ema_drift``), newest first. ``?kind=`` / ``?cause=`` filter;
-    ``?limit=`` bounds the page (default 100). A healthy engine serves
-    an EMPTY list — every entry here is a regression with a dispatch id
-    attached."""
+    """GET /admin/anomalies: the anomaly surface — typed events, newest
+    first: the cost model's (``slow_dispatch`` when a dispatch blew past
+    its prediction, ``ema_drift`` when a family's residual EMA left the
+    band) and the SLO engine's burn verdicts (``slo_fast_burn`` /
+    ``slo_slow_burn``) in the SAME ring. ``?kind=`` / ``?cause=``
+    filter; ``?limit=`` bounds the page (default 100). A healthy
+    process serves an EMPTY list — every entry here is a regression
+    with evidence attached. On a device-wired replica the ring is the
+    cost model's (the SLO engine shares it); a router or bare process
+    serves the SLO engine's own host-side ring."""
+    from gofr_tpu.anomaly import ANOMALY_CAUSES
     from gofr_tpu.errors import HTTPError, InvalidParamError
-    from gofr_tpu.tpu.costmodel import ANOMALY_CAUSES
 
     _check_admin(ctx)
-    if ctx.tpu is None:
-        raise HTTPError(503, "tpu not configured (set MODEL_NAME)")
     costmodel = getattr(ctx.tpu, "costmodel", None)
-    if costmodel is None:
-        raise HTTPError(503, "cost model disabled (set COSTMODEL=on)")
+    slo = getattr(ctx.container, "slo", None)
+    ring = costmodel.ring if costmodel is not None else (
+        slo.ring if slo is not None else None
+    )
+    if ring is None:
+        raise HTTPError(
+            503,
+            "no anomaly ring on this process (set COSTMODEL=on or SLO=on)",
+        )
     try:
         limit = int(ctx.param("limit") or "100")
     except ValueError:
@@ -388,11 +453,11 @@ def anomalies_admin_handler(ctx: Context) -> Any:
             f'"cause" must be one of {", ".join(ANOMALY_CAUSES)}'
         )
     kind = ctx.param("kind") or None
-    events = costmodel.ring.events(limit=limit, kind=kind, cause=cause)
+    events = ring.events(limit=limit, kind=kind, cause=cause)
     return {
         "anomalies": events,
         "count": len(events),
-        "stats": costmodel.ring.stats(),
+        "stats": ring.stats(),
     }
 
 
@@ -465,6 +530,14 @@ def overview_admin_handler(ctx: Context) -> Any:
         ),
         "postmortems": container.postmortem.list()[-5:],
     }
+    # SLO headline: worst fast-window burn + thinnest budget + who is
+    # alerting (the page's loudest line when non-empty); "slo" above
+    # stays the raw-percentile view
+    slo = getattr(container, "slo", None)
+    out["slo_budget"] = slo.headline() if slo is not None else None
+    # tenant pressure: top talkers by token volume from the bounded
+    # sketch (never a full listing — that is /admin/tenants)
+    out["tenants"] = container.tenants.overview()
     tpu = container.tpu
     if tpu is None:
         out["engine"] = None
@@ -610,6 +683,14 @@ def fleet_overview_handler(ctx: Context) -> Any:
     brownout_max = 0
     anomalies_total = 0
     anomalies_seen = False
+    slo_alerting: list[dict[str, Any]] = []
+    slo_worst_burn = None
+    slo_worst_replica = None
+    slo_budget_min = None
+    slo_alerts_total = 0
+    slo_seen = False
+    tenant_totals: dict[str, dict[str, int]] = {}
+    tenants_tracked = 0
     replicas = []
     for replica in fleet.replica_set.replicas:
         snap = replica.snapshot()
@@ -636,6 +717,37 @@ def fleet_overview_handler(ctx: Context) -> Any:
         if isinstance(anomalies, int) and not isinstance(anomalies, bool):
             anomalies_seen = True
             anomalies_total += anomalies
+        # SLO + tenant rollup off the same piggybacked engine scrape —
+        # router-side aggregation only, never a fan-out on request
+        slo = engine.get("slo") or {}
+        burn = slo.get("worst_burn")
+        if isinstance(burn, (int, float)) and not isinstance(burn, bool):
+            slo_seen = True
+            if slo_worst_burn is None or burn > slo_worst_burn:
+                slo_worst_burn = burn
+                slo_worst_replica = snap.get("name")
+        remaining = slo.get("budget_remaining_min")
+        if isinstance(remaining, (int, float)) and not isinstance(
+            remaining, bool
+        ):
+            if slo_budget_min is None or remaining < slo_budget_min:
+                slo_budget_min = remaining
+        for objective in slo.get("alerting") or []:
+            slo_alerting.append(
+                {"replica": snap.get("name"), "objective": objective}
+            )
+        slo_alerts_total += int(slo.get("alerts_total") or 0)
+        tenants = engine.get("tenants") or {}
+        tenants_tracked += int(tenants.get("tracked") or 0)
+        for row in tenants.get("top") or []:
+            name = row.get("tenant")
+            if not name:
+                continue
+            agg = tenant_totals.setdefault(
+                name, {"requests": 0, "tokens": 0, "sheds": 0}
+            )
+            for field in ("requests", "tokens", "sheds"):
+                agg[field] += int(row.get(field) or 0)
         replicas.append({
             "name": snap.get("name"),
             "state": state,
@@ -651,6 +763,10 @@ def fleet_overview_handler(ctx: Context) -> Any:
             # blowing its predictions (scraped off /admin/engine)
             "anomalies": anomalies,
             "worst_residual_ema": engine.get("worst_residual_ema"),
+            # SLO headline per replica: which box is burning its budget
+            "slo_worst_burn": slo.get("worst_burn"),
+            "slo_alerting": slo.get("alerting"),
+            "tenants_tracked": tenants.get("tracked"),
         })
     timebase = container.timebase
     return {
@@ -668,6 +784,24 @@ def fleet_overview_handler(ctx: Context) -> Any:
         "kv_transfers": transfers,
         "brownout_level_max": brownout_max,
         "anomalies_total": anomalies_total if anomalies_seen else None,
+        "slo": {
+            "worst_burn": slo_worst_burn,
+            "worst_replica": slo_worst_replica,
+            "budget_remaining_min": slo_budget_min,
+            "alerting": slo_alerting,
+            "alerts_total": slo_alerts_total,
+        } if slo_seen else None,
+        "tenants": {
+            "tracked": tenants_tracked,
+            # fleet-wide top talkers: per-replica top lists merged and
+            # re-ranked by token volume (exact within what each replica's
+            # sketch tracked)
+            "top": sorted(
+                (dict(v, tenant=k) for k, v in tenant_totals.items()),
+                key=lambda row: (row["tokens"], row["requests"]),
+                reverse=True,
+            )[:5],
+        },
         "req_per_sec": _trend(
             timebase.rate_total("gofr_tpu_router_requests_total")
         ),

@@ -120,7 +120,14 @@ def respond(
             message = "some unexpected error has occurred"
         else:
             message = str(error) or error.__class__.__name__
-        body = _json_bytes({"error": {"message": message}})
+        payload: dict[str, Any] = {"message": message}
+        # shed verdicts echo the HASHED tenant id the admission gate
+        # derived (never the raw key), so a 429'd client can quote the
+        # exact id /admin/tenants and /admin/requests?tenant= rank under
+        tenant = getattr(error, "tenant", None)
+        if tenant:
+            payload["tenant"] = tenant
+        body = _json_bytes({"error": payload})
         headers = {"Content-Type": _JSON}
         # overload verdicts (brownout 429s, admission sheds) carry an
         # explicit backoff hint — bounded-queue discipline end to end

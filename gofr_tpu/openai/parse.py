@@ -66,15 +66,38 @@ def _admit_request(ctx: Any, max_tokens: int) -> int:
         ctx.request.header("X-Gofr-Request-Id"),
         ctx.request.header("X-Gofr-Hop"),
     ))
+    # hashed tenant id (same derivation as the router's admission gate:
+    # X-Tenant only under FLEET_TRUST_TENANT_HEADER, else a sha256 of
+    # the Authorization credential — raw keys never leave this frame):
+    # rides a contextvar onto the FlightRecord, so per-tenant usage
+    # meters on replicas too, router or not
+    from gofr_tpu.fleet.admission import tenant_of
+    from gofr_tpu.telemetry import activate_tenant
+
+    tenant = tenant_of(
+        ctx.request,
+        config.get_or_default(
+            "FLEET_TRUST_TENANT_HEADER", ""
+        ).lower() in ("on", "1", "true", "yes"),
+    )
+    activate_tenant(tenant)
     brownout = getattr(ctx.tpu, "brownout", None)
     if brownout is not None:
         admitted, max_tokens, level = brownout.admit(priority, max_tokens)
         if not admitted:
+            # the shed never makes a flight record, so the tenant ledger
+            # meters it here; the 429 body echoes the hashed tenant id
+            # so a shed client can quote the exact id /admin/tenants and
+            # /admin/requests?tenant= rank it under
+            tenants = getattr(ctx.container, "tenants", None)
+            if tenants is not None and tenant:
+                tenants.shed(tenant)
             exc = TooManyRequestsError(
                 f"shed by overload brownout (level {level}, request "
                 f"priority {priority}); retry later or raise X-Priority"
             )
             exc.retry_after_s = 1.0
+            exc.tenant = tenant
             raise exc
     return max_tokens
 

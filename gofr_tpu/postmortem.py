@@ -73,8 +73,8 @@ CONFIG_PREFIXES = (
     "COSTMODEL_", "DECODE_",
     "DISPATCH_", "ECHO_", "FLIGHT_", "GEN_", "GRPC_", "HANDLER_", "HTTP_",
     "LOG_", "METRICS_", "MODEL_", "POSTMORTEM_", "PREFILL_", "PREFIX_",
-    "SCHED_", "SPEC_", "TIMEBASE_", "TOKENIZER", "TPU_", "TRACER_",
-    "WATCHDOG_",
+    "SCHED_", "SLO", "SPEC_", "TENANT_", "TIMEBASE_", "TOKENIZER", "TPU_",
+    "TRACER_", "WATCHDOG_",
 )
 # suffixes marking a value as secret: redacted, never written (suffix,
 # not substring — GEN_STOP_TOKENS is model config, ADMIN_TOKEN is not)
@@ -264,6 +264,20 @@ class PostmortemStore:
         if telemetry is not None:
             out["requests"] = telemetry.records(limit=telemetry.capacity)
             out["requests_in_flight"] = telemetry.active_records()
+        slo = getattr(c, "slo", None)
+        if slo is not None:
+            # the error-budget ledger at death: "were we already burning
+            # before this happened" — a fresh evaluation, not a cache,
+            # plus the latched alert evidence it carries
+            try:
+                out["slo_budget"] = slo.budget()
+            except Exception as exc:
+                out["slo_budget"] = {"error": repr(exc)}
+        tenants = getattr(c, "tenants", None)
+        if tenants is not None:
+            # who was on the box: top-K tenants by token volume (hashed
+            # ids only — the sketch never holds raw keys)
+            out["tenants"] = tenants.snapshot(k=50)
         timebase = getattr(c, "timebase", None)
         if timebase is not None:
             from gofr_tpu.timebase import jsonable_snapshots

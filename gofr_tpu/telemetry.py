@@ -178,6 +178,33 @@ def origin_from_headers(request_id_raw: Any, hop_raw: Any) -> Optional[dict]:
     return origin
 
 
+# -- tenant identity (bounded-cardinality usage metering) --------------------
+#
+# The admission gate resolves the request's HASHED tenant id
+# (fleet/admission.tenant_of: sha256 of the Authorization value — never
+# raw key material) and binds it here, the same contextvar ride the
+# deadline, the KV-donor hint, and the fleet origin take. The
+# FlightRecord born downstream stamps it, the recorder's TenantLedger
+# meters it, and /admin/requests?tenant= joins a support ticket to the
+# flight records that carried it.
+
+_current_tenant: contextvars.ContextVar[Optional[str]] = (
+    contextvars.ContextVar("gofr_request_tenant", default=None)
+)
+
+
+def activate_tenant(tenant: Optional[str]) -> Any:
+    """Bind the request's hashed tenant id (None/"" clears); returns the
+    contextvar reset token."""
+    return _current_tenant.set(tenant or None)
+
+
+def current_tenant() -> Optional[str]:
+    """The in-flight request's hashed tenant id, if admission bound one
+    (None on paths that never ran the admission gate)."""
+    return _current_tenant.get()
+
+
 def exemplar_provider() -> Optional[dict]:
     """Default metrics exemplar provider (metrics.py Histogram): the
     correlating ids of the CURRENT observation — the active request's
@@ -230,7 +257,7 @@ class FlightRecord:
         "pool_reject_reason", "dispatch_ids", "anomalous_dispatches",
         "spec_drafted", "spec_accepted", "spec_dispatches", "spec_emitted",
         "kv_blocks", "kv_aliased_blocks", "mesh_axes",
-        "deadline_s", "priority", "shed_stage",
+        "tenant", "deadline_s", "priority", "shed_stage",
         "wall_start", "t_start", "t_enqueue", "t_dispatch",
         "t_first_token", "t_last_token", "t_done", "wall_done", "_lock",
         # the recorder's in-flight index holds records WEAKLY (an
@@ -296,6 +323,10 @@ class FlightRecord:
         # serving-mesh axes this request ran on ({"tp": 2, ...}; None =
         # single chip) — latency is only comparable within one topology
         self.mesh_axes: Optional[dict] = None
+        # hashed tenant id (admission gate via the tenant contextvar —
+        # same ride as the origin above); None on paths that never ran
+        # admission (bare test containers, internal probes)
+        self.tenant = current_tenant()
         # deadline-aware serving (gofr_tpu/deadline.py): the request's
         # total budget + priority tier, read off the request contextvars
         # at record start (priority rides its own var so a deadline-less
@@ -523,6 +554,7 @@ class FlightRecord:
             "kv_blocks": self.kv_blocks or None,
             "kv_aliased_blocks": self.kv_aliased_blocks or None,
             "mesh_axes": self.mesh_axes,
+            "tenant": self.tenant,
             "deadline_s": self.deadline_s,
             "priority": self.priority,
             "shed_stage": self.shed_stage or None,
@@ -916,13 +948,192 @@ def flight(
     return Flight(recorder, record)
 
 
+class TenantLedger:
+    """Bounded per-tenant usage metering: a space-saving heavy-hitter
+    sketch over hashed tenant ids.
+
+    Exactly ``size`` tenants are tracked at a time (``TENANT_LEDGER_SIZE``,
+    default 256). Per tracked tenant the ledger keeps exact counters —
+    requests, tokens in/out, sheds, deadline misses, errors — from the
+    moment the tenant entered the table. When a new tenant arrives at a
+    full table, the minimum-weight slot (weight = requests + sheds) is
+    evicted: its counters roll into the ``~other`` aggregate (sum
+    conservation — fleet totals never lose a request), and the newcomer
+    starts fresh carrying ``err`` = the evicted weight, the classic
+    space-saving undercount bound ("this tenant may have had up to err
+    earlier requests attributed to ~other"). Heavy hitters therefore
+    stay exact: once a tenant's weight exceeds the churn floor it is
+    never the minimum, so 10k distinct scanners can never evict a real
+    workload — and, critically, NO per-tenant Prometheus series is ever
+    minted (bounded cardinality is the point; the only /metrics surface
+    is the tracked-entries gauge and the overflow counter).
+
+    Lock-guarded dict arithmetic only — the feed point is
+    ``FlightRecorder.finish`` plus the shed paths, i.e. the request hot
+    path (bench.py's slo_microbench keeps the cost honest)."""
+
+    OTHER = "~other"
+    FIELDS = (
+        "requests", "tokens_in", "tokens_out", "sheds",
+        "deadline_misses", "errors",
+    )
+
+    def __init__(self, size: int = 256, metrics: Any = None):
+        if size < 1:
+            raise ValueError("TENANT_LEDGER_SIZE must be >= 1")
+        self.size = int(size)
+        self._slots: dict[str, dict[str, int]] = {}
+        self._other: dict[str, int] = {f: 0 for f in self.FIELDS}
+        self._evictions = 0
+        self._lock = threading.Lock()
+        self._tracked_gauge = (
+            metrics.gauge(
+                "gofr_tpu_tenants_tracked_entries",
+                "tenants currently tracked exactly by the ledger "
+                "(bounded by TENANT_LEDGER_SIZE; the rest aggregate "
+                "into ~other)",
+            )
+            if metrics is not None else None
+        )
+        self._overflow_counter = (
+            metrics.counter(
+                "gofr_tpu_tenant_overflow_total",
+                "tenant slots evicted into the ~other aggregate "
+                "(space-saving overflow)",
+            )
+            if metrics is not None else None
+        )
+
+    @staticmethod
+    def _weight(slot: dict[str, int]) -> int:
+        return slot["requests"] + slot["sheds"]
+
+    def observe(
+        self,
+        tenant: str,
+        requests: int = 0,
+        tokens_in: int = 0,
+        tokens_out: int = 0,
+        sheds: int = 0,
+        deadline_misses: int = 0,
+        errors: int = 0,
+    ) -> None:
+        """Add one observation to ``tenant``'s slot (admitting it into
+        the table, evicting the minimum-weight slot if full)."""
+        if not tenant:
+            return
+        evicted = False
+        with self._lock:
+            slot = self._slots.get(tenant)
+            if slot is None:
+                err = 0
+                if len(self._slots) >= self.size:
+                    victim = min(self._slots, key=lambda t: self._weight(self._slots[t]))
+                    old = self._slots.pop(victim)
+                    for field in self.FIELDS:
+                        self._other[field] += old[field]
+                    err = self._weight(old)
+                    self._evictions += 1
+                    evicted = True
+                slot = {f: 0 for f in self.FIELDS}
+                slot["err"] = err
+                self._slots[tenant] = slot
+            slot["requests"] += requests
+            slot["tokens_in"] += tokens_in
+            slot["tokens_out"] += tokens_out
+            slot["sheds"] += sheds
+            slot["deadline_misses"] += deadline_misses
+            slot["errors"] += errors
+            tracked = len(self._slots)
+        # metric writes OUTSIDE the ledger lock (registry has its own)
+        if evicted and self._overflow_counter is not None:
+            self._overflow_counter.inc()
+        if self._tracked_gauge is not None:
+            self._tracked_gauge.set(float(tracked))
+
+    def shed(self, tenant: str) -> None:
+        """Meter one shed (brownout / quota / router 429-503): sheds
+        never create a FlightRecord, so the shed sites feed directly."""
+        self.observe(tenant, sheds=1)
+
+    # -- read side (admin API / postmortem / fleetsim) -----------------------
+    def get(self, tenant: str) -> Optional[dict[str, Any]]:
+        """One tenant's exact counters (None = not currently tracked —
+        it may still have history inside ``~other``)."""
+        with self._lock:
+            slot = self._slots.get(tenant)
+            if slot is None:
+                return None
+            return dict(slot, tenant=tenant)
+
+    def top(self, k: int = 50) -> list[dict[str, Any]]:
+        """Top-``k`` tracked tenants by total tokens (in + out), ties
+        broken by weight — the '/admin/tenants' default page."""
+        with self._lock:
+            rows = [dict(slot, tenant=t) for t, slot in self._slots.items()]
+        rows.sort(
+            key=lambda r: (
+                r["tokens_in"] + r["tokens_out"],
+                r["requests"] + r["sheds"],
+                r["tenant"],
+            ),
+            reverse=True,
+        )
+        return rows[: max(0, k)]
+
+    def totals(self) -> dict[str, int]:
+        """Exact fleet-wide counters: tracked slots + ~other summed (sum
+        conservation — eviction moves counts, never drops them)."""
+        with self._lock:
+            out = dict(self._other)
+            for slot in self._slots.values():
+                for field in self.FIELDS:
+                    out[field] += slot[field]
+        return out
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "tracked": len(self._slots),
+                "size": self.size,
+                "evictions": self._evictions,
+                "other": dict(self._other),
+            }
+
+    def snapshot(self, k: int = 50) -> dict[str, Any]:
+        """The ``/admin/tenants`` (and postmortem ``tenants`` block)
+        shape: stats + totals + the top-``k`` page."""
+        return dict(self.stats(), totals=self.totals(), tenants=self.top(k))
+
+    def overview(self, k: int = 3) -> dict[str, Any]:
+        """Compact headline for /admin/overview and the /admin/engine
+        scrape: tracked count, eviction pressure, the top-``k`` heavy
+        hitters by tokens."""
+        stats = self.stats()
+        return {
+            "tracked": stats["tracked"],
+            "size": stats["size"],
+            "evictions": stats["evictions"],
+            "top": [
+                {
+                    "tenant": r["tenant"],
+                    "requests": r["requests"],
+                    "tokens": r["tokens_in"] + r["tokens_out"],
+                    "sheds": r["sheds"],
+                }
+                for r in self.top(k)
+            ],
+        }
+
+
 class FlightRecorder:
     """Thread-safe bounded store of completed FlightRecords.
 
     ``capacity`` bounds the main ring (most recent completions);
     ``keep`` bounds the side buffer that always retains slow/errored
     requests even after the ring evicts them. ``slow_threshold_s``
-    classifies slow: total duration or TTFT past it."""
+    classifies slow: total duration or TTFT past it. ``tenants`` is the
+    optional :class:`TenantLedger` every finished record meters into."""
 
     def __init__(
         self,
@@ -930,10 +1141,12 @@ class FlightRecorder:
         keep: int = 128,
         slow_threshold_s: float = 2.0,
         logger: Any = None,
+        tenants: Optional["TenantLedger"] = None,
     ):
         self.capacity = capacity
         self.slow_threshold_s = slow_threshold_s
         self.logger = logger
+        self.tenants = tenants
         self._ring: "deque[FlightRecord]" = deque(maxlen=max(1, capacity))
         self._notable: "deque[FlightRecord]" = deque(maxlen=max(1, keep))
         # records started but not yet finished — the postmortem bundle
@@ -988,6 +1201,21 @@ class FlightRecorder:
             self._ring.append(record)
             if self.is_slow(record) or record.status != "ok":
                 self._notable.append(record)
+        # per-tenant usage metering: every completed flight lands in the
+        # bounded ledger (sheds never reach here — the shed sites feed
+        # the ledger directly). Cancelled still counts as a request: the
+        # tenant consumed admission + tokens up to the abort.
+        if self.tenants is not None and record.tenant:
+            self.tenants.observe(
+                record.tenant,
+                requests=1,
+                tokens_in=record.tokens_in,
+                tokens_out=record.tokens_out,
+                deadline_misses=(
+                    1 if record.status == "deadline_exceeded" else 0
+                ),
+                errors=1 if record.status == "error" else 0,
+            )
         if self.logger is not None:
             try:
                 self.logger.info(record.to_dict())
@@ -1049,11 +1277,13 @@ class FlightRecorder:
         limit: int = 100,
         request_id: Optional[str] = None,
         trace_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> list[dict[str, Any]]:
         """Most-recent-first record dicts. ``slow=True``/``errored=True``
-        filter; ``request_id``/``trace_id`` match exactly (the jump from
-        an id in a log line to the records that carried it); the side
-        buffer is merged in so flagged requests stay visible after ring
+        filter; ``request_id``/``trace_id``/``tenant`` match exactly
+        (the jump from an id in a log line — or a hashed tenant id off a
+        429 body — to the records that carried it); the side buffer is
+        merged in so flagged requests stay visible after ring
         eviction."""
         with self._lock:
             merged: list[FlightRecord] = list(self._ring)
@@ -1070,10 +1300,24 @@ class FlightRecorder:
                 continue
             if trace_id is not None and record.trace_id != trace_id:
                 continue
+            if tenant is not None and record.tenant != tenant:
+                continue
             out.append(record.to_dict())
             if len(out) >= limit:
                 break
         return out
+
+    def finished_since(self, horizon: float) -> list[FlightRecord]:
+        """Completed records with ``t_done >= horizon`` (a
+        ``time.perf_counter`` mark, the records' own timebase) — the SLO
+        engine's windowed scan. Returns the live record objects (marks
+        are set-once, completed records no longer mutate): treat as
+        read-only."""
+        with self._lock:
+            return [
+                r for r in self._ring
+                if r.t_done is not None and r.t_done >= horizon
+            ]
 
     def slo(self, window_s: float = 300.0) -> dict[str, Any]:
         """Rolling-window per-model SLO view: exact p50/p95/p99 of TTFT

@@ -238,6 +238,35 @@ class TimebaseSampler:
             points.append((snap["ts"], snap["mono"], total))
         return _rate_of(points)
 
+    def counter_delta(
+        self,
+        metric: str,
+        window: Optional[float] = None,
+        labels: Optional[dict] = None,
+    ) -> float:
+        """Total increase of a cumulative metric (counter, or histogram
+        event count) over the window, summed across matching label-sets:
+        consecutive-snapshot deltas with resets clamped to 0 (same
+        discipline as ``_rate_of``). This is the SLO engine's shed-rate
+        source — sheds never create flight records, so their counters
+        are the only window-scoped truth. Returns 0.0 when the ring has
+        never seen the metric (or holds < 2 snapshots in the window:
+        increments older than the ring's retention are invisible — the
+        caller's window silently clips to what the timebase retains)."""
+        snaps = self.snapshots(window=window)
+        points: list[float] = []
+        for snap in snaps:
+            entry = snap["metrics"].get(metric)
+            if entry is None:
+                continue
+            label_names = tuple(entry["label_names"])
+            points.append(sum(
+                self._scalar(entry["kind"], v)
+                for key, v in entry["series"].items()
+                if self._match(label_names, key, labels)
+            ))
+        return sum(max(0.0, b - a) for a, b in zip(points, points[1:]))
+
     def hist_quantile_trend(
         self,
         metric: str,

@@ -87,10 +87,17 @@ def test_prefetch_close_stops_producer():
     it.close()
     import time
 
-    time.sleep(0.2)
+    # poll until the count stabilizes (a slow-to-park producer thread
+    # must not flake a fixed-sleep snapshot), then require it stays put
+    deadline = time.monotonic() + 5.0
     n = len(produced)
-    time.sleep(0.2)
-    assert len(produced) == n, "producer kept running after close"
+    streak = 0
+    while streak < 4 and time.monotonic() < deadline:
+        time.sleep(0.05)
+        m = len(produced)
+        streak = streak + 1 if m == n else 0
+        n = m
+    assert streak >= 4, "producer kept running after close"
     assert n < 100
 
 

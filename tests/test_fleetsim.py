@@ -228,6 +228,16 @@ def _artifact(**overrides):
                         "duplicated_tokens": 0, "missing_tokens": 0},
             "resume": {"resumed": 3, "exhausted": 0, "refused": 0,
                        "failures": 0},
+            "tenants": [
+                {"tenant": "t-platinum", "requests": 24, "ok": 24,
+                 "sheds": 0, "errors": 0, "client_aborted": 0,
+                 "availability": 1.0, "target": 0.9995,
+                 "budget_remaining": 1.0},
+                {"tenant": "t00", "requests": 70, "ok": 60, "sheds": 9,
+                 "errors": 1, "client_aborted": 0,
+                 "availability": 0.9833, "target": 0.999,
+                 "budget_remaining": -15.7},
+            ],
             "breaker_flaps": 6,
             "pools_idle": True,
             "converged": {"rotation": True, "pools_idle": True},
@@ -271,6 +281,13 @@ def test_gate_absolute_invariants():
         ({"scenario.injected": {"error_burst": 5, "disconnect_after": 2}},
          "'slow_loris' never fired"),
         ({"slo.resume.resumed": 0}, "vacuously true"),
+        # the protected tenant: its SLO lines must exist and hold
+        ({"slo.tenants": []}, "no per-tenant SLO lines"),
+        ({"slo.tenants": [{"tenant": "t00", "budget_remaining": 1.0}]},
+         "t-platinum"),
+        ({"slo.tenants": [{"tenant": "t-platinum", "availability": 0.5,
+                           "target": 0.9995, "budget_remaining": -999.0}]},
+         "exhausted its availability budget"),
     ]
     for overrides, needle in cases:
         failures = fleetsim_gate.gate(_artifact(**overrides), baseline)

@@ -260,6 +260,30 @@ def test_gfl005_costmodel_family_covered():
     ) == ["GFL005"]
 
 
+def test_gfl005_slo_tenant_family_covered():
+    """The SLO/tenant-metering family (slo.py + telemetry.TenantLedger):
+    the burn-rate and budget gauges (``_rate``, ``_remaining``), the
+    alert counter, and the ledger's tracked-entries gauge all pass;
+    suffix drift within the family still fails."""
+    assert lint('m.gauge("gofr_tpu_slo_burn_rate", "b")\n') == []
+    assert lint('m.gauge("gofr_tpu_slo_budget_remaining", "b")\n') == []
+    assert lint(
+        'm.counter("gofr_tpu_slo_burn_alerts_total", "a")\n'
+    ) == []
+    assert lint(
+        'm.gauge("gofr_tpu_tenants_tracked_entries", "t")\n'
+    ) == []
+    assert lint(
+        'm.counter("gofr_tpu_tenant_overflow_total", "o")\n'
+    ) == []
+    assert rules_of(
+        lint('m.gauge("gofr_tpu_slo_burn", "b")\n')
+    ) == ["GFL005"]
+    assert rules_of(
+        lint('m.counter("gofr_tpu_slo_burn_alerts", "a")\n')
+    ) == ["GFL005"]
+
+
 # -- GFL006: swallowed exceptions ---------------------------------------------
 
 def test_gfl006_bare_except_everywhere():

@@ -25,7 +25,7 @@ _HISTOGRAM_SUFFIXES = ("_seconds", "_bytes", "_size")
 _GAUGE_SUFFIXES = (
     "_seconds", "_bytes", "_total", "_depth", "_ratio", "_entries",
     "_active", "_acceptance", "_state", "_blocks", "_size", "_level",
-    "_per_dispatch",
+    "_per_dispatch", "_rate", "_remaining",
 )
 # roofline utilization gauges: the suffix IS the (well-known) metric name
 _GAUGE_ALLOWLIST = {"gofr_tpu_mfu", "gofr_tpu_mbu"}
@@ -99,6 +99,14 @@ def test_scanner_sees_the_known_registrations():
     # EMA gauge and the anomaly counter stay scan-visible
     assert {"gofr_tpu_dispatch_residual_ratio",
             "gofr_tpu_dispatch_anomalies_total"} <= names
+    # SLO engine (slo.py) + bounded tenant metering (telemetry.py
+    # TenantLedger): burn/budget surfaces and the sketch's OWN
+    # cardinality ledger — per-tenant series are forbidden by design
+    assert {"gofr_tpu_slo_burn_rate",
+            "gofr_tpu_slo_budget_remaining",
+            "gofr_tpu_slo_burn_alerts_total",
+            "gofr_tpu_tenants_tracked_entries",
+            "gofr_tpu_tenant_overflow_total"} <= names
     assert len(names) >= 35
 
 

@@ -136,9 +136,13 @@ def test_condition_wait_notify_on_sanitized_locks(factory):
 
     t = threading.Thread(target=waiter, name="san-cond-wait")
     t.start()
-    time.sleep(0.05)
-    with cond:
-        cond.notify()
+    # keep notifying until the waiter wakes: a single notify fired
+    # before the waiter reaches wait() would be lost (flake)
+    deadline = time.monotonic() + 5.0
+    while not hits and time.monotonic() < deadline:
+        with cond:
+            cond.notify()
+        time.sleep(0.005)
     t.join(timeout=5)
     assert hits == [1]
     assert sanitizer.drain()["violations"] == []

@@ -121,7 +121,13 @@ def test_long_prefill_interleaves_with_decode_cadence():
     worker = threading.Thread(target=decode_loop, daemon=True)
     worker.start()
     try:
-        time.sleep(0.03)  # decode is established and busy
+        # decode is established and busy: poll, don't trust a fixed
+        # sleep (a slow-starting worker thread would flake the
+        # strict-advance invariant below)
+        deadline = time.monotonic() + 5.0
+        while sched._decode_seq < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert sched._decode_seq >= 2, "decode loop never started"
         seqs = []
         for _ in range(8):  # the "long prefill": 8 bounded chunks
             sched.admit_prefill(512)

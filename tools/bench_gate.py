@@ -77,7 +77,16 @@ baseline:
   at begin + residual EMA at finish ride EVERY dispatch record), and
   the microbench's healthy loop must report ``anomalies == 0`` (an
   anomaly raised by steady-state traffic means the watchtower's
-  false-positive floor broke).
+  false-positive floor broke);
+- SLO + tenant metering (slo.py, telemetry.TenantLedger) must stay a
+  bounded tax on the flight-record path:
+  ``slo_microbench.per_request_us <= baseline *
+  BENCH_GATE_SLO_FACTOR`` (default 10.0, loose-first — the measured
+  loop deliberately churns the sketch's eviction path, its worst
+  case), and the microbench's all-ok loop must report
+  ``burn_alerts == 0`` (a burn alert raised by healthy traffic means
+  the multi-window judge or its thresholds broke — the one regression
+  that pages a human at 3am for nothing).
 
 Usage::
 
@@ -119,6 +128,7 @@ def gate(bench: dict, baseline: dict) -> list[str]:
     costmodel_factor = float(
         os.environ.get("BENCH_GATE_COSTMODEL_FACTOR", "10.0")
     )
+    slo_factor = float(os.environ.get("BENCH_GATE_SLO_FACTOR", "10.0"))
 
     if bench.get("backend") != baseline.get("backend"):
         failures.append(
@@ -353,6 +363,27 @@ def gate(bench: dict, baseline: dict) -> list[str]:
                     f"cost-model microbench raised {anomalies} anomalies on "
                     "a healthy steady-state loop — the false-positive floor "
                     "(COSTMODEL_MIN_ANOMALY_MS) is broken"
+                )
+    slo = bench.get("slo_microbench") or {}
+    base_slo = baseline.get("slo_microbench") or {}
+    if base_slo:
+        got = _num(slo, "per_request_us")
+        base = _num(base_slo, "per_request_us")
+        if got is None:
+            failures.append("slo_microbench missing from the bench artifact")
+        else:
+            if base and got > base * slo_factor:
+                failures.append(
+                    f"tenant-metering per-request overhead regression: "
+                    f"{got}us > {base}us * {slo_factor} "
+                    f"(= {base * slo_factor:.2f}us)"
+                )
+            burn_alerts = _num(slo, "burn_alerts")
+            if burn_alerts:
+                failures.append(
+                    f"SLO microbench raised {burn_alerts} burn alerts on an "
+                    "all-ok loop — a healthy run must never page "
+                    "(slo.py burn thresholds or judge logic are broken)"
                 )
     return failures
 

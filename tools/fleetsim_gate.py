@@ -12,6 +12,11 @@ ABSOLUTE invariants — correctness under chaos, no tolerance:
 - ``resume.failures == 0`` — every mid-stream failover on a seeded
   stream spliced a continuation (100% resume success);
 - ``shed.p9 == 0`` — the protected priority-9 cohort is never shed;
+- the tier-9 tenant's error budget never exhausts: the artifact's
+  per-tenant SLO lines must carry ``t-platinum`` with
+  ``budget_remaining > 0`` against its 0.9995 availability target
+  (under default chaos the protected cohort stays INSIDE its SLO,
+  not merely un-shed — errors count too);
 - ``pools_idle`` — every replica's paged-KV pool balanced back to idle
   (zero leaked blocks after wedges, drains, aborts, corrupt pulls);
 - the hardening A/B holds: jittered probe spread strictly below the
@@ -114,6 +119,31 @@ def _absolute_failures(slo: dict, hardening: dict) -> list[str]:
                 f"({_num(quota, 'after', 'syncs_per_request')}/request)"
             )
     return failures
+
+
+def _tenant_budget_failures(slo: dict) -> list[str]:
+    """The protected cohort's SLO, gated: the tier-9 tenant line must
+    exist (its traffic share guarantees requests in every trace) and
+    its availability budget must not exhaust under default chaos."""
+    lines = slo.get("tenants")
+    if not isinstance(lines, list) or not lines:
+        return ["artifact carries no per-tenant SLO lines (slo.tenants)"]
+    platinum = next(
+        (row for row in lines if row.get("tenant") == "t-platinum"), None
+    )
+    if platinum is None:
+        return ["no SLO line for the protected tenant 't-platinum' — "
+                "the tier-9 cohort never made it into the artifact"]
+    remaining = platinum.get("budget_remaining")
+    if not isinstance(remaining, (int, float)) or remaining <= 0:
+        return [
+            "the protected tenant 't-platinum' exhausted its "
+            f"availability budget (budget_remaining={remaining}, "
+            f"availability={platinum.get('availability')} vs target "
+            f"{platinum.get('target')}) — tier 9 must stay inside its "
+            "SLO under default chaos"
+        ]
+    return []
 
 
 def _chaos_fired_failures(artifact: dict, slo: dict) -> list[str]:
@@ -240,6 +270,7 @@ def gate(artifact: dict, baseline: dict) -> list[str]:
         )
     slo = artifact.get("slo") or {}
     failures += _absolute_failures(slo, artifact.get("hardening") or {})
+    failures += _tenant_budget_failures(slo)
     failures += _chaos_fired_failures(artifact, slo)
     failures += _process_kill_failures(artifact, slo)
     failures += _relative_failures(slo, baseline.get("slo") or {})

@@ -90,7 +90,7 @@ _HISTOGRAM_SUFFIXES = ("_seconds", "_bytes", "_size")
 _GAUGE_SUFFIXES = (  # keep in lockstep with tests/test_metric_naming.py
     "_seconds", "_bytes", "_total", "_depth", "_ratio", "_entries",
     "_active", "_acceptance", "_state", "_blocks", "_size", "_level",
-    "_per_dispatch",
+    "_per_dispatch", "_rate", "_remaining",
 )
 _GAUGE_ALLOWLIST = {"gofr_tpu_mfu", "gofr_tpu_mbu"}
 
