@@ -10,7 +10,9 @@ values with ``monkeypatch.setenv``.
 TPU-native keys added on top of the reference set (SURVEY.md §2 #22):
 ``TPU_ENABLED``, ``TPU_MESH`` (serving mesh, e.g. "tp=4,dp=4"),
 ``MODEL_NAME``, ``MODEL_PATH``, ``MODEL_QUANT``, ``BATCH_MAX_SIZE``,
-``BATCH_TIMEOUT_MS``, ``METRICS_ENABLED``.
+``BATCH_TIMEOUT_MS``. (An early ``METRICS_ENABLED`` toggle was never
+wired — metrics are always on; the knob was dropped rather than left
+inert. gofrlint GFL008 now guards this class of drift.)
 
 Paged-KV keys (tpu/kv_blocks.py, see docs/advanced-guide/performance):
 ``KV_PAGED`` (default on) switches KV storage/admission to
@@ -241,6 +243,192 @@ from __future__ import annotations
 
 import os
 from typing import Optional, Protocol
+
+# The config-surface provenance registry (gofrlint GFL008): every env
+# key package code reads must have a row here, and every row must be
+# read somewhere in the tree (package, tools, bench or tests) — an
+# unreadable row is an inert knob and fails lint. Harness-only knobs
+# (BENCH_*, FLEETSIM_GATE_*, WATCH_*) belong to their scripts, not to
+# the package surface, and are deliberately NOT declared. The prose
+# sections of the module docstring above stay the operator-facing
+# documentation; this dict is the machine-checked index of it.
+DECLARED_KEYS: dict[str, str] = {
+    # core serving / reference-parity surface
+    "APP_NAME": "service name stamped on traces",
+    "LOG_LEVEL": "root logger level",
+    "HTTP_PORT": "HTTP listen port",
+    "GRPC_PORT": "gRPC listen port",
+    "HANDLER_THREADS": "HTTP handler thread-pool size",
+    "ADMIN_TOKEN": "bearer token gating the /admin plane",
+    # datasources (reference parity: sql + redis)
+    "DB_DIALECT": "sql dialect (mysql/postgres/sqlite)",
+    "DB_HOST": "sql host (presence arms the datasource)",
+    "DB_PORT": "sql port",
+    "DB_NAME": "sql database name",
+    "DB_USER": "sql user",
+    "DB_PASSWORD": "sql password",
+    "REDIS_HOST": "redis host (presence arms the client)",
+    "REDIS_PORT": "redis port",
+    # TPU / model boot
+    "TPU_ENABLED": "arm the TPU serving engine",
+    "TPU_BOOT": "boot-mode override (echo/real)",
+    "TPU_MESH": "serving mesh spec, e.g. tp=4,dp=4",
+    "TPU_TOPOLOGY": "expected device topology assertion",
+    "TPU_COORDINATOR": "multihost coordinator address",
+    "TPU_NUM_PROCESSES": "multihost process count",
+    "TPU_PROCESS_ID": "this host's multihost process id",
+    "MODEL_NAME": "served model name",
+    "MODEL_PATH": "checkpoint path",
+    "MODEL_QUANT": "weight quantization mode",
+    "MODEL_BUCKETS": "prefill padding bucket list",
+    "MODEL_MAX_SEQ": "max sequence length",
+    "MODEL_ATTN_IMPL": "attention implementation override",
+    "MODEL_KV_DTYPE": "KV-cache dtype (e.g. f8)",
+    "TOKENIZER": "tokenizer implementation override",
+    "TOKENIZER_PATH": "tokenizer asset path",
+    "GEN_STOP_EOS": "stop generation on EOS token",
+    "GEN_STOP_TOKENS": "extra stop-token ids",
+    "ECHO_STEP_MS": "echo runner per-step latency",
+    "LORA_ADAPTERS": "pooled multi-LoRA adapter table",
+    # batching / scheduling / decode pool
+    "BATCH_MAX_SIZE": "max continuous-batch size",
+    "BATCH_TIMEOUT_MS": "batch formation window",
+    "BATCH_COHORT": "cohort grouping policy",
+    "SCHED_POLICY": "scheduler policy (fcfs/interference)",
+    "SCHED_MAX_DEFER_MS": "interference-scheduler defer bound",
+    "PREFILL_CHUNK_TOKENS": "chunked-prefill chunk size",
+    "DECODE_CHUNK": "decode loop chunk size",
+    "DECODE_SLOTS": "decode pool slot count",
+    "DECODE_POOL": "enable the continuous-batching pool",
+    "DECODE_PIPELINE": "overlap host/device decode stages",
+    "DECODE_POOL_PENALTIES": "penalized-pool admission weights",
+    "PREFIX_CACHE": "shared prefix cache toggle",
+    "PREFIX_LCP_MIN": "min longest-common-prefix to reuse",
+    # paged KV + cross-replica transfer
+    "KV_PAGED": "block-granular paged KV mode",
+    "KV_BLOCK_TOKENS": "tokens per KV block",
+    "KV_BLOCKS": "fixed shared block budget (0 = auto)",
+    "KV_HBM_BUDGET_MB": "HBM budget for the block arena",
+    "KV_TRANSFER": "serve/pull warm KV across replicas",
+    "KV_TRANSFER_TIMEOUT_S": "one pull's overall budget",
+    "KV_TRANSFER_PIN_TTL_S": "bounded export block-pin lifetime",
+    "KV_TRANSFER_TRUST_HINT": "trust client X-KV-Donor (SSRF gate)",
+    # speculative decoding
+    "SPEC_POOLED": "route speculation through the pool",
+    "SPEC_NGRAM": "n-gram/prompt-lookup drafting",
+    "SPEC_K_MAX": "draft-width bound",
+    "SPEC_FAKE_ACCEPT": "echo-runner deterministic accepts",
+    "DRAFT_MODEL_NAME": "solo-mode draft model name",
+    "DRAFT_MODEL_PATH": "solo-mode draft checkpoint",
+    "DRAFT_TOKENS": "solo-mode draft depth",
+    # deadlines / brownout
+    "REQUEST_DEADLINE_S": "default end-to-end request budget",
+    "PRIORITY_DEFAULT": "tier for requests without X-Priority",
+    "BROWNOUT_QUEUE_DEPTH": "queue depth arming brownout",
+    "BROWNOUT_KV_UTIL": "KV utilization arming brownout",
+    "BROWNOUT_SHED_PRIORITY": "priority floor shed under brownout",
+    "BROWNOUT_CLAMP_TOKENS": "max_tokens clamp at level 2",
+    # observability: metrics / timebase / postmortem / profiling
+    "METRICS_MAX_SERIES": "per-metric label-cardinality cap",
+    "METRICS_EXEMPLARS": "OpenMetrics histogram exemplars",
+    "TIMEBASE_ENABLED": "metric-snapshot ring toggle",
+    "TIMEBASE_INTERVAL_S": "snapshot cadence",
+    "TIMEBASE_WINDOW_S": "snapshot retention window",
+    "POSTMORTEM_DIR": "black-box bundle dir (arms crash hooks)",
+    "POSTMORTEM_KEEP": "bundles retained",
+    "POSTMORTEM_MIN_INTERVAL_S": "bundle rate limit",
+    "POSTMORTEM_SNAPSHOTS": "timebase snapshots per bundle",
+    "FLIGHT_RECORDER_SIZE": "flight-record ring capacity",
+    "FLIGHT_RECORDER_KEEP": "completed records retained",
+    "FLIGHT_SLOW_MS": "slow-request capture threshold",
+    "PROFILE_DIR": "jax profiler trace output dir",
+    "DISPATCH_TIMELINE_SIZE": "dispatch timeline ring capacity",
+    # tracing
+    "TRACER_HOST": "zipkin exporter host",
+    "TRACER_PORT": "zipkin exporter port",
+    "FLEET_TRACE_SCRAPE_TIMEOUT_S": "per-replica trace-evidence budget",
+    # dispatch cost model
+    "COSTMODEL": "roofline prediction + anomaly surface",
+    "COSTMODEL_PROFILE": "cost-profile JSON path",
+    "COSTMODEL_HLO": "HLO cost-sheet harvest mode",
+    "COSTMODEL_ANOMALY_FACTOR": "slow-dispatch multiple",
+    "COSTMODEL_MIN_ANOMALY_MS": "absolute anomaly excess floor",
+    "COSTMODEL_EMA_ALPHA": "residual EMA smoothing",
+    "COSTMODEL_EMA_BAND": "residual EMA drift band",
+    "ANOMALY_RING_SIZE": "typed anomaly-event ring capacity",
+    # SLO engine + tenant metering
+    "SLO": "SLO evaluation layer toggle",
+    "SLO_TARGETS": "objective spec (scope:metric=target;...)",
+    "SLO_BURN_FAST_S": "fast-burn short window",
+    "SLO_BURN_FAST_LONG_S": "fast-burn long window",
+    "SLO_BURN_FAST_RATE": "fast-burn page threshold",
+    "SLO_BURN_SLOW_S": "slow-burn short window",
+    "SLO_BURN_SLOW_LONG_S": "slow-burn long window / budget ledger",
+    "SLO_BURN_SLOW_RATE": "slow-burn ticket threshold",
+    "SLO_EVAL_INTERVAL_S": "evaluator thread cadence",
+    "TENANT_LEDGER_SIZE": "top-K tenant sketch capacity",
+    # self-healing / journal / WAL
+    "RECOVERY_ENABLED": "wedge-recovery state machine",
+    "RECOVERY_MAX_ATTEMPTS": "rebuild attempts before failed",
+    "RECOVERY_BACKOFF_S": "first rebuild backoff",
+    "RECOVERY_BACKOFF_MAX_S": "backoff ceiling",
+    "RECOVERY_ATTEMPT_TIMEOUT_S": "hung-rebuild terminal timeout",
+    "WATCHDOG_DISPATCH_TIMEOUT_S": "dispatch watchdog threshold",
+    "JOURNAL": "durable generation journal",
+    "JOURNAL_CAPACITY": "interrupted entries retained",
+    "JOURNAL_MAX_TOKENS": "tokens recorded per entry",
+    "JOURNAL_DIR": "disk-backed WAL dir (unset = memory)",
+    "JOURNAL_FSYNC": "WAL durability mode",
+    "JOURNAL_SEGMENT_BYTES": "WAL segment rotation size",
+    "JOURNAL_SEGMENTS": "WAL segments retained",
+    # fleet router / replicas
+    "FLEET_REPLICAS": "replica URL list (arms the router)",
+    "FLEET_ROUTES": "extra route table entries",
+    "FLEET_ROUTER_ID": "HA router instance label",
+    "FLEET_RETRIES": "per-request retry budget",
+    "FLEET_DEADLINE_S": "router end-to-end deadline",
+    "FLEET_CONNECT_TIMEOUT_S": "upstream connect timeout",
+    "FLEET_READ_TIMEOUT_S": "upstream read timeout",
+    "FLEET_AFFINITY": "prefix-affinity routing",
+    "FLEET_AFFINITY_MAX_SKEW": "affinity load-skew bound",
+    "FLEET_PROBE_INTERVAL_S": "health probe cadence",
+    "FLEET_PROBE_TIMEOUT_S": "health probe timeout",
+    "FLEET_PROBE_HEDGE_MS": "hedged second probe delay",
+    "FLEET_PROBE_JITTER": "decorrelated probe jitter fraction",
+    "FLEET_OUT_AFTER": "failed probes before out",
+    "FLEET_PROBATION_PROBES": "probes to re-admit a replica",
+    "FLEET_BREAKER_THRESHOLD": "breaker error threshold",
+    "FLEET_BREAKER_COOLDOWN_S": "breaker half-open cooldown",
+    "FLEET_QUOTA_RPS": "per-tenant quota (redis-backed)",
+    "FLEET_QUOTA_BURST": "quota bucket burst",
+    "FLEET_QUOTA_CACHE_TTL_S": "local token-lease cache TTL",
+    "FLEET_TRUST_TENANT_HEADER": "trust client X-Tenant",
+    "FLEET_MAX_INFLIGHT": "per-instance in-flight cap",
+    "FLEET_SATURATION_QUEUE": "admission queue depth",
+    "FLEET_RETRY_AFTER_S": "Retry-After on shed",
+    "FLEET_DRAIN_TIMEOUT_S": "graceful drain budget",
+    "FLEET_RESUME": "mid-stream failover for SSE",
+    "FLEET_MAX_RESUMES": "continuation attempts per stream",
+    "FLEET_ROLE": "advertised replica role",
+    "FLEET_ROLE_ROUTING": "router honors advertised roles",
+    # openai-compat layer
+    "OPENAI_ACCEPT_UNKNOWN_MODEL": "serve unknown model names",
+    "OPENAI_FANOUT_WORKERS": "n>1 sampling fanout pool size",
+    "CHAT_TEMPLATE": "chat template style",
+    "CHAT_TEMPLATE_JINJA": "jinja template path override",
+    "CHAT_TEMPLATE_OPENER": "assistant-turn opener override",
+    # native extension loader
+    "GOFR_NATIVE_LIB": "prebuilt native library path",
+    "GOFR_NATIVE_CACHE": "native build cache dir",
+    "GOFR_NATIVE_DISABLE": "force the pure-python fallback",
+    # correctness tooling (devtools/sanitizer.py + tests/conftest.py)
+    "GOFR_POOL_DEBUG": "decode-pool debug logging",
+    "GOFR_SANITIZE": "runtime concurrency sanitizer",
+    "GOFR_SANITIZE_ALL": "track non-project locks too",
+    "GOFR_SANITIZE_HOLD_MS": "lock hold-time warning threshold",
+    "GOFR_SANITIZE_REPORT": "sanitizer findings file",
+    "GOFR_SANITIZE_GRAPH": "observed lock-order graph JSON file",
+}
 
 
 class Config(Protocol):

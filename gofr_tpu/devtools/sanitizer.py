@@ -375,6 +375,45 @@ def reset() -> None:
         _edges.clear()
 
 
+def export_graph(path: Optional[str] = None) -> dict[str, Any]:
+    """Snapshot of the OBSERVED lock-order graph, in the same schema as
+    the static exporter (``tools/gofrlint.py --emit-lock-graph``) so
+    ``tools/lockgraph_check.py`` can union the two: node ids are lock
+    creation labels (``file:lineno``, absolute here — the checker
+    normalizes paths), each edge is "``to`` was acquired while ``from``
+    was held", ``site`` is the innermost acquiring frame. Deterministic:
+    nodes and edges are sorted, and re-exporting an unchanged graph
+    yields an identical document. Wired to ``GOFR_SANITIZE_GRAPH`` by
+    tests/conftest.py and to ``--emit-graph`` by devtools/fleetsim.py."""
+    nodes: set[str] = set()
+    edges: dict[tuple[str, str], dict[str, Any]] = {}
+    with _state_lock:
+        for out in _edges.values():
+            for info in out.values():
+                a, b = info["from"], info["to"]
+                nodes.add(a)
+                nodes.add(b)
+                site = info["acquire_stack"][0] if info["acquire_stack"] else ""
+                edges.setdefault((a, b), {
+                    "from": a, "to": b,
+                    "site": site.split(" in ")[0],
+                    "thread": info["thread"],
+                })
+    graph: dict[str, Any] = {
+        "version": 1,
+        "source": "runtime",
+        "nodes": [{"id": n} for n in sorted(nodes)],
+        "edges": [edges[k] for k in sorted(edges)],
+    }
+    if path:
+        import json
+
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(graph, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return graph
+
+
 def is_allowlisted(thread: threading.Thread) -> bool:
     return any(
         thread.name.startswith(p) for p in THREAD_ALLOWLIST_PREFIXES

@@ -403,13 +403,13 @@ class DecodePool:
         self._chunk_ema_s = 0.0
         self._mfu_gauge = self._tokens_counter = self._mbu_gauge = None
         if metrics is not None and n_params and peak_flops:
+            # lookups — the registration home (help text) for both
+            # families is tpu/device.py _init_metrics (GFL007)
             self._mfu_gauge = metrics.gauge(
-                "gofr_tpu_mfu",
-                "model FLOPs utilization of the last dispatch (2*N*tokens/time/peak)",
-                labels=("model", "op"),
+                "gofr_tpu_mfu", labels=("model", "op")
             )
             self._tokens_counter = metrics.counter(
-                "gofr_tpu_tokens_total", "tokens processed", labels=("model", "op")
+                "gofr_tpu_tokens_total", labels=("model", "op")
             )
         if metrics is not None and peak_hbm_bw:
             from gofr_tpu.tpu.flops import tree_bytes

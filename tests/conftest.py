@@ -97,6 +97,19 @@ def gofr_sanitize(request):
         )
 
 
+def pytest_sessionfinish(session):
+    """GOFR_SANITIZE_GRAPH=<file>: write the whole session's OBSERVED
+    lock-order graph (the edge graph survives drain() on purpose) in
+    the static exporter's schema, for the static∪runtime cycle check
+    in tools/lockgraph_check.py."""
+    graph_path = os.environ.get("GOFR_SANITIZE_GRAPH")
+    if graph_path and _sanitizer.enabled():
+        try:
+            _sanitizer.export_graph(graph_path)
+        except OSError:
+            pass
+
+
 @pytest.fixture
 def free_port():
     def _get():

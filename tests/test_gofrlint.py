@@ -403,3 +403,389 @@ def test_cli_entrypoint_runs(tmp_path):
         capture_output=True, text=True, timeout=60,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- GFL004 interprocedural (whole-program) -----------------------------------
+
+def interproc(sources: dict) -> list:
+    """Run only the whole-program half over a {rel: source} tree."""
+    project = gofrlint.Project.from_sources(sources)
+    return gofrlint.WholeProgram(project).violations()
+
+
+def test_interproc_direct_call():
+    out = interproc({"gofr_tpu/m.py": (
+        "import time, threading\n"
+        "_LOCK = threading.Lock()\n"
+        "def helper():\n"
+        "    time.sleep(1)\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        helper()\n"
+    )})
+    assert [v.rule for v in out] == ["GFL004"]
+    assert "helper" in out[0].message and "time.sleep" in out[0].message
+
+
+def test_interproc_self_method_under_foreign_lock():
+    out = interproc({"gofr_tpu/m.py": (
+        "import time, threading\n"
+        "_LOCK = threading.Lock()\n"
+        "class C:\n"
+        "    def run(self):\n"
+        "        with _LOCK:\n"
+        "            self._drain()\n"
+        "    def _drain(self):\n"
+        "        time.sleep(1)\n"
+    )})
+    assert [v.rule for v in out] == ["GFL004"]
+
+
+def test_interproc_class_typed_attribute_dispatch():
+    """``self.attr.method()`` resolves through the attribute type
+    inferred from the ``__init__`` assignment — the dispatch shape the
+    per-file rule cannot see."""
+    out = interproc({"gofr_tpu/m.py": (
+        "import time, threading\n"
+        "class Worker:\n"
+        "    def pump(self):\n"
+        "        time.sleep(1)\n"
+        "class Owner:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._worker = Worker()\n"
+        "    def step(self):\n"
+        "        with self._lock:\n"
+        "            self._worker.pump()\n"
+    )})
+    assert [v.rule for v in out] == ["GFL004"]
+    assert "pump" in out[0].message
+
+
+def test_interproc_two_hop_chain_carries_a_witness():
+    out = interproc({"gofr_tpu/m.py": (
+        "import time, threading\n"
+        "_LOCK = threading.Lock()\n"
+        "def c():\n"
+        "    time.sleep(1)\n"
+        "def b():\n"
+        "    c()\n"
+        "def a():\n"
+        "    with _LOCK:\n"
+        "        b()\n"
+    )})
+    assert [v.rule for v in out] == ["GFL004"]
+    # the finding names the path, not just the endpoint
+    assert "b" in out[0].message and "c" in out[0].message
+
+
+def test_interproc_suppression_on_the_call_line():
+    out = interproc({"gofr_tpu/m.py": (
+        "import time, threading\n"
+        "_LOCK = threading.Lock()\n"
+        "def helper():\n"
+        "    time.sleep(1)\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        helper()  # gofrlint: disable=GFL004 — fixture\n"
+    )})
+    assert out == []
+
+
+def test_interproc_resource_guard_exemption():
+    """A class serializing its OWN blocking resource behind its own
+    lock (the JournalWAL fsync shape) is exempt: every may-block path
+    stays inside the class. The cross-object variant in the committed
+    WAL fixture must still be flagged (next test)."""
+    out = interproc({"gofr_tpu/m.py": (
+        "import os, threading\n"
+        "class Wal:\n"
+        "    def __init__(self, fd):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._fd = fd\n"
+        "    def append(self, b):\n"
+        "        with self._lock:\n"
+        "            os.write(self._fd, b)\n"
+        "            self._sync()\n"
+        "    def _sync(self):\n"
+        "        os.fsync(self._fd)\n"
+    )})
+    assert out == []
+
+
+def test_interproc_bounded_join_is_not_blocking():
+    """join(timeout=...) is a bounded teardown wait — the device.py
+    recovery path (reinit under _reinit_lock → teardown → pool close
+    with a bounded join) must stay clean."""
+    out = interproc({"gofr_tpu/m.py": (
+        "import threading\n"
+        "class C:\n"
+        "    def close(self):\n"
+        "        with self._lock:\n"
+        "            self._teardown()\n"
+        "    def _teardown(self):\n"
+        "        self._thread.join(timeout=2.0)\n"
+    )})
+    assert out == []
+
+
+def test_wal_under_lock_fixture_is_caught():
+    """The PR 14 regression contract: the committed cross-object
+    WAL-under-journal-lock fixture is flagged by the interprocedural
+    pass — at the reach-through call in Journal.record, with the fsync
+    chain as witness — while WalWriter's own-lock fsync (the
+    resource-guard shape) is not."""
+    fixture = REPO / "tests" / "fixtures" / "wal_under_lock.py"
+    violations, scanned = gofrlint.lint_paths([str(fixture)])
+    assert scanned == 1
+    assert [v.rule for v in violations] == ["GFL004"]
+    v = violations[0]
+    assert "append_tokens" in v.message and "os.fsync" in v.message
+    # the finding sits on Journal.record's call, not inside WalWriter
+    source = fixture.read_text().splitlines()
+    assert "self._wal.append_tokens" in source[v.line - 1]
+
+
+# -- GFL007: metric contract registries ---------------------------------------
+
+def run_tree(tmp_path, files: dict) -> list:
+    """Materialize {rel: source} under tmp_path and run the full lint."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    violations, _ = gofrlint.lint_paths([str(tmp_path)])
+    return violations
+
+
+def test_gfl007_duplicate_registration_home(tmp_path):
+    out = run_tree(tmp_path, {
+        "gofr_tpu/__init__.py": "",
+        "gofr_tpu/a.py":
+            'm.counter("gofr_tpu_x_total", "things", labels=("op",))\n',
+        "gofr_tpu/b.py":
+            'm.counter("gofr_tpu_x_total", "things", labels=("op",))\n',
+    })
+    assert [v.rule for v in out] == ["GFL007"]
+    assert "duplicate registration home" in out[0].message
+
+
+def test_gfl007_kind_flip_and_help_divergence(tmp_path):
+    out = run_tree(tmp_path, {
+        "gofr_tpu/__init__.py": "",
+        "gofr_tpu/a.py": 'm.counter("gofr_tpu_x_total", "things")\n',
+        "gofr_tpu/b.py": 'm.gauge("gofr_tpu_x_total")\n',
+    })
+    assert "GFL007" in [v.rule for v in out]
+    assert any("kind" in v.message for v in out)
+
+
+def test_gfl007_label_disagreement(tmp_path):
+    out = run_tree(tmp_path, {
+        "gofr_tpu/__init__.py": "",
+        "gofr_tpu/a.py":
+            'm.counter("gofr_tpu_x_total", "t", labels=("model",))\n',
+        "gofr_tpu/b.py":
+            'm.counter("gofr_tpu_x_total", labels=("op",))\n',
+    })
+    assert any(
+        v.rule == "GFL007" and "label" in v.message for v in out
+    )
+
+
+def test_gfl007_lookup_sites_are_fine(tmp_path):
+    """One home with help text + N help-less lookups is the sanctioned
+    idiom (decode_pool.py looks up device.py's registrations)."""
+    out = run_tree(tmp_path, {
+        "gofr_tpu/__init__.py": "",
+        "gofr_tpu/a.py":
+            'm.counter("gofr_tpu_x_total", "things", labels=("op",))\n',
+        "gofr_tpu/b.py":
+            'm.counter("gofr_tpu_x_total", labels=("op",))\n',
+    })
+    assert out == []
+
+
+def test_gfl007_requires_a_naming_test_row(tmp_path):
+    """With a tests/test_metric_naming.py present, every registered
+    family needs a row in it — the drift-proof link between the
+    registry and the convention test."""
+    out = run_tree(tmp_path, {
+        "gofr_tpu/__init__.py": "",
+        "gofr_tpu/a.py": 'm.counter("gofr_tpu_x_total", "t")\n',
+        "tests/test_metric_naming.py": "# no rows here\n",
+    })
+    assert [v.rule for v in out] == ["GFL007"]
+    assert "test_metric_naming" in out[0].message
+
+
+# -- GFL008: config-key provenance --------------------------------------------
+
+def test_gfl008_undeclared_package_read(tmp_path):
+    out = run_tree(tmp_path, {
+        "gofr_tpu/__init__.py": "",
+        "gofr_tpu/config.py": 'DECLARED_KEYS = {"GOOD_KEY": "doc"}\n',
+        "gofr_tpu/m.py": (
+            "from gofr_tpu.config import get_env\n"
+            'x = get_env("MYSTERY_KEY")\n'
+            'y = get_env("GOOD_KEY")\n'
+        ),
+    })
+    assert [v.rule for v in out] == ["GFL008"]
+    assert "MYSTERY_KEY" in out[0].message
+
+
+def test_gfl008_inert_declared_knob(tmp_path):
+    out = run_tree(tmp_path, {
+        "gofr_tpu/__init__.py": "",
+        "gofr_tpu/config.py": 'DECLARED_KEYS = {"NEVER_READ": "doc"}\n',
+    })
+    assert [v.rule for v in out] == ["GFL008"]
+    assert "NEVER_READ" in out[0].message and "inert" in out[0].message
+
+
+def test_gfl008_wrapper_and_harness_reads_count(tmp_path):
+    """A one-hop wrapper read (the fleet ``_f`` idiom) traces to the
+    key; a harness-only read (bench/tools) proves a declared key live
+    but is NOT itself held to the package registry."""
+    out = run_tree(tmp_path, {
+        "gofr_tpu/__init__.py": "",
+        "gofr_tpu/config.py": 'DECLARED_KEYS = {"WRAPPED_KEY": "doc"}\n',
+        "gofr_tpu/m.py": (
+            "from gofr_tpu.config import get_env\n"
+            "def _f(key, default):\n"
+            "    return get_env(key) or default\n"
+            'x = _f("WRAPPED_KEY", "1")\n'
+        ),
+        "bench.py": (
+            "import os\n"
+            'y = os.getenv("BENCH_ONLY_KEY")\n'
+        ),
+    })
+    assert out == []
+
+
+# -- GFL009: admin-surface parity ---------------------------------------------
+
+def test_gfl009_code_route_missing_from_readme(tmp_path):
+    out = run_tree(tmp_path, {
+        "gofr_tpu/__init__.py": "",
+        "gofr_tpu/app.py": 'app.get("/admin/newthing", handler)\n',
+        "README.md": "| `/admin/other` | something |\n",
+    })
+    rules = [v.rule for v in out]
+    assert rules.count("GFL009") == 2  # missing route AND stale row
+    assert any("/admin/newthing" in v.message for v in out)
+    assert any("stale" in v.message for v in out)
+
+
+def test_gfl009_param_spelling_does_not_break_parity(tmp_path):
+    """Code's ``{hash}`` vs the README's ``{prompt_hash}`` is the same
+    route — parity guards the surface's shape, not parameter names."""
+    out = run_tree(tmp_path, {
+        "gofr_tpu/__init__.py": "",
+        "gofr_tpu/app.py": 'app.get("/admin/kv/{hash}", handler)\n',
+        "README.md": "| `/admin/kv/{prompt_hash}` | kv export |\n",
+    })
+    assert out == []
+
+
+# -- suppression ledger ratchet -----------------------------------------------
+
+def test_ledger_emission_and_ratchet(tmp_path):
+    src = tmp_path / "gofr_tpu" / "m.py"
+    src.parent.mkdir()
+    src.write_text(
+        "import time\n"
+        "t = time.time()  # gofrlint: disable=GFL002 — fixture\n"
+        "u = time.time()  # gofrlint: disable=GFL002 — fixture\n"
+    )
+    run = gofrlint.LintRun([str(tmp_path)])
+    assert run.ledger == {"GFL002": 2}
+    baseline = tmp_path / "ledger.json"
+    baseline.write_text(json.dumps({"version": 1, "counts": {"GFL002": 2}}))
+    assert gofrlint.check_ledger(run.ledger, str(baseline)) == []
+    # ratchet: baseline of 1 means the second disable is growth
+    baseline.write_text(json.dumps({"version": 1, "counts": {"GFL002": 1}}))
+    errors = gofrlint.check_ledger(run.ledger, str(baseline))
+    assert len(errors) == 1 and "grew" in errors[0]
+    # a rule absent from the baseline is allowed zero
+    baseline.write_text(json.dumps({"version": 1, "counts": {}}))
+    assert len(gofrlint.check_ledger(run.ledger, str(baseline))) == 1
+
+
+def test_committed_ledger_matches_the_tree():
+    """The baseline in tools/gofrlint_ledger.json IS the current tree's
+    ledger — the ratchet starts tight (a stale-but-loose baseline would
+    let new suppressions ride in under old headroom)."""
+    run = gofrlint.LintRun([
+        str(REPO / "gofr_tpu"), str(REPO / "tools"), str(REPO / "bench.py")
+    ])
+    committed = json.loads(
+        (REPO / "tools" / "gofrlint_ledger.json").read_text()
+    )["counts"]
+    assert run.ledger == committed
+
+
+# -- lock-order graph (static + merge) ----------------------------------------
+
+def test_static_lock_graph_schema_and_edges():
+    project = gofrlint.Project.from_sources({"gofr_tpu/m.py": (
+        "import threading\n"
+        "_a_lock = threading.Lock()\n"
+        "_b_lock = threading.Lock()\n"
+        "def f():\n"
+        "    with _a_lock:\n"
+        "        with _b_lock:\n"
+        "            pass\n"
+    )})
+    graph = gofrlint.WholeProgram(project).lock_graph()
+    assert graph["version"] == 1 and graph["source"] == "static"
+    ids = {n["id"] for n in graph["nodes"]}
+    assert ids == {"gofr_tpu/m.py:2", "gofr_tpu/m.py:3"}
+    assert [(e["from"], e["to"]) for e in graph["edges"]] == [
+        ("gofr_tpu/m.py:2", "gofr_tpu/m.py:3")
+    ]
+
+
+def _load_lockgraph_check():
+    spec = importlib.util.spec_from_file_location(
+        "lockgraph_check", REPO / "tools" / "lockgraph_check.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lockgraph_merge_finds_cross_tool_cycle(tmp_path):
+    """The point of the union: A→B proved statically, B→A observed at
+    runtime — a deadlock neither graph contains alone."""
+    lgc = _load_lockgraph_check()
+    static = {"version": 1, "source": "static", "nodes": [], "edges": [
+        {"from": "gofr_tpu/a.py:10", "to": "gofr_tpu/b.py:20", "site": "s"},
+    ]}
+    runtime = {"version": 1, "source": "runtime", "nodes": [], "edges": [
+        {"from": "/ci/work/repo/gofr_tpu/b.py:20",
+         "to": "/ci/work/repo/gofr_tpu/a.py:10", "site": "r"},
+    ]}
+    for name, doc in (("s.json", static), ("r.json", runtime)):
+        (tmp_path / name).write_text(json.dumps(doc))
+    assert lgc.main(["lockgraph_check", str(tmp_path / "s.json")]) == 0
+    assert lgc.main([
+        "lockgraph_check", str(tmp_path / "s.json"), str(tmp_path / "r.json")
+    ]) == 1
+
+
+def test_lockgraph_normalization_and_self_loops():
+    lgc = _load_lockgraph_check()
+    assert lgc.normalize("/home/ci/repo/gofr_tpu/x.py:12") == \
+        "gofr_tpu/x.py:12"
+    assert lgc.normalize("gofr_tpu/x.py:12") == "gofr_tpu/x.py:12"
+    assert lgc.normalize("gofr_tpu/m.py::C._lock") == "gofr_tpu/m.py::C._lock"
+    # two instances created at one site collapse — the resulting
+    # self-loop must NOT count as a cycle
+    adj = lgc.merge([{"source": "runtime", "edges": [
+        {"from": "/r/gofr_tpu/x.py:5", "to": "/r/gofr_tpu/x.py:5",
+         "site": "s"},
+    ]}])
+    assert lgc.find_cycles(adj) == []

@@ -107,6 +107,27 @@ def test_scanner_sees_the_known_registrations():
             "gofr_tpu_slo_burn_alerts_total",
             "gofr_tpu_tenants_tracked_entries",
             "gofr_tpu_tenant_overflow_total"} <= names
+    # the device serving core (tpu/device.py _init_metrics is the one
+    # registration home — GFL007 — for request/token/memory accounting,
+    # speculative acceptance and the prefix-cache surfaces)
+    assert {"gofr_tpu_requests_total",
+            "gofr_tpu_tokens_total",
+            "gofr_tpu_device_memory_bytes",
+            "gofr_tpu_spec_acceptance",
+            "gofr_tpu_prefix_hit_ratio",
+            "gofr_tpu_prefix_partial_hit_ratio",
+            "gofr_tpu_prefix_entries"} <= names
+    # continuous batching internals: queue-wait histogram (batcher.py)
+    # and the live decode-slot gauge (decode_pool.py)
+    assert {"gofr_tpu_queue_wait_seconds",
+            "gofr_tpu_decode_slots_active"} <= names
+    # crash-recovery surfaces: engine recovery outcomes (tpu/recovery.py),
+    # journal resume modes (telemetry.py), and the fleet's replica
+    # restart / stream-resume ledgers (fleet/router.py)
+    assert {"gofr_tpu_engine_recoveries_total",
+            "gofr_tpu_journal_resumes_total",
+            "gofr_tpu_router_replica_restarts_total",
+            "gofr_tpu_router_stream_resumes_total"} <= names
     assert len(names) >= 35
 
 

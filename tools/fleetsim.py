@@ -71,6 +71,11 @@ def main(argv: list[str]) -> int:
     parser.add_argument("--capture-out", default="",
                         help="write this run's served traffic as a "
                         "TRACE_CAPTURE file before teardown")
+    parser.add_argument("--emit-graph", default="",
+                        help="write the sanitizer's OBSERVED lock-order "
+                        "graph as JSON (requires GOFR_SANITIZE=1; same "
+                        "schema as gofrlint --emit-lock-graph — union "
+                        "the two with tools/lockgraph_check.py)")
     args = parser.parse_args(argv[1:])
 
     # sanitizer-armed when the environment asks (the CI fleet-sim job
@@ -130,12 +135,23 @@ def main(argv: list[str]) -> int:
         file=sys.stderr,
     )
     if sanitizer.enabled():
+        if args.emit_graph:
+            graph = sanitizer.export_graph(args.emit_graph)
+            print(
+                f"fleetsim: observed lock graph: "
+                f"{len(graph['nodes'])} locks, {len(graph['edges'])} "
+                f"edges -> {args.emit_graph}",
+                file=sys.stderr,
+            )
         report = sanitizer.drain()
         for finding in report["violations"]:
             print(f"fleetsim: SANITIZER: {finding.get('summary')}",
                   file=sys.stderr)
         if report["violations"]:
             return 1
+    elif args.emit_graph:
+        print("fleetsim: --emit-graph needs GOFR_SANITIZE=1 (no graph "
+              "was recorded)", file=sys.stderr)
     return 0
 
 

@@ -1,550 +1,67 @@
 #!/usr/bin/env python3
 """gofrlint — project-invariant linter for the gofr_tpu tree.
 
-ruff holds the style/complexity line; this tool holds the PROJECT
-invariants that generic linters cannot know — the conventions PRs 1-4
-enforced by hand in review (config discipline, timestamp discipline,
-thread hygiene, lock-hold discipline, metric naming, exception
-swallowing in engine threads). Stdlib only (``ast`` + ``tokenize``), so
-it runs anywhere the tests run.
-
-Rules
------
-GFL001  no raw ``os.environ``/``os.getenv`` READS outside ``config.py``.
-        Scope: package code (``gofr_tpu/``). Entry-point scripts
-        (``tools/``, ``bench.py``, examples) configure the process
-        environment before boot and are exempt, as are environment
-        WRITES anywhere (``update``/``setdefault``/``pop``/item
-        assignment — test scaffolding restores what it changed).
-GFL002  timestamp discipline: ``time.time()`` is forbidden for
-        durations/ordering — use ``time.monotonic()`` or
-        ``time.perf_counter()``. Wall-clock is allowed only at sites
-        explicitly annotated ``# gofrlint: wall-clock — <why>``
-        (presentation: log lines, API timestamps, filenames).
-GFL003  every ``threading.Thread`` must be named (``name=...``) and
-        either ``daemon=True`` or joined (a zero-positional-arg
-        ``.join()`` call somewhere in the same module).
-GFL004  no blocking calls while holding a lock: ``time.sleep``,
-        thread ``.join``, timeout-less queue ``get``/``put``, socket
-        accept/recv, subprocess, HTTP — inside a ``with <lock>:`` block
-        or between ``.acquire()``/``.release()``. ``Condition.wait``
-        is exempt (it releases the lock it guards).
-GFL005  metric names passed to the ``metrics.py`` constructors
-        (``.counter()``/``.gauge()``/``.histogram()``) must follow the
-        naming convention statically: ``gofr_`` prefix, snake_case,
-        counters end ``_total``, histograms carry a unit suffix,
-        gauges carry a unit/dimension suffix or an allowlist entry.
-GFL006  a bare ``except:`` is forbidden everywhere; ``except
-        Exception/BaseException: pass`` (swallow-and-continue) is
-        forbidden in engine modules (``gofr_tpu/tpu/``, telemetry,
-        timebase, tracing, postmortem, metrics) — a silently swallowed
-        exception on an engine thread is a wedge with no evidence.
-
-Suppression
------------
-``# gofrlint: disable=GFL001[,GFL004] — <reason>`` on the reported
-line (or on a comment-only line directly above it) suppresses those
-rules there. Suppressions are the violation LEDGER: grep-able, carried
-in-file next to the code they excuse, and expected to only shrink.
+This file is the stable entry point (CI and the test suite invoke
+``python tools/gofrlint.py`` / import it by path); the implementation
+lives in the ``tools/gofrlint/`` package. See that package's
+``__init__`` docstring for the rule table (GFL001–GFL009), the
+suppression-ledger contract, and the whole-program analysis model —
+or docs/advanced-guide/static-analysis.md for the prose version.
 
 Usage
 -----
-    python tools/gofrlint.py [--format=text|json] PATH [PATH...]
+    python tools/gofrlint.py [--format=text|json] [--ledger]
+        [--ledger-check FILE] [--emit-lock-graph FILE] PATH [PATH...]
 
-Exit status 0 when clean, 1 when violations were reported.
+Exit status 0 when clean, 1 when violations were reported (or the
+suppression ledger grew past the committed baseline).
 """
 
 from __future__ import annotations
 
-import argparse
-import ast
-import io
-import json
-import re
+import importlib.util
 import sys
-import tokenize
 from pathlib import Path
-from typing import Optional
 
-RULES = {
-    "GFL001": "raw environment read outside config.py",
-    "GFL002": "time.time() without a wall-clock annotation",
-    "GFL003": "threading.Thread hygiene (name + daemon-or-joined)",
-    "GFL004": "blocking call while holding a lock",
-    "GFL005": "metric name violates the naming convention",
-    "GFL006": "swallowed exception in an engine path",
-}
-
-_DISABLE_RE = re.compile(r"#\s*gofrlint:\s*disable=([A-Z0-9,\s]+)")
-_WALL_RE = re.compile(r"#\s*gofrlint:\s*wall-clock")
-
-# GFL001: os.environ methods that WRITE (allowed anywhere — scripts and
-# test scaffolding set the process environment; only reads must route
-# through config.py accessors)
-_ENV_WRITE_METHODS = {"update", "pop", "setdefault", "clear", "__setitem__"}
-
-# GFL005: mirrored from tests/test_metric_naming.py — the static half
-# of the same convention
-_COUNTER_SUFFIXES = ("_total",)
-_HISTOGRAM_SUFFIXES = ("_seconds", "_bytes", "_size")
-_GAUGE_SUFFIXES = (  # keep in lockstep with tests/test_metric_naming.py
-    "_seconds", "_bytes", "_total", "_depth", "_ratio", "_entries",
-    "_active", "_acceptance", "_state", "_blocks", "_size", "_level",
-    "_per_dispatch", "_rate", "_remaining",
-)
-_GAUGE_ALLOWLIST = {"gofr_tpu_mfu", "gofr_tpu_mbu"}
-
-# GFL006: modules whose code runs on (or under the locks of) engine
-# threads — a swallowed exception there is a silent wedge
-_ENGINE_MODULES = {
-    "telemetry.py", "timebase.py", "tracing.py", "postmortem.py",
-    "metrics.py", "profiling.py",
-}
-
-# GFL004 heuristics
-_LOCKISH_RE = re.compile(r"(lock|mutex|_mu)\b", re.IGNORECASE)
-_QUEUEISH_RE = re.compile(r"(queue|(^|\.)q$|_q$)", re.IGNORECASE)
-_EVENTISH_RE = re.compile(r"(event|_stop$|_ready$|stopped)", re.IGNORECASE)
-_THREADISH_RE = re.compile(r"(thread|worker|proc)", re.IGNORECASE)
+_PKG_DIR = Path(__file__).resolve().parent / "gofrlint"
 
 
-class Violation:
-    __slots__ = ("rule", "path", "line", "col", "message")
-
-    def __init__(self, rule: str, path: str, line: int, col: int, message: str):
-        self.rule = rule
-        self.path = path
-        self.line = line
-        self.col = col
-        self.message = message
-
-    def as_dict(self) -> dict:
-        return {
-            "file": self.path, "line": self.line, "col": self.col,
-            "rule": self.rule, "message": self.message,
-        }
-
-
-def _src(node: ast.AST) -> str:
-    try:
-        return ast.unparse(node)
-    except Exception:  # very old nodes / synthetic trees
-        return ""
-
-
-def _collect_comments(source: str) -> dict[int, str]:
-    """line number -> comment text (tokenize-accurate: a ``# gofrlint``
-    inside a string literal never counts)."""
-    out: dict[int, str] = {}
-    try:
-        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
-            if tok.type == tokenize.COMMENT:
-                out[tok.start[0]] = tok.string
-    except (tokenize.TokenError, IndentationError):
-        pass
-    return out
-
-
-class FileLinter:
-    def __init__(self, path: Path, rel: str, source: str):
-        self.path = path
-        self.rel = rel
-        self.source = source
-        self.lines = source.splitlines()
-        self.comments = _collect_comments(source)
-        self.violations: list[Violation] = []
-        self.in_package = "gofr_tpu" in Path(rel).parts
-        parts = Path(rel).parts
-        self.is_engine = (
-            ("tpu" in parts and self.in_package)
-            or Path(rel).name in _ENGINE_MODULES and self.in_package
-        )
-        # comment-only lines pass their directives down to the next
-        # CODE line (cascading through blank lines and further comment
-        # lines, so a multi-line reason block above a statement works)
-        self._directive_lines: dict[int, str] = {}
-        for lineno, comment in self.comments.items():
-            line = self.lines[lineno - 1]
-            code = line[: line.index("#")] if "#" in line else line
-            target = lineno
-            if not code.strip():
-                target = lineno + 1
-                while target <= len(self.lines):
-                    stripped = self.lines[target - 1].strip()
-                    if stripped and not stripped.startswith("#"):
-                        break
-                    target += 1
-            self._directive_lines.setdefault(target, "")
-            self._directive_lines[target] += " " + comment
-
-    # -- directives -----------------------------------------------------------
-    def _directives_at(self, lineno: int) -> str:
-        return self._directive_lines.get(lineno, "")
-
-    def suppressed(self, rule: str, lineno: int) -> bool:
-        m = _DISABLE_RE.search(self._directives_at(lineno))
-        if not m:
-            return False
-        codes = {c.strip() for c in m.group(1).split(",")}
-        return rule in codes
-
-    def wall_annotated(self, lineno: int) -> bool:
-        return bool(_WALL_RE.search(self._directives_at(lineno)))
-
-    def report(self, rule: str, node: ast.AST, message: str) -> None:
-        lineno = getattr(node, "lineno", 1)
-        col = getattr(node, "col_offset", 0)
-        if self.suppressed(rule, lineno):
-            return
-        self.violations.append(Violation(rule, self.rel, lineno, col, message))
-
-    # -- entry ----------------------------------------------------------------
-    def run(self) -> list[Violation]:
-        try:
-            tree = ast.parse(self.source)
-        except SyntaxError as exc:
-            self.violations.append(Violation(
-                "GFL000", self.rel, exc.lineno or 1, 0,
-                f"syntax error: {exc.msg}",
-            ))
-            return self.violations
-        parents: dict[int, ast.AST] = {}
-        for parent in ast.walk(tree):
-            for child in ast.iter_child_nodes(parent):
-                parents[id(child)] = parent
-        self._parents = parents
-        module_joins = self._module_has_thread_join(tree)
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call):
-                self._check_env_read_call(node)
-                self._check_wall_clock(node)
-                self._check_thread(node, module_joins)
-                self._check_metric_name(node)
-            elif isinstance(node, ast.Attribute):
-                self._check_environ_use(node)
-            elif isinstance(node, ast.ExceptHandler):
-                self._check_except(node)
-            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self._check_lock_holds(node)
-        return self.violations
-
-    # -- GFL001 ---------------------------------------------------------------
-    def _gfl001_active(self) -> bool:
-        return self.in_package and Path(self.rel).name != "config.py"
-
-    def _check_env_read_call(self, node: ast.Call) -> None:
-        if not self._gfl001_active():
-            return
-        fn = node.func
-        if isinstance(fn, ast.Attribute) and fn.attr == "getenv" and \
-                isinstance(fn.value, ast.Name) and fn.value.id == "os":
-            self.report(
-                "GFL001", node,
-                "os.getenv() outside config.py — use a config.py accessor "
-                "(get_env/env_flag)",
-            )
-
-    def _check_environ_use(self, node: ast.Attribute) -> None:
-        if not self._gfl001_active():
-            return
-        if node.attr != "environ" or not (
-            isinstance(node.value, ast.Name) and node.value.id == "os"
-        ):
-            return
-        parent = self._parents.get(id(node))
-        # allowed: write-method calls and item writes/deletes
-        if isinstance(parent, ast.Attribute) and \
-                parent.attr in _ENV_WRITE_METHODS:
-            return
-        if isinstance(parent, ast.Subscript) and isinstance(
-            parent.ctx, (ast.Store, ast.Del)
-        ):
-            return
-        self.report(
-            "GFL001", node,
-            "raw os.environ read outside config.py — use a config.py "
-            "accessor (get_env/env_flag/environ_snapshot)",
-        )
-
-    # -- GFL002 ---------------------------------------------------------------
-    def _check_wall_clock(self, node: ast.Call) -> None:
-        fn = node.func
-        is_time_time = (
-            isinstance(fn, ast.Attribute) and fn.attr == "time"
-            and isinstance(fn.value, ast.Name) and fn.value.id == "time"
-        )
-        if not is_time_time:
-            return
-        if self.wall_annotated(node.lineno):
-            return
-        self.report(
-            "GFL002", node,
-            "time.time() — use time.monotonic()/perf_counter() for "
-            "durations and ordering; annotate true presentation sites "
-            "with '# gofrlint: wall-clock — <why>'",
-        )
-
-    # -- GFL003 ---------------------------------------------------------------
-    @staticmethod
-    def _module_has_thread_join(tree: ast.Module) -> bool:
-        """A zero-positional-arg ``.join()`` call anywhere in the module
-        (``t.join()``, ``self._thread.join(timeout=5)``). ``str.join``
-        and ``os.path.join`` always take positional args."""
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "join"
-                and not node.args
-            ):
-                return True
-        return False
-
-    def _check_thread(self, node: ast.Call, module_joins: bool) -> None:
-        fn = node.func
-        is_thread = (
-            isinstance(fn, ast.Attribute) and fn.attr == "Thread"
-            and isinstance(fn.value, ast.Name) and fn.value.id == "threading"
-        ) or (isinstance(fn, ast.Name) and fn.id == "Thread")
-        if not is_thread:
-            return
-        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
-        if "name" not in kwargs:
-            self.report(
-                "GFL003", node,
-                "unnamed thread — pass name=... so stacks, the watchdog, "
-                "and the leak detector can attribute it",
-            )
-        daemon = kwargs.get("daemon")
-        is_daemon = isinstance(daemon, ast.Constant) and daemon.value is True
-        if not is_daemon and not module_joins:
-            self.report(
-                "GFL003", node,
-                "non-daemon thread with no .join() in this module — "
-                "daemonize it or join it in close()",
-            )
-
-    # -- GFL004 ---------------------------------------------------------------
-    def _check_lock_holds(self, func: ast.AST) -> None:
-        self._walk_stmts(list(getattr(func, "body", [])), held=[])
-
-    def _walk_stmts(self, stmts: list, held: list) -> None:
-        for stmt in stmts:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue  # nested defs are visited on their own
-            if isinstance(stmt, (ast.With, ast.AsyncWith)):
-                acquired = [
-                    _src(item.context_expr)
-                    for item in stmt.items
-                    if self._lockish(item.context_expr)
-                ]
-                held.extend(acquired)
-                self._walk_stmts(stmt.body, held)
-                for _ in acquired:
-                    held.pop()
-                continue
-            lock_op = self._acquire_release(stmt)
-            if lock_op is not None:
-                op, name = lock_op
-                if op == "acquire":
-                    held.append(name)
-                elif name in held:
-                    held.remove(name)
-                continue
-            if held:
-                for call in (
-                    n for n in ast.walk(stmt) if isinstance(n, ast.Call)
-                ):
-                    self._check_blocking(call, held)
-            else:
-                for attr in ("body", "orelse", "finalbody"):
-                    self._walk_stmts(list(getattr(stmt, attr, [])), held)
-                for handler in getattr(stmt, "handlers", []):
-                    self._walk_stmts(list(handler.body), held)
-
-    @staticmethod
-    def _lockish(expr: ast.AST) -> bool:
-        return bool(_LOCKISH_RE.search(_src(expr)))
-
-    def _acquire_release(self, stmt: ast.stmt) -> Optional[tuple]:
-        if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
-            return None
-        call = stmt.value
-        if not isinstance(call.func, ast.Attribute):
-            return None
-        if call.func.attr not in ("acquire", "release"):
-            return None
-        receiver = _src(call.func.value)
-        if not _LOCKISH_RE.search(receiver):
-            return None
-        return (call.func.attr, receiver)
-
-    @staticmethod
-    def _has_timeout(call: ast.Call) -> bool:
-        if any(kw.arg == "timeout" for kw in call.keywords):
-            return True
-        # Queue.get(block, timeout) positional form
-        return len(call.args) >= 2
-
-    def _check_blocking(self, call: ast.Call, held: list) -> None:
-        fn = call.func
-        label = None
-        if isinstance(fn, ast.Attribute):
-            receiver = _src(fn.value)
-            attr = fn.attr
-            if attr == "sleep" and receiver == "time":
-                label = "time.sleep()"
-            elif attr == "join" and not call.args and \
-                    _THREADISH_RE.search(receiver):
-                label = f"{receiver}.join()"
-            elif attr in ("get", "put") and _QUEUEISH_RE.search(receiver) \
-                    and not self._has_timeout(call):
-                label = f"timeout-less {receiver}.{attr}()"
-            elif attr == "wait" and _EVENTISH_RE.search(receiver) and \
-                    not self._has_timeout(call) and not call.args:
-                label = f"timeout-less {receiver}.wait()"
-            elif attr in ("accept", "recv", "recvfrom") and \
-                    _LOCKISH_RE.search(" ".join(held)):
-                label = f"socket .{attr}()"
-            elif receiver == "subprocess" and attr in (
-                "run", "call", "check_call", "check_output"
-            ):
-                label = f"subprocess.{attr}()"
-            elif receiver in ("requests", "urllib.request") or \
-                    attr == "urlopen":
-                label = f"{receiver}.{attr}()"
-        elif isinstance(fn, ast.Name) and fn.id == "sleep":
-            label = "sleep()"
-        if label is None:
-            return
-        self.report(
-            "GFL004", call,
-            f"{label} while holding {held[-1]!r} — blocking under a lock "
-            "stalls every contending thread (move it outside the "
-            "critical section)",
-        )
-
-    # -- GFL005 ---------------------------------------------------------------
-    def _check_metric_name(self, node: ast.Call) -> None:
-        fn = node.func
-        if not (
-            isinstance(fn, ast.Attribute)
-            and fn.attr in ("counter", "gauge", "histogram")
-        ):
-            return
-        if not node.args or not (
-            isinstance(node.args[0], ast.Constant)
-            and isinstance(node.args[0].value, str)
-        ):
-            return
-        name = node.args[0].value
-        kind = fn.attr
-        problem = None
-        if not name.startswith("gofr_"):
-            problem = "missing gofr_ prefix"
-        elif not re.fullmatch(r"[a-z][a-z0-9_]*", name) or "__" in name:
-            problem = "not snake_case"
-        elif kind == "counter" and not name.endswith(_COUNTER_SUFFIXES):
-            problem = "counter must end in _total"
-        elif kind == "histogram" and not name.endswith(_HISTOGRAM_SUFFIXES):
-            problem = f"histogram needs a unit suffix {_HISTOGRAM_SUFFIXES}"
-        elif kind == "gauge" and name not in _GAUGE_ALLOWLIST and \
-                not name.endswith(_GAUGE_SUFFIXES):
-            problem = (
-                f"gauge needs a unit/dimension suffix {_GAUGE_SUFFIXES} "
-                "(or an allowlist entry)"
-            )
-        if problem:
-            self.report("GFL005", node, f"metric {name!r}: {problem}")
-
-    # -- GFL006 ---------------------------------------------------------------
-    def _check_except(self, node: ast.ExceptHandler) -> None:
-        if node.type is None:
-            self.report(
-                "GFL006", node,
-                "bare except: — catch a concrete exception type",
-            )
-            return
-        if not self.is_engine:
-            return
-        broad = isinstance(node.type, ast.Name) and node.type.id in (
-            "Exception", "BaseException"
-        )
-        body_is_pass = all(
-            isinstance(s, ast.Pass)
-            or (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant))
-            for s in node.body
-        )
-        if broad and body_is_pass:
-            # report at the pass statement: the suppression comment (the
-            # ledger entry) belongs next to the swallow itself
-            self.report(
-                "GFL006", node.body[0],
-                f"except {node.type.id}: pass in an engine path — a "
-                "swallowed exception on an engine thread is a silent "
-                "wedge; log it, re-raise, or narrow the type",
-            )
-
-
-def iter_files(paths: list[str]) -> list[Path]:
-    out: list[Path] = []
-    for raw in paths:
-        p = Path(raw)
-        if p.is_dir():
-            out.extend(
-                f for f in sorted(p.rglob("*.py"))
-                if "__pycache__" not in f.parts
-            )
-        elif p.suffix == ".py":
-            out.append(p)
-    return out
-
-
-def lint_paths(paths: list[str]) -> tuple[list[Violation], int]:
-    violations: list[Violation] = []
-    files = iter_files(paths)
-    for path in files:
-        try:
-            source = path.read_text(encoding="utf-8")
-        except (OSError, UnicodeDecodeError):
-            continue
-        rel = str(path)
-        violations.extend(FileLinter(path, rel, source).run())
-    return violations, len(files)
-
-
-def main(argv: Optional[list[str]] = None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="gofrlint", description=__doc__,
-        formatter_class=argparse.RawDescriptionHelpFormatter,
+def _load_impl():
+    cached = sys.modules.get("_gofrlint_impl")
+    if cached is not None:
+        return cached
+    spec = importlib.util.spec_from_file_location(
+        "_gofrlint_impl",
+        _PKG_DIR / "__init__.py",
+        submodule_search_locations=[str(_PKG_DIR)],
     )
-    parser.add_argument("paths", nargs="+", help="files or directories")
-    parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        dest="fmt", help="output format",
-    )
-    args = parser.parse_args(argv)
-    violations, scanned = lint_paths(args.paths)
-    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
-    if args.fmt == "json":
-        counts: dict[str, int] = {}
-        for v in violations:
-            counts[v.rule] = counts.get(v.rule, 0) + 1
-        print(json.dumps({
-            "version": 1,
-            "files_scanned": scanned,
-            "violations": [v.as_dict() for v in violations],
-            "counts_by_rule": counts,
-        }, indent=2))
-    else:
-        for v in violations:
-            print(f"{v.path}:{v.line}:{v.col + 1}: {v.rule} {v.message}")
-        print(
-            f"gofrlint: {len(violations)} violation(s) in {scanned} file(s)"
-            if violations else f"gofrlint: clean ({scanned} files)"
-        )
-    return 1 if violations else 0
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["_gofrlint_impl"] = module
+    try:
+        spec.loader.exec_module(module)
+    except BaseException:
+        sys.modules.pop("_gofrlint_impl", None)
+        raise
+    return module
 
+
+_impl = _load_impl()
+
+RULES = _impl.RULES
+Violation = _impl.Violation
+FileLinter = _impl.FileLinter
+LintRun = _impl.LintRun
+Project = _impl.Project
+WholeProgram = _impl.WholeProgram
+check_ledger = _impl.check_ledger
+contract_violations = _impl.contract_violations
+iter_files = _impl.iter_files
+lint_paths = _impl.lint_paths
+main = _impl.main
+_COUNTER_SUFFIXES = _impl._COUNTER_SUFFIXES
+_HISTOGRAM_SUFFIXES = _impl._HISTOGRAM_SUFFIXES
+_GAUGE_SUFFIXES = _impl._GAUGE_SUFFIXES
+_GAUGE_ALLOWLIST = _impl._GAUGE_ALLOWLIST
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,0 +1,180 @@
+"""lint_paths + the command line. Orchestration order:
+
+1. per-file pass (GFL001–006) — unchanged v1 semantics,
+2. whole-program pass over the same files: project model → fixpoint
+   summaries → interprocedural GFL004 + static lock-order graph,
+3. contract registries (GFL007/008/009) against the repo artifacts
+   (tests/test_metric_naming.py, config.py DECLARED_KEYS, README.md).
+
+Flags on top of v1's ``--format``: ``--ledger`` (print the per-rule
+suppression counts), ``--ledger-check FILE`` (fail if any count grew
+past the committed baseline — the ledger only shrinks), and
+``--emit-lock-graph FILE`` (write the static lock-order graph JSON for
+tools/lockgraph_check.py)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Optional
+
+from .base import Violation, iter_files
+from .contracts import contract_violations
+from .interproc import WholeProgram
+from .local import FileLinter
+from .model import Project
+
+
+def _detect_root(paths: list[str], files: list[Path]) -> Path:
+    candidates = [Path(p).resolve() for p in paths] or \
+        [f.resolve() for f in files]
+    try:
+        root = Path(os.path.commonpath([str(c) for c in candidates]))
+    except ValueError:  # mixed drives / empty
+        return Path.cwd()
+    if root.is_file():
+        root = root.parent
+    if root.name == "gofr_tpu" and (root / "__init__.py").exists():
+        root = root.parent  # scanning the package dir alone: artifacts
+        # (README, tests/) live beside it
+    return root
+
+
+class LintRun:
+    """One full analysis: violations, suppression ledger, lock graph."""
+
+    def __init__(self, paths: list[str]):
+        self.files = iter_files(paths)
+        self.root = _detect_root(paths, self.files)
+        self.violations: list[Violation] = []
+        self.ledger: dict[str, int] = {}
+        sources: dict[str, str] = {}      # model rel -> source
+        display: dict[str, str] = {}      # model rel -> output path
+        for path in self.files:
+            try:
+                source = path.read_text(encoding="utf-8")
+            except (OSError, UnicodeDecodeError):
+                continue
+            rel = str(path)
+            linter = FileLinter(path, rel, source)
+            self.violations.extend(linter.run())
+            for rule, count in linter.directives.disable_counts().items():
+                self.ledger[rule] = self.ledger.get(rule, 0) + count
+            try:
+                model_rel = path.resolve().relative_to(self.root).as_posix()
+            except ValueError:
+                model_rel = path.as_posix()
+            sources[model_rel] = source
+            display[model_rel] = rel
+        self.project = Project.from_sources(sources)
+        whole = WholeProgram(self.project)
+        self.lock_graph = whole.lock_graph()
+        seen = {(v.rule, v.path, v.line) for v in self.violations}
+        for v in whole.violations() + contract_violations(
+            self.project, self.root
+        ):
+            v.path = display.get(v.path, v.path)
+            if (v.rule, v.path, v.line) not in seen:
+                seen.add((v.rule, v.path, v.line))
+                self.violations.append(v)
+
+
+def lint_paths(paths: list[str]) -> tuple[list[Violation], int]:
+    run = LintRun(paths)
+    return run.violations, len(run.files)
+
+
+def check_ledger(current: dict[str, int], baseline_path: str) -> list[str]:
+    """Growth errors vs the committed ledger (empty = ok). A rule
+    missing from the baseline counts as baseline 0."""
+    try:
+        with open(baseline_path, "r", encoding="utf-8") as f:
+            baseline = json.load(f).get("counts", {})
+    except (OSError, ValueError) as exc:
+        return [f"ledger baseline {baseline_path} unreadable: {exc}"]
+    errors = []
+    for rule in sorted(set(current) | set(baseline)):
+        have, allowed = current.get(rule, 0), baseline.get(rule, 0)
+        if have > allowed:
+            errors.append(
+                f"suppression ledger grew: {rule} has {have} "
+                f"disable(s), baseline allows {allowed} — fix the "
+                "violation instead of suppressing it (the ledger only "
+                "shrinks; if a suppression was genuinely removed "
+                "elsewhere, re-emit the baseline with --ledger)"
+            )
+    return errors
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="gofrlint",
+        description="project-invariant linter for the gofr_tpu tree",
+    )
+    parser.add_argument("paths", nargs="+", help="files or directories")
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        dest="fmt", help="output format",
+    )
+    parser.add_argument(
+        "--ledger", action="store_true",
+        help="print the per-rule suppression-ledger counts as JSON "
+             "and exit (0 always — this is the baseline emitter)",
+    )
+    parser.add_argument(
+        "--ledger-check", metavar="FILE", default=None,
+        help="fail (exit 1) if any per-rule suppression count exceeds "
+             "the committed baseline FILE",
+    )
+    parser.add_argument(
+        "--emit-lock-graph", metavar="FILE", default=None,
+        help="write the static lock-order graph JSON to FILE",
+    )
+    args = parser.parse_args(argv)
+    run = LintRun(args.paths)
+    if args.ledger:
+        print(json.dumps(
+            {"version": 1, "counts": dict(sorted(run.ledger.items()))},
+            indent=2,
+        ))
+        return 0
+    if args.emit_lock_graph:
+        with open(args.emit_lock_graph, "w", encoding="utf-8") as f:
+            json.dump(run.lock_graph, f, indent=2)
+            f.write("\n")
+    violations = run.violations
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    ledger_errors = (
+        check_ledger(run.ledger, args.ledger_check)
+        if args.ledger_check else []
+    )
+    if args.fmt == "json":
+        counts: dict[str, int] = {}
+        for v in violations:
+            counts[v.rule] = counts.get(v.rule, 0) + 1
+        print(json.dumps({
+            "version": 1,
+            "files_scanned": len(run.files),
+            "violations": [v.as_dict() for v in violations],
+            "counts_by_rule": counts,
+            "suppressions": dict(sorted(run.ledger.items())),
+            "ledger_errors": ledger_errors,
+        }, indent=2))
+    else:
+        for v in violations:
+            print(f"{v.path}:{v.line}:{v.col + 1}: {v.rule} {v.message}")
+        for err in ledger_errors:
+            print(f"gofrlint: {err}")
+        print(
+            f"gofrlint: {len(violations)} violation(s) in "
+            f"{len(run.files)} file(s)"
+            if violations else f"gofrlint: clean ({len(run.files)} files)"
+        )
+    return 1 if (violations or ledger_errors) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

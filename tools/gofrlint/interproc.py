@@ -1,0 +1,292 @@
+"""Interprocedural half of GFL004 plus the static lock-order graph.
+
+Per function we extract a summary: the call sites it makes (with the
+set of locks held at each site), any directly-blocking primitive in
+its body, and the locks it acquires. Two monotone facts are then
+computed to a fixpoint over the call graph:
+
+- ``may_block(f)``: f contains a blocking primitive, or calls (through
+  the resolved graph) a function that does. A witness chain is kept so
+  the finding names the path (``append_tokens → _sync → os.fsync()``).
+- ``acquires_any(f)``: every lock f may take, directly or transitively.
+
+Findings: a call site executed while a lock is held whose callee
+``may_block`` — the PR 14 shape (WAL fsync reached through attribute
+dispatch while the per-token journal lock is held) that the per-file
+rule is structurally blind to.
+
+Lock-order edges: lock B acquired (directly or via a callee) while A
+is held → edge A→B, exported as JSON for the merge with the runtime
+sanitizer's observed graph (tools/lockgraph_check.py)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .base import Violation, classify_blocking, lockish, src_of
+from .model import FunctionInfo, Project
+
+_STMT_LIST_FIELDS = {"body", "orelse", "finalbody", "handlers", "items"}
+
+_FIXPOINT_CAP = 50  # call-graph depth bound; deeper chains than this
+# don't occur in a ~25k LoC tree and a cap keeps pathological inputs
+# from spinning
+
+
+def _expr_calls(stmt: ast.stmt) -> Iterator[ast.Call]:
+    """Call nodes in a statement's own expressions (header of a
+    compound statement, the whole of a simple one) — NOT in nested
+    statement bodies, which the structural walk visits itself."""
+    for field, value in ast.iter_fields(stmt):
+        if field in _STMT_LIST_FIELDS:
+            continue
+        values = value if isinstance(value, list) else [value]
+        for v in values:
+            if isinstance(v, ast.AST):
+                for node in ast.walk(v):
+                    if isinstance(node, ast.Call):
+                        yield node
+
+
+class Summary:
+    __slots__ = (
+        "func", "calls", "direct_block", "acquires", "edges",
+        "may_block", "witness", "acquires_any",
+    )
+
+    def __init__(self, func: FunctionInfo):
+        self.func = func
+        # (call node, tuple of held lock ids at the site)
+        self.calls: list[tuple[ast.Call, tuple]] = []
+        self.direct_block: Optional[tuple] = None  # (label, lineno)
+        self.acquires: set[str] = set()
+        # (held_id, acquired_id, "rel:lineno" of the acquisition)
+        self.edges: set[tuple] = set()
+        self.may_block = False
+        self.witness = ""          # human chain, e.g. "_sync → os.fsync()"
+        self.acquires_any: set[str] = set()
+
+
+class _FunctionScanner:
+    """One structural walk of a function body, tracking the held-lock
+    stack through ``with`` blocks and acquire()/release() statements."""
+
+    def __init__(self, project: Project, func: FunctionInfo):
+        self.project = project
+        self.func = func
+        self.summary = Summary(func)
+
+    def run(self) -> Summary:
+        self._walk(list(self.func.node.body), held=[])
+        self.summary.acquires_any = set(self.summary.acquires)
+        return self.summary
+
+    def _record_call(self, call: ast.Call, held: list) -> None:
+        self.summary.calls.append(
+            (call, tuple(lid for lid, _src in held))
+        )
+        if self.summary.direct_block is None:
+            label = classify_blocking(call, None)
+            if label is not None:
+                self.summary.direct_block = (label, call.lineno)
+
+    def _acquire(self, expr: ast.AST, held: list) -> str:
+        lid = self.project.lock_id(expr, self.func)
+        site = f"{self.func.rel}:{getattr(expr, 'lineno', 0)}"
+        for held_id, _src in held:
+            if held_id != lid:
+                self.summary.edges.add((held_id, lid, site))
+        self.summary.acquires.add(lid)
+        return lid
+
+    def _walk(self, stmts: list, held: list) -> None:
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # nested defs don't run at definition time
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for call in _expr_calls(stmt):
+                    self._record_call(call, held)
+                acquired = 0
+                for item in stmt.items:
+                    if lockish(item.context_expr):
+                        lid = self._acquire(item.context_expr, held)
+                        held.append((lid, src_of(item.context_expr)))
+                        acquired += 1
+                self._walk(stmt.body, held)
+                for _ in range(acquired):
+                    held.pop()
+                continue
+            lock_op = self._acquire_release_stmt(stmt)
+            if lock_op is not None:
+                op, expr = lock_op
+                if op == "acquire":
+                    lid = self._acquire(expr, held)
+                    held.append((lid, src_of(expr)))
+                else:
+                    lid = self.project.lock_id(expr, self.func)
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i][0] == lid:
+                            del held[i]
+                            break
+                continue
+            for call in _expr_calls(stmt):
+                self._record_call(call, held)
+            for field in ("body", "orelse", "finalbody"):
+                self._walk(list(getattr(stmt, field, [])), held)
+            for handler in getattr(stmt, "handlers", []):
+                self._walk(list(handler.body), held)
+
+    @staticmethod
+    def _acquire_release_stmt(stmt: ast.stmt) -> Optional[tuple]:
+        if not (isinstance(stmt, ast.Expr) and
+                isinstance(stmt.value, ast.Call)):
+            return None
+        call = stmt.value
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        if call.func.attr not in ("acquire", "release"):
+            return None
+        if not lockish(call.func.value):
+            return None
+        return (call.func.attr, call.func.value)
+
+
+class WholeProgram:
+    """The fixpoint pass over a built :class:`Project`."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.summaries: dict[str, Summary] = {}
+        for func in project.functions.values():
+            self.summaries[func.qname] = _FunctionScanner(project, func).run()
+        self._resolved: dict[int, list[FunctionInfo]] = {}
+        self._fixpoint()
+
+    def _callees(self, summary: Summary, call: ast.Call) -> list[FunctionInfo]:
+        key = id(call)
+        if key not in self._resolved:
+            self._resolved[key] = self.project.resolve_call(
+                call, summary.func
+            )
+        return self._resolved[key]
+
+    def _fixpoint(self) -> None:
+        for s in self.summaries.values():
+            if s.direct_block is not None:
+                s.may_block = True
+                s.witness = s.direct_block[0]
+        for _ in range(_FIXPOINT_CAP):
+            changed = False
+            for s in self.summaries.values():
+                for call, _held in s.calls:
+                    for callee in self._callees(s, call):
+                        cs = self.summaries.get(callee.qname)
+                        if cs is None:
+                            continue
+                        if cs.may_block and not s.may_block:
+                            s.may_block = True
+                            s.witness = f"{callee.name} → {cs.witness}"
+                            changed = True
+                        extra = cs.acquires_any - s.acquires_any
+                        if extra:
+                            s.acquires_any |= extra
+                            changed = True
+            if not changed:
+                break
+
+    # -- whole-program GFL004 -------------------------------------------------
+    def _blocks_only_within(self, cls, qname: str, seen: set) -> bool:
+        """Every may-block path from ``qname`` stays inside methods of
+        ``cls`` — the resource-guard shape (a class serializing its OWN
+        blocking resource behind its own lock: JournalWAL's fsync under
+        JournalWAL._lock). Such chains are visible in one screen of
+        code; the rule exists for reach-through that crosses object
+        boundaries."""
+        summary = self.summaries.get(qname)
+        if summary is None or summary.func.cls is not cls:
+            return False
+        if qname in seen:
+            return True
+        seen.add(qname)
+        for call, _held in summary.calls:
+            for callee in self._callees(summary, call):
+                cs = self.summaries.get(callee.qname)
+                if cs is not None and cs.may_block and \
+                        not self._blocks_only_within(cls, callee.qname, seen):
+                    return False
+        return True
+
+    def _self_intrinsic(self, s: Summary, held: tuple,
+                        callee: FunctionInfo) -> bool:
+        cls = s.func.cls
+        if cls is None:
+            return False
+        if not all(
+            self.project.lock_owned_by_class(lid, cls) for lid in held
+        ):
+            return False
+        return self._blocks_only_within(cls, callee.qname, set())
+
+    def violations(self) -> list[Violation]:
+        out: list[Violation] = []
+        for s in self.summaries.values():
+            directives = s.func.module.directives
+            for call, held in s.calls:
+                if not held:
+                    continue
+                for callee in self._callees(s, call):
+                    cs = self.summaries.get(callee.qname)
+                    if cs is None or not cs.may_block:
+                        continue
+                    if self._self_intrinsic(s, held, callee):
+                        continue
+                    if directives.suppressed("GFL004", call.lineno):
+                        continue
+                    out.append(Violation(
+                        "GFL004", s.func.rel, call.lineno,
+                        call.col_offset,
+                        f"call to {callee.name}() may block "
+                        f"({callee.name} → {cs.witness}) while holding "
+                        f"lock {held[-1]} — reached through the call "
+                        "graph; move the blocking work outside the "
+                        "critical section",
+                    ))
+                    break  # one finding per call site is enough
+        return out
+
+    # -- static lock-order graph ----------------------------------------------
+    def lock_graph(self) -> dict:
+        """``{"source": "static", "nodes": [...], "edges": [...]}`` —
+        node ids are creation sites (``rel:lineno``) where resolvable,
+        matching the runtime sanitizer's creation labels."""
+        edges: dict[tuple, str] = {}
+        for s in self.summaries.values():
+            for a, b, site in s.edges:
+                edges.setdefault((a, b), site)
+            # interprocedural: a call made under lock A to a function
+            # that may acquire B is an A→B ordering edge
+            for call, held in s.calls:
+                if not held:
+                    continue
+                for callee in self._callees(s, call):
+                    cs = self.summaries.get(callee.qname)
+                    if cs is None:
+                        continue
+                    site = f"{s.func.rel}:{call.lineno}"
+                    for b in sorted(cs.acquires_any):
+                        for a in held:
+                            if a != b:
+                                edges.setdefault((a, b), site)
+        nodes = sorted({n for pair in edges for n in pair})
+        return {
+            "version": 1,
+            "source": "static",
+            "nodes": [{"id": n} for n in nodes],
+            "edges": [
+                {"from": a, "to": b, "site": site}
+                for (a, b), site in sorted(edges.items())
+            ],
+        }

@@ -1,0 +1,365 @@
+"""Project model for the whole-program pass: modules, classes,
+module-level functions, an import map, lock creation sites, and the
+conservative call-resolution the interprocedural rules share.
+
+Resolution is deliberately conservative (documented blind spots in
+docs/advanced-guide/static-analysis.md): it follows
+
+- direct calls to module-level functions (same module or imported),
+- ``self.method(...)`` through the enclosing class and its in-project
+  bases,
+- ``self.attr.method(...)`` where ``attr``'s class is inferred from a
+  ``self.attr = SomeClass(...)`` assignment in any method of the class
+  (the PR 14 journal→WAL shape), and
+- ``module.func(...)`` through ``import``/``from .. import`` aliases.
+
+Dynamic dispatch through dicts, monkeypatched attributes, callables
+passed as arguments, and nested ``def``s are NOT resolved — the rules
+built on top must stay sound-for-the-resolved-subgraph, not complete.
+
+Lock identity is the CREATION SITE ``relpath:lineno`` of the
+``threading.Lock()`` / ``RLock()`` / ``Condition()`` call — the same
+label the runtime sanitizer stamps on its observed graph (modulo path
+normalization), so the static and runtime lock-order graphs merge on
+node id."""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Optional
+
+from .base import Directives
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def dotted_name(rel: str) -> str:
+    parts = list(Path(rel).parts)
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    else:
+        parts[-1] = parts[-1][:-3]  # strip .py
+    return ".".join(parts)
+
+
+class FunctionInfo:
+    __slots__ = ("qname", "name", "rel", "cls", "node", "module")
+
+    def __init__(self, qname, name, rel, cls, node, module):
+        self.qname = qname          # "rel::Class.meth" or "rel::func"
+        self.name = name
+        self.rel = rel
+        self.cls = cls              # ClassInfo | None
+        self.node = node
+        self.module = module        # ModuleInfo
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<fn {self.qname}>"
+
+
+class ClassInfo:
+    __slots__ = ("qname", "name", "rel", "bases", "methods", "attr_types",
+                 "module")
+
+    def __init__(self, qname, name, rel, bases, module):
+        self.qname = qname
+        self.name = name
+        self.rel = rel
+        self.bases = bases          # list[ast.expr]
+        self.methods: dict[str, FunctionInfo] = {}
+        self.attr_types: dict[str, str] = {}   # attr -> ClassInfo.qname
+        self.module = module
+
+
+class ModuleInfo:
+    __slots__ = ("rel", "dotted", "source", "tree", "directives",
+                 "functions", "classes", "import_map")
+
+    def __init__(self, rel: str, source: str, tree: ast.Module):
+        self.rel = rel
+        self.dotted = dotted_name(rel)
+        self.source = source
+        self.tree = tree
+        self.directives = Directives(source)
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        # local name -> ("module", dotted) | ("symbol", dotted, symbol)
+        self.import_map: dict[str, tuple] = {}
+
+
+class Project:
+    """Symbol table + call resolution over a set of parsed sources."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.by_dotted: dict[str, ModuleInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}       # qname -> ClassInfo
+        self.functions: dict[str, FunctionInfo] = {}  # qname -> FunctionInfo
+        # (owner, attr/name) -> "rel:lineno" creation site; owner is a
+        # class qname for instance locks, a module rel for globals
+        self.lock_sites: dict[tuple, str] = {}
+
+    # -- construction ---------------------------------------------------------
+    @classmethod
+    def from_sources(cls, sources: dict[str, str]) -> "Project":
+        proj = cls()
+        for rel in sorted(sources):
+            try:
+                tree = ast.parse(sources[rel])
+            except SyntaxError:
+                continue  # the per-file pass reports GFL000
+            proj._add_module(rel, sources[rel], tree)
+        for mod in proj.modules.values():
+            proj._infer_attr_types(mod)
+        return proj
+
+    def _add_module(self, rel: str, source: str, tree: ast.Module) -> None:
+        mod = ModuleInfo(rel, source, tree)
+        self.modules[rel] = mod
+        self.by_dotted[mod.dotted] = mod
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = alias.asname and alias.name or \
+                        alias.name.split(".")[0]
+                    mod.import_map[local] = ("module", target)
+            elif isinstance(stmt, ast.ImportFrom):
+                base = self._resolve_from(mod, stmt)
+                if base is None:
+                    continue
+                for alias in stmt.names:
+                    local = alias.asname or alias.name
+                    mod.import_map[local] = ("symbol", base, alias.name)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qname = f"{rel}::{stmt.name}"
+                info = FunctionInfo(qname, stmt.name, rel, None, stmt, mod)
+                mod.functions[stmt.name] = info
+                self.functions[qname] = info
+            elif isinstance(stmt, ast.ClassDef):
+                qname = f"{rel}::{stmt.name}"
+                cinfo = ClassInfo(qname, stmt.name, rel, stmt.bases, mod)
+                mod.classes[stmt.name] = cinfo
+                self.classes[qname] = cinfo
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fq = f"{rel}::{stmt.name}.{sub.name}"
+                        finfo = FunctionInfo(
+                            fq, sub.name, rel, cinfo, sub, mod
+                        )
+                        cinfo.methods[sub.name] = finfo
+                        self.functions[fq] = finfo
+            elif isinstance(stmt, ast.Assign):
+                self._maybe_lock_site(
+                    mod, stmt.targets, stmt.value, owner=rel, selfish=False
+                )
+
+    @staticmethod
+    def _resolve_from(mod: ModuleInfo, stmt: ast.ImportFrom) -> Optional[str]:
+        if stmt.level == 0:
+            return stmt.module or ""
+        parts = mod.dotted.split(".")
+        # level=1 from a plain module: strip the module's own name;
+        # from a package __init__: the package itself is the base
+        is_pkg = Path(mod.rel).name == "__init__.py"
+        drop = stmt.level - 1 if is_pkg else stmt.level
+        if drop >= len(parts):
+            return stmt.module
+        base = parts[: len(parts) - drop] if drop else parts
+        if stmt.module:
+            return ".".join(base + [stmt.module])
+        return ".".join(base)
+
+    def _maybe_lock_site(self, mod, targets, value, owner, selfish) -> None:
+        """Record ``<target> = threading.Lock()`` creation sites."""
+        if not isinstance(value, ast.Call):
+            return
+        fn = value.func
+        is_factory = (
+            isinstance(fn, ast.Attribute) and fn.attr in _LOCK_FACTORIES
+            and isinstance(fn.value, ast.Name) and fn.value.id == "threading"
+        ) or (isinstance(fn, ast.Name) and fn.id in _LOCK_FACTORIES)
+        if not is_factory:
+            return
+        site = f"{mod.rel}:{value.lineno}"
+        for target in targets:
+            if selfish:
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    self.lock_sites[(owner, target.attr)] = site
+            elif isinstance(target, ast.Name):
+                self.lock_sites[(owner, target.id)] = site
+
+    def _infer_attr_types(self, mod: ModuleInfo) -> None:
+        """``self.attr = SomeClass(...)`` in any method body → the attr
+        is SomeClass for dispatch purposes; also record lock creation
+        sites on self attributes."""
+        for cinfo in mod.classes.values():
+            for meth in cinfo.methods.values():
+                for node in ast.walk(meth.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    self._maybe_lock_site(
+                        mod, node.targets, node.value,
+                        owner=cinfo.qname, selfish=True,
+                    )
+                    if not isinstance(node.value, ast.Call):
+                        continue
+                    target_cls = self.resolve_class(node.value.func, mod)
+                    if target_cls is None:
+                        continue
+                    for target in node.targets:
+                        if isinstance(target, ast.Attribute) and \
+                                isinstance(target.value, ast.Name) and \
+                                target.value.id == "self":
+                            cinfo.attr_types[target.attr] = target_cls.qname
+
+    # -- resolution -----------------------------------------------------------
+    def resolve_module(self, mod: ModuleInfo, local: str) -> Optional[ModuleInfo]:
+        entry = mod.import_map.get(local)
+        if entry and entry[0] == "module":
+            return self.by_dotted.get(entry[1])
+        if entry and entry[0] == "symbol":
+            # "from pkg import submodule" style
+            return self.by_dotted.get(f"{entry[1]}.{entry[2]}")
+        return None
+
+    def resolve_class(self, func_expr: ast.AST,
+                      mod: ModuleInfo) -> Optional[ClassInfo]:
+        """The ClassInfo a constructor expression refers to, if any."""
+        if isinstance(func_expr, ast.Name):
+            if func_expr.id in mod.classes:
+                return mod.classes[func_expr.id]
+            entry = mod.import_map.get(func_expr.id)
+            if entry and entry[0] == "symbol":
+                target = self.by_dotted.get(entry[1])
+                if target:
+                    return target.classes.get(entry[2])
+        elif isinstance(func_expr, ast.Attribute) and \
+                isinstance(func_expr.value, ast.Name):
+            target = self.resolve_module(mod, func_expr.value.id)
+            if target:
+                return target.classes.get(func_expr.attr)
+        return None
+
+    def method_lookup(self, cinfo: ClassInfo, name: str,
+                      _depth: int = 0) -> Optional[FunctionInfo]:
+        if name in cinfo.methods:
+            return cinfo.methods[name]
+        if _depth >= 4:
+            return None
+        for base_expr in cinfo.bases:
+            base = self.resolve_class(base_expr, cinfo.module)
+            if base is not None:
+                found = self.method_lookup(base, name, _depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def attr_class(self, caller: FunctionInfo,
+                   attr: str) -> Optional[ClassInfo]:
+        if caller.cls is None:
+            return None
+        qname = caller.cls.attr_types.get(attr)
+        return self.classes.get(qname) if qname else None
+
+    def resolve_call(self, call: ast.Call,
+                     caller: FunctionInfo) -> list[FunctionInfo]:
+        """Callees a call site may reach (possibly empty — unresolved)."""
+        fn = call.func
+        mod = caller.module
+        out: list[FunctionInfo] = []
+        if isinstance(fn, ast.Name):
+            if fn.id in mod.functions:
+                out.append(mod.functions[fn.id])
+            else:
+                cls = self.resolve_class(fn, mod)
+                if cls is not None:
+                    init = self.method_lookup(cls, "__init__")
+                    if init is not None:
+                        out.append(init)
+                else:
+                    entry = mod.import_map.get(fn.id)
+                    if entry and entry[0] == "symbol":
+                        target = self.by_dotted.get(entry[1])
+                        if target and entry[2] in target.functions:
+                            out.append(target.functions[entry[2]])
+        elif isinstance(fn, ast.Attribute):
+            value = fn.value
+            if isinstance(value, ast.Name) and value.id == "self" and \
+                    caller.cls is not None:
+                found = self.method_lookup(caller.cls, fn.attr)
+                if found is not None:
+                    out.append(found)
+            elif isinstance(value, ast.Name):
+                target = self.resolve_module(mod, value.id)
+                if target and fn.attr in target.functions:
+                    out.append(target.functions[fn.attr])
+            elif isinstance(value, ast.Attribute) and \
+                    isinstance(value.value, ast.Name) and \
+                    value.value.id == "self":
+                # self.attr.method(): class-typed attribute dispatch
+                cls = self.attr_class(caller, value.attr)
+                if cls is not None:
+                    found = self.method_lookup(cls, fn.attr)
+                    if found is not None:
+                        out.append(found)
+        return out
+
+    # -- lock identity --------------------------------------------------------
+    def lock_id(self, expr: ast.AST, caller: FunctionInfo) -> str:
+        """A stable id for the lock object an acquisition expression
+        names: the ``relpath:lineno`` creation site when resolvable
+        (mergeable with the runtime sanitizer's labels), else a
+        synthetic ``relpath::qualifier`` id."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and caller.cls is not None:
+                # walk the class and its bases for the creation site
+                cinfo: Optional[ClassInfo] = caller.cls
+                depth = 0
+                while cinfo is not None and depth < 5:
+                    site = self.lock_sites.get((cinfo.qname, expr.attr))
+                    if site:
+                        return site
+                    nxt = None
+                    for base_expr in cinfo.bases:
+                        nxt = self.resolve_class(base_expr, cinfo.module)
+                        if nxt is not None:
+                            break
+                    cinfo, depth = nxt, depth + 1
+                return f"{caller.rel}::{caller.cls.name}.{expr.attr}"
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Attribute) and \
+                isinstance(expr.value.value, ast.Name) and \
+                expr.value.value.id == "self":
+            # self.attr._lock — a lock owned by a class-typed attribute
+            cls = self.attr_class(caller, expr.value.attr)
+            if cls is not None:
+                site = self.lock_sites.get((cls.qname, expr.attr))
+                if site:
+                    return site
+                return f"{cls.rel}::{cls.name}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            site = self.lock_sites.get((caller.rel, expr.id))
+            if site:
+                return site
+            return f"{caller.rel}::{expr.id}"
+        try:
+            text = ast.unparse(expr)
+        except Exception:
+            text = "<lock>"
+        return f"{caller.rel}::{text}"
+
+    def lock_owned_by_class(self, lock_id: str, cinfo: ClassInfo) -> bool:
+        """True when ``lock_id`` names a lock this class created on
+        ``self`` (creation site recorded in one of its methods) or a
+        synthetic id minted for one of its own attributes."""
+        if lock_id.startswith(f"{cinfo.rel}::{cinfo.name}."):
+            return True
+        return any(
+            owner == cinfo.qname and site == lock_id
+            for (owner, _attr), site in self.lock_sites.items()
+        )
